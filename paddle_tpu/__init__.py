@@ -1,0 +1,55 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+Re-designed from scratch for TPU (not a port): eager tensors are PJRT buffers,
+ops are XLA lowerings cached per shape, autograd is a define-by-run tape over
+``jax.vjp`` closures, parallelism is one ``jax.sharding.Mesh`` with named
+dp/pp/mp/sep/sharding axes, and whole-graph compilation is jit capture of the
+same eager code path.
+
+Public API surface mirrors `python/paddle/__init__.py` of the reference.
+"""
+
+__version__ = "0.1.0"
+
+from . import flags as _flags_mod  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+from .core import dtypes as _dtypes  # noqa: F401
+from .core.dtypes import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, iinfo, int8, int16, int32, int64, finfo,
+    set_default_dtype, uint8,
+)
+from .core.device import (  # noqa: F401
+    CPUPlace, CustomPlace, Place, TPUPlace, device_count, get_device,
+    is_compiled_with_tpu, set_device,
+)
+
+from .framework import (  # noqa: F401
+    Parameter, Tensor, enable_grad, get_rng_state, is_grad_enabled, is_tensor,
+    no_grad, seed, set_grad_enabled, set_rng_state, to_tensor,
+)
+
+# ops namespace — paddle.* free functions
+from .ops import *  # noqa: F401,F403
+from .ops import registry as _op_registry  # noqa: F401
+from .ops import linalg  # noqa: F401  (paddle.linalg.* namespace)
+
+
+def disable_static(*a, **k):
+    """Eager is the default and only pre-capture mode; kept for API parity."""
+
+
+def enable_static(*a, **k):
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static mode; use paddle_tpu.jit.to_static "
+        "for whole-graph capture.")
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+# Subsystem imports appended as they are built (nn, optimizer, amp, io, jit,
+# distributed, vision, hapi, ...) — see the bottom of this file.

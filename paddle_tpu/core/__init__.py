@@ -1,0 +1,3 @@
+from . import device, dtypes  # noqa: F401
+from .device import (CPUPlace, CustomPlace, Place, TPUPlace, device_count,  # noqa: F401
+                     get_device, is_compiled_with_tpu, set_device)

@@ -1,0 +1,126 @@
+"""Device management.
+
+Analogue of the reference's DeviceManager/place system
+(`paddle/phi/backends/device_manager.h:134`, `phi/common/place.h`): enumerate
+devices, select a current device, and expose Place-like handles.  On TPU the
+"device" is a PJRT device obtained from JAX; multi-chip topology is expressed
+through `jax.sharding.Mesh` (see paddle_tpu.distributed), not through per-place
+streams.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CustomPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_tpu", "current_jax_device",
+]
+
+
+class Place:
+    """A device handle, equivalent to phi::Place."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = [d for d in jax.devices() if _kind(d) == self.device_type]
+        if not devs:
+            # Fall back to any-platform lookup (e.g. "cpu" when only cpu exists).
+            devs = jax.devices(self.device_type) if self.device_type in (
+                "cpu", "tpu", "gpu") else jax.devices()
+        return devs[self.device_id]
+
+
+def CPUPlace(device_id: int = 0) -> Place:
+    return Place("cpu", device_id)
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def CustomPlace(device_type: str, device_id: int = 0) -> Place:
+    return Place(device_type, device_id)
+
+
+def _kind(d: jax.Device) -> str:
+    plat = d.platform
+    # Some PJRT plugins (e.g. the axon tunnel) report their own platform name;
+    # normalize anything TPU-like to "tpu".
+    if "tpu" in plat or "axon" in plat:
+        return "tpu"
+    return plat
+
+
+_lock = threading.RLock()
+_current: Optional[Place] = None
+
+
+def get_all_devices() -> List[str]:
+    return [f"{_kind(d)}:{d.id}" for d in jax.devices()]
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return len(jax.devices())
+    return len([d for d in jax.devices() if _kind(d) == device_type])
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return any(_kind(d) == "tpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def set_device(device: str | Place) -> Place:
+    """Select the current device, e.g. ``set_device("tpu:0")``."""
+    global _current
+    if isinstance(device, str):
+        if ":" in device:
+            kind, idx = device.split(":", 1)
+            place = Place(kind, int(idx))
+        else:
+            place = Place(device, 0)
+    else:
+        place = device
+    with _lock:
+        _current = place
+    return place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place() -> Place:
+    global _current
+    with _lock:
+        if _current is None:
+            d = jax.devices()[0]
+            _current = Place(_kind(d), 0)
+        return _current
+
+
+def current_jax_device() -> jax.Device:
+    return current_place().jax_device

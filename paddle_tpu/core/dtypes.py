@@ -1,0 +1,131 @@
+"""Dtype system.
+
+Analogue of the reference's ``phi::DataType`` (`paddle/phi/common/data_type.h`)
+exposed in Python as ``paddle.float32`` etc.  We alias JAX/NumPy dtypes so that
+tensors interoperate with jax.numpy directly, and keep paddle's names and
+default-dtype machinery (`python/paddle/framework/framework.py` set_default_dtype).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "bfloat16", "float16", "float32", "float64",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128",
+    "set_default_dtype", "get_default_dtype", "convert_dtype",
+    "is_floating_point_dtype", "is_integer_dtype", "promote_types",
+    "finfo", "iinfo",
+]
+
+bfloat16 = jnp.bfloat16
+float16 = jnp.float16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64, "int": int32,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+_state = threading.local()
+
+
+_X64_DOWNMAP = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (str, np/jnp dtype, paddle name) to np.dtype.
+
+    TPU-native policy: with JAX in default x32 mode, 64-bit integer requests
+    canonicalize to 32-bit (the reference defaults indices to int64 because
+    CUDA handles it; on TPU int32 is the native lane width).
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _ALIASES:
+            raise TypeError(f"Unknown dtype {dtype!r}")
+        d = np.dtype(_ALIASES[dtype])
+    else:
+        d = np.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64 and d in _X64_DOWNMAP:
+        return _X64_DOWNMAP[d]
+    return d
+
+
+def set_default_dtype(d) -> None:
+    d = convert_dtype(d)
+    if d not in (np.dtype(float16), np.dtype(bfloat16), np.dtype(float32),
+                 np.dtype(float64)):
+        raise TypeError(f"Default dtype must be a float type, got {d}")
+    _state.default_dtype = d
+
+
+def get_default_dtype() -> np.dtype:
+    return getattr(_state, "default_dtype", np.dtype(np.float32))
+
+
+@contextlib.contextmanager
+def default_dtype_guard(d):
+    old = get_default_dtype()
+    set_default_dtype(d)
+    try:
+        yield
+    finally:
+        _state.default_dtype = old
+
+
+def canonical_index_dtype() -> np.dtype:
+    """Native index dtype: int32 in x32 mode (TPU lane width), else int64."""
+    import jax
+    return np.dtype(np.int64) if jax.config.jax_enable_x64 else np.dtype(np.int32)
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.floating) or \
+        convert_dtype(dtype) == np.dtype(bfloat16)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return np.issubdtype(convert_dtype(dtype), np.integer)
+
+
+def promote_types(a, b) -> np.dtype:
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

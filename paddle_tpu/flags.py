@@ -1,0 +1,114 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's gflags clone
+(`paddle/common/flags_native.cc:299` RegisterFlag / `:377` SetFlagsFromEnv /
+`:400` ParseCommandLineFlags and the `paddle.set_flags/get_flags` Python API at
+`python/paddle/base/framework.py:76,:101`).  One process-global registry; every
+flag can be seeded from the environment (``FLAGS_xxx``) at import time and
+changed at runtime via :func:`set_flags`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional
+
+__all__ = [
+    "define_flag",
+    "get_flags",
+    "set_flags",
+    "flag_guard",
+]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_registry: Dict[str, _Flag] = {}
+_lock = threading.RLock()
+
+
+def _coerce(ftype: type, raw: Any) -> Any:
+    if isinstance(raw, str) and ftype is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return ftype(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides the default."""
+    with _lock:
+        if name in _registry:
+            return
+        ftype = type(default)
+        value = default
+        env = os.environ.get("FLAGS_" + name)
+        if env is not None:
+            value = _coerce(ftype, env)
+        _registry[name] = _Flag(name, default, value, ftype, help, on_change)
+
+
+def get_flags(names: Iterable[str] | str) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    with _lock:
+        out = {}
+        for n in names:
+            if n not in _registry:
+                raise ValueError(f"Unknown flag: {n!r}")
+            out[n] = _registry[n].value
+        return out
+
+
+def get_flag(name: str) -> Any:
+    return get_flags([name])[name]
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    with _lock:
+        for name, v in flags.items():
+            if name not in _registry:
+                raise ValueError(f"Unknown flag: {name!r}")
+        for name, v in flags.items():
+            f = _registry[name]
+            f.value = _coerce(f.type, v)
+            if f.on_change is not None:
+                f.on_change(f.value)
+
+
+class flag_guard:
+    """Context manager that temporarily overrides flags."""
+
+    def __init__(self, **flags: Any):
+        self._flags = flags
+        self._saved: Dict[str, Any] = {}
+
+    def __enter__(self):
+        self._saved = get_flags(list(self._flags))
+        set_flags(self._flags)
+        return self
+
+    def __exit__(self, *exc):
+        set_flags(self._saved)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Core flags (mirroring the commonly used subset of paddle/common/flags.cc)
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan every op output for NaN/Inf (debugging).")
+define_flag("check_nan_inf_level", 0, "0: fail on nan/inf; >0: warn only.")
+define_flag("use_stride_kernel", False, "Unused on TPU; kept for API parity.")
+define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA owns memory.")
+define_flag("benchmark", False, "Block on every op for accurate per-op timing.")
+define_flag("tpu_deterministic", False, "Force deterministic XLA reductions.")
+define_flag("log_level", 0, "VLOG-style verbosity for paddle_tpu internals.")

@@ -1,0 +1,4 @@
+from . import autograd_engine, dygraph, random  # noqa: F401
+from .dygraph import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .random import get_rng_state, seed, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, is_tensor, to_tensor  # noqa: F401

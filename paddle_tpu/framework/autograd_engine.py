@@ -1,0 +1,225 @@
+"""Tape-free define-by-run autograd engine.
+
+Same design as the reference's eager engine (`fluid/eager/backward.cc:105`
+RunBackward, in-degree map at `backward.cc:23`, `fluid/eager/grad_node_info.h:197`
+GradNodeBase / `:53` Edge, grad accumulation `fluid/eager/accumulation/`):
+
+* every differentiable op creates one :class:`OpGradNode` holding a VJP
+  closure (by default the one returned by ``jax.vjp`` over the op's forward
+  function — XLA residuals instead of Paddle's TensorWrapper saves);
+* nodes are linked by :class:`Edge` (producer node, output slot);
+* leaves get a :class:`GradAccumulationNode` that writes ``tensor.grad``;
+* ``backward()`` seeds output grads, BFS-counts in-degrees over the edge
+  graph, then walks a ready queue accumulating per-(node, slot) grads.
+
+Grads flow as raw jax Arrays inside the engine; they are wrapped into Tensors
+only when stored on leaves or handed to user hooks.
+"""
+
+from __future__ import annotations
+
+import weakref
+from collections import defaultdict, deque
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Edge", "GradNode", "OpGradNode", "GradAccumulationNode", "run_backward"]
+
+
+class Edge:
+    """Connects one input slot of a consumer node to (producer node, out slot)."""
+
+    __slots__ = ("node", "slot")
+
+    def __init__(self, node: "GradNode", slot: int):
+        self.node = node
+        self.slot = slot
+
+
+class GradNode:
+    """Base grad node: maps output-cotangents -> input-cotangents."""
+
+    op_name: str = "unknown"
+
+    def __init__(self, num_outputs: int):
+        self.num_outputs = num_outputs
+        # out_meta[i] = (shape, dtype) for constructing zero cotangents of
+        # outputs that received no gradient (multi-output ops).
+        self.out_meta: List[Optional[Tuple[Tuple[int, ...], Any]]] = [None] * num_outputs
+        self.next_edges: List[Optional[Edge]] = []
+        # user hooks on this node's *outputs'* grads (tensor.register_hook).
+        self.grad_hooks: List[List[Callable]] = [[] for _ in range(num_outputs)]
+
+    def apply(self, out_grads: List[Any]) -> List[Optional[Any]]:
+        raise NotImplementedError
+
+    def release(self):
+        """Drop saved residuals (retain_graph=False path)."""
+
+
+class OpGradNode(GradNode):
+    """Grad node for a registered op; holds the vjp closure + static attrs."""
+
+    __slots__ = ("vjp_fn", "input_treedef", "op_name")
+
+    def __init__(self, op_name: str, num_outputs: int, vjp_fn: Callable):
+        super().__init__(num_outputs)
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+
+    def apply(self, out_grads: List[Any]) -> List[Optional[Any]]:
+        if self.vjp_fn is None:
+            raise RuntimeError(
+                f"Grad node for op '{self.op_name}' was already released. "
+                "Call backward(retain_graph=True) to backprop twice.")
+        cot = out_grads[0] if self.num_outputs == 1 else tuple(out_grads)
+        in_grads = self.vjp_fn(cot)
+        out: List[Optional[Any]] = []
+        for g in in_grads:
+            out.append(_drop_float0(g))
+        return out
+
+    def release(self):
+        self.vjp_fn = None
+
+
+def _drop_float0(g):
+    """jax returns float0 cotangents for integer/bool inputs — treat as None."""
+    if g is None:
+        return None
+    if isinstance(g, (list, tuple)):
+        return type(g)(_drop_float0(x) for x in g)
+    dt = getattr(g, "dtype", None)
+    if dt is not None and dt == jax.dtypes.float0:
+        return None
+    return g
+
+
+class GradAccumulationNode(GradNode):
+    """Leaf sink: accumulates the cotangent into ``tensor.grad``.
+
+    Mirrors `fluid/eager/accumulation/accumulation_node.h`.  Holds a weakref so
+    dead leaves don't keep memory alive; also carries reducer hooks used by
+    DataParallel (`fluid/distributed/collective/reducer.h:88`).
+    """
+
+    op_name = "grad_accumulation"
+
+    def __init__(self, tensor):
+        super().__init__(1)
+        self._ref = weakref.ref(tensor)
+        self.reducer_hooks: List[Callable] = []
+
+    def apply(self, out_grads: List[Any]) -> List[Optional[Any]]:
+        t = self._ref()
+        g = out_grads[0]
+        if t is not None and g is not None:
+            t._accumulate_grad(g)
+            for hook in self.reducer_hooks:
+                hook(t)
+        return []
+
+
+def _zeros_cotangent(meta):
+    """Zero cotangent for an output that received no gradient.
+
+    Integer/bool outputs take float0 cotangents (jax.vjp's convention for
+    non-differentiable values)."""
+    shape, dtype = meta
+    if jnp.issubdtype(dtype, jnp.integer) or dtype == jnp.bool_:
+        import numpy as _np
+        return _np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)
+
+
+def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
+                 retain_graph: bool = False) -> None:
+    """The engine loop — reference: egr::RunBackward (`fluid/eager/backward.cc:105`)."""
+    # 1. Seed output grads per (node, slot).
+    pending: dict = defaultdict(dict)  # node -> {slot: grad}
+    roots: List[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        node, slot = t._grad_node, t._output_slot
+        if node is None:
+            if not t.stop_gradient:
+                t._accumulate_grad(g)
+            continue
+        slots = pending[node]
+        slots[slot] = g if slot not in slots else slots[slot] + g
+        if node not in roots:
+            roots.append(node)
+
+    if not roots:
+        return
+
+    # 2. In-degree map via BFS over edges (`backward.cc:23` getInDegreeMap).
+    indeg: dict = defaultdict(int)
+    visited = set()
+    queue = deque(roots)
+    visited.update(id(n) for n in roots)
+    nodes_by_id = {id(n): n for n in roots}
+    while queue:
+        node = queue.popleft()
+        for edge in node.next_edges:
+            if edge is None:
+                continue
+            indeg[id(edge.node)] += 1
+            if id(edge.node) not in visited:
+                visited.add(id(edge.node))
+                nodes_by_id[id(edge.node)] = edge.node
+                queue.append(edge.node)
+
+    # 3. Ready-queue walk.
+    ready = deque(n for n in roots if indeg[id(n)] == 0)
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        slot_grads = pending.pop(node, {})
+        if not slot_grads and not isinstance(node, GradAccumulationNode):
+            # No real gradient reached this node (e.g. only float0 paths):
+            # propagate None but still unblock downstream nodes.
+            in_grads = [None] * len(node.next_edges)
+        else:
+            out_grads: List[Any] = []
+            for i in range(node.num_outputs):
+                g = slot_grads.get(i)
+                if g is None and node.out_meta[i] is not None and not isinstance(
+                        node, GradAccumulationNode):
+                    g = _zeros_cotangent(node.out_meta[i])
+                for hook in node.grad_hooks[i]:
+                    res = hook(g)
+                    if res is not None:
+                        g = res
+                out_grads.append(g)
+
+            in_grads = node.apply(out_grads)
+            if not retain_graph:
+                node.release()
+
+        for g, edge in zip(in_grads, node.next_edges):
+            if edge is None:
+                continue
+            tgt = edge.node
+            if g is not None:
+                slots = pending[tgt]
+                slots[edge.slot] = g if edge.slot not in slots \
+                    else slots[edge.slot] + g
+            # Always decrement: a None gradient still resolves the dependency,
+            # otherwise nodes reachable only via non-differentiable paths
+            # would stall and leaf grads on other paths would be lost.
+            indeg[id(tgt)] -= 1
+            if indeg[id(tgt)] == 0:
+                ready.append(tgt)
+
+    # Flush any leaf accumulation nodes that became ready only via pending
+    # (degenerate graphs where an accumulation node still has in-degree > 0
+    # because some producer was unreachable — shouldn't happen, but be safe).
+    for node, slots in list(pending.items()):
+        if isinstance(node, GradAccumulationNode) and indeg[id(node)] <= 0:
+            node.apply([slots.get(0)])

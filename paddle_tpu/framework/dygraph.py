@@ -1,0 +1,79 @@
+"""Dygraph (eager) mode state: gradient recording on/off.
+
+Analogue of the reference's tracer switch + ``paddle.no_grad``
+(`python/paddle/base/dygraph/base.py:595`, `fluid/eager/api/utils/global_utils.h:46`
+Controller::HasGrad).  paddle_tpu is always eager-first; "static mode" is
+entered only through jit capture which traces this same eager path.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+
+__all__ = ["no_grad", "enable_grad", "set_grad_enabled", "is_grad_enabled"]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set(enabled: bool) -> bool:
+    old = is_grad_enabled()
+    _state.grad_enabled = enabled
+    return old
+
+
+class _GradModeCtx:
+    """Usable as context manager AND decorator, like paddle.no_grad."""
+
+    def __init__(self, enabled: bool):
+        self._enabled = enabled
+        self._old = None
+
+    def __enter__(self):
+        self._old = _set(self._enabled)
+        return self
+
+    def __exit__(self, *exc):
+        _set(self._old)
+        return False
+
+    def __call__(self, func):
+        if func is None:
+            return self
+        enabled = self._enabled
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            old = _set(enabled)
+            try:
+                return func(*args, **kwargs)
+            finally:
+                _set(old)
+
+        return wrapper
+
+
+def no_grad(func=None):
+    ctx = _GradModeCtx(False)
+    return ctx(func) if func is not None else ctx
+
+
+def enable_grad(func=None):
+    ctx = _GradModeCtx(True)
+    return ctx(func) if func is not None else ctx
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._old = _set(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _set(self._old)
+        return False

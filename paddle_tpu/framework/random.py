@@ -1,0 +1,97 @@
+"""Random state management.
+
+The reference keeps per-device stateful generators (`paddle.seed`,
+`phi/core/generator.h`).  On TPU/XLA randomness must be functional, so the
+global "generator" is a JAX PRNG key that is split on every draw.  Under jit
+capture (paddle_tpu.jit) a *traced* key source is installed so random ops
+(dropout, rand) become pure functions of a key argument threaded by the
+captured program — the TPU-native equivalent of Paddle's RNG state tracker
+(`fleet/layers/mpu/random.py` uses the same fold-in idea for TP determinism).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "key_source_guard"]
+
+
+class StatefulKeySource:
+    """Host-side stateful source: splits a stored key each draw."""
+
+    def __init__(self, seed_val: int = 0):
+        self._key = jax.random.key(seed_val)
+        self._lock = threading.Lock()
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+class TracedKeySource:
+    """Pure source used during jit capture: splits a traced key.
+
+    The split counter is Python-side, so a fixed trace draws a deterministic
+    *sequence* of subkeys from the per-call key argument — each call of the
+    compiled function passes a fresh key, so randomness varies across steps.
+    """
+
+    def __init__(self, key):
+        self._key = key
+
+    def next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_state = threading.local()
+_global_source = StatefulKeySource(0)
+
+
+def _current_source():
+    stack = getattr(_state, "stack", None)
+    if stack:
+        return stack[-1]
+    return _global_source
+
+
+def next_key():
+    """Draw a fresh PRNG key from the active source (global or traced)."""
+    return _current_source().next_key()
+
+
+def seed(value: int):
+    """Reset the global generator, like paddle.seed."""
+    global _global_source
+    _global_source = StatefulKeySource(int(value))
+    return _global_source
+
+
+def get_rng_state():
+    return _global_source.get_state()
+
+
+def set_rng_state(key):
+    _global_source.set_state(key)
+
+
+@contextlib.contextmanager
+def key_source_guard(source):
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+    stack.append(source)
+    try:
+        yield source
+    finally:
+        stack.pop()

@@ -1,0 +1,281 @@
+"""Op corpus + Tensor method patching.
+
+Mirrors `python/paddle/tensor/__init__.py` + the monkey-patch pass in
+`python/paddle/base/dygraph/tensor_patch_methods.py` (operator dunders and
+methods attached to the eager Tensor type at import).
+"""
+
+from __future__ import annotations
+
+import builtins as _builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .registry import dispatch as _d, register_op, list_ops  # noqa: F401
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+
+from . import math as _math
+from . import creation as _creation
+from . import manipulation as _manip
+from . import logic as _logic
+from . import linalg as _linalg
+from . import search as _search
+from . import random_ops as _random_ops
+
+
+# ---------------------------------------------------------------- indexing
+def _split_index(index):
+    """Split an index spec into a static template + traced array parts."""
+    if not isinstance(index, tuple):
+        index = (index,)
+    template = []
+    arrays = []
+    for it in index:
+        if isinstance(it, Tensor):
+            template.append(("arr", len(arrays)))
+            arrays.append(it)
+        elif isinstance(it, (np.ndarray, list)) and not _is_static_list(it):
+            template.append(("arr", len(arrays)))
+            arrays.append(Tensor(np.asarray(it)))
+        else:
+            if isinstance(it, _builtins.slice):
+                template.append(("slice", (_si(it.start), _si(it.stop), _si(it.step))))
+            else:
+                template.append(("static", it))
+    return tuple(template), arrays
+
+
+def _si(v):
+    if isinstance(v, Tensor):
+        return int(v.item())
+    return v
+
+
+def _is_static_list(it):
+    # list of ints used as fancy index -> treat as array; keep python ints static
+    return False
+
+
+def _rebuild_index(template, arr_vals):
+    out = []
+    for kind, payload in template:
+        if kind == "arr":
+            out.append(arr_vals[payload])
+        elif kind == "slice":
+            out.append(builtins_slice(*payload))
+        else:
+            out.append(payload)
+    return tuple(out)
+
+
+# `slice` is shadowed by the paddle-API slice() from manipulation.py.
+builtins_slice = _builtins.slice
+
+
+def _getitem_fwd(x, arrs, *, template):
+    idx = _rebuild_index(template, arrs)
+    return x[idx]
+
+
+register_op("getitem", _getitem_fwd)
+
+
+def _setitem_fwd(x, arrs, v, *, template):
+    idx = _rebuild_index(template, arrs)
+    return x.at[idx].set(jnp.asarray(v).astype(x.dtype))
+
+
+register_op("setitem", _setitem_fwd)
+
+
+def _tensor_getitem(self, index):
+    # bool-mask fancy indexing has dynamic shape: eager numpy path
+    if isinstance(index, Tensor) and index.dtype == jnp.bool_:
+        return _search.masked_select(self, index) if index.ndim == self.ndim \
+            else Tensor._wrap(self._value[index._value])
+    template, arrays = _split_index(index)
+    return _d("getitem", (self, [a for a in arrays]), {"template": template})
+
+
+def _tensor_setitem(self, index, value):
+    template, arrays = _split_index(index)
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value))
+    out = _d("setitem", (self, [a for a in arrays], value),
+             {"template": template})
+    # in-place semantics: this tensor becomes the op output
+    self._value = out._value
+    self._grad_node = out._grad_node
+    self._output_slot = out._output_slot
+    self.stop_gradient = out.stop_gradient
+
+
+# ---------------------------------------------------------------- operators
+def _binary_op(fn, reverse=False):
+    def op(self, other):
+        if reverse:
+            if not isinstance(other, Tensor):
+                other = Tensor(jnp.asarray(other))
+            return fn(other, self)
+        return fn(self, other)
+    return op
+
+
+def _patch():
+    T = Tensor
+    T.__getitem__ = _tensor_getitem
+    T.__setitem__ = _tensor_setitem
+
+    T.__add__ = _binary_op(_math.add)
+    T.__radd__ = _binary_op(_math.add, True)
+    T.__sub__ = _binary_op(_math.subtract)
+    T.__rsub__ = _binary_op(_math.subtract, True)
+    T.__mul__ = _binary_op(_math.multiply)
+    T.__rmul__ = _binary_op(_math.multiply, True)
+    T.__truediv__ = _binary_op(_math.divide)
+    T.__rtruediv__ = _binary_op(_math.divide, True)
+    T.__floordiv__ = _binary_op(_math.floor_divide)
+    T.__rfloordiv__ = _binary_op(_math.floor_divide, True)
+    T.__mod__ = _binary_op(_math.mod)
+    T.__rmod__ = _binary_op(_math.mod, True)
+    T.__pow__ = _binary_op(_math.pow)
+    T.__rpow__ = _binary_op(_math.pow, True)
+    T.__matmul__ = _binary_op(_linalg.matmul)
+    T.__rmatmul__ = _binary_op(_linalg.matmul, True)
+    T.__neg__ = lambda self: _math.neg(self)
+    T.__abs__ = lambda self: _math.abs(self)
+    T.__invert__ = lambda self: _logic.logical_not(self) \
+        if self.dtype == jnp.bool_ else _logic.bitwise_not(self)
+
+    T.__eq__ = _binary_op(_logic.equal)
+    T.__ne__ = _binary_op(_logic.not_equal)
+    T.__lt__ = _binary_op(_logic.less_than)
+    T.__le__ = _binary_op(_logic.less_equal)
+    T.__gt__ = _binary_op(_logic.greater_than)
+    T.__ge__ = _binary_op(_logic.greater_equal)
+    # paddle maps &,|,^ to bitwise ops (== logical for bool operands)
+    T.__and__ = _binary_op(_logic.bitwise_and)
+    T.__or__ = _binary_op(_logic.bitwise_or)
+    T.__xor__ = _binary_op(_logic.bitwise_xor)
+
+    # methods (subset of eager_method.cc surface; widened continuously)
+    method_map = {
+        # math
+        "add": _math.add, "subtract": _math.subtract, "multiply": _math.multiply,
+        "divide": _math.divide, "floor_divide": _math.floor_divide,
+        "mod": _math.mod, "remainder": _math.mod, "pow": _math.pow,
+        "scale": _math.scale, "neg": _math.neg, "abs": _math.abs,
+        "sign": _math.sign, "sqrt": _math.sqrt, "rsqrt": _math.rsqrt,
+        "square": _math.square, "reciprocal": _math.reciprocal,
+        "exp": _math.exp, "log": _math.log, "log2": _math.log2,
+        "log10": _math.log10, "log1p": _math.log1p, "expm1": _math.expm1,
+        "sin": _math.sin, "cos": _math.cos, "tan": _math.tan,
+        "asin": _math.asin, "acos": _math.acos, "atan": _math.atan,
+        "sinh": _math.sinh, "cosh": _math.cosh, "tanh": _math.tanh,
+        "floor": _math.floor, "ceil": _math.ceil, "round": _math.round,
+        "trunc": _math.trunc, "erf": _math.erf, "lgamma": _math.lgamma,
+        "clip": _math.clip, "maximum": _math.maximum, "minimum": _math.minimum,
+        "isnan": _math.isnan, "isinf": _math.isinf, "isfinite": _math.isfinite,
+        "sum": _math.sum, "mean": _math.mean, "max": _math.max, "min": _math.min,
+        "prod": _math.prod, "logsumexp": _math.logsumexp, "std": _math.std,
+        "var": _math.var, "cumsum": _math.cumsum, "cumprod": _math.cumprod,
+        "trace": _math.trace, "lerp": _math.lerp,
+        # manipulation
+        "cast": _manip.cast, "astype": _manip.cast, "reshape": _manip.reshape,
+        "transpose": _manip.transpose, "squeeze": _manip.squeeze,
+        "unsqueeze": _manip.unsqueeze, "flatten": _manip.flatten,
+        "expand": _manip.expand, "expand_as": _manip.expand_as,
+        "tile": _manip.tile, "broadcast_to": _manip.broadcast_to,
+        "gather": _manip.gather, "gather_nd": _manip.gather_nd,
+        "scatter": _manip.scatter, "index_select": _manip.index_select,
+        "flip": _manip.flip, "roll": _manip.roll, "unbind": _manip.unbind,
+        "split": _manip.split, "chunk": _manip.chunk, "concat": None,
+        "take_along_axis": _manip.take_along_axis,
+        "put_along_axis": _manip.put_along_axis, "pad": _manip.pad,
+        "repeat_interleave": _manip.repeat_interleave, "numel": _manip.numel,
+        "one_hot": _manip.one_hot, "masked_fill": _manip.masked_fill,
+        "diagonal": _manip.diagonal, "where": _manip.where,
+        # logic
+        "equal": _logic.equal, "not_equal": _logic.not_equal,
+        "greater_than": _logic.greater_than, "greater_equal": _logic.greater_equal,
+        "less_than": _logic.less_than, "less_equal": _logic.less_equal,
+        "equal_all": _logic.equal_all, "logical_and": _logic.logical_and,
+        "logical_or": _logic.logical_or, "logical_not": _logic.logical_not,
+        "isclose": _logic.isclose, "allclose": _logic.allclose,
+        "all": _logic.all, "any": _logic.any,
+        # linalg
+        "matmul": _linalg.matmul, "mm": _linalg.mm, "bmm": _linalg.bmm,
+        "dot": _linalg.dot, "norm": _linalg.norm, "t": _manip.t,
+        "inverse": _linalg.inverse, "cholesky": _linalg.cholesky,
+        # search
+        "argmax": _search.argmax, "argmin": _search.argmin,
+        "argsort": _search.argsort, "sort": _search.sort, "topk": _search.topk,
+        "nonzero": _search.nonzero, "masked_select": _search.masked_select,
+        "unique": _search.unique, "bincount": _search.bincount,
+        "median": _search.median,
+    }
+    for name, fn in method_map.items():
+        if fn is not None:
+            setattr(T, name, fn)
+
+    T.__array_priority__ = 100
+
+    @property
+    def T_prop(self):
+        return _manip.transpose(self)
+    Tensor.T = T_prop
+
+    # a few in-place helpers used by optimizers/layers
+    def add_(self, y):
+        yv = y._value if isinstance(y, Tensor) else y
+        self._value = self._value + yv
+        return self
+
+    def subtract_(self, y):
+        yv = y._value if isinstance(y, Tensor) else y
+        self._value = self._value - yv
+        return self
+
+    def multiply_(self, y):
+        yv = y._value if isinstance(y, Tensor) else y
+        self._value = self._value * yv
+        return self
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self._value = self._value * scale + bias
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._value = jnp.clip(self._value, min, max)
+        return self
+
+    T.add_ = add_
+    T.subtract_ = subtract_
+    T.multiply_ = multiply_
+    T.scale_ = scale_
+    T.zero_ = zero_
+    T.fill_ = fill_
+    T.clip_ = clip_
+    T.uniform_ = _random_ops.uniform_
+    T.normal_ = _random_ops.normal_
+    T.exponential_ = _random_ops.exponential_
+
+
+_patch()

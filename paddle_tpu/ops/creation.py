@@ -1,0 +1,188 @@
+"""Tensor creation ops. Parity: `python/paddle/tensor/creation.py`."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..framework.tensor import Tensor, to_tensor
+from .registry import dispatch as _d, register_op
+from ..core.dtypes import canonical_index_dtype as _ityfn
+_ITYPE = _ityfn()
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "create_parameter", "tril_indices", "triu_indices", "complex_",
+]
+
+
+def _dt(dtype):
+    return _dtypes.convert_dtype(dtype) if dtype is not None else \
+        _dtypes.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [shape]
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor._wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor._wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        return Tensor._wrap(jnp.full(_shape(shape), fill_value))
+    return Tensor._wrap(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+register_op("zeros_like", lambda x: jnp.zeros_like(x))
+register_op("ones_like", lambda x: jnp.ones_like(x))
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    out = Tensor._wrap(jnp.zeros_like(x._value if isinstance(x, Tensor) else x))
+    return out.astype(dtype) if dtype is not None else out
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    out = Tensor._wrap(jnp.ones_like(x._value if isinstance(x, Tensor) else x))
+    return out.astype(dtype) if dtype is not None else out
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    d = _dtypes.convert_dtype(dtype) if dtype is not None else v.dtype
+    return Tensor._wrap(jnp.full(v.shape, fill_value, d))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = _ITYPE
+        else:
+            dtype = _dtypes.get_default_dtype()
+    return Tensor._wrap(jnp.arange(start, end, step, _dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor._wrap(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                                     dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor._wrap(jnp.logspace(start, stop, int(num), base=base,
+                                     dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor._wrap(jnp.eye(int(num_rows),
+                                int(num_columns) if num_columns else None,
+                                dtype=_dt(dtype)))
+
+
+register_op("diag", lambda x, *, offset: jnp.diag(x, k=offset))
+register_op("diagflat", lambda x, *, offset: jnp.diagflat(x, k=offset))
+register_op("tril", lambda x, *, diagonal: jnp.tril(x, k=diagonal))
+register_op("triu", lambda x, *, diagonal: jnp.triu(x, k=diagonal))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    out = _d("diag", (x,), {"offset": int(offset)})
+    return out
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return _d("diagflat", (x,), {"offset": int(offset)})
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return _d("tril", (x,), {"diagonal": int(diagonal)})
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return _d("triu", (x,), {"diagonal": int(diagonal)})
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]), _dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor._wrap(jnp.asarray(np.stack([r, c]), _dtypes.convert_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor._wrap(v) for v in jnp.meshgrid(*vals, indexing="ij")]
+
+
+register_op("assign", lambda x: x + 0 if hasattr(x, "dtype") else jnp.asarray(x))
+
+
+def assign(x, output=None, name=None) -> Tensor:
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    out = _d("assign", (x,), {})
+    if output is not None:
+        output.set_value(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return assign(x)
+
+
+def complex_(real, imag, name=None) -> Tensor:
+    return _d("complex", (real, imag), {})
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """paddle.create_parameter equivalent (base/param_attr path)."""
+    from ..framework.tensor import Parameter
+    from ..nn import initializer as I
+    shape = _shape(shape)
+    init = default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(jnp.zeros(shape, _dt(dtype)), name=name)
+    init(p)
+    return p
+
+
+import jax  # noqa: E402  (used by the complex op lowering)
+
+register_op("complex", lambda r, i: jax.lax.complex(r, i))

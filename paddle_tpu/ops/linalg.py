@@ -1,0 +1,311 @@
+"""Linear algebra ops. Parity: `python/paddle/tensor/linalg.py` (matmul at
+`:176`) — all matmuls route to jnp.matmul/einsum so XLA places them on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .registry import dispatch as _d, register_op
+from ..core.dtypes import canonical_index_dtype as _ityfn
+_ITYPE = _ityfn()
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "norm", "dist", "einsum", "cross",
+    "histogram", "cholesky", "qr", "svd", "eig", "eigh", "eigvals", "eigvalsh",
+    "matrix_power", "inverse", "pinv", "solve", "triangular_solve", "lstsq",
+    "det", "slogdet", "matrix_rank", "cond", "lu", "householder_product",
+    "corrcoef", "cov", "multi_dot", "vecdot", "vector_norm", "matrix_norm",
+]
+
+
+def _matmul_fwd(x, y, *, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+register_op("matmul", _matmul_fwd, tags=("mxu",))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _d("matmul", (x, y), {"transpose_x": bool(transpose_x),
+                                 "transpose_y": bool(transpose_y)})
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+register_op("bmm", lambda x, y: jnp.matmul(x, y), tags=("mxu",))
+
+
+def bmm(x, y, name=None):
+    return _d("bmm", (x, y), {})
+
+
+register_op("dot", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def dot(x, y, name=None):
+    return _d("dot", (x, y), {})
+
+
+register_op("mv", lambda x, v: jnp.matmul(x, v), tags=("mxu",))
+
+
+def mv(x, vec, name=None):
+    return _d("mv", (x, vec), {})
+
+
+def _norm_fwd(x, *, p, axis, keepdim):
+    if p == "fro":
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+register_op("p_norm", _norm_fwd)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return _d("p_norm", (x,), {"p": p, "axis": axis, "keepdim": bool(keepdim)})
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+register_op("dist", lambda a, b, *, p: _norm_fwd(a - b, p=p, axis=None,
+                                                 keepdim=False))
+
+
+def dist(x, y, p=2, name=None):
+    return _d("dist", (x, y), {"p": float(p)})
+
+
+register_op("einsum", lambda operands, *, equation: jnp.einsum(equation, *operands),
+            tags=("mxu",))
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return _d("einsum", (list(operands),), {"equation": equation})
+
+
+register_op("cross", lambda x, y, *, axis: jnp.cross(x, y, axis=axis))
+
+
+def cross(x, y, axis=9, name=None):
+    if axis == 9:  # paddle default: first dim of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return _d("cross", (x, y), {"axis": int(axis)})
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    if min == 0 and max == 0:
+        lo, hi = float(v.min()), float(v.max())
+    else:
+        lo, hi = float(min), float(max)
+    hist, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor._wrap(hist.astype(_ITYPE))
+
+
+# ---- decompositions / solvers (CPU-friendly; XLA lowers what it can) -------
+def _simple(op_name, jfn, n_out=1):
+    register_op(op_name, jfn)
+
+    def fn(x, name=None, _op=op_name):
+        return _d(_op, (x,), {})
+    fn.__name__ = op_name
+    return fn
+
+
+cholesky_ = _simple("cholesky", lambda x: jnp.linalg.cholesky(x))
+
+
+def cholesky(x, upper=False, name=None):
+    out = cholesky_(x)
+    if upper:
+        from .manipulation import transpose
+        perm = list(range(out.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        out = transpose(out, perm)
+    return out
+
+
+register_op("qr", lambda x, *, mode: tuple(jnp.linalg.qr(x, mode=mode)))
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return _d("qr", (x,), {"mode": "r"})
+    return _d("qr", (x,), {"mode": mode})
+
+
+register_op("svd", lambda x, *, full_matrices:
+            tuple(jnp.linalg.svd(x, full_matrices=full_matrices)))
+
+
+def svd(x, full_matrices=False, name=None):
+    return _d("svd", (x,), {"full_matrices": bool(full_matrices)})
+
+
+register_op("eigh", lambda x, *, UPLO: tuple(jnp.linalg.eigh(x, UPLO=UPLO)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return _d("eigh", (x,), {"UPLO": UPLO})
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(np_fallback(x))
+    return Tensor._wrap(w), Tensor._wrap(v)
+
+
+def eigvals(x, name=None):
+    return Tensor._wrap(jnp.linalg.eigvals(np_fallback(x)))
+
+
+def np_fallback(x):
+    import numpy as np
+    return jnp.asarray(np.asarray(x._value if isinstance(x, Tensor) else x))
+
+
+register_op("eigvalsh", lambda x, *, UPLO: jnp.linalg.eigvalsh(x, UPLO=UPLO))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _d("eigvalsh", (x,), {"UPLO": UPLO})
+
+
+register_op("matrix_power", lambda x, *, n: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_power(x, n, name=None):
+    return _d("matrix_power", (x,), {"n": int(n)})
+
+
+inverse = _simple("inverse", lambda x: jnp.linalg.inv(x))
+
+
+register_op("pinv", lambda x, *, rcond: jnp.linalg.pinv(x, rtol=rcond))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _d("pinv", (x,), {"rcond": float(rcond)})
+
+
+register_op("solve", lambda a, b: jnp.linalg.solve(a, b))
+
+
+def solve(x, y, name=None):
+    return _d("solve", (x, y), {})
+
+
+register_op("triangular_solve", lambda a, b, *, upper, transpose, unitriangular:
+            jax.scipy.linalg.solve_triangular(a, b, lower=not upper,
+                                              trans=1 if transpose else 0,
+                                              unit_diagonal=unitriangular))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return _d("triangular_solve", (x, y), {"upper": upper, "transpose": transpose,
+                                           "unitriangular": unitriangular})
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(
+        x._value if isinstance(x, Tensor) else x,
+        y._value if isinstance(y, Tensor) else y, rcond=rcond)
+    return (Tensor._wrap(sol), Tensor._wrap(res), Tensor._wrap(rank),
+            Tensor._wrap(sv))
+
+
+det = _simple("det", lambda x: jnp.linalg.det(x))
+
+
+register_op("slogdet", lambda x: tuple(jnp.linalg.slogdet(x)))
+
+
+def slogdet(x, name=None):
+    sign, logdet = _d("slogdet", (x,), {})
+    from .manipulation import stack
+    return stack([sign, logdet], axis=0)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor._wrap(jnp.linalg.matrix_rank(
+        x._value if isinstance(x, Tensor) else x, rtol=tol))
+
+
+def cond(x, p=None, name=None):
+    return Tensor._wrap(jnp.linalg.cond(
+        x._value if isinstance(x, Tensor) else x, p=p))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(
+        x._value if isinstance(x, Tensor) else x)
+    out = (Tensor._wrap(lu_), Tensor._wrap(piv + 1))  # paddle pivots are 1-based
+    if get_infos:
+        return out + (Tensor._wrap(jnp.zeros((), jnp.int32)),)
+    return out
+
+
+def householder_product(x, tau, name=None):
+    raise NotImplementedError("householder_product: planned (low priority)")
+
+
+register_op("corrcoef", lambda x, *, rowvar: jnp.corrcoef(x, rowvar=rowvar))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _d("corrcoef", (x,), {"rowvar": bool(rowvar)})
+
+
+register_op("cov", lambda x, *, rowvar, ddof: jnp.cov(x, rowvar=rowvar, ddof=ddof))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _d("cov", (x,), {"rowvar": bool(rowvar), "ddof": 1 if ddof else 0})
+
+
+register_op("multi_dot", lambda xs: jnp.linalg.multi_dot(xs), tags=("mxu",))
+
+
+def multi_dot(x, name=None):
+    return _d("multi_dot", (list(x),), {})
+
+
+register_op("vecdot", lambda x, y, *, axis: jnp.sum(x * y, axis=axis))
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return _d("vecdot", (x, y), {"axis": int(axis)})

@@ -1,0 +1,578 @@
+"""Shape/layout manipulation ops. Parity: `python/paddle/tensor/manipulation.py`."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtypes as _dtypes
+from ..framework.tensor import Tensor
+from .registry import dispatch as _d, register_op
+from ..core.dtypes import canonical_index_dtype as _ityfn
+_ITYPE = _ityfn()
+
+__all__ = [
+    "cast", "reshape", "transpose", "moveaxis", "swapaxes", "concat", "stack",
+    "split", "chunk", "squeeze", "unsqueeze", "flatten", "expand", "expand_as",
+    "tile", "broadcast_to", "broadcast_tensors", "gather", "gather_nd",
+    "scatter", "scatter_nd_add", "index_select", "index_add", "index_put",
+    "slice", "flip", "rot90", "roll", "unbind", "where", "take_along_axis",
+    "put_along_axis", "pad", "repeat_interleave", "numel", "one_hot", "unstack",
+    "as_complex", "as_real", "view", "view_as", "atleast_1d", "atleast_2d",
+    "atleast_3d", "crop", "shard_index", "tensordot", "diagonal", "t",
+    "strided_slice", "tolist", "unflatten", "masked_fill", "clip_by_norm",
+]
+
+
+register_op("cast", lambda v, *, dtype: v.astype(dtype))
+
+
+def cast(x, dtype):
+    return _d("cast", (x,), {"dtype": _dtypes.convert_dtype(dtype)})
+
+
+def _resolve_shape(x, shape):
+    """Paddle reshape semantics: 0 copies the input dim, -1 infers."""
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    out = []
+    for i, s in enumerate(shape):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        if s == 0:
+            out.append(x.shape[i])
+        else:
+            out.append(s)
+    return tuple(out)
+
+
+register_op("reshape", lambda v, *, shape: jnp.reshape(v, shape))
+
+
+def reshape(x, shape, name=None):
+    return _d("reshape", (x,), {"shape": _resolve_shape(x, shape)})
+
+
+register_op("transpose", lambda v, *, perm: jnp.transpose(v, perm))
+
+
+def transpose(x, perm=None, name=None):
+    if perm is None:
+        perm = tuple(reversed(range(x.ndim)))
+    return _d("transpose", (x,), {"perm": tuple(int(p) for p in perm)})
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    if x.ndim != 2:
+        raise ValueError("paddle.t only supports ndim<=2")
+    return transpose(x, [1, 0])
+
+
+register_op("moveaxis", lambda v, *, source, destination:
+            jnp.moveaxis(v, source, destination))
+
+
+def moveaxis(x, source, destination, name=None):
+    return _d("moveaxis", (x,), {"source": tuple(np.atleast_1d(source).tolist()),
+                                 "destination": tuple(np.atleast_1d(destination).tolist())})
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    perm = list(range(x.ndim))
+    perm[axis0], perm[axis1] = perm[axis1], perm[axis0]
+    return transpose(x, perm)
+
+
+register_op("concat", lambda vs, *, axis: jnp.concatenate(vs, axis=axis))
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _d("concat", (list(x),), {"axis": int(axis)})
+
+
+register_op("stack", lambda vs, *, axis: jnp.stack(vs, axis=axis))
+
+
+def stack(x, axis=0, name=None):
+    return _d("stack", (list(x),), {"axis": int(axis)})
+
+
+register_op("split", lambda v, *, indices, axis: tuple(jnp.split(v, indices, axis=axis)))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        indices = n  # equal split
+        outs = _d("split", (x,), {"indices": n, "axis": axis})
+    else:
+        sections = [int(s) for s in num_or_sections]
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = dim - known
+        cuts = np.cumsum(sections)[:-1].tolist()
+        outs = _d("split", (x,), {"indices": tuple(cuts), "axis": axis})
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def _norm_axes(axes):
+    if axes is None:
+        return None
+    if isinstance(axes, (int, np.integer)):
+        return (int(axes),)
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return tuple(int(a) for a in axes)
+
+
+register_op("squeeze", lambda v, *, axis: jnp.squeeze(v, axis=axis))
+
+
+def squeeze(x, axis=None, name=None):
+    axis = _norm_axes(axis)
+    if axis is not None:
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        if not axis:
+            return _d("assign", (x,), {})
+    return _d("squeeze", (x,), {"axis": axis})
+
+
+register_op("unsqueeze", lambda v, *, axis: jnp.expand_dims(v, axis=axis))
+
+
+def unsqueeze(x, axis, name=None):
+    return _d("unsqueeze", (x,), {"axis": _norm_axes(axis)})
+
+
+register_op("flatten", lambda v, *, shape: jnp.reshape(v, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = x.shape
+    new_shape = tuple(shape[:start]) + (-1,) + tuple(shape[stop + 1:])
+    return _d("flatten", (x,), {"shape": new_shape})
+
+
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    new_shape = tuple(x.shape[:axis]) + tuple(int(s) for s in shape) + \
+        tuple(x.shape[axis + 1:])
+    return reshape(x, new_shape)
+
+
+register_op("broadcast_to", lambda v, *, shape: jnp.broadcast_to(v, shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return _d("broadcast_to", (x,), {"shape": _resolve_shape(x, shape)})
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    shape = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape]
+    # Paddle expand: -1 means keep the input dim (trailing-aligned);
+    # -1 is invalid for new leading dims that have no corresponding input dim.
+    nd_in, nd_out = x.ndim, len(shape)
+    full_shape = []
+    for i, s in enumerate(shape):
+        in_i = i - (nd_out - nd_in)
+        if s == -1:
+            if in_i < 0:
+                raise ValueError(
+                    f"expand: -1 at position {i} has no corresponding input "
+                    f"dim (input ndim={nd_in}, target rank={nd_out})")
+            full_shape.append(x.shape[in_i])
+        else:
+            full_shape.append(s)
+    return _d("broadcast_to", (x,), {"shape": tuple(full_shape)})
+
+
+def expand_as(x, y, name=None):
+    return _d("broadcast_to", (x,), {"shape": tuple(y.shape)})
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    out_shape = np.broadcast_shapes(*shapes)
+    return [broadcast_to(t, out_shape) for t in inputs]
+
+
+register_op("tile", lambda v, *, reps: jnp.tile(v, reps))
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return _d("tile", (x,), {"reps": tuple(int(r) for r in repeat_times)})
+
+
+register_op("gather", lambda v, idx, *, axis: jnp.take(v, idx, axis=axis))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(index, Tensor) and index.ndim == 2 and index.shape[1] == 1:
+        index = reshape(index, [-1])
+    return _d("gather", (x, index), {"axis": int(axis)})
+
+
+def _gather_nd_fwd(v, idx):
+    idx = jnp.asarray(idx)
+    k = idx.shape[-1]
+    out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+    return out
+
+
+register_op("gather_nd", _gather_nd_fwd)
+
+
+def gather_nd(x, index, name=None):
+    return _d("gather_nd", (x, index), {})
+
+
+def _scatter_fwd(v, idx, updates, *, overwrite):
+    idx = idx.reshape(-1)
+    if overwrite:
+        return v.at[idx].set(updates)
+    # Paddle semantics for overwrite=False: zero the rows, then add.
+    zeroed = v.at[idx].set(jnp.zeros_like(updates))
+    return zeroed.at[idx].add(updates)
+
+
+register_op("scatter", _scatter_fwd)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _d("scatter", (x, index, updates), {"overwrite": bool(overwrite)})
+
+
+def _scatter_nd_add_fwd(v, idx, updates):
+    k = idx.shape[-1]
+    return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(updates)
+
+
+register_op("scatter_nd_add", _scatter_nd_add_fwd)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _d("scatter_nd_add", (x, index, updates), {})
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _d("gather", (x, index), {"axis": int(axis)})
+
+
+register_op("index_add_", lambda v, i, u: v.at[i].add(u))
+
+
+def index_add(x, index, axis, value, name=None):
+    axis = axis % x.ndim
+    perm = [axis] + [i for i in range(x.ndim) if i != axis]
+    inv = np.argsort(perm).tolist()
+    xt = transpose(x, perm)
+    vt = transpose(value, perm)
+    out = _d("index_add_", (xt, index, vt), {})
+    return transpose(out, inv)
+
+
+def _index_put_fwd(v, idx_list, val, *, acc):
+    idx = tuple(idx_list)
+    return v.at[idx].add(val) if acc else v.at[idx].set(val)
+
+
+register_op("index_put", _index_put_fwd)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = [i if isinstance(i, Tensor) else Tensor(jnp.asarray(i))
+           for i in indices]
+    return _d("index_put", (x, idx, value), {"acc": bool(accumulate)})
+
+
+register_op("slice_op", lambda v, *, slices: v[slices])
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        slices[ax] = jnp.s_[st:en]
+    return _d("slice_op", (x,), {"slices": tuple(slices)})
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = jnp.s_[int(st):int(en):int(sd)]
+    return _d("slice_op", (x,), {"slices": tuple(slices)})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    offsets = offsets or [0] * x.ndim
+    shape = _resolve_shape(x, shape)
+    slices = tuple(jnp.s_[int(o):int(o) + int(s)]
+                   for o, s in zip(offsets, shape))
+    return _d("slice_op", (x,), {"slices": slices})
+
+
+register_op("flip", lambda v, *, axis: jnp.flip(v, axis=axis))
+
+
+def flip(x, axis, name=None):
+    return _d("flip", (x,), {"axis": _norm_axes(axis)})
+
+
+register_op("rot90", lambda v, *, k, axes: jnp.rot90(v, k=k, axes=axes))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _d("rot90", (x,), {"k": int(k), "axes": tuple(axes)})
+
+
+register_op("roll", lambda v, *, shifts, axis: jnp.roll(v, shifts, axis=axis))
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, Tensor):
+        shifts = shifts.tolist()
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(shifts)
+    return _d("roll", (x,), {"shifts": sh, "axis": _norm_axes(axis)})
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+unstack = unbind
+
+
+register_op("where", lambda c, a, b: jnp.where(c, a, b))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return _d("where", (condition, x, y), {})
+
+
+register_op("take_along_axis", lambda v, idx, *, axis:
+            jnp.take_along_axis(v, idx, axis=axis))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return _d("take_along_axis", (arr, indices), {"axis": int(axis)})
+
+
+def _put_along_axis_fwd(v, idx, values, *, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(v, idx, values, axis=axis, inplace=False)
+    dims = list(range(v.ndim))
+    # build full index grids
+    idx_full = [jnp.broadcast_to(jnp.expand_dims(jnp.arange(v.shape[d]),
+                                                 tuple(i for i in dims if i != d)),
+                                 idx.shape) for d in dims]
+    idx_full[axis] = idx
+    values = jnp.broadcast_to(values, idx.shape)
+    if reduce in ("add", "sum"):
+        return v.at[tuple(idx_full)].add(values)
+    if reduce in ("mul", "multiply"):
+        return v.at[tuple(idx_full)].multiply(values)
+    raise ValueError(f"Unknown reduce {reduce}")
+
+
+register_op("put_along_axis", _put_along_axis_fwd)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.broadcast_to(jnp.asarray(values),
+                                         tuple(indices.shape)).astype(arr.dtype))
+    return _d("put_along_axis", (arr, indices, values),
+              {"axis": int(axis), "reduce": reduce})
+
+
+register_op("pad_op", lambda v, *, pad_width, mode, value:
+            jnp.pad(v, pad_width, mode=mode, constant_values=value)
+            if mode == "constant" else jnp.pad(v, pad_width, mode=mode))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle nn.functional.pad convention: pads last dims, reversed pairs,
+        # layout-aware for 3D/4D/5D (NCL/NCHW/NCDHW pad spatial dims only).
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NLC/NHWC/NDHWC: spatial dims start at 1
+            spatial_dims = list(range(1, 1 + n_spatial))
+        else:  # NCL/NCHW/NCDHW: spatial dims start at 2
+            spatial_dims = list(range(2, 2 + n_spatial))
+        for i, d in enumerate(spatial_dims):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return _d("pad_op", (x,), {"pad_width": tuple(width), "mode": jmode,
+                               "value": value})
+
+
+register_op("repeat_interleave", lambda v, *, repeats, axis:
+            jnp.repeat(v, repeats, axis=axis))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._value
+    return _d("repeat_interleave", (x,),
+              {"repeats": repeats if isinstance(repeats, int) else tuple(np.asarray(repeats).tolist()),
+               "axis": axis})
+
+
+def numel(x, name=None):
+    return Tensor._wrap(jnp.asarray(x.size, _ITYPE))
+
+
+register_op("one_hot", lambda v, *, num_classes:
+            jax.nn.one_hot(v, num_classes, dtype=jnp.float32))
+
+
+def one_hot(x, num_classes, name=None):
+    return _d("one_hot", (x,), {"num_classes": int(num_classes)})
+
+
+register_op("as_complex", lambda v: jax.lax.complex(v[..., 0], v[..., 1]))
+register_op("as_real", lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1))
+
+
+def as_complex(x, name=None):
+    return _d("as_complex", (x,), {})
+
+
+def as_real(x, name=None):
+    return _d("as_real", (x,), {})
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(x, [1]) if x.ndim == 0 else x for x in inputs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 2:
+            x = unsqueeze(x, 0)
+        outs.append(x)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        x = atleast_2d(x)
+        if x.ndim < 3:
+            x = unsqueeze(x, -1)
+        outs.append(x)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def _shard_index_fwd(v, *, shard_size, shard_id, ignore_value):
+    in_shard = (v // shard_size) == shard_id
+    return jnp.where(in_shard, v % shard_size, ignore_value)
+
+
+register_op("shard_index", _shard_index_fwd)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    shard_size = (index_num + nshards - 1) // nshards
+    return _d("shard_index", (input,), {"shard_size": shard_size,
+                                        "shard_id": shard_id,
+                                        "ignore_value": ignore_value})
+
+
+register_op("tensordot", lambda a, b, *, axes: jnp.tensordot(a, b, axes=axes))
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a.tolist() if isinstance(a, Tensor) else a) for a in axes)
+    return _d("tensordot", (x, y), {"axes": axes})
+
+
+register_op("diagonal", lambda v, *, offset, axis1, axis2:
+            jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _d("diagonal", (x,), {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+def masked_fill(x, mask, value, name=None):
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, x.dtype))
+    return where(mask, broadcast_to(value, x.shape) if value.ndim == 0 else value, x)
+
+
+def _clip_by_norm_fwd(v, *, max_norm):
+    norm = jnp.sqrt(jnp.sum(v * v))
+    return jnp.where(norm > max_norm, v * (max_norm / norm), v)
+
+
+register_op("clip_by_norm", _clip_by_norm_fwd)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _d("clip_by_norm", (x,), {"max_norm": float(max_norm)})
+
+
+def tolist(x):
+    return x.tolist()

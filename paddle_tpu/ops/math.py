@@ -1,0 +1,342 @@
+"""Elementwise + reduction math ops.
+
+Parity target: `python/paddle/tensor/math.py` + `ops.py` (reference wraps
+`_C_ops.*`; here every op's "kernel" is its jnp/lax lowering, registered in
+ops/registry.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import dispatch as _d, primitive, register_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
+    "pow", "float_power", "scale", "neg", "abs", "sign", "sqrt", "rsqrt",
+    "square", "reciprocal", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+    "asinh", "acosh", "atanh", "floor", "ceil", "round", "trunc", "frac",
+    "erf", "erfinv", "lgamma", "digamma", "clip", "maximum", "minimum",
+    "fmax", "fmin", "atan2", "hypot", "logit", "nan_to_num",
+    "sum", "mean", "max", "min", "prod", "logsumexp", "amax", "amin",
+    "std", "var", "cumsum", "cumprod", "cummax", "cummin", "add_n",
+    "isnan", "isinf", "isfinite", "nansum", "nanmean", "count_nonzero",
+    "diff", "sgn", "trace", "inner", "outer", "heaviside", "rad2deg", "deg2rad",
+    "lerp", "addmm", "increment", "stanh", "multiplex", "gcd", "lcm",
+]
+
+
+def _binary(op_name, jfn):
+    register_op(op_name, jfn)
+
+    def fn(x, y, name=None, _op=op_name):
+        return _d(_op, (x, y), {})
+    fn.__name__ = op_name
+    return fn
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+pow_ = _binary("pow", jnp.power)
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return pow_(x, y)
+
+
+float_power = _binary("float_power", lambda x, y: jnp.float_power(x, y))
+
+
+def _unary(op_name, jfn):
+    register_op(op_name, jfn)
+
+    def fn(x, name=None, _op=op_name):
+        return _d(_op, (x,), {})
+    fn.__name__ = op_name
+    return fn
+
+
+neg = _unary("neg", jnp.negative)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+sign = _unary("sign", jnp.sign)
+sgn = sign
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+# paddle rounds half away from zero, not banker's rounding
+round = _unary("round", lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5))  # noqa: A001
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+logit_ = _unary("logit", jax.scipy.special.logit)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        from . import manipulation as _m
+        x = clip(x, eps, 1.0 - eps)
+    return logit_(x)
+
+
+register_op("stanh", lambda x, *, scale_a, scale_b: scale_b * jnp.tanh(scale_a * x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _d("stanh", (x,), {"scale_a": scale_a, "scale_b": scale_b})
+
+
+register_op("scale", lambda x, *, scale, bias, bias_after_scale:
+            x * scale + bias if bias_after_scale else (x + bias) * scale)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = _d("scale", (x,), {"scale": float(scale), "bias": float(bias),
+                             "bias_after_scale": bool(bias_after_scale)})
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+register_op("clip", lambda x, *, min, max: jnp.clip(x, min, max))
+
+
+def clip(x, min=None, max=None, name=None):
+    from ..framework.tensor import Tensor
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return _d("clip", (x,), {"min": mn, "max": mx})
+
+
+register_op("nan_to_num", lambda x, *, nan, posinf, neginf:
+            jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _d("nan_to_num", (x,), {"nan": nan, "posinf": posinf, "neginf": neginf})
+
+
+register_op("lerp", lambda x, y, w: x + w * (y - x))
+
+
+def lerp(x, y, weight, name=None):
+    return _d("lerp", (x, y, weight), {})
+
+
+register_op("addmm", lambda input, x, y, *, beta, alpha:
+            beta * input + alpha * (x @ y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _d("addmm", (input, x, y), {"beta": beta, "alpha": alpha})
+
+
+# ---------------------------------------------------------------- reductions
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(op_name, jfn, has_dtype=False):
+    if has_dtype:
+        register_op(op_name, lambda x, *, axis, keepdim, dtype:
+                    jfn(x, axis=axis, keepdims=keepdim, dtype=dtype))
+
+        def fn(x, axis=None, dtype=None, keepdim=False, name=None, _op=op_name):
+            from ..core.dtypes import convert_dtype
+            return _d(_op, (x,), {"axis": _axis_arg(axis), "keepdim": bool(keepdim),
+                                  "dtype": convert_dtype(dtype)})
+    else:
+        register_op(op_name, lambda x, *, axis, keepdim:
+                    jfn(x, axis=axis, keepdims=keepdim))
+
+        def fn(x, axis=None, keepdim=False, name=None, _op=op_name):
+            return _d(_op, (x,), {"axis": _axis_arg(axis), "keepdim": bool(keepdim)})
+    fn.__name__ = op_name
+    return fn
+
+
+sum = _reduce("sum", jnp.sum, has_dtype=True)  # noqa: A001
+mean = _reduce("mean", jnp.mean)
+max = _reduce("max", jnp.max)  # noqa: A001
+min = _reduce("min", jnp.min)  # noqa: A001
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+prod = _reduce("prod", jnp.prod, has_dtype=True)
+logsumexp = _reduce("logsumexp", lambda x, axis, keepdims:
+                    jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+nansum = _reduce("nansum", jnp.nansum, has_dtype=True)
+nanmean = _reduce("nanmean", jnp.nanmean)
+
+register_op("std", lambda x, *, axis, unbiased, keepdim:
+            jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+register_op("var", lambda x, *, axis, unbiased, keepdim:
+            jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _d("std", (x,), {"axis": _axis_arg(axis), "unbiased": bool(unbiased),
+                            "keepdim": bool(keepdim)})
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _d("var", (x,), {"axis": _axis_arg(axis), "unbiased": bool(unbiased),
+                            "keepdim": bool(keepdim)})
+
+
+register_op("count_nonzero", lambda x, *, axis, keepdim:
+            jnp.count_nonzero(x, axis=axis, keepdims=keepdim))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _d("count_nonzero", (x,), {"axis": _axis_arg(axis), "keepdim": keepdim})
+
+
+register_op("cumsum", lambda x, *, axis: jnp.cumsum(x, axis=axis))
+register_op("cumprod", lambda x, *, axis: jnp.cumprod(x, axis=axis))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        from . import manipulation as _m
+        x = _m.flatten(x)
+        axis = 0
+    out = _d("cumsum", (x,), {"axis": int(axis)})
+    if dtype is not None:
+        from . import manipulation as _m
+        out = _m.cast(out, dtype)
+    return out
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    if dim is None:
+        from . import manipulation as _m
+        x = _m.flatten(x)
+        dim = 0
+    out = _d("cumprod", (x,), {"axis": int(dim)})
+    if dtype is not None:
+        from . import manipulation as _m
+        out = _m.cast(out, dtype)
+    return out
+
+
+register_op("cummax_val", lambda x, *, axis: jax.lax.cummax(x, axis=axis))
+register_op("cummin_val", lambda x, *, axis: jax.lax.cummin(x, axis=axis))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    axis = -1 if axis is None else int(axis)
+    val = _d("cummax_val", (x,), {"axis": axis % x.ndim if axis < 0 else axis})
+    return val, None  # indices path provided in search.cummax_with_indices
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    axis = -1 if axis is None else int(axis)
+    val = _d("cummin_val", (x,), {"axis": axis % x.ndim if axis < 0 else axis})
+    return val, None
+
+
+register_op("add_n", lambda xs: functools_reduce(xs))
+
+
+def functools_reduce(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    return _d("add_n", (list(inputs),), {})
+
+
+register_op("trace", lambda x, *, offset, axis1, axis2:
+            jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _d("trace", (x,), {"offset": offset, "axis1": axis1, "axis2": axis2})
+
+
+register_op("inner", lambda x, y: jnp.inner(x, y))
+register_op("outer", lambda x, y: jnp.outer(x, y))
+
+
+def inner(x, y, name=None):
+    return _d("inner", (x, y), {})
+
+
+def outer(x, y, name=None):
+    return _d("outer", (x, y), {})
+
+
+register_op("diff", lambda x, *, n, axis: jnp.diff(x, n=n, axis=axis))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return _d("diff", (x,), {"n": n, "axis": axis})
+
+
+def increment(x, value=1.0, name=None):
+    x.set_value(x._value + value)
+    return x
+
+
+register_op("multiplex", lambda inputs, index:
+            jnp.take_along_axis(jnp.stack(inputs, axis=0),
+                                index.reshape(1, -1, 1).astype(jnp.int32),
+                                axis=0)[0])
+
+
+def multiplex(inputs, index, name=None):
+    return _d("multiplex", (list(inputs), index), {})

@@ -1,0 +1,157 @@
+"""Random ops. Parity: `python/paddle/tensor/random.py`.
+
+All draws go through framework.random.next_key() so they are stateful in
+eager mode and functional (key-threaded) under jit capture.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtypes as _dtypes
+from ..core.dtypes import canonical_index_dtype as _ityfn
+_ITYPE = _ityfn()
+from ..framework import random as _random
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "multinomial", "bernoulli", "poisson",
+    "exponential_", "uniform_", "normal_", "gumbel_softmax_sample",
+]
+
+
+def _dt(dtype):
+    return _dtypes.convert_dtype(dtype) if dtype is not None else \
+        _dtypes.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        shape = [shape]
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    return Tensor._wrap(jax.random.normal(_random.next_key(), _shape(shape),
+                                          _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        eps = jax.random.normal(_random.next_key(), out_shape,
+                                _dtypes.get_default_dtype())
+        return Tensor._wrap(m + eps * s)
+    if shape is None:
+        shape = [1]
+    eps = jax.random.normal(_random.next_key(), _shape(shape),
+                            _dtypes.get_default_dtype())
+    return Tensor._wrap(mean + eps * std)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    key = jax.random.key(seed) if seed else _random.next_key()
+    return Tensor._wrap(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                           minval=float(min), maxval=float(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor._wrap(jax.random.randint(_random.next_key(), _shape(shape),
+                                           int(low), int(high),
+                                           _dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    dtype = dtype or x.dtype
+    return randint(low, high, tuple(x.shape), dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor._wrap(jax.random.permutation(_random.next_key(), int(n))
+                        .astype(_dtypes.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_random.next_key(), logits,
+                                     shape=v.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(_random.next_key(),
+                              v.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor._wrap(out.astype(_ITYPE))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    u = jax.random.uniform(_random.next_key(), v.shape, v.dtype)
+    return Tensor._wrap((u < v).astype(v.dtype))
+
+
+def poisson(x, name=None) -> Tensor:
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(jax.random.poisson(_random.next_key(), v).astype(v.dtype))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    u = jax.random.exponential(_random.next_key(), tuple(x.shape),
+                               x._value.dtype) / lam
+    x.set_value(u)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:  # noqa: A002
+    x.set_value(jax.random.uniform(_random.next_key(), tuple(x.shape),
+                                   x._value.dtype, minval=float(min),
+                                   maxval=float(max)))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x.set_value(mean + std * jax.random.normal(_random.next_key(),
+                                               tuple(x.shape), x._value.dtype))
+    return x
+
+
+def gumbel_softmax_sample(logits, tau=1.0, hard=False, axis=-1):
+    v = logits._value if isinstance(logits, Tensor) else logits
+    g = jax.random.gumbel(_random.next_key(), v.shape, v.dtype)
+    from ..nn import functional as F
+    from ..framework.tensor import Tensor as T
+    y = F.softmax(T._wrap((v + g) / tau) if not isinstance(logits, Tensor)
+                  else _gumbel_add(logits, g, tau), axis=axis)
+    if hard:
+        from . import search, manipulation
+        idx = search.argmax(y, axis=axis, keepdim=True)
+        from .creation import zeros_like
+        y_hard = manipulation.put_along_axis(zeros_like(y), idx,
+                                             1.0, axis=axis)
+        y = y_hard.detach() + (y - y.detach())
+    return y
+
+
+def _gumbel_add(logits, g, tau):
+    from .registry import dispatch as _d
+    return _d("gumbel_add", (logits, g), {"tau_": tau})
+
+
+from .registry import register_op as _reg  # noqa: E402
+_reg("gumbel_add", lambda x, g_, *, tau_: (x + g_) / tau_)

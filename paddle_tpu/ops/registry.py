@@ -1,0 +1,206 @@
+"""Op registry + eager dispatcher.
+
+TPU-native analogue of the reference's PHI kernel registry/dispatch pipeline
+(`paddle/phi/core/kernel_registry.h:196` PD_REGISTER_KERNEL,
+`phi/core/kernel_factory.h:326` SelectKernelOrThrowError, and the generated
+``*_ad_func`` eager functions from `fluid/eager/auto_code_generator/generator/
+eager_gen.py`; exemplar `multiply_fwd_func.cc:39`).
+
+Design: every op is a pure function over raw jax values.  Dispatch does, in
+the same order as the reference's generated ad_func:
+  1. AMP autocast (hook installed by paddle_tpu.amp; ref `multiply_fwd_func.cc:54`)
+  2. forward — under grad, via ``jax.vjp`` so XLA keeps the residuals
+     (replacing TensorWrapper saves) unless the op registered a manual VJP
+  3. NaN/Inf scan when FLAGS_check_nan_inf (ref `multiply_fwd_func.cc:140`)
+  4. GradNode creation + edge wiring (ref `multiply_fwd_func.cc:164-192`)
+
+"Kernel selection" is XLA's job: each op's forward is its lowering rule to
+StableHLO; per-shape executable caching is handled by JAX's op-by-op jit
+cache.  Ops compose transparently with jit capture because values may be
+tracers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import flags as _flags
+from ..framework import autograd_engine as _engine
+from ..framework.dygraph import is_grad_enabled
+from ..framework.tensor import Tensor
+
+__all__ = ["OpDef", "register_op", "get_op", "dispatch", "set_autocast_hook",
+           "list_ops"]
+
+
+class OpDef:
+    __slots__ = ("name", "fwd", "custom_vjp", "n_inputs", "tags")
+
+    def __init__(self, name: str, fwd: Callable, custom_vjp: Optional[Callable],
+                 tags: Tuple[str, ...]):
+        self.name = name
+        self.fwd = fwd
+        self.custom_vjp = custom_vjp
+        self.tags = tags
+
+
+_OPS: Dict[str, OpDef] = {}
+
+# Hook installed by paddle_tpu.amp: (op_name, dtypes) -> target dtype or None.
+_autocast_hook: Optional[Callable] = None
+
+
+def set_autocast_hook(fn: Optional[Callable]) -> None:
+    global _autocast_hook
+    _autocast_hook = fn
+
+
+def register_op(name: str, fwd: Callable, custom_vjp: Optional[Callable] = None,
+                tags: Sequence[str] = ()) -> OpDef:
+    op = OpDef(name, fwd, custom_vjp, tuple(tags))
+    _OPS[name] = op
+    return op
+
+
+def get_op(name: str) -> OpDef:
+    return _OPS[name]
+
+
+def list_ops() -> List[str]:
+    return sorted(_OPS)
+
+
+def _is_tensor_leaf(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _flatten_inputs(diff_inputs):
+    """Flatten nested (tuple/list of) Tensor/array inputs.
+
+    Returns (vals_flat, leaves, treedef): leaves[i] is the Tensor for that
+    slot or None for raw arrays/scalars.
+    """
+    flat, treedef = jax.tree_util.tree_flatten(
+        list(diff_inputs), is_leaf=_is_tensor_leaf)
+    vals = []
+    leaves: List[Optional[Tensor]] = []
+    for x in flat:
+        if isinstance(x, Tensor):
+            vals.append(x._value)
+            leaves.append(x)
+        else:
+            vals.append(x)
+            leaves.append(None)
+    return vals, leaves, treedef
+
+
+def _check_nan_inf(name: str, outs):
+    level = _flags.get_flag("check_nan_inf_level")
+    for o in outs:
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
+            bad = bool(jnp.any(~jnp.isfinite(o)))
+            if bad:
+                msg = f"Op '{name}' produced NaN/Inf output"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                import warnings
+                warnings.warn(msg)
+
+
+def _autocast_vals(op_name: str, vals: List[Any]):
+    """Apply AMP casting to float inputs; returns (vals, cast_back_dtype)."""
+    if _autocast_hook is None:
+        return vals, None
+    target = _autocast_hook(op_name, vals)
+    if target is None:
+        return vals, None
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating) \
+                and v.dtype != target:
+            out.append(v.astype(target))
+        else:
+            out.append(v)
+    return out, None
+
+
+def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
+             op: Optional[OpDef] = None):
+    """Execute one op eagerly with autograd tracking."""
+    if op is None:
+        op = _OPS[name]
+    vals, leaves, treedef = _flatten_inputs(diff_inputs)
+    vals, _ = _autocast_vals(name, vals)
+
+    requires_grad = is_grad_enabled() and any(
+        t is not None and not t.stop_gradient for t in leaves)
+
+    fn = op.fwd
+
+    def fn_flat(*vs):
+        args = jax.tree_util.tree_unflatten(treedef, vs)
+        return fn(*args, **static)
+
+    if not requires_grad:
+        outs = fn_flat(*vals)
+        multi = isinstance(outs, tuple)
+        outs_t = tuple(outs) if multi else (outs,)
+        if _flags.get_flag("check_nan_inf"):
+            _check_nan_inf(name, outs_t)
+        wrapped = tuple(Tensor._wrap(o, stop_gradient=True) for o in outs_t)
+        return wrapped if multi else wrapped[0]
+
+    if op.custom_vjp is not None:
+        outs, vjp_fn = op.custom_vjp(treedef, vals, static)
+    else:
+        outs, vjp_fn = jax.vjp(fn_flat, *vals)
+
+    multi = isinstance(outs, tuple)
+    outs_t = tuple(outs) if multi else (outs,)
+    if _flags.get_flag("check_nan_inf"):
+        _check_nan_inf(name, outs_t)
+
+    node = _engine.OpGradNode(name, len(outs_t), vjp_fn)
+    edges: List[Optional[_engine.Edge]] = []
+    for t in leaves:
+        if t is None or t.stop_gradient:
+            edges.append(None)
+        elif t._grad_node is not None:
+            edges.append(_engine.Edge(t._grad_node, t._output_slot))
+        else:
+            edges.append(_engine.Edge(t._get_accum_node(), 0))
+    node.next_edges = edges
+
+    wrapped = []
+    for i, o in enumerate(outs_t):
+        node.out_meta[i] = (o.shape, o.dtype)
+        w = Tensor._wrap(o, stop_gradient=False)
+        w._grad_node = node
+        w._output_slot = i
+        wrapped.append(w)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def primitive(name: str, custom_vjp: Optional[Callable] = None,
+              tags: Sequence[str] = ()):
+    """Decorator: register ``fn(*diff_args, **static)`` and return a
+    user-facing wrapper that dispatches Tensors through the engine.
+
+    The wrapper separates inputs: positional args are differentiable inputs
+    (Tensor / array / nested lists of Tensors), keyword args are static attrs.
+    """
+    def deco(fn):
+        op = register_op(name, fn, custom_vjp, tags)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            kwargs.pop("name", None)
+            return dispatch(name, args, kwargs, op)
+
+        wrapper.op = op
+        return wrapper
+    return deco

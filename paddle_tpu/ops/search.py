@@ -1,0 +1,228 @@
+"""Search/sort/sampling-index ops. Parity: `python/paddle/tensor/search.py`.
+
+Dynamic-output-shape ops (nonzero, masked_select, unique) execute eagerly on
+concrete values only — they cannot appear under jit capture, same as the
+reference's dy2static graph-break behavior for them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .registry import dispatch as _d, register_op
+from ..core.dtypes import canonical_index_dtype as _ityfn
+_ITYPE = _ityfn()
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "nonzero", "masked_select", "index_sample", "unique", "unique_consecutive",
+    "searchsorted", "bucketize", "median", "nanmedian", "quantile",
+    "bincount", "histogramdd",
+]
+
+
+register_op("argmax", lambda x, *, axis, keepdim:
+            jnp.argmax(x, axis=axis, keepdims=keepdim).astype(_ITYPE))
+register_op("argmin", lambda x, *, axis, keepdim:
+            jnp.argmin(x, axis=axis, keepdims=keepdim).astype(_ITYPE))
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _d("argmax", (x,), {"axis": axis if axis is None else int(axis),
+                               "keepdim": bool(keepdim)})
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _d("argmin", (x,), {"axis": axis if axis is None else int(axis),
+                               "keepdim": bool(keepdim)})
+
+
+register_op("argsort", lambda x, *, axis, descending:
+            (jnp.flip(jnp.argsort(x, axis=axis), axis=axis) if descending
+             else jnp.argsort(x, axis=axis)).astype(_ITYPE))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return _d("argsort", (x,), {"axis": int(axis), "descending": bool(descending)})
+
+
+register_op("sort", lambda x, *, axis, descending:
+            jnp.flip(jnp.sort(x, axis=axis), axis=axis) if descending
+            else jnp.sort(x, axis=axis))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return _d("sort", (x,), {"axis": int(axis), "descending": bool(descending)})
+
+
+def _topk_fwd(x, *, k, axis, largest):
+    if axis != x.ndim - 1 and axis != -1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis != x.ndim - 1 and axis != -1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(_ITYPE)
+
+
+register_op("topk", _topk_fwd)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return _d("topk", (x,), {"k": int(k), "axis": int(axis),
+                             "largest": bool(largest)})
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    axis = axis % x.ndim
+    vals = sort(x, axis=axis)
+    idxs = argsort(x, axis=axis)
+    from .manipulation import take_along_axis, squeeze
+    from .creation import full
+    sel = full([1], k - 1, dtype="int64")
+    shape = [1] * x.ndim
+    from .manipulation import reshape, broadcast_to
+    idx_shape = list(x.shape)
+    idx_shape[axis] = 1
+    gather_idx = broadcast_to(reshape(sel, shape), idx_shape)
+    v = take_along_axis(vals, gather_idx, axis)
+    i = take_along_axis(idxs, gather_idx, axis)
+    if not keepdim:
+        v, i = squeeze(v, axis), squeeze(i, axis)
+    return v, i
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = x._value if isinstance(x, Tensor) else x
+    from scipy import stats  # available via numpy ecosystem? fallback manual
+    raise NotImplementedError("mode: planned")
+
+
+def nonzero(x, as_tuple=False):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    idx = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(i, _ITYPE)) for i in idx)
+    return Tensor._wrap(jnp.asarray(np.stack(idx, axis=1), _ITYPE))
+
+
+def masked_select(x, mask, name=None):
+    """Dynamic-shape select; indices are resolved eagerly on the host, then the
+    pick is a differentiable gather so gradients flow like the reference op."""
+    from .manipulation import broadcast_to, flatten, gather
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    m = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    out_shape = np.broadcast_shapes(tuple(x.shape), m.shape)
+    xb = flatten(broadcast_to(x, list(out_shape)))
+    idx = np.nonzero(np.broadcast_to(m, out_shape).reshape(-1))[0].astype(np.int32)
+    return gather(xb, Tensor._wrap(jnp.asarray(idx)), axis=0)
+
+
+def _index_sample_fwd(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+register_op("index_sample", _index_sample_fwd)
+
+
+def index_sample(x, index):
+    return _d("index_sample", (x, index), {})
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = [Tensor._wrap(jnp.asarray(r)) for r in res]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is not None:
+        raise NotImplementedError
+    flat = v.reshape(-1)
+    keep = np.ones(len(flat), bool)
+    keep[1:] = flat[1:] != flat[:-1]
+    out = [Tensor._wrap(jnp.asarray(flat[keep]))]
+    if return_inverse:
+        out.append(Tensor._wrap(jnp.asarray(np.cumsum(keep) - 1, np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, len(flat)))
+        out.append(Tensor._wrap(jnp.asarray(counts, np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+register_op("searchsorted", lambda sorted_seq, values, *, right:
+            jnp.searchsorted(sorted_seq, values,
+                             side="right" if right else "left").astype(_ITYPE))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = _d("searchsorted", (sorted_sequence, values), {"right": bool(right)})
+    if out_int32:
+        from .manipulation import cast
+        out = cast(out, "int32")
+    return out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+register_op("median", lambda x, *, axis, keepdim:
+            jnp.median(x, axis=axis, keepdims=keepdim))
+register_op("nanmedian", lambda x, *, axis, keepdim:
+            jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _d("median", (x,), {"axis": axis if axis is None else int(axis),
+                               "keepdim": bool(keepdim)})
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _d("nanmedian", (x,), {"axis": axis if axis is None else int(axis),
+                                  "keepdim": bool(keepdim)})
+
+
+register_op("quantile", lambda x, *, q, axis, keepdim:
+            jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return _d("quantile", (x,), {"q": q, "axis": axis if axis is None else int(axis),
+                                 "keepdim": bool(keepdim)})
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = x._value if isinstance(x, Tensor) else x
+    w = weights._value if isinstance(weights, Tensor) else weights
+    n = max(int(v.max()) + 1 if v.size else 0, minlength)
+    return Tensor._wrap(jnp.bincount(v, weights=w, length=n))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    hist, edges = np.histogramdd(v, bins=bins, range=ranges, density=density,
+                                 weights=np.asarray(weights._value)
+                                 if isinstance(weights, Tensor) else weights)
+    return Tensor._wrap(jnp.asarray(hist)), [Tensor._wrap(jnp.asarray(e))
+                                             for e in edges]

@@ -1,0 +1,78 @@
+"""OpTest harness: numpy-reference forward check + numeric finite-difference
+gradient check, run in eager mode and (optionally) under jit capture.
+
+TPU-native analogue of the reference's `test/legacy_test/op_test.py:418`
+(numeric gradient at `op_test.py:148`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn: Callable, inputs: List[np.ndarray], wrt: int,
+                 delta: float = 1e-3) -> np.ndarray:
+    """Central finite differences of sum(fn(*inputs)) wrt inputs[wrt]."""
+    x = inputs[wrt].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def f(v):
+        args = list(inputs)
+        args[wrt] = v.reshape(x.shape).astype(inputs[wrt].dtype)
+        out = fn(*args)
+        return float(np.sum(np.asarray(out, dtype=np.float64)))
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        fp = f(flat)
+        flat[i] = orig - delta
+        fm = f(flat)
+        flat[i] = orig
+        gflat[i] = (fp - fm) / (2 * delta)
+    return grad
+
+
+def check_forward(paddle_fn: Callable, np_fn: Callable,
+                  inputs: Sequence[np.ndarray], rtol: float = 1e-5,
+                  atol: float = 1e-6, **kwargs):
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = paddle_fn(*tensors, **kwargs)
+    ref = np_fn(*inputs, **kwargs)
+    if not isinstance(out, (list, tuple)):
+        out, ref = [out], [ref]
+    for o, r in zip(out, ref):
+        np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    return out
+
+
+def check_grad(paddle_fn: Callable, inputs: Sequence[np.ndarray],
+               rtol: float = 1e-2, atol: float = 1e-3, delta: float = 1e-3,
+               **kwargs):
+    """Compare engine grads of sum(fn(...)) against finite differences."""
+    tensors = [paddle.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = paddle_fn(*tensors, **kwargs)
+    loss = out.sum() if not isinstance(out, (list, tuple)) else \
+        sum((o.sum() for o in out[1:]), out[0].sum())
+    loss.backward()
+
+    def np_eval(*np_inputs):
+        ts = [paddle.to_tensor(x) for x in np_inputs]
+        o = paddle_fn(*ts, **kwargs)
+        if isinstance(o, (list, tuple)):
+            return sum(np.sum(oo.numpy()) for oo in o)
+        return o.numpy()
+
+    for i, t in enumerate(tensors):
+        if not np.issubdtype(inputs[i].dtype, np.floating):
+            continue
+        ng = numeric_grad(np_eval, list(inputs), i, delta=delta)
+        assert t.grad is not None, f"missing grad for input {i}"
+        np.testing.assert_allclose(t.grad.numpy(), ng, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")

@@ -1,0 +1,184 @@
+"""Autograd engine tests: tape construction, backward walk, hooks,
+accumulation, retain_graph — mirroring `test/legacy_test` backward tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    y.backward()
+    assert x.grad.item() == 6.0
+
+
+def test_chain():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    z = ((x * 3) + 1) ** 2
+    z.backward()
+    assert x.grad.item() == pytest.approx(2 * 7 * 3)
+
+
+def test_multi_use_accumulation():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x  # dy/dx = 2x + 1 = 5
+    y.backward()
+    assert x.grad.item() == 5.0
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x.sum()
+    b = (x * x).sum()
+    loss = a + b
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 5.0])
+
+
+def test_matmul_grad():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(3, 4).astype(np.float32)
+    wv = rng.rand(4, 5).astype(np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    w = paddle.to_tensor(wv, stop_gradient=False)
+    paddle.matmul(x, w).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((3, 5)) @ wv.T, rtol=1e-5)
+    np.testing.assert_allclose(w.grad.numpy(), xv.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient=True
+    z = x * y
+    z.backward()
+    assert x.grad.item() == 2.0
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_no_grad_decorator():
+    @paddle.no_grad()
+    def f(t):
+        return t * 2
+
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    assert f(x).stop_gradient
+
+
+def test_backward_twice_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    s = y.sum()
+    s.backward()
+    with pytest.raises(RuntimeError):
+        s.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    s = (x * 2).sum()
+    s.backward(retain_graph=True)
+    s.backward()
+    assert x.grad.item() == 4.0
+
+
+def test_grad_accumulate_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    assert x.grad.item() == 5.0
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_leaf_hook_modifies_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    assert x.grad.item() == 20.0
+
+
+def test_intermediate_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    seen = []
+    y.register_hook(lambda g: seen.append(g.item()))
+    (y * 3).sum().backward()
+    assert seen == [3.0]
+    assert x.grad.item() == 6.0
+
+
+def test_hook_remove():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 100)
+    h.remove()
+    (x * 2).sum().backward()
+    assert x.grad.item() == 2.0
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32), stop_gradient=False)
+    parts = paddle.split(x, 3)
+    loss = parts[0].sum() + (parts[2] * 2).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 1, 0, 0, 2, 2])
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 1), np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones((1, 4), np.float32), stop_gradient=False)
+    (x + y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 1), 4.0))
+    np.testing.assert_allclose(y.grad.numpy(), np.full((1, 4), 3.0))
+
+
+def test_int_input_no_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    idx = paddle.to_tensor([0, 2])
+    out = paddle.gather(x, idx)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1, 0, 1])
+
+
+def test_grad_dtype_matches_param():
+    x = paddle.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+    (x * 2.0).sum().backward()
+    assert x.grad.dtype == x.dtype
+
+
+def test_scalar_backward_seeds_ones():
+    x = paddle.to_tensor([[1.0, 2.0]], stop_gradient=False)
+    x.mean().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0.5, 0.5]])
+
+
+def test_grad_through_nondiff_side_path():
+    """Regression: nodes reachable only via float0 paths must not stall the walk."""
+    x = paddle.to_tensor([3.0, 1.0, 2.0], stop_gradient=False)
+    idx = paddle.argmax(x)
+    y = paddle.gather(x, idx)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 0.0])
+
+
+def test_masked_select_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = paddle.masked_select(x, paddle.to_tensor([True, False, True]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])

@@ -1,0 +1,328 @@
+"""Op corpus tests via the OpTest harness (numpy forward + numeric grads)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+
+RNG = np.random.RandomState(42)
+
+
+def _f32(*shape):
+    return RNG.rand(*shape).astype(np.float32) + 0.1
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("pfn,nfn", [
+        (paddle.add, np.add), (paddle.subtract, np.subtract),
+        (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+        (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    ])
+    def test_binary_forward(self, pfn, nfn):
+        check_forward(pfn, nfn, [_f32(3, 4), _f32(3, 4)])
+
+    @pytest.mark.parametrize("pfn", [paddle.add, paddle.multiply,
+                                     paddle.subtract, paddle.divide])
+    def test_binary_grad(self, pfn):
+        check_grad(pfn, [_f32(2, 3), _f32(2, 3)])
+
+    @pytest.mark.parametrize("pfn,nfn", [
+        (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+        (paddle.tanh, np.tanh), (paddle.sin, np.sin), (paddle.cos, np.cos),
+        (paddle.abs, np.abs), (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+        (paddle.square, np.square),
+    ])
+    def test_unary_forward(self, pfn, nfn):
+        check_forward(pfn, nfn, [_f32(4, 4)], rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("pfn", [paddle.exp, paddle.log, paddle.sqrt,
+                                     paddle.tanh, paddle.square])
+    def test_unary_grad(self, pfn):
+        check_grad(pfn, [_f32(3, 3) + 0.5])
+
+    def test_pow_scalar(self):
+        check_forward(lambda x: paddle.pow(x, 3.0),
+                      lambda x: np.power(x, 3.0), [_f32(3)])
+
+    def test_clip(self):
+        x = np.array([-1.0, 0.5, 2.0], np.float32)
+        out = paddle.clip(paddle.to_tensor(x), 0.0, 1.0)
+        np.testing.assert_allclose(out.numpy(), [0, 0.5, 1.0])
+
+    def test_scale(self):
+        out = paddle.scale(paddle.to_tensor([1.0, 2.0]), scale=2.0, bias=1.0)
+        np.testing.assert_allclose(out.numpy(), [3.0, 5.0])
+
+
+class TestReductions:
+    @pytest.mark.parametrize("pfn,nfn", [
+        (paddle.sum, np.sum), (paddle.mean, np.mean),
+        (paddle.max, np.max), (paddle.min, np.min), (paddle.prod, np.prod),
+    ])
+    def test_forward_all(self, pfn, nfn):
+        check_forward(lambda t: pfn(t), lambda a: nfn(a), [_f32(3, 4)],
+                      rtol=1e-4, atol=1e-5)
+
+    def test_axis_keepdim(self):
+        x = _f32(2, 3, 4)
+        out = paddle.sum(paddle.to_tensor(x), axis=[1, 2], keepdim=True)
+        np.testing.assert_allclose(out.numpy(), x.sum(axis=(1, 2), keepdims=True),
+                                   rtol=1e-5)
+
+    def test_mean_grad(self):
+        check_grad(lambda t: paddle.mean(t, axis=1), [_f32(3, 4)])
+
+    def test_std_var(self):
+        x = _f32(5, 5)
+        np.testing.assert_allclose(paddle.std(paddle.to_tensor(x)).item(),
+                                   x.std(ddof=1), rtol=1e-4)
+        np.testing.assert_allclose(paddle.var(paddle.to_tensor(x)).item(),
+                                   x.var(ddof=1), rtol=1e-4)
+
+    def test_cumsum(self):
+        x = _f32(3, 4)
+        np.testing.assert_allclose(paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.cumsum(x, axis=1), rtol=1e-5)
+
+    def test_logsumexp_grad(self):
+        check_grad(lambda t: paddle.logsumexp(t, axis=1), [_f32(3, 4)])
+
+
+class TestManipulation:
+    def test_reshape_paddle_semantics(self):
+        x = paddle.ones([2, 3, 4])
+        assert paddle.reshape(x, [0, -1]).shape == [2, 12]
+        assert paddle.reshape(x, [-1]).shape == [24]
+
+    def test_concat_stack(self):
+        a, b = _f32(2, 3), _f32(2, 3)
+        check_forward(lambda x, y: paddle.concat([x, y], axis=0),
+                      lambda x, y: np.concatenate([x, y], axis=0), [a, b])
+        check_forward(lambda x, y: paddle.stack([x, y], axis=1),
+                      lambda x, y: np.stack([x, y], axis=1), [a, b])
+
+    def test_concat_grad(self):
+        check_grad(lambda x, y: paddle.concat([x, y], axis=1),
+                   [_f32(2, 2), _f32(2, 3)])
+
+    def test_split_sections(self):
+        x = paddle.to_tensor(_f32(7, 2))
+        outs = paddle.split(x, [2, 2, 3], axis=0)
+        assert [o.shape[0] for o in outs] == [2, 2, 3]
+
+    def test_squeeze_unsqueeze(self):
+        x = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(x).shape == [3]
+        assert paddle.squeeze(x, axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(x, [0, 4]).shape == [1, 1, 3, 1, 1]
+
+    def test_flatten(self):
+        x = paddle.ones([2, 3, 4])
+        assert paddle.flatten(x).shape == [24]
+        assert paddle.flatten(x, 1, 2).shape == [2, 12]
+
+    def test_expand_tile(self):
+        x = paddle.ones([1, 3])
+        assert paddle.expand(x, [4, 3]).shape == [4, 3]
+        assert paddle.expand(x, [2, -1]).shape == [2, 3]
+        assert paddle.tile(x, [2, 2]).shape == [2, 6]
+
+    def test_gather_scatter(self):
+        x = _f32(5, 3)
+        idx = np.array([0, 3], np.int32)
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[idx])
+        upd = _f32(2, 3)
+        s = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                           paddle.to_tensor(upd))
+        ref = x.copy()
+        ref[idx] = upd
+        np.testing.assert_allclose(s.numpy(), ref)
+
+    def test_gather_nd(self):
+        x = _f32(3, 4, 5)
+        idx = np.array([[0, 1], [2, 3]], np.int32)
+        out = paddle.gather_nd(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[[0, 2], [1, 3]])
+
+    def test_pad(self):
+        x = _f32(2, 3)
+        out = paddle.pad(paddle.to_tensor(x), [1, 1, 0, 2])
+        assert out.shape == [4, 5]
+
+    def test_take_along_axis(self):
+        x = _f32(3, 4)
+        idx = np.argsort(x, axis=1).astype(np.int32)
+        out = paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx), 1)
+        np.testing.assert_allclose(out.numpy(), np.take_along_axis(x, idx, 1))
+
+    def test_one_hot(self):
+        out = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
+        np.testing.assert_allclose(out.numpy(), [[1, 0, 0], [0, 0, 1]])
+
+    def test_where(self):
+        c = np.array([True, False])
+        out = paddle.where(paddle.to_tensor(c), paddle.ones([2]), paddle.zeros([2]))
+        np.testing.assert_allclose(out.numpy(), [1, 0])
+
+    def test_flip_roll(self):
+        x = _f32(3, 4)
+        np.testing.assert_allclose(paddle.flip(paddle.to_tensor(x), [0]).numpy(),
+                                   x[::-1])
+        np.testing.assert_allclose(paddle.roll(paddle.to_tensor(x), 1, 0).numpy(),
+                                   np.roll(x, 1, 0))
+
+
+class TestLinalg:
+    def test_matmul_transpose_flags(self):
+        a, b = _f32(3, 4), _f32(5, 4)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_y=True)
+        np.testing.assert_allclose(out.numpy(), a @ b.T, rtol=1e-4)
+
+    def test_batched_matmul(self):
+        a, b = _f32(2, 3, 4), _f32(2, 4, 5)
+        out = paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [_f32(3, 4), _f32(4, 2)], rtol=2e-2)
+
+    def test_norm(self):
+        x = _f32(3, 4)
+        np.testing.assert_allclose(paddle.norm(paddle.to_tensor(x)).item(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.norm(paddle.to_tensor(x), p=1, axis=1).numpy(),
+            np.abs(x).sum(axis=1), rtol=1e-5)
+
+    def test_einsum(self):
+        a, b = _f32(3, 4), _f32(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-4)
+
+    def test_solve_inverse(self):
+        a = _f32(3, 3) + 3 * np.eye(3, dtype=np.float32)
+        b = _f32(3, 2)
+        out = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.linalg.solve(a, b), rtol=1e-3,
+                                   atol=1e-4)
+        inv = paddle.linalg.inverse(paddle.to_tensor(a))
+        np.testing.assert_allclose(inv.numpy(), np.linalg.inv(a), rtol=1e-3,
+                                   atol=1e-4)
+
+
+class TestSearchSort:
+    def test_argmax_min(self):
+        x = _f32(3, 4)
+        assert paddle.argmax(paddle.to_tensor(x)).item() == x.argmax()
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), x.argmax(1))
+
+    def test_sort_argsort(self):
+        x = _f32(4, 5)
+        np.testing.assert_allclose(paddle.sort(paddle.to_tensor(x), axis=1).numpy(),
+                                   np.sort(x, axis=1))
+        out = paddle.sort(paddle.to_tensor(x), axis=1, descending=True)
+        np.testing.assert_allclose(out.numpy(), -np.sort(-x, axis=1))
+
+    def test_topk(self):
+        x = _f32(3, 10)
+        vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=1)
+        ref = -np.sort(-x, axis=1)[:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+    def test_nonzero(self):
+        x = np.array([[1.0, 0.0], [0.0, 2.0]])
+        out = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [[0, 0], [1, 1]])
+
+    def test_unique(self):
+        out = paddle.unique(paddle.to_tensor([3, 1, 2, 1, 3]))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+    def test_searchsorted(self):
+        seq = paddle.to_tensor([1.0, 3.0, 5.0])
+        out = paddle.searchsorted(seq, paddle.to_tensor([2.0, 5.0]))
+        np.testing.assert_array_equal(out.numpy(), [1, 2])
+
+
+class TestLogic:
+    def test_comparisons(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([2.0, 2.0])
+        assert paddle.equal(a, b).numpy().tolist() == [False, True]
+        assert paddle.less_than(a, b).numpy().tolist() == [True, False]
+        assert paddle.allclose(a, a).item()
+
+    def test_logical(self):
+        t = paddle.to_tensor([True, False])
+        f = paddle.to_tensor([False, False])
+        assert paddle.logical_or(t, f).numpy().tolist() == [True, False]
+        assert paddle.logical_not(f).numpy().tolist() == [True, True]
+        assert paddle.any(t).item()
+        assert not paddle.all(t).item()
+
+
+class TestRandom:
+    def test_shapes_and_ranges(self):
+        assert paddle.rand([3, 4]).shape == [3, 4]
+        u = paddle.uniform([100], min=2.0, max=3.0)
+        assert float(u.min().item()) >= 2.0 and float(u.max().item()) <= 3.0
+        r = paddle.randint(0, 5, [100])
+        assert int(r.max().item()) < 5
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
+
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_bernoulli_multinomial(self):
+        probs = paddle.full([1000], 0.5)
+        draws = paddle.bernoulli(probs)
+        assert 300 < draws.sum().item() < 700
+        m = paddle.multinomial(paddle.to_tensor([0.1, 0.0, 0.9]), 5,
+                               replacement=True)
+        assert set(m.numpy().tolist()) <= {0, 2}
+
+
+def test_nan_inf_flag():
+    paddle.set_flags({"check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            paddle.log(paddle.to_tensor([-1.0]))
+    finally:
+        paddle.set_flags({"check_nan_inf": False})
+
+
+def test_bitwise_operators_on_ints():
+    a = paddle.to_tensor([6, 3], dtype="int32")
+    b = paddle.to_tensor([3, 1], dtype="int32")
+    assert (a & b).numpy().tolist() == [2, 1]
+    assert (a | b).numpy().tolist() == [7, 3]
+    assert str((a & b).dtype) == "int32"
+
+
+def test_descending_sort_unsigned_and_bool():
+    s = paddle.sort(paddle.to_tensor(np.array([0, 200, 3], np.uint8)),
+                    descending=True)
+    assert s.numpy().tolist() == [200, 3, 0]
+    sb = paddle.sort(paddle.to_tensor([True, False]), descending=True)
+    assert sb.numpy().tolist() == [True, False]
+
+
+def test_round_half_away_from_zero():
+    out = paddle.round(paddle.to_tensor([0.5, 1.5, 2.5, -0.5]))
+    assert out.numpy().tolist() == [1.0, 2.0, 3.0, -1.0]
+
+
+def test_expand_invalid_minus_one():
+    with pytest.raises(ValueError):
+        paddle.expand(paddle.ones([3]), [-1, 3])

@@ -1,0 +1,123 @@
+"""Tensor basics: creation, meta, conversion, indexing, in-place."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert str(t.dtype) == "float32"
+    assert t.stop_gradient is True
+    np.testing.assert_array_equal(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_inference():
+    assert str(paddle.to_tensor([1, 2]).dtype) == "int32"
+    assert str(paddle.to_tensor([1.5]).dtype) == "float32"
+    assert str(paddle.to_tensor([True]).dtype) == "bool"
+    # TPU-native policy: 64-bit requests canonicalize to 32-bit in x32 mode
+    assert str(paddle.to_tensor([1], dtype="float64").dtype) == "float32"
+    assert str(paddle.to_tensor(np.zeros((2,), np.float16)).dtype) == "float16"
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).sum().item() == 4.0
+    assert paddle.full([2], 7).numpy().tolist() == [7.0, 7.0]
+    assert paddle.arange(10).shape == [10]
+    assert paddle.eye(3).numpy()[1, 1] == 1.0
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    z = paddle.zeros_like(paddle.ones([3, 3]))
+    assert z.sum().item() == 0.0
+
+
+def test_item_tolist():
+    t = paddle.to_tensor([5.0])
+    assert t.item() == 5.0
+    assert paddle.to_tensor([[1, 2]]).tolist() == [[1, 2]]
+
+
+def test_astype_cast():
+    t = paddle.ones([2], dtype="float32")
+    assert str(t.astype("int32").dtype) == "int32"
+    assert str(paddle.cast(t, "bool").dtype) == "bool"
+
+
+def test_indexing_read():
+    t = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype(np.float32))
+    assert t[0].shape == [3, 4]
+    assert t[0, 1, 2].item() == 6.0
+    assert t[:, 1].shape == [2, 4]
+    assert t[..., -1].shape == [2, 3]
+    assert t[0, ::2].shape == [2, 4]
+    idx = paddle.to_tensor([0, 2])
+    assert t[0, idx].shape == [2, 4]
+
+
+def test_indexing_write():
+    t = paddle.zeros([3, 3])
+    t[1, 1] = 9.0
+    assert t.numpy()[1, 1] == 9.0
+    t[0] = paddle.ones([3])
+    assert t.numpy()[0].tolist() == [1, 1, 1]
+
+
+def test_bool_mask_select():
+    t = paddle.to_tensor([1.0, -2.0, 3.0])
+    out = t[t > 0]
+    assert out.numpy().tolist() == [1.0, 3.0]
+
+
+def test_inplace_helpers():
+    t = paddle.ones([2, 2])
+    t.add_(paddle.ones([2, 2]))
+    assert t.numpy()[0, 0] == 2.0
+    t.zero_()
+    assert t.sum().item() == 0.0
+    t.fill_(3.0)
+    assert t.numpy()[1, 1] == 3.0
+
+
+def test_operators():
+    a = paddle.to_tensor([2.0, 4.0])
+    b = paddle.to_tensor([1.0, 2.0])
+    assert (a + b).numpy().tolist() == [3, 6]
+    assert (a - b).numpy().tolist() == [1, 2]
+    assert (a * b).numpy().tolist() == [2, 8]
+    assert (a / b).numpy().tolist() == [2, 2]
+    assert (a ** 2).numpy().tolist() == [4, 16]
+    assert (-a).numpy().tolist() == [-2, -4]
+    assert (a @ b.reshape([2, 1])).shape == [1]
+    assert (a > b).numpy().tolist() == [True, True]
+    assert (1.0 + a).numpy().tolist() == [3, 5]
+    assert (8.0 / a).numpy().tolist() == [4, 2]
+
+
+def test_detach_and_clone():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    d = a.detach()
+    assert d.stop_gradient
+    c = a.clone()
+    assert not c.stop_gradient  # clone tracks grad
+    c.sum().backward()
+    assert a.grad.item() == 1.0
+
+
+def test_set_value_shape_check():
+    t = paddle.ones([2])
+    with pytest.raises(ValueError):
+        t.set_value(np.zeros((3,), np.float32))
+
+
+def test_transpose_T():
+    t = paddle.to_tensor(np.arange(6).reshape(2, 3).astype(np.float32))
+    assert t.T.shape == [3, 2]
+    assert paddle.transpose(t, [1, 0]).shape == [3, 2]
+
+
+def test_default_dtype():
+    paddle.set_default_dtype("float32")
+    assert paddle.get_default_dtype() == np.dtype(np.float32)
