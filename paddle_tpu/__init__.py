@@ -51,5 +51,12 @@ def in_dynamic_mode() -> bool:
     return True
 
 
-# Subsystem imports appended as they are built (nn, optimizer, amp, io, jit,
-# distributed, vision, hapi, ...) — see the bottom of this file.
+# Subsystems
+from . import nn  # noqa: F401,E402
+from . import optimizer  # noqa: F401,E402
+from . import io  # noqa: F401,E402
+from . import vision  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import distributed  # noqa: F401,E402
+from .param_attr import ParamAttr  # noqa: F401,E402
+

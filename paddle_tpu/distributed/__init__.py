@@ -1,0 +1,5 @@
+"""paddle_tpu.distributed — built up across collective/fleet/auto_parallel.
+Parity target: `python/paddle/distributed/`."""
+
+from . import env  # noqa: F401
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401

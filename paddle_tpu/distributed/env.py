@@ -1,0 +1,81 @@
+"""Distributed environment state.
+
+Parity: `python/paddle/distributed/parallel.py` env accessors
+(get_rank/get_world_size, ParallelEnv).  Multi-host identity comes from JAX's
+distributed runtime (process_index) or the launcher's env vars
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM are honored for CLI parity).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def _mark_initialized():
+    global _initialized
+    _initialized = True
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(get_rank())
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        return jax.process_index()
+    except RuntimeError:
+        return 0
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+class ParallelEnv:
+    """Reference: `distributed/parallel.py` ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                              "127.0.0.1:6170").split(",")
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

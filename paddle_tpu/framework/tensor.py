@@ -300,6 +300,19 @@ class Tensor:
     def __dlpack__(self, *a, **k):
         return self._value.__dlpack__(*a, **k)
 
+    def __deepcopy__(self, memo):
+        """Copy value + flags; the autograd graph is never copied (matches
+        paddle: deepcopy of a mid-graph tensor detaches)."""
+        cls = type(self)
+        t = cls._wrap(self._value, stop_gradient=self.stop_gradient)
+        t.persistable = self.persistable
+        t.trainable = self.trainable
+        if isinstance(self, Parameter):
+            t.optimize_attr = dict(self.optimize_attr)
+            t.need_clip = self.need_clip
+        memo[id(self)] = t
+        return t
+
     # Arithmetic/indexing dunders are patched in paddle_tpu/ops/__init__.py.
 
 

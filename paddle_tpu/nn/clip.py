@@ -1,0 +1,104 @@
+"""Gradient clipping. Parity: `python/paddle/nn/clip.py`
+(ClipGradByGlobalNorm is what HybridParallelOptimizer composes across mesh
+axes — see distributed/fleet)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._wrap(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
+            scale = jnp.where(norm > self.clip_norm, self.clip_norm /
+                              jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, Tensor._wrap(g._value * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        # hook used by hybrid-parallel: sums the squared-norm across mesh
+        # groups before the scale is computed (fleet sets this)
+        self._global_norm_reduce_fn = None
+
+    def _compute_global_sq_norm(self, params_grads):
+        sq = None
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            sq = s if sq is None else sq + s
+        return sq
+
+    def __call__(self, params_grads):
+        sq = self._compute_global_sq_norm(params_grads)
+        if sq is None:
+            return params_grads
+        if self._global_norm_reduce_fn is not None:
+            sq = self._global_norm_reduce_fn(sq)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor._wrap((g._value.astype(jnp.float32) * scale)
+                                        .astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(g._value), norm_type))
+                              for g in grads), 1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = p.grad._value * clip_coef
+    return Tensor._wrap(total)

@@ -1,0 +1,210 @@
+"""Activation functionals. Parity: `python/paddle/nn/functional/activation.py`.
+All are single fused XLA expressions (elementwise — XLA fuses them into
+adjacent matmuls on TPU)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = [
+    "relu", "relu6", "relu_", "gelu", "sigmoid", "silu", "swish", "mish",
+    "softplus", "softsign", "hardswish", "hardsigmoid", "hardtanh",
+    "leaky_relu", "elu", "celu", "selu", "prelu", "softmax", "log_softmax",
+    "glu", "tanhshrink", "softshrink", "hardshrink", "log_sigmoid", "maxout",
+    "thresholded_relu", "tanh", "gumbel_softmax",
+]
+
+
+def _unary(op_name, jfn):
+    register_op(op_name, jfn, tags=("activation",))
+
+    def fn(x, name=None, _op=op_name):
+        return _d(_op, (x,), {})
+    fn.__name__ = op_name
+    return fn
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+silu = _unary("silu", jax.nn.silu)
+mish = _unary("mish", jax.nn.mish)
+softsign = _unary("softsign", jax.nn.soft_sign)
+hardswish = _unary("hardswish", jax.nn.hard_swish)
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+tanh = _unary("tanh_act", jnp.tanh)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value = out._value
+    return x
+
+
+register_op("gelu", lambda x, *, approximate: jax.nn.gelu(x, approximate=approximate),
+            tags=("activation",))
+
+
+def gelu(x, approximate=False, name=None):
+    return _d("gelu", (x,), {"approximate": bool(approximate)})
+
+
+register_op("swish", lambda x: jax.nn.silu(x), tags=("activation",))
+
+
+def swish(x, name=None):
+    return _d("swish", (x,), {})
+
+
+register_op("softplus", lambda x, *, beta, threshold:
+            jnp.where(x * beta > threshold, x,
+                      (1.0 / beta) * jnp.log1p(jnp.exp(beta * x))),
+            tags=("activation",))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _d("softplus", (x,), {"beta": float(beta), "threshold": float(threshold)})
+
+
+register_op("hardsigmoid", lambda x, *, slope, offset:
+            jnp.clip(x * slope + offset, 0.0, 1.0), tags=("activation",))
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return _d("hardsigmoid", (x,), {"slope": slope, "offset": offset})
+
+
+register_op("hardtanh", lambda x, *, min, max: jnp.clip(x, min, max),
+            tags=("activation",))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return _d("hardtanh", (x,), {"min": float(min), "max": float(max)})
+
+
+register_op("leaky_relu", lambda x, *, negative_slope:
+            jax.nn.leaky_relu(x, negative_slope), tags=("activation",))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _d("leaky_relu", (x,), {"negative_slope": float(negative_slope)})
+
+
+register_op("elu", lambda x, *, alpha: jax.nn.elu(x, alpha), tags=("activation",))
+
+
+def elu(x, alpha=1.0, name=None):
+    return _d("elu", (x,), {"alpha": float(alpha)})
+
+
+register_op("celu", lambda x, *, alpha: jax.nn.celu(x, alpha), tags=("activation",))
+
+
+def celu(x, alpha=1.0, name=None):
+    return _d("celu", (x,), {"alpha": float(alpha)})
+
+
+register_op("selu", lambda x, *, scale, alpha:
+            scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)),
+            tags=("activation",))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _d("selu", (x,), {"scale": scale, "alpha": alpha})
+
+
+register_op("prelu_op", lambda x, w: jnp.where(x > 0, x, w * x),
+            tags=("activation",))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1:
+        # per-channel: reshape for broadcast over the channel dim
+        from ...ops import manipulation as _m
+        if data_format == "NCHW" and x.ndim > 2:
+            shape = [1, w.shape[0]] + [1] * (x.ndim - 2)
+        else:
+            shape = [1] * (x.ndim - 1) + [w.shape[0]]
+        w = _m.reshape(w, shape)
+    return _d("prelu_op", (x, w), {})
+
+
+register_op("softmax", lambda x, *, axis: jax.nn.softmax(x, axis=axis),
+            tags=("activation",))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...ops import manipulation as _m
+    if dtype is not None:
+        x = _m.cast(x, dtype)
+    return _d("softmax", (x,), {"axis": int(axis)})
+
+
+register_op("log_softmax", lambda x, *, axis: jax.nn.log_softmax(x, axis=axis),
+            tags=("activation",))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...ops import manipulation as _m
+    if dtype is not None:
+        x = _m.cast(x, dtype)
+    return _d("log_softmax", (x,), {"axis": int(axis)})
+
+
+register_op("glu", lambda x, *, axis: jax.nn.glu(x, axis=axis),
+            tags=("activation",))
+
+
+def glu(x, axis=-1, name=None):
+    return _d("glu", (x,), {"axis": int(axis)})
+
+
+register_op("softshrink", lambda x, *, threshold:
+            jnp.where(x > threshold, x - threshold,
+                      jnp.where(x < -threshold, x + threshold, 0.0)),
+            tags=("activation",))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _d("softshrink", (x,), {"threshold": float(threshold)})
+
+
+register_op("hardshrink", lambda x, *, threshold:
+            jnp.where(jnp.abs(x) > threshold, x, 0.0), tags=("activation",))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _d("hardshrink", (x,), {"threshold": float(threshold)})
+
+
+register_op("thresholded_relu", lambda x, *, threshold:
+            jnp.where(x > threshold, x, 0.0), tags=("activation",))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _d("thresholded_relu", (x,), {"threshold": float(threshold)})
+
+
+register_op("maxout", lambda x, *, groups, axis: _maxout_impl(x, groups, axis),
+            tags=("activation",))
+
+
+def _maxout_impl(x, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    new_shape = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _d("maxout", (x,), {"groups": int(groups), "axis": int(axis)})
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...ops.random_ops import gumbel_softmax_sample
+    return gumbel_softmax_sample(x, tau=temperature, hard=hard, axis=axis)

@@ -1,0 +1,86 @@
+"""Attention functionals.
+
+`scaled_dot_product_attention` is the public API (parity:
+`paddle.nn.functional.scaled_dot_product_attention` and the PHI
+flash-attention path `phi/kernels/gpu/flash_attn_kernel.cu`).  On TPU the
+fast path is a Pallas flash-attention kernel (paddle_tpu/ops/pallas_kernels.py,
+used when running on TPU with supported shapes); the fallback is a fused XLA
+softmax(QK^T)V which XLA already schedules well on the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention", "sdpa_xla"]
+
+
+def _sdpa_xla_impl(q, k, v, mask, *, causal, dropout_p, scale, key):
+    # inputs [B, S, H, D] (paddle flash_attn layout); compute in [B,H,S,D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = qh.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        idx_q = jnp.arange(q_len)[:, None]
+        idx_k = jnp.arange(k_len)[None, :]
+        cmask = idx_q >= (idx_k - (k_len - q_len))
+        logits = jnp.where(cmask, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = 1.0 - dropout_p
+        dmask = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+register_op("sdpa", _sdpa_xla_impl, tags=("mxu", "fused"))
+
+
+def sdpa_xla(query, key, value, attn_mask=None, dropout_p=0.0,
+             is_causal=False, scale=None, training=True):
+    from ...framework import random as _random
+    rng = _random.next_key() if (dropout_p > 0 and training) else None
+    return _d("sdpa", (query, key, value, attn_mask),
+              {"causal": bool(is_causal),
+               "dropout_p": float(dropout_p) if training else 0.0,
+               "scale": scale, "key": rng})
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Layout [batch, seq, heads, head_dim] like paddle's flash-attn API."""
+    from ...ops import pallas_kernels
+    if pallas_kernels.flash_attention_available(query, key, value, attn_mask):
+        return pallas_kernels.flash_attention(query, key, value,
+                                              causal=is_causal,
+                                              dropout_p=dropout_p if training
+                                              else 0.0)
+    return sdpa_xla(query, key, value, attn_mask, dropout_p, is_causal,
+                    None, training)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None

@@ -1,0 +1,269 @@
+"""Common functionals: linear, dropout, embedding, normalize, interpolate,
+cosine_similarity. Parity: `python/paddle/nn/functional/common.py`, `input.py`."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as _random
+from ...framework.tensor import Tensor
+from ...ops.registry import dispatch as _d, register_op
+from ...ops.manipulation import pad  # noqa: F401  (re-exported, paddle parity)
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
+    "normalize", "interpolate", "upsample", "cosine_similarity", "pad",
+    "unfold", "fold", "pixel_shuffle", "pixel_unshuffle", "label_smooth",
+    "channel_shuffle",
+]
+
+
+register_op("linear", lambda x, w, b: jnp.matmul(x, w) + b if b is not None
+            else jnp.matmul(x, w), tags=("mxu",))
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b.  Weight layout [in, out] like the reference
+    (`python/paddle/nn/functional/common.py` linear → matmul weight [in,out])."""
+    return _d("linear", (x, weight, bias), {})
+
+
+register_op("dropout_op", lambda x, *, p, mode, key:
+            _dropout_impl(x, p, mode, key))
+
+
+def _dropout_impl(x, p, mode, key):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops.math import scale as _scale
+            return _scale(x, scale=1.0 - p)
+        return x
+    if p == 1.0:
+        from ...ops.creation import zeros_like
+        return zeros_like(x)
+    if axis is not None:
+        # mask broadcast along the non-listed axes (paddle axis semantics)
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        mask_shape = [s if i in axes else 1 for i, s in enumerate(x.shape)]
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(_random.next_key(), keep, tuple(mask_shape))
+        return _d("dropout_axis", (x, Tensor._wrap(mask)), {"keep": keep})
+    return _d("dropout_op", (x,), {"p": float(p), "mode": mode,
+                                   "key": _random.next_key()})
+
+
+register_op("dropout_axis", lambda v, m, *, keep:
+            jnp.where(m, v / keep, 0.0).astype(v.dtype))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or p == 0.0:
+        return x
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def _alpha_dropout_fwd(v, *, p, alpha_p, key):
+    q = 1 - p
+    mask = jax.random.bernoulli(key, q, v.shape)
+    a = (q + alpha_p ** 2 * q * p) ** -0.5
+    b = -a * alpha_p * p
+    return a * jnp.where(mask, v, alpha_p) + b
+
+
+register_op("alpha_dropout", _alpha_dropout_fwd)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha_p = -1.6732632423543772 * 1.0507009873554805
+    return _d("alpha_dropout", (x,), {"p": float(p), "alpha_p": alpha_p,
+                                      "key": _random.next_key()})
+
+
+register_op("embedding_op", lambda w, ids, *, padding_idx:
+            _embedding_impl(w, ids, padding_idx))
+
+
+def _embedding_impl(w, ids, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _d("embedding_op", (weight, x), {"padding_idx": padding_idx})
+
+
+register_op("normalize_op", lambda x, *, p, axis, epsilon:
+            x / jnp.maximum(jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True),
+                            epsilon))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _d("normalize_op", (x,), {"p": p, "axis": int(axis),
+                                     "epsilon": float(epsilon)})
+
+
+register_op("cosine_similarity", lambda x1, x2, *, axis, eps:
+            jnp.sum(x1 * x2, axis=axis) /
+            jnp.maximum(jnp.linalg.norm(x1, axis=axis) *
+                        jnp.linalg.norm(x2, axis=axis), eps))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return _d("cosine_similarity", (x1, x2), {"axis": int(axis),
+                                              "eps": float(eps)})
+
+
+def _interp_impl(x, *, size, mode, align_corners, data_format):
+    # x: NCHW (or NCL/NCDHW); use jax.image.resize on the spatial dims.
+    if data_format.endswith("C"):
+        spatial_start = 1
+    else:
+        spatial_start = 2
+    n_spatial = len(size)
+    full_shape = list(x.shape)
+    for i, s in enumerate(size):
+        full_shape[spatial_start + i] = int(s)
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    return jax.image.resize(x, tuple(full_shape), method=method)
+
+
+register_op("interpolate", _interp_impl)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    n_spatial = x.ndim - 2
+    if size is None:
+        if scale_factor is None:
+            raise ValueError("interpolate needs size or scale_factor")
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * n_spatial
+        start = 2 if not data_format.endswith("C") else 1
+        size = [int(x.shape[start + i] * sf[i]) for i in range(n_spatial)]
+    if isinstance(size, Tensor):
+        size = size.tolist()
+    size = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    return _d("interpolate", (x,), {"size": tuple(size), "mode": mode,
+                                    "align_corners": bool(align_corners),
+                                    "data_format": data_format})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+register_op("label_smooth", lambda label, *, epsilon:
+            label * (1 - epsilon) + epsilon / label.shape[-1])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _d("label_smooth", (label,), {"epsilon": float(epsilon)})
+
+
+register_op("pixel_shuffle_op", lambda x, *, r:
+            _pixel_shuffle_impl(x, r))
+
+
+def _pixel_shuffle_impl(x, r):
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _d("pixel_shuffle_op", (x,), {"r": int(upscale_factor)})
+
+
+register_op("pixel_unshuffle_op", lambda x, *, r: _pixel_unshuffle_impl(x, r))
+
+
+def _pixel_unshuffle_impl(x, r):
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return jnp.reshape(x, (n, c * r * r, h // r, w // r))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _d("pixel_unshuffle_op", (x,), {"r": int(downscale_factor)})
+
+
+register_op("channel_shuffle_op", lambda x, *, groups:
+            _channel_shuffle_impl(x, groups))
+
+
+def _channel_shuffle_impl(x, groups):
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, groups, c // groups, h, w))
+    x = jnp.transpose(x, (0, 2, 1, 3, 4))
+    return jnp.reshape(x, (n, c, h, w))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _d("channel_shuffle_op", (x,), {"groups": int(groups)})
+
+
+def _unfold_impl(x, *, kernel, strides, paddings, dilations):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), strides, [(paddings[0], paddings[1]),
+                               (paddings[2], paddings[3])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, out_h, out_w] -> [N, C*kh*kw, L]
+    return jnp.reshape(patches, (n, c * kh * kw, -1))
+
+
+register_op("unfold", _unfold_impl)
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (int(v), int(v))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    if isinstance(paddings, int):
+        pads = [paddings] * 4
+    elif len(paddings) == 2:
+        pads = [paddings[0], paddings[0], paddings[1], paddings[1]]
+    else:
+        pads = list(paddings)
+    return _d("unfold", (x,), {"kernel": (kh, kw), "strides": (sh, sw),
+                               "paddings": tuple(pads), "dilations": (dh, dw)})
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    raise NotImplementedError("fold: planned (inverse of unfold)")

@@ -1,0 +1,174 @@
+"""Convolution functionals over jax.lax.conv_general_dilated (lowers straight
+to XLA convolution → TPU MXU). Parity: `python/paddle/nn/functional/conv.py`.
+Weight layout matches paddle: [out_c, in_c/groups, *kernel]."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _resolve_padding(padding, n, strides=None):
+    """Return XLA padding spec: 'SAME'/'VALID' or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[lo,hi],...] including batch/channel
+    if len(padding) == n + 2:
+        return [tuple(p) for p in padding[2:]]
+    raise ValueError(f"Bad padding spec {padding}")
+
+
+def _conv_impl(x, w, b, *, strides, padding, dilations, groups, dims, channel_last):
+    n = dims
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+        out_spec = lhs_spec
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n:]
+        out_spec = lhs_spec
+    rhs_spec = "OI" + "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        (lhs_spec, rhs_spec, out_spec))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+    if b is not None:
+        if channel_last:
+            out = out + b
+        else:
+            out = out + jnp.reshape(b, (1, -1) + (1,) * n)
+    return out
+
+
+register_op("conv_nd", _conv_impl, tags=("mxu",))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, dims,
+          data_format):
+    channel_last = data_format.endswith("C")
+    strides = _tuplize(stride, dims)
+    dilations = _tuplize(dilation, dims)
+    pad = _resolve_padding(padding, dims)
+    return _d("conv_nd", (x, weight, bias),
+              {"strides": strides, "padding": pad, "dilations": dilations,
+               "groups": int(groups), "dims": dims,
+               "channel_last": channel_last})
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose_impl(x, w, b, *, strides, padding, output_padding,
+                         dilations, groups, dims, channel_last):
+    """Transposed conv as a fractionally-strided forward conv:
+    lhs_dilation = stride, spatial-flipped + IO-swapped kernel, padding
+    dil*(k-1) - p (the standard deconv construction — output size matches
+    paddle's (in-1)*s - 2p + dil*(k-1) + 1 + output_padding)."""
+    n = dims
+    k_spatial = w.shape[2:]
+    pads = [(dilations[i] * (k_spatial[i] - 1) - padding[i][0],
+             dilations[i] * (k_spatial[i] - 1) - padding[i][1]
+             + output_padding[i]) for i in range(n)]
+    w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    if groups == 1:
+        w_t = jnp.swapaxes(w_flip, 0, 1)  # [I,O,*k] -> [O,I,*k]
+    else:
+        ic, og = w_flip.shape[0], w_flip.shape[1]
+        w_g = jnp.reshape(w_flip, (groups, ic // groups, og) + k_spatial)
+        w_g = jnp.swapaxes(w_g, 1, 2)  # [g, O/g, I/g, *k]
+        w_t = jnp.reshape(w_g, (groups * og, ic // groups) + k_spatial)
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    else:
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    rhs_spec = "OI" + "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w_t.shape,
+                                        (lhs_spec, rhs_spec, lhs_spec))
+    out = jax.lax.conv_general_dilated(
+        x, w_t, window_strides=(1,) * n, padding=pads,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    if b is not None:
+        if channel_last:
+            out = out + b
+        else:
+            out = out + jnp.reshape(b, (1, -1) + (1,) * n)
+    return out
+
+
+register_op("conv_transpose_nd", _conv_transpose_impl, tags=("mxu",))
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, dims, data_format, output_size=None):
+    channel_last = data_format.endswith("C")
+    strides = _tuplize(stride, dims)
+    dilations = _tuplize(dilation, dims)
+    out_pad = _tuplize(output_padding, dims)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose: use ints")
+    pad = _resolve_padding(padding, dims)
+    return _d("conv_transpose_nd", (x, weight, bias),
+              {"strides": strides, "padding": tuple(pad),
+               "output_padding": out_pad, "dilations": dilations,
+               "groups": int(groups), "dims": dims,
+               "channel_last": channel_last})
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size)

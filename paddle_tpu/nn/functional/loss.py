@@ -1,0 +1,283 @@
+"""Loss functionals. Parity: `python/paddle/nn/functional/loss.py`
+(cross_entropy is the reference's softmax_with_cross_entropy fused op —
+here one fused XLA expression with the same soft_label / ignore_index /
+label_smoothing semantics)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "cosine_embedding_loss", "hinge_embedding_loss", "square_error_cost",
+    "log_loss", "triplet_margin_loss", "sigmoid_focal_loss",
+]
+
+
+def _reduce_loss(loss_val, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss_val)
+    if reduction == "sum":
+        return jnp.sum(loss_val)
+    return loss_val
+
+
+def _ce_impl(logits, label, weight, *, soft_label, ignore_index, reduction,
+             axis, label_smoothing, use_softmax):
+    num_classes = logits.shape[axis]
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    if soft_label:
+        target = label
+        if label_smoothing > 0:
+            target = target * (1 - label_smoothing) + label_smoothing / num_classes
+        per = -jnp.sum(target * logp, axis=axis)
+        valid = None
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        valid = (lbl != ignore_index)
+        safe = jnp.where(valid, lbl, 0).astype(jnp.int32)
+        target = jax.nn.one_hot(safe, num_classes, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0:
+            target = target * (1 - label_smoothing) + label_smoothing / num_classes
+        per = -jnp.sum(target * logp, axis=axis)
+        if weight is not None:
+            per = per * jnp.take(weight, safe)
+        per = jnp.where(valid, per, 0.0)
+    if reduction == "mean":
+        if valid is not None:
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            if weight is not None:
+                denom = jnp.maximum(jnp.sum(
+                    jnp.where(valid, jnp.take(weight, safe), 0.0)), 1e-12)
+            return jnp.sum(per) / denom
+        return jnp.mean(per)
+    if reduction == "sum":
+        return jnp.sum(per)
+    return per
+
+
+register_op("cross_entropy", _ce_impl, tags=("fused",))
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1, use_softmax=True,
+                  label_smoothing=0.0, name=None):
+    return _d("cross_entropy", (input, label, weight),
+              {"soft_label": bool(soft_label), "ignore_index": int(ignore_index),
+               "reduction": reduction, "axis": int(axis),
+               "label_smoothing": float(label_smoothing),
+               "use_softmax": bool(use_softmax)})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+register_op("nll_loss", lambda logp, label, weight, *, ignore_index, reduction:
+            _nll_impl(logp, label, weight, ignore_index, reduction))
+
+
+def _nll_impl(logp, label, weight, ignore_index, reduction):
+    # logp: [N, C, *spatial], label: [N, *spatial] (paddle N-D semantics)
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0).astype(jnp.int32)
+    per = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+    per = jnp.squeeze(per, axis=1)
+    w = jnp.take(weight, safe) if weight is not None else 1.0
+    per = jnp.where(valid, per * w, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(jnp.where(valid, w, 0.0)) if weight is not None else \
+            jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+        return jnp.sum(per) / denom
+    return _reduce_loss(per, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+             name=None):
+    return _d("nll_loss", (input, label, weight),
+              {"ignore_index": int(ignore_index), "reduction": reduction})
+
+
+register_op("mse_loss", lambda x, y, *, reduction:
+            _reduce_loss(jnp.square(x - y), reduction))
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _d("mse_loss", (input, label), {"reduction": reduction})
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return _d("mse_loss", (input, label), {"reduction": "none"})
+
+
+register_op("l1_loss", lambda x, y, *, reduction:
+            _reduce_loss(jnp.abs(x - y), reduction))
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return _d("l1_loss", (input, label), {"reduction": reduction})
+
+
+register_op("smooth_l1_loss", lambda x, y, *, reduction, delta:
+            _reduce_loss(jnp.where(jnp.abs(x - y) < delta,
+                                   0.5 * jnp.square(x - y) / delta,
+                                   jnp.abs(x - y) - 0.5 * delta), reduction))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    return _d("smooth_l1_loss", (input, label),
+              {"reduction": reduction, "delta": float(delta)})
+
+
+register_op("bce", lambda x, y, w, *, reduction:
+            _reduce_loss((-(y * jnp.log(jnp.maximum(x, 1e-12))
+                            + (1 - y) * jnp.log(jnp.maximum(1 - x, 1e-12))))
+                         * (w if w is not None else 1.0), reduction))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    return _d("bce", (input, label, weight), {"reduction": reduction})
+
+
+def _bce_logits_impl(x, y, w, pos_w, *, reduction):
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_w is not None:
+        log_w = (pos_w - 1) * y + 1
+        loss = loss * log_w
+    if w is not None:
+        loss = loss * w
+    return _reduce_loss(loss, reduction)
+
+
+register_op("bce_with_logits", _bce_logits_impl)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    return _d("bce_with_logits", (logit, label, weight, pos_weight),
+              {"reduction": reduction})
+
+
+register_op("kl_div", lambda x, y, *, reduction, log_target:
+            _reduce_loss(jnp.exp(y) * (y - x) if log_target
+                         else y * (jnp.log(jnp.maximum(y, 1e-12)) - x),
+                         reduction))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    # paddle semantics: input is log-probabilities
+    out = _d("kl_div", (input, label), {"reduction": "none",
+                                        "log_target": bool(log_target)})
+    from ...ops import math as _math
+    if reduction == "mean":
+        return _math.mean(out)
+    if reduction == "sum":
+        return _math.sum(out)
+    if reduction == "batchmean":
+        return _math.sum(out) / out.shape[0]
+    return out
+
+
+register_op("margin_ranking_loss", lambda x1, x2, y, *, margin, reduction:
+            _reduce_loss(jnp.maximum(0.0, -y * (x1 - x2) + margin), reduction))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    return _d("margin_ranking_loss", (input, other, label),
+              {"margin": float(margin), "reduction": reduction})
+
+
+register_op("cosine_embedding_loss", lambda x1, x2, y, *, margin, reduction:
+            _cos_emb_impl(x1, x2, y, margin, reduction))
+
+
+def _cos_emb_impl(x1, x2, y, margin, reduction):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce_loss(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    return _d("cosine_embedding_loss", (input1, input2, label),
+              {"margin": float(margin), "reduction": reduction})
+
+
+register_op("hinge_embedding_loss", lambda x, y, *, margin, reduction:
+            _reduce_loss(jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)),
+                         reduction))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    return _d("hinge_embedding_loss", (input, label),
+              {"margin": float(margin), "reduction": reduction})
+
+
+register_op("log_loss", lambda pred, label, *, epsilon:
+            -label * jnp.log(pred + epsilon)
+            - (1 - label) * jnp.log(1 - pred + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return _d("log_loss", (input, label), {"epsilon": float(epsilon)})
+
+
+def _triplet_impl(a, p, n, *, margin, pnorm, reduction):
+    dp = jnp.linalg.norm(a - p, ord=pnorm, axis=-1)
+    dn = jnp.linalg.norm(a - n, ord=pnorm, axis=-1)
+    return _reduce_loss(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+
+register_op("triplet_margin_loss", _triplet_impl)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    return _d("triplet_margin_loss", (input, positive, negative),
+              {"margin": float(margin), "pnorm": p, "reduction": reduction})
+
+
+def _focal_impl(logit, label, norm, *, alpha, gamma, reduction):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if norm is not None:
+        loss = loss / norm
+    return _reduce_loss(loss, reduction)
+
+
+register_op("sigmoid_focal_loss", _focal_impl)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    return _d("sigmoid_focal_loss", (logit, label, normalizer),
+              {"alpha": float(alpha), "gamma": float(gamma),
+               "reduction": reduction})

@@ -1,0 +1,186 @@
+"""Normalization functionals. Parity: `python/paddle/nn/functional/norm.py`.
+
+layer_norm/rms_norm are single fused XLA expressions; on TPU the compiler
+fuses them with surrounding elementwise work (the role of the reference's
+`fused_layernorm_kernel.cu` / fused rmsnorm). batch_norm handles running-stat
+updates functionally — the Layer owns the buffers."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
+           "rms_norm", "local_response_norm"]
+
+
+def _layer_norm_impl(x, w, b, *, eps, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        out = out * w
+    if b is not None:
+        out = out + b
+    return out
+
+
+register_op("layer_norm", _layer_norm_impl, tags=("fused",))
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin_axis = x.ndim - len(normalized_shape)
+    return _d("layer_norm", (x, weight, bias),
+              {"eps": float(epsilon), "begin_axis": begin_axis})
+
+
+def _rms_norm_impl(x, w, *, eps, axis):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    out = (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    if w is not None:
+        out = out * w
+    return out
+
+
+register_op("rms_norm", _rms_norm_impl, tags=("fused",))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """RMSNorm (fused; reference ships it as incubate fused_rms_norm)."""
+    return _d("rms_norm", (x, weight), {"eps": float(epsilon),
+                                        "axis": int(axis)})
+
+
+def _bn_impl(x, w, b, mean, var, *, eps, channel_axis):
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    mean = jnp.reshape(mean, shape)
+    var = jnp.reshape(var, shape)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        out = out * jnp.reshape(w, shape)
+    if b is not None:
+        out = out + jnp.reshape(b, shape)
+    return out
+
+
+register_op("batch_norm_apply", _bn_impl, tags=("fused",))
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Functional BN.  In training mode computes batch stats, normalizes with
+    them, and updates the running buffers in place (paddle momentum semantics:
+    running = momentum*running + (1-momentum)*batch)."""
+    channel_axis = 1 if not data_format.endswith("C") else x.ndim - 1
+    use_batch_stats = training and not use_global_stats
+    if use_batch_stats:
+        from ...ops import math as _math, manipulation as _m
+        axes = [i for i in range(x.ndim) if i != channel_axis]
+        batch_mean = _math.mean(x, axis=axes)
+        diff = x - _m.reshape(batch_mean, [1 if i != channel_axis else -1
+                                           for i in range(x.ndim)])
+        batch_var = _math.mean(diff * diff, axis=axes)
+        out = _d("batch_norm_apply",
+                 (x, weight, bias, batch_mean, batch_var),
+                 {"eps": float(epsilon), "channel_axis": channel_axis})
+        # update running stats (unbiased var like the reference kernel)
+        n = int(np.prod([x.shape[i] for i in axes]))
+        unbias = n / max(n - 1, 1)
+        if running_mean is not None:
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * batch_mean._value)
+        if running_var is not None:
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * batch_var._value * unbias)
+        return out
+    return _d("batch_norm_apply",
+              (x, weight, bias, running_mean, running_var),
+              {"eps": float(epsilon), "channel_axis": channel_axis})
+
+
+def _instance_norm_impl(v, w, b, *, eps):
+    axes = tuple(range(2, v.ndim))
+    mean = jnp.mean(v, axis=axes, keepdims=True)
+    var = jnp.var(v, axis=axes, keepdims=True)
+    out = (v - mean) * jax.lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (v.ndim - 2)
+    if w is not None:
+        out = out * jnp.reshape(w, shape)
+    if b is not None:
+        out = out + jnp.reshape(b, shape)
+    return out
+
+
+register_op("instance_norm", _instance_norm_impl, tags=("fused",))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    return _d("instance_norm", (x, weight, bias), {"eps": float(eps)})
+
+
+def _group_norm_impl(v, w, b, *, groups, eps, channel_last):
+    if channel_last:
+        perm = (0, v.ndim - 1) + tuple(range(1, v.ndim - 1))
+        v = jnp.transpose(v, perm)
+    n, c = v.shape[0], v.shape[1]
+    spatial = v.shape[2:]
+    g = jnp.reshape(v, (n, groups, c // groups) + spatial)
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    g = (g - mean) * jax.lax.rsqrt(var + eps)
+    out = jnp.reshape(g, (n, c) + spatial)
+    shape = (1, -1) + (1,) * (out.ndim - 2)
+    if w is not None:
+        out = out * jnp.reshape(w, shape)
+    if b is not None:
+        out = out + jnp.reshape(b, shape)
+    if channel_last:
+        inv = (0,) + tuple(range(2, v.ndim)) + (1,)
+        out = jnp.transpose(out, inv)
+    return out
+
+
+register_op("group_norm", _group_norm_impl, tags=("fused",))
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    return _d("group_norm", (x, weight, bias),
+              {"groups": int(num_groups), "eps": float(epsilon),
+               "channel_last": data_format.endswith("C")})
+
+
+def _lrn_impl(v, *, size, alpha, beta, k):
+    sq = jnp.square(v)
+    half = size // 2
+    # sum over a window of channels (NCHW dim 1)
+    pads = [(0, 0)] * v.ndim
+    pads[1] = (half, size - 1 - half)
+    sq_pad = jnp.pad(sq, pads)
+    win = [1] * v.ndim
+    win[1] = size
+    acc = jax.lax.reduce_window(sq_pad, 0.0, jax.lax.add, tuple(win),
+                                (1,) * v.ndim, "VALID")
+    return v / jnp.power(k + alpha * acc, beta)
+
+
+register_op("local_response_norm", _lrn_impl)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _d("local_response_norm", (x,),
+              {"size": int(size), "alpha": float(alpha), "beta": float(beta),
+               "k": float(k)})

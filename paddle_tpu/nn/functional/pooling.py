@@ -1,0 +1,183 @@
+"""Pooling functionals via jax.lax.reduce_window.
+Parity: `python/paddle/nn/functional/pooling.py` (NCHW layouts)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+           "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+           "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "global_avg_pool"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool_impl(x, *, kind, kernel, strides, padding, dims, ceil_mode,
+               exclusive, channel_last):
+    n = dims
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        stride_full = (1,) + strides + (1,)
+        pad_full = ((0, 0),) + padding + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        stride_full = (1, 1) + strides
+        pad_full = ((0, 0), (0, 0)) + padding
+    if kind == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, stride_full,
+                                     pad_full)
+    # avg pool
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                                   window, stride_full, pad_full)
+    if exclusive and any(p != (0, 0) for p in pad_full):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                       stride_full, pad_full)
+        return summed / counts
+    return summed / float(np.prod(kernel))
+
+
+register_op("pool_nd", _pool_impl)
+
+
+def _pool(x, kind, kernel_size, stride, padding, dims, ceil_mode, exclusive,
+          data_format):
+    channel_last = data_format.endswith("C")
+    kernel = _tuplize(kernel_size, dims)
+    strides = _tuplize(stride if stride is not None else kernel_size, dims)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for pooling: use ints")
+    pad = _tuplize(padding, dims)
+    pairs = []
+    spatial_start = 1 if channel_last else 2
+    for i, p in enumerate(pad):
+        hi = p
+        if ceil_mode:
+            # pad the high side so the last partial window is kept
+            size = x.shape[spatial_start + i]
+            out_ceil = -(-(size + 2 * p - kernel[i]) // strides[i]) + 1
+            hi = max(p, (out_ceil - 1) * strides[i] + kernel[i] - size - p)
+        pairs.append((p, hi))
+    return _d("pool_nd", (x,), {"kind": kind, "kernel": kernel,
+                                "strides": strides, "padding": tuple(pairs),
+                                "dims": dims, "ceil_mode": bool(ceil_mode),
+                                "exclusive": bool(exclusive or ceil_mode),
+                                "channel_last": channel_last})
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 1, ceil_mode, True,
+                 data_format)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 2, ceil_mode, True,
+                 data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, 3, ceil_mode, True,
+                 data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 1, ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 2, ceil_mode,
+                 exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, 3, ceil_mode,
+                 exclusive, data_format)
+
+
+def _adaptive_pool_impl(x, *, kind, out_sizes, dims, channel_last):
+    # Split each spatial dim into out_size nearly-equal windows.  When the
+    # input divides evenly this is a reshape+reduce (fast XLA path).
+    start = 1 if channel_last else 2
+    out = x
+    for i, osz in enumerate(out_sizes):
+        axis = start + i
+        isz = out.shape[axis]
+        if isz % osz == 0:
+            k = isz // osz
+            shape = out.shape[:axis] + (osz, k) + out.shape[axis + 1:]
+            r = jnp.reshape(out, shape)
+            out = jnp.max(r, axis=axis + 1) if kind == "max" \
+                else jnp.mean(r, axis=axis + 1)
+        else:
+            # general case: gather per-window slices (sizes differ by ≤1)
+            bounds = [(int(np.floor(j * isz / osz)), int(np.ceil((j + 1) * isz / osz)))
+                      for j in range(osz)]
+            slices = []
+            for lo, hi in bounds:
+                sl = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
+                red = jnp.max(sl, axis=axis, keepdims=True) if kind == "max" \
+                    else jnp.mean(sl, axis=axis, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=axis)
+    return out
+
+
+register_op("adaptive_pool_nd", _adaptive_pool_impl)
+
+
+def _adaptive(x, kind, output_size, dims, data_format):
+    channel_last = data_format.endswith("C")
+    out_sizes = _tuplize(output_size, dims)
+    return _d("adaptive_pool_nd", (x,), {"kind": kind, "out_sizes": out_sizes,
+                                         "dims": dims,
+                                         "channel_last": channel_last})
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, "avg", output_size, 1, "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, "avg", output_size, 2, data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, "avg", output_size, 3, data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, "max", output_size, 1, "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, "max", output_size, 2, "NCHW")
+
+
+def global_avg_pool(x, data_format="NCHW"):
+    from ...ops.math import mean
+    axes = list(range(2, x.ndim)) if not data_format.endswith("C") \
+        else list(range(1, x.ndim - 1))
+    return mean(x, axis=axes, keepdim=True)

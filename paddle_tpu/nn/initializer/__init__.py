@@ -1,0 +1,210 @@
+"""Parameter initializers. Parity: `python/paddle/nn/initializer/`."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...framework.tensor import Tensor
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain", "set_global_initializer",
+]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c, *spatial] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity in gains:
+        return gains[nonlinearity]
+    raise ValueError(f"Unknown nonlinearity {nonlinearity}")
+
+
+class Initializer:
+    def __call__(self, param: Tensor, block=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        param._value = jnp.full(tuple(param.shape), self.value,
+                                param._value.dtype)
+        return param
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._value
+        param._value = jnp.asarray(np.asarray(v), param._value.dtype).reshape(
+            tuple(param.shape))
+        return param
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        eps = jax.random.normal(_random.next_key(), tuple(param.shape),
+                                jnp.float32)
+        param._value = (self.mean + self.std * eps).astype(param._value.dtype)
+        return param
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, param, block=None):
+        eps = jax.random.truncated_normal(_random.next_key(), self.a, self.b,
+                                          tuple(param.shape), jnp.float32)
+        param._value = (self.mean + self.std * eps).astype(param._value.dtype)
+        return param
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        u = jax.random.uniform(_random.next_key(), tuple(param.shape),
+                               jnp.float32, self.low, self.high)
+        param._value = u.astype(param._value.dtype)
+        return param
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std)(param)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fans(param.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit)(param)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else \
+            calculate_gain(self.nonlinearity)
+        std = gain / math.sqrt(fi)
+        return Normal(0.0, std)(param)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope: float = 0.0,
+                 nonlinearity: str = "relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fans(param.shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope) \
+            if self.nonlinearity == "leaky_relu" else \
+            calculate_gain(self.nonlinearity)
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit)(param)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = tuple(param.shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        a = jax.random.normal(_random.next_key(), (max(rows, cols),
+                                                   min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        param._value = (self.gain * q[:rows, :cols]).reshape(shape).astype(
+            param._value.dtype)
+        return param
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = tuple(param.shape)
+        v = np.zeros(shape, np.float32)
+        out_per_group = shape[0] // self.groups
+        centers = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                v[(g * out_per_group + i, i) + centers] = 1.0
+        param._value = jnp.asarray(v, param._value.dtype)
+        return param
+
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init

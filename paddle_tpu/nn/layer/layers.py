@@ -1,0 +1,328 @@
+"""Layer base class.
+
+Parity: `python/paddle/nn/layer/layers.py:332` (paddle.nn.Layer): parameter /
+buffer / sublayer registries via __setattr__, state_dict, hooks, train/eval,
+dtype/device movement, apply.  Parameters live as framework Parameters whose
+values are PJRT buffers; jit capture swaps their values for tracers.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dtypes as _dtypes
+from ...framework.tensor import Parameter, Tensor
+from .. import initializer as I
+
+__all__ = ["Layer"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: OrderedDict, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = _dtypes.convert_dtype(dtype) if dtype else None
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Optional[Tensor]]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._forward_pre_hooks: OrderedDict = OrderedDict()
+        self._forward_post_hooks: OrderedDict = OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------ registries
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            self._sub_layers.pop(name, None)
+            self._buffers.pop(name, None)
+            params[name] = value
+            object.__setattr__(self, name, value)
+            return
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            self._parameters.pop(name, None)
+            self._buffers.pop(name, None)
+            layers[name] = value
+            object.__setattr__(self, name, value)
+            return
+        bufs = self.__dict__.get("_buffers")
+        if bufs is not None and name in bufs:
+            if value is None or isinstance(value, Tensor):
+                bufs[name] = value
+        object.__setattr__(self, name, value)
+
+    def __delattr__(self, name):
+        self._parameters.pop(name, None)
+        self._sub_layers.pop(name, None)
+        self._buffers.pop(name, None)
+        object.__delattr__(self, name)
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        object.__setattr__(self, str(name), sublayer)
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is not None:
+            self._parameters[str(name)] = parameter
+        object.__setattr__(self, str(name), parameter)
+        return parameter
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[str(name)] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+        object.__setattr__(self, str(name), tensor)
+        return tensor
+
+    # ------------------------------------------------------- param creation
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        from ...param_attr import ParamAttr
+        dtype = _dtypes.convert_dtype(dtype) if dtype is not None else \
+            (self._dtype or _dtypes.get_default_dtype())
+        if attr is False:
+            return None
+        init = default_initializer
+        name = None
+        learning_rate = 1.0
+        trainable = True
+        if isinstance(attr, ParamAttr):
+            name = attr.name
+            learning_rate = attr.learning_rate
+            trainable = attr.trainable
+            if attr.initializer is not None:
+                init = attr.initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        p = Parameter(jnp.zeros(tuple(int(s) for s in shape), dtype), name=name,
+                      trainable=trainable)
+        p.optimize_attr["learning_rate"] = learning_rate
+        init(p)
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return Tensor(jnp.zeros((), _dtypes.convert_dtype(dtype)
+                                if dtype else jnp.float32))
+
+    # ------------------------------------------------------------ iteration
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (layer_prefix + pname, p)
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield (self._name_scope, prefix, self)
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                yield from sub._walk(prefix + name + ".", True)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        out = [self] if include_self else []
+        for _, sub in self.named_sublayers():
+            out.append(sub)
+        return out
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if include_self:
+            yield (prefix, self)
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = prefix + ("." if prefix else "") + name
+            yield (p, sub)
+            yield from sub.named_sublayers(p)
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self._sub_layers.items():
+            if sub is not None:
+                yield sub
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for _, layer_prefix, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (layer_prefix + bname, b)
+
+    # ------------------------------------------------------------ run modes
+    def train(self):
+        self.training = True
+        for sub in self.children():
+            sub.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for sub in self.children():
+            sub.eval()
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for sub in self.children():
+            sub.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # ------------------------------------------------------------ call
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"({name}): " + "\n  ".join(sub_repr))
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    # ------------------------------------------------------------ state
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True) -> Dict[str, Tensor]:
+        out = OrderedDict() if destination is None else destination
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for _, layer_prefix, layer in self._walk(structured_name_prefix, True):
+            for bname, b in layer._buffers.items():
+                if b is not None and bname not in \
+                        layer._non_persistable_buffer_names:
+                    out[layer_prefix + bname] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                if isinstance(v, Tensor):
+                    v = v._value
+                v = jnp.asarray(np.asarray(v))
+                if tuple(v.shape) != tuple(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: {v.shape} vs "
+                        f"{tuple(target.shape)}")
+                target._value = v.astype(target._value.dtype)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------ conversion
+    def _convert_dtype(self, dtype):
+        d = _dtypes.convert_dtype(dtype)
+        for p in self.parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._value = p._value.astype(d)
+        for b in self.buffers():
+            if b is not None and jnp.issubdtype(b._value.dtype, jnp.floating):
+                b._value = b._value.astype(d)
+        self._dtype = d
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._convert_dtype(dtype)
+        if device is not None:
+            from ...core.device import Place
+            if isinstance(device, str):
+                kind, _, idx = device.partition(":")
+                device = Place(kind, int(idx or 0))
+            for p in self.parameters():
+                p._value = jax.device_put(p._value, device.jax_device)
+            for b in self.buffers():
+                if b is not None:
+                    b._value = jax.device_put(b._value, device.jax_device)
+        return self
+
+    def astype(self, dtype):
+        return self._convert_dtype(dtype)
+
+    def float(self):
+        return self._convert_dtype("float32")
+
+    def half(self):
+        return self._convert_dtype("float16")
+
+    def bfloat16(self):
+        return self._convert_dtype("bfloat16")
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()

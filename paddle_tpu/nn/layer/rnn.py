@@ -1,0 +1,314 @@
+"""Recurrent layers. Parity: `python/paddle/nn/layer/rnn.py`.
+
+TPU-native design: the time loop is `jax.lax.scan` (compiles to one fused XLA
+while loop; no per-step dispatch), batch-major [B, T, *] like paddle's
+time_major=False default."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...ops.registry import dispatch as _d, register_op
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN"]
+
+
+def _rnn_scan_impl(x, h0, c0, params, *, mode, num_layers, bidirect, time_major):
+    """params: flat list per (layer, direction): [w_ih, w_hh, b_ih, b_hh]."""
+    if time_major:
+        x = jnp.swapaxes(x, 0, 1)  # -> [B, T, I]
+
+    def cell_step(mode, w_ih, w_hh, b_ih, b_hh, h, c, xt):
+        gates = xt @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+        if mode == "LSTM":
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        if mode == "GRU":
+            r, z, n = jnp.split(gates, 3, axis=-1)
+            # paddle/cudnn GRU: n = tanh(x W_n + r * (h U_n + b_hn))
+            xr = xt @ w_ih.T + b_ih
+            hr = h @ w_hh.T + b_hh
+            xr_r, xr_z, xr_n = jnp.split(xr, 3, axis=-1)
+            hr_r, hr_z, hr_n = jnp.split(hr, 3, axis=-1)
+            r = jax.nn.sigmoid(xr_r + hr_r)
+            z = jax.nn.sigmoid(xr_z + hr_z)
+            n = jnp.tanh(xr_n + r * hr_n)
+            h_new = (1 - z) * n + z * h
+            return h_new, c
+        h_new = jnp.tanh(gates)
+        return h_new, c
+
+    num_dirs = 2 if bidirect else 1
+    out = x
+    h_finals, c_finals = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(num_dirs):
+            pi = (layer * num_dirs + d) * 4
+            w_ih, w_hh, b_ih, b_hh = params[pi:pi + 4]
+            idx = layer * num_dirs + d
+            h_init = h0[idx]
+            c_init = c0[idx] if c0 is not None else jnp.zeros_like(h_init)
+            seq = out if d == 0 else jnp.flip(out, axis=1)
+            xs = jnp.swapaxes(seq, 0, 1)  # [T, B, I] for scan
+
+            def step(carry, xt, _w_ih=w_ih, _w_hh=w_hh, _b_ih=b_ih,
+                     _b_hh=b_hh):
+                h, c = carry
+                h2, c2 = cell_step(mode, _w_ih, _w_hh, _b_ih, _b_hh, h, c, xt)
+                return (h2, c2), h2
+
+            (hf, cf), ys = jax.lax.scan(step, (h_init, c_init), xs)
+            ys = jnp.swapaxes(ys, 0, 1)  # [B, T, H]
+            if d == 1:
+                ys = jnp.flip(ys, axis=1)
+            dir_outs.append(ys)
+            h_finals.append(hf)
+            c_finals.append(cf)
+        out = dir_outs[0] if num_dirs == 1 else jnp.concatenate(dir_outs, -1)
+    h_out = jnp.stack(h_finals, axis=0)
+    c_out = jnp.stack(c_finals, axis=0)
+    if time_major:
+        out = jnp.swapaxes(out, 0, 1)
+    if mode == "LSTM":
+        return out, h_out, c_out
+    return out, h_out
+
+
+register_op("rnn_scan", _rnn_scan_impl)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1}[mode]
+        num_dirs = 2 if self.bidirect else 1
+        self._param_names = []
+        std = 1.0 / np.sqrt(hidden_size)
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_size = input_size if layer == 0 else hidden_size * num_dirs
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                names = [f"weight_ih{sfx}", f"weight_hh{sfx}",
+                         f"bias_ih{sfx}", f"bias_hh{sfx}"]
+                shapes = [[gate_mult * hidden_size, in_size],
+                          [gate_mult * hidden_size, hidden_size],
+                          [gate_mult * hidden_size], [gate_mult * hidden_size]]
+                for n, s in zip(names, shapes):
+                    p = self.create_parameter(
+                        s, default_initializer=I.Uniform(-std, std))
+                    self.add_parameter(n, p)
+                self._param_names.append(names)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_axis = 1 if self.time_major else 0
+        b = inputs.shape[batch_axis]
+        num_dirs = 2 if self.bidirect else 1
+        n_states = self.num_layers * num_dirs
+        if initial_states is None:
+            from ...ops.creation import zeros
+            h0 = zeros([n_states, b, self.hidden_size], dtype=inputs.dtype)
+            c0 = zeros([n_states, b, self.hidden_size], dtype=inputs.dtype) \
+                if self.mode == "LSTM" else None
+        else:
+            if self.mode == "LSTM":
+                h0, c0 = initial_states
+            else:
+                h0, c0 = initial_states, None
+        params = []
+        for names in self._param_names:
+            params.extend(getattr(self, n) for n in names)
+        res = _d("rnn_scan", (inputs, h0, c0, params),
+                 {"mode": self.mode, "num_layers": self.num_layers,
+                  "bidirect": self.bidirect, "time_major": self.time_major})
+        if self.mode == "LSTM":
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN_TANH", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class _CellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value,
+                    dtype=dtype or "float32")
+
+
+class SimpleRNNCell(_CellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [hidden_size], is_bias=True, default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [hidden_size], is_bias=True, default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops import linalg, math as _math
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = linalg.matmul(inputs, self.weight_ih, transpose_y=True) + \
+            linalg.matmul(states, self.weight_hh, transpose_y=True) + \
+            self.bias_ih + self.bias_hh
+        h = _math.tanh(h)
+        return h, h
+
+
+class LSTMCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+        res = _d("lstm_cell", (inputs, h, c, self.weight_ih, self.weight_hh,
+                               self.bias_ih, self.bias_hh), {})
+        h2, c2 = res
+        return h2, (h2, c2)
+
+
+def _lstm_cell_impl(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+register_op("lstm_cell", _lstm_cell_impl)
+
+
+class GRUCell(_CellBase):
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size],
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size],
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        res = _d("gru_cell", (inputs, states, self.weight_ih, self.weight_hh,
+                              self.bias_ih, self.bias_hh), {})
+        return res, res
+
+
+def _gru_cell_impl(x, h, w_ih, w_hh, b_ih, b_hh):
+    xr = x @ w_ih.T + b_ih
+    hr = h @ w_hh.T + b_hh
+    xr_r, xr_z, xr_n = jnp.split(xr, 3, axis=-1)
+    hr_r, hr_z, hr_n = jnp.split(hr, 3, axis=-1)
+    r = jax.nn.sigmoid(xr_r + hr_r)
+    z = jax.nn.sigmoid(xr_z + hr_z)
+    n = jnp.tanh(xr_n + r * hr_n)
+    return (1 - z) * n + z * h
+
+
+register_op("gru_cell", _gru_cell_impl)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager python loop (jit capture unrolls; fine for small T)
+        from ...ops import manipulation as _m
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for t in order:
+            xt = _m.squeeze(_m.slice(inputs, [t_axis], [t], [t + 1]), t_axis)
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out = _m.stack(outs, axis=t_axis)
+        return out, states

@@ -1,0 +1,53 @@
+"""Pallas TPU kernels (flash attention etc.).
+
+Role of the reference's hand-fused CUDA kernels
+(`phi/kernels/gpu/flash_attn_kernel.cu`, `fusion/gpu/fused_rope_kernel.cu`,
+`fused_layernorm_kernel.cu`): ops XLA won't fuse optimally get hand-written
+TPU kernels.  Each kernel has an XLA fallback so the same model code runs on
+the CPU test mesh.
+
+Availability gating: kernels require a real TPU backend and MXU-friendly
+shapes (head_dim multiple of 128 preferred); otherwise callers fall back.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flash_attention", "flash_attention_available"]
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention_available(q, k, v, mask=None) -> bool:
+    if mask is not None:
+        return False
+    if not _on_tpu():
+        return False
+    head_dim = q.shape[-1]
+    seq = q.shape[1]
+    # block sizes need seq multiple of 128 and head_dim in MXU-friendly range
+    return head_dim % 128 == 0 and seq % 128 == 0
+
+
+def flash_attention(q, k, v, causal=False, dropout_p=0.0):
+    """Pallas flash-attention (forward); falls back to fused XLA if the
+    kernel can't apply.  Dropout inside the kernel is not yet supported —
+    callers pass dropout_p=0 or use the XLA path."""
+    from ..nn.functional.attention import sdpa_xla
+    if dropout_p > 0.0 or not flash_attention_available(q, k, v):
+        return sdpa_xla(q, k, v, None, dropout_p, causal, None, True)
+    try:
+        from .pallas_flash import flash_attention_fwd
+    except ImportError:
+        return sdpa_xla(q, k, v, None, 0.0, causal, None, True)
+    return flash_attention_fwd(q, k, v, causal=causal)

@@ -1,0 +1,3 @@
+from . import lr  # noqa: F401
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
+                        Momentum, Optimizer, RMSProp, SGD)

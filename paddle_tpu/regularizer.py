@@ -1,0 +1,21 @@
+"""Weight-decay regularizers. Parity: `python/paddle/regularizer.py`."""
+
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"

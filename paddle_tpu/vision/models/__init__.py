@@ -1,0 +1,7 @@
+from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152, resnext50_32x4d, resnext101_32x4d,
+                     wide_resnet50_2, wide_resnet101_2)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401

@@ -1,0 +1,158 @@
+"""Vision transforms (numpy host-side). Parity: `python/paddle/vision/transforms/`."""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "Pad", "to_tensor", "normalize"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+def to_tensor(pic, data_format="CHW"):
+    raw = np.asarray(pic)
+    arr = raw.astype(np.float32)
+    if arr.ndim == 2:
+        arr = arr[None] if data_format == "CHW" else arr[..., None]
+    elif arr.ndim == 3 and data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+        arr = arr.transpose(2, 0, 1)
+    if raw.dtype == np.uint8:  # keyed on dtype, not pixel values
+        arr = arr / 255.0
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, pic):
+        return to_tensor(pic, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        img = np.asarray(img._value)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return Tensor((img - mean) / std)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        # nearest-neighbor host resize (cheap; bilinear on device via F.interpolate)
+        ih, iw = arr.shape[0], arr.shape[1]
+        ridx = (np.arange(h) * ih / h).astype(int)
+        cidx = (np.arange(w) * iw / w).astype(int)
+        return arr[ridx][:, cidx]
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        ih, iw = arr.shape[0], arr.shape[1]
+        top = (ih - h) // 2
+        left = (iw - w) // 2
+        return arr[top:top + h, left:left + w]
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            pads = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pads)
+        h, w = self.size
+        ih, iw = arr.shape[0], arr.shape[1]
+        top = np.random.randint(0, ih - h + 1)
+        left = np.random.randint(0, iw - w + 1)
+        return arr[top:top + h, left:left + w]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return np.asarray(img)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        p = self.padding
+        if isinstance(p, int):
+            pads = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+        else:
+            pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+        return np.pad(arr, pads)

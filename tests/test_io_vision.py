@@ -1,0 +1,141 @@
+"""io DataLoader + vision models tests; gate 1 (MNIST LeNet e2e)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, Subset, TensorDataset, random_split)
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet, resnet18
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batching():
+    dl = DataLoader(SquareDataset(), batch_size=8)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [8, 1]
+    assert y.numpy()[3, 0] == 9.0
+
+
+def test_dataloader_drop_last_and_shuffle():
+    dl = DataLoader(SquareDataset(), batch_size=8, drop_last=True, shuffle=True)
+    assert len(dl) == 2
+    seen = set()
+    for x, _ in dl:
+        seen.update(int(v) for v in x.numpy().ravel())
+    assert len(seen) == 16
+
+
+def test_dataloader_threaded_prefetch():
+    dl = DataLoader(SquareDataset(), batch_size=4, num_workers=2)
+    xs = [x for x, _ in dl]
+    assert sum(x.shape[0] for x in xs) == 20
+
+
+def test_iterable_dataset():
+    class Stream(IterableDataset):
+        def __iter__(self):
+            for i in range(10):
+                yield np.float32([i])
+
+    dl = DataLoader(Stream(), batch_size=4)
+    batches = list(dl)
+    assert [b.shape[0] for b in batches] == [4, 4, 2]
+
+
+def test_tensor_dataset_subset_split():
+    td = TensorDataset([paddle.arange(10), paddle.arange(10) * 2])
+    a, b = td[3]
+    assert int(a.item()) == 3 and int(b.item()) == 6
+    sub = Subset(td, [1, 2])
+    assert len(sub) == 2
+    tr, va = random_split(td, [8, 2])
+    assert len(tr) == 8 and len(va) == 2
+
+
+def test_distributed_batch_sampler_shards():
+    ds = SquareDataset(20)
+    s0 = DistributedBatchSampler(ds, 4, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, 4, num_replicas=2, rank=1)
+    idx0 = [i for b in s0 for i in b]
+    idx1 = [i for b in s1 for i in b]
+    assert len(idx0) == len(idx1) == 10
+    assert not (set(idx0) & set(idx1))
+
+
+def test_collate_nested_dict():
+    class D(Dataset):
+        def __getitem__(self, i):
+            return {"a": np.float32([i]), "b": i}
+
+        def __len__(self):
+            return 4
+
+    batch = next(iter(DataLoader(D(), batch_size=4)))
+    assert batch["a"].shape == [4, 1]
+    assert batch["b"].shape == [4]
+
+
+def test_lenet_mnist_gate1():
+    """BASELINE config 1: MNIST LeNet converges in eager mode."""
+    paddle.seed(42)
+    train = MNIST(mode="train", synthetic_size=512)
+    loader = DataLoader(train, batch_size=128, shuffle=True, drop_last=True)
+    model = LeNet()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    acc = 0.0
+    for epoch in range(4):
+        correct = total = 0
+        for imgs, labels in loader:
+            loss = loss_fn(model(imgs), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for imgs, labels in loader:
+            pred = paddle.argmax(model(imgs), axis=1)
+            correct += int((pred == labels).astype("int32").sum().item())
+            total += labels.shape[0]
+        acc = correct / total
+        if acc > 0.95:
+            break
+    assert acc > 0.9, f"LeNet failed to learn: acc={acc}"
+
+
+def test_resnet18_forward_backward():
+    model = resnet18(num_classes=10)
+    x = paddle.randn([2, 3, 32, 32])
+    out = model(x)
+    assert out.shape == [2, 10]
+    out.sum().backward()
+    assert model.conv1.weight.grad is not None
+
+
+def test_vision_model_shapes():
+    from paddle_tpu.vision.models import LeNet, mobilenet_v2
+    assert LeNet()(paddle.randn([1, 1, 28, 28])).shape == [1, 10]
+
+
+def test_transforms():
+    from paddle_tpu.vision import transforms as T
+    t = T.Compose([T.ToTensor(), T.Normalize(mean=[0.5], std=[0.5],
+                                             data_format="CHW")])
+    img = np.random.randint(0, 255, (28, 28), np.uint8)
+    out = t(img)
+    assert out.shape == [1, 28, 28]
+    assert float(out.numpy().min()) >= -1.001

@@ -1,0 +1,276 @@
+"""nn.Layer + layer zoo tests (mirrors test/legacy_test layer tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_layer_registries():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+            self.w = paddle.Parameter(paddle.ones([2])._value)
+            self.register_buffer("buf", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "w" in names and "fc.weight" in names and "fc.bias" in names
+    assert len(net.parameters()) == 3
+    assert len(net.buffers()) == 1
+    sd = net.state_dict()
+    assert "buf" in sd and "fc.weight" in sd
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+
+
+def test_dropout_train_vs_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    out = d(x)
+    assert 0 < float((out == 0).astype("float32").mean().item()) < 1
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_forward_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h1 = lin.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+    h2 = lin.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+    lin(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    calls.clear()
+    lin(paddle.ones([1, 2]))
+    assert calls == []
+
+
+def test_linear_matches_numpy():
+    lin = nn.Linear(3, 2)
+    x = np.random.rand(4, 3).astype(np.float32)
+    ref = x @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(lin(paddle.to_tensor(x)).numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_matches_reference_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    out = conv(paddle.randn([2, 3, 17, 17]))
+    assert out.shape == [2, 8, 9, 9]
+    g = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+    assert g(paddle.randn([1, 4, 8, 8])).shape == [1, 8, 8, 8]
+
+
+def test_conv2d_grad_flows():
+    conv = nn.Conv2D(1, 2, 3)
+    out = conv(paddle.randn([1, 1, 5, 5]))
+    out.sum().backward()
+    assert conv.weight.grad is not None
+    assert conv.bias.grad is not None
+
+
+def test_conv_transpose_shape():
+    convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1, output_padding=1)
+    assert convt(paddle.randn([1, 4, 8, 8])).shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_stats_and_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.5)
+    x = paddle.randn([8, 3, 4, 4]) * 2 + 1
+    out = bn(x)
+    # normalized output ~ zero mean unit var per channel
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    assert bn._mean.numpy().any()
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 3, 4, 4]
+
+
+def test_layernorm_matches_numpy():
+    ln = nn.LayerNorm(8)
+    x = np.random.rand(2, 4, 8).astype(np.float32)
+    out = ln(paddle.to_tensor(x)).numpy()
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = np.random.rand(2, 8).astype(np.float32)
+    out = rn(paddle.to_tensor(x)).numpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+def test_groupnorm():
+    gn = nn.GroupNorm(2, 4)
+    out = gn(paddle.randn([2, 4, 3, 3]))
+    assert out.shape == [2, 4, 3, 3]
+
+
+def test_embedding_padding_idx_grad():
+    emb = nn.Embedding(5, 3, padding_idx=0)
+    out = emb(paddle.to_tensor([[0, 1]]))
+    assert float(out[0, 0].abs().sum().item()) == 0.0
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_pools():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D((2, 2))(x).shape == [1, 2, 2, 2]
+    x2 = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    out = nn.MaxPool2D(2)(paddle.to_tensor(x2)).numpy()
+    ref = x2.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, ref)
+
+
+def test_activations_shapes():
+    x = paddle.randn([3, 3])
+    for layer in [nn.ReLU(), nn.GELU(), nn.Sigmoid(), nn.Tanh(), nn.Silu(),
+                  nn.LeakyReLU(), nn.ELU(), nn.Softmax(), nn.LogSoftmax(),
+                  nn.Hardswish(), nn.Mish(), nn.SELU()]:
+        assert layer(x).shape == [3, 3]
+
+
+def test_softmax_values():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    out = F.softmax(paddle.to_tensor(x)).numpy()
+    e = np.exp(x - x.max())
+    np.testing.assert_allclose(out, e / e.sum(), rtol=1e-5)
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(("a", nn.Linear(2, 2)), ("b", nn.ReLU()))
+    assert seq["a"] is seq[0]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll)) == 4
+
+
+def test_mha_self_attention_causal_consistency():
+    mha = nn.MultiHeadAttention(8, 2)
+    x = paddle.randn([2, 4, 8])
+    out = mha(x)
+    assert out.shape == [2, 4, 8]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder_decoder():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 3, 16]
+
+
+def test_lstm_gradients():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 6, 4])
+    out, (h, c) = lstm(x)
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_losses():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([0, 1, 2, 3])
+    l1 = nn.CrossEntropyLoss()(logits, labels)
+    assert l1.shape == []
+    # ignore_index
+    labels2 = paddle.to_tensor([0, -100, 2, -100])
+    l2 = nn.CrossEntropyLoss(ignore_index=-100)(logits, labels2)
+    assert np.isfinite(l2.item())
+    # soft label
+    soft = F.softmax(paddle.randn([4, 5]))
+    l3 = nn.CrossEntropyLoss(soft_label=True)(logits, soft)
+    assert np.isfinite(l3.item())
+    # label smoothing
+    l4 = nn.CrossEntropyLoss(label_smoothing=0.1)(logits, labels)
+    assert np.isfinite(l4.item())
+    x, y = paddle.randn([3, 3]), paddle.randn([3, 3])
+    assert nn.MSELoss()(x, y).shape == []
+    assert nn.L1Loss()(x, y).shape == []
+    p = F.sigmoid(x)
+    t = (y > 0).astype("float32")
+    assert np.isfinite(nn.BCELoss()(p, t).item())
+    assert np.isfinite(nn.BCEWithLogitsLoss()(x, t).item())
+    assert np.isfinite(nn.SmoothL1Loss()(x, y).item())
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.rand(6, 4).astype(np.float32)
+    labels = np.array([0, 1, 2, 3, 0, 1])
+    out = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels)).item()
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    ref = -np.log(p[np.arange(6), labels]).mean()
+    assert abs(out - ref) < 1e-5
+
+
+def test_clip_grad_by_global_norm():
+    p1 = paddle.Parameter(paddle.ones([2])._value)
+    p2 = paddle.Parameter(paddle.ones([3])._value)
+    g1 = paddle.full([2], 3.0)
+    g2 = paddle.full([3], 4.0)
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_state_dict_roundtrip_nested():
+    m1 = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4), nn.Linear(4, 2))
+    x = paddle.randn([5, 3])
+    m1.eval()
+    ref = m1(x).numpy()
+    m2 = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4), nn.Linear(4, 2))
+    m2.eval()
+    missing, unexpected = m2.set_state_dict(m1.state_dict())
+    assert not missing and not unexpected
+    np.testing.assert_allclose(m2(x).numpy(), ref, rtol=1e-5)
+
+
+def test_layer_to_dtype():
+    lin = nn.Linear(2, 2)
+    lin.bfloat16()
+    assert str(lin.weight.dtype) == "bfloat16"
+    lin.float()
+    assert str(lin.weight.dtype) == "float32"
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+    p = paddle.Parameter(paddle.zeros([100, 100])._value)
+    I.XavierNormal()(p)
+    std = p.numpy().std()
+    assert 0.05 < std < 0.25
+    I.Constant(3.0)(p)
+    assert (p.numpy() == 3.0).all()
+    I.Uniform(-0.5, 0.5)(p)
+    assert -0.5 <= p.numpy().min() and p.numpy().max() <= 0.5
+    I.Orthogonal()(p)
+    q = p.numpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(100), atol=1e-4)

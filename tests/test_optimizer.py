@@ -1,0 +1,160 @@
+"""Optimizer + LR scheduler tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _train(opt_cls, steps=60, **kw):
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = opt_cls(parameters=net.parameters(), **kw)
+    X = paddle.to_tensor(np.random.RandomState(0).rand(32, 4).astype("float32"))
+    Y = X.sum(axis=1, keepdim=True)
+    loss = None
+    for _ in range(steps):
+        loss = nn.MSELoss()(net(X), Y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss.item())
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (optimizer.Adam, dict(learning_rate=0.05)),
+    (optimizer.AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+    (optimizer.RMSProp, dict(learning_rate=0.01)),
+    (optimizer.Adagrad, dict(learning_rate=0.3)),
+    (optimizer.Adamax, dict(learning_rate=0.1)),
+    (optimizer.Lamb, dict(learning_rate=0.1)),
+])
+def test_optimizers_converge(cls, kw):
+    assert _train(cls, **kw) < 0.2
+
+
+def test_sgd_matches_manual():
+    p = paddle.Parameter(np.array([1.0, 2.0], np.float32))
+    p.grad = paddle.to_tensor([0.5, 0.5])
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.95, 1.95], rtol=1e-6)
+
+
+def test_adam_bias_correction_first_step():
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    p.grad = paddle.to_tensor([0.1])
+    opt = optimizer.Adam(learning_rate=0.001, parameters=[p])
+    opt.step()
+    # first step of Adam moves by ~lr regardless of grad magnitude
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.001], rtol=1e-3)
+
+
+def test_adamw_decoupled_decay():
+    p = paddle.Parameter(np.array([10.0], np.float32))
+    p.grad = paddle.to_tensor([0.0])
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+    opt.step()
+    # pure decay: w -= lr * wd * w
+    np.testing.assert_allclose(p.numpy(), [10.0 - 0.1 * 0.5 * 10.0], rtol=1e-5)
+
+
+def test_param_groups():
+    a = paddle.Parameter(np.ones(2, np.float32))
+    b = paddle.Parameter(np.ones(2, np.float32))
+    a.grad = paddle.to_tensor([1.0, 1.0])
+    b.grad = paddle.to_tensor([1.0, 1.0])
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[
+        {"params": [a]},
+        {"params": [b], "learning_rate": 0.1},  # 0.1 * base lr
+    ])
+    opt.step()
+    np.testing.assert_allclose(a.numpy(), [0.9, 0.9], rtol=1e-6)
+    np.testing.assert_allclose(b.numpy(), [0.99, 0.99], rtol=1e-5)
+
+
+def test_multi_precision_master_weights():
+    p = paddle.Parameter(np.ones(4, np.float32))
+    p._value = p._value.astype("bfloat16")
+    p.grad = paddle.to_tensor(np.full(4, 1e-3, np.float32))
+    opt = optimizer.SGD(learning_rate=0.01, parameters=[p],
+                        multi_precision=True)
+    for _ in range(10):
+        p.grad = paddle.to_tensor(np.full(4, 1e-3, np.float32))
+        opt.step()
+    # master accumulates small updates that bf16 alone would lose
+    mw = opt._accumulators["master_weight"][id(p)]
+    np.testing.assert_allclose(np.asarray(mw), np.full(4, 1 - 1e-4), rtol=1e-4)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = paddle.Parameter(np.ones(2, np.float32))
+    p.grad = paddle.to_tensor([1.0, 1.0])
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    opt2.set_state_dict(sd)
+    assert opt2._global_step == 1
+    np.testing.assert_allclose(
+        np.asarray(opt2._accumulators["moment1"][id(p)]),
+        np.asarray(opt._accumulators["moment1"][id(p)]))
+
+
+def test_lr_scheduler_with_optimizer():
+    sched = optimizer.lr.MultiStepDecay(0.1, milestones=[2, 4], gamma=0.1)
+    p = paddle.Parameter(np.ones(1, np.float32))
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    lrs = []
+    for _ in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.01, 0.01, 0.001], rtol=1e-6)
+
+
+@pytest.mark.parametrize("sched_fn,expected0", [
+    (lambda: optimizer.lr.ExponentialDecay(1.0, 0.5), 1.0),
+    (lambda: optimizer.lr.StepDecay(1.0, 2, 0.5), 1.0),
+    (lambda: optimizer.lr.CosineAnnealingDecay(1.0, 10), 1.0),
+    (lambda: optimizer.lr.PolynomialDecay(1.0, 10), 1.0),
+    (lambda: optimizer.lr.LinearWarmup(1.0, 5, 0.0, 1.0), 0.0),
+    (lambda: optimizer.lr.NoamDecay(64, 100), None),
+    (lambda: optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001]), 0.1),
+    (lambda: optimizer.lr.InverseTimeDecay(1.0, 0.5), 1.0),
+    (lambda: optimizer.lr.LambdaDecay(1.0, lambda e: 0.9 ** e), 1.0),
+    (lambda: optimizer.lr.OneCycleLR(1.0, 10), None),
+    (lambda: optimizer.lr.CyclicLR(0.1, 1.0, 5), None),
+])
+def test_schedulers_run(sched_fn, expected0):
+    s = sched_fn()
+    if expected0 is not None:
+        assert abs(s() - expected0) < 1e-6
+    for _ in range(12):
+        s.step()
+    assert np.isfinite(s())
+
+
+def test_reduce_on_plateau():
+    s = optimizer.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    for v in [1.0, 1.0, 1.0, 1.0]:
+        s.step(v)
+    assert s() == 0.5
+
+
+def test_cosine_decay_reaches_min():
+    s = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10, eta_min=0.1)
+    for _ in range(10):
+        s.step()
+    np.testing.assert_allclose(s(), 0.1, atol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    p = paddle.Parameter(np.zeros(2, np.float32))
+    p.grad = paddle.to_tensor([30.0, 40.0])  # norm 50
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                        grad_clip=nn.ClipGradByGlobalNorm(5.0))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [-3.0, -4.0], rtol=1e-5)
