@@ -59,4 +59,6 @@ from . import vision  # noqa: F401,E402
 from . import regularizer  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402
+from . import jit  # noqa: F401,E402
+from . import amp  # noqa: F401,E402
 

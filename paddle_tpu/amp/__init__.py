@@ -1,0 +1,4 @@
+from .auto_cast import (amp_guard, auto_cast, decorate,  # noqa: F401
+                        FP16_WHITE_LIST, FP16_BLACK_LIST)
+from .grad_scaler import GradScaler  # noqa: F401
+from . import debugging  # noqa: F401

@@ -1,0 +1,156 @@
+"""AMP autocast.
+
+Parity: `python/paddle/amp/auto_cast.py:359` amp_guard + `amp/amp_lists.py`
+O1/O2 lists.  TPU-native: the default low-precision dtype is bfloat16 (no
+loss scaling needed; fp16 also supported).  Casting happens at the dispatch
+layer via the hook installed into ops/registry.py — the same interception
+point as the reference's generated `ad_func` AMP block
+(`multiply_fwd_func.cc:54` GetAmpDestDtype/AmpAutoCast).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core import dtypes as _dtypes
+from ..ops import registry as _registry
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "FP16_WHITE_LIST",
+           "FP16_BLACK_LIST"]
+
+# O1 white list: MXU-bound ops where low precision wins (ref amp_lists.py
+# white_list: conv2d, matmul, mul, ...)
+FP16_WHITE_LIST: Set[str] = {
+    "matmul", "bmm", "mv", "mm", "linear", "conv_nd", "conv_transpose_nd",
+    "einsum", "addmm", "multi_dot", "sdpa", "lstm_cell", "gru_cell",
+    "rnn_scan",
+}
+
+# O1 black list: precision-sensitive ops kept in fp32 (ref black_list:
+# exp, log, softmax, cross_entropy, layer_norm-ish reductions ...)
+FP16_BLACK_LIST: Set[str] = {
+    "exp", "expm1", "log", "log2", "log10", "log1p", "pow", "square",
+    "reciprocal", "rsqrt", "softmax", "log_softmax", "cross_entropy",
+    "bce", "bce_with_logits", "nll_loss", "kl_div", "cumsum", "cumprod",
+    "logsumexp", "p_norm", "layer_norm", "rms_norm", "group_norm",
+    "instance_norm", "batch_norm_apply", "mse_loss", "l1_loss",
+    "sigmoid_focal_loss", "softmax_with_cross_entropy", "erfinv", "cosh",
+    "sinh", "atanh", "acosh", "asinh", "tan", "sum", "mean", "std", "var",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.bfloat16
+        self.white = FP16_WHITE_LIST
+        self.black = FP16_BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def _hook(op_name: str, vals):
+    if not _state.enabled:
+        return None
+    if op_name in _state.black:
+        # black-listed ops compute in fp32: promote low-precision float inputs
+        for v in vals:
+            if hasattr(v, "dtype") and v.dtype in (jnp.float16, jnp.bfloat16):
+                return jnp.float32
+        return None
+    if _state.level == "O2" or op_name in _state.white:
+        return _state.dtype
+    return None
+
+
+class auto_cast:
+    """Context manager enabling autocast. paddle.amp.auto_cast parity."""
+
+    def __init__(self, enable: bool = True, custom_white_list=None,
+                 custom_black_list=None, level: str = "O1",
+                 dtype: str = "bfloat16", use_promote: bool = True):
+        if level not in ("O0", "O1", "O2"):
+            raise ValueError(f"level must be O0/O1/O2, got {level}")
+        self._enable = enable and level != "O0"
+        self._level = level
+        self._dtype = _dtypes.convert_dtype(dtype)
+        if self._dtype not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)):
+            raise ValueError("amp dtype must be float16 or bfloat16")
+        self._white = set(FP16_WHITE_LIST)
+        self._black = set(FP16_BLACK_LIST)
+        if custom_white_list:
+            self._white |= set(custom_white_list)
+            self._black -= set(custom_white_list)
+        if custom_black_list:
+            self._black |= set(custom_black_list)
+            self._white -= set(custom_black_list)
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_state.enabled, _state.level, _state.dtype,
+                       _state.white, _state.black)
+        _state.enabled = self._enable
+        _state.level = self._level
+        _state.dtype = jnp.bfloat16 if self._dtype == jnp.dtype(jnp.bfloat16) \
+            else jnp.float16
+        _state.white = self._white
+        _state.black = self._black
+        _registry.set_autocast_hook(_hook if self._enable else None)
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.level, _state.dtype, _state.white,
+         _state.black) = self._saved
+        _registry.set_autocast_hook(_hook if _state.enabled else None)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def _cast_model_keep_norms(layer, dtype):
+    """O2 cast that keeps normalization layers in fp32 (reference
+    `amp/auto_cast.py` decorate keeps BN/LN fp32 — bf16 running-stat EMA
+    loses low-order bits every step)."""
+    from ..nn.layer.norm import (GroupNorm, LayerNorm, RMSNorm,
+                                 _BatchNormBase, _InstanceNormBase)
+    norm_types = (_BatchNormBase, LayerNorm, GroupNorm, RMSNorm,
+                  _InstanceNormBase)
+    for sub in layer.sublayers(include_self=True):
+        if isinstance(sub, norm_types):
+            continue
+        d = _dtypes.convert_dtype(dtype)
+        for p in sub._parameters.values():
+            if p is not None and jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._value = p._value.astype(d)
+        for b in sub._buffers.values():
+            if b is not None and jnp.issubdtype(b._value.dtype, jnp.floating):
+                b._value = b._value.astype(d)
+    return layer
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: O2 casts model params to the AMP dtype (norm
+    layers stay fp32) and turns on master weights in the optimizer."""
+    from ..nn import Layer
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            _cast_model_keep_norms(m, dtype)
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+        if single_model and optimizers is not None:
+            return models, optimizers
+        return model_list, opt_list
+    return models if single_model else model_list

@@ -1,0 +1,74 @@
+"""AMP debugging utilities. Parity: `python/paddle/amp/debugging.py`
+(check_numerics `:338`, nan/inf tracking via FLAGS_check_nan_inf)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .. import flags as _flags
+from ..framework.tensor import Tensor
+
+__all__ = ["check_numerics", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "DebugMode", "enable_tensor_checker", "disable_tensor_checker"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None,
+                   stack_height_limit=1):
+    """Scan a tensor for nan/inf; raises (mode 0) or warns (mode 1)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(v.dtype, jnp.floating):
+        return tensor
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    if n_nan or n_inf:
+        msg = (f"check_numerics: op={op_type!r} var={var_name!r} has "
+               f"{n_nan} NaN and {n_inf} Inf values")
+        if debug_mode in (None, DebugMode.CHECK_NAN_INF_AND_ABORT):
+            raise FloatingPointError(msg)
+        import warnings
+        warnings.warn(msg)
+    return tensor
+
+
+def enable_tensor_checker():
+    _flags.set_flags({"check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"check_nan_inf": False})
+
+
+_op_stats = {}
+
+
+def enable_operator_stats_collection():
+    from ..ops import registry as _registry
+    _op_stats.clear()
+    _registry._op_stats_sink = _op_stats
+
+
+def disable_operator_stats_collection():
+    from ..ops import registry as _registry
+    _registry._op_stats_sink = None
+    if _op_stats:
+        print("<{:-^60}>".format(" op list "))
+        for name, count in sorted(_op_stats.items(), key=lambda x: -x[1]):
+            print(f"  {name:<40} calls: {count}")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

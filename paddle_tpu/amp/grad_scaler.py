@@ -1,0 +1,118 @@
+"""Dynamic loss scaling. Parity: `python/paddle/amp/grad_scaler.py:619`
+GradScaler with found_inf plumbing.
+
+On TPU bf16 training rarely needs scaling (exponent range == fp32), so
+`enable=False` is the common path; the full fp16 machinery is provided for
+parity and for fp16 models."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 65536.0,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 2000,
+                 decr_every_n_nan_or_inf: int = 1, use_dynamic_loss_scaling:
+                 bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    is_use_dynamic_loss_scaling = is_enable
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops.math import scale as _scale_op
+        return _scale_op(var, scale=self._scale)
+
+    def _unscale_and_check(self, optimizer):
+        """Divide grads by scale; detect nan/inf (found_inf plumbing)."""
+        found = jnp.zeros((), jnp.bool_)
+        params = optimizer._parameter_list
+        inv = 1.0 / self._scale
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._value * inv
+            found = found | jnp.any(~jnp.isfinite(g))
+            p.grad._value = g
+        self._found_inf = bool(found)
+        return self._found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        # don't unscale twice when the user already called unscale_()
+        # (the unscale_ -> clip -> step recipe)
+        if not self._already_unscaled:
+            self._unscale_and_check(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._already_unscaled = False
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        if scaled_loss._grad_node is not None:
+            scaled_loss.backward()
+        self.step(optimizer)
+
+    def unscale_(self, optimizer):
+        if self._enable:
+            self._unscale_and_check(optimizer)
+            self._already_unscaled = True
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale = self._scale * self._incr_ratio
+                self._good_steps = 0
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+
+AmpScaler = GradScaler

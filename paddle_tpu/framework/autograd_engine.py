@@ -196,6 +196,14 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
                     res = hook(g)
                     if res is not None:
                         g = res
+                # AMP: a consumer computing in fp32 sends fp32 cotangents to a
+                # low-precision producer — cast to the node's output dtype
+                meta = node.out_meta[i]
+                if g is not None and meta is not None and \
+                        hasattr(g, "dtype") and g.dtype != meta[1] and \
+                        jnp.issubdtype(meta[1], jnp.floating) and \
+                        g.dtype != jax.dtypes.float0:
+                    g = g.astype(meta[1])
                 out_grads.append(g)
 
             in_grads = node.apply(out_grads)

@@ -1,0 +1,1 @@
+from .api import StaticFunction, ignore_module, not_to_static, to_static  # noqa: F401

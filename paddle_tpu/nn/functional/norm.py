@@ -92,15 +92,24 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         out = _d("batch_norm_apply",
                  (x, weight, bias, batch_mean, batch_var),
                  {"eps": float(epsilon), "channel_axis": channel_axis})
-        # update running stats (unbiased var like the reference kernel)
+        # update running stats (unbiased var like the reference kernel);
+        # expressed through dispatched Tensor ops so jit capture records the
+        # buffers as program state (not baked constants)
+        from ...framework.dygraph import no_grad
         n = int(np.prod([x.shape[i] for i in axes]))
         unbias = n / max(n - 1, 1)
-        if running_mean is not None:
-            running_mean._value = (momentum * running_mean._value
-                                   + (1 - momentum) * batch_mean._value)
-        if running_var is not None:
-            running_var._value = (momentum * running_var._value
-                                  + (1 - momentum) * batch_var._value * unbias)
+        with no_grad():
+            if running_mean is not None:
+                new_mean = running_mean * momentum + batch_mean * (1 - momentum)
+                # keep the buffer's dtype: autocast must not drift fp32
+                # running stats to bf16
+                running_mean._value = new_mean._value.astype(
+                    running_mean._value.dtype)
+            if running_var is not None:
+                new_var = running_var * momentum + \
+                    batch_var * ((1 - momentum) * unbias)
+                running_var._value = new_var._value.astype(
+                    running_var._value.dtype)
         return out
     return _d("batch_norm_apply",
               (x, weight, bias, running_mean, running_var),

@@ -53,10 +53,31 @@ _OPS: Dict[str, OpDef] = {}
 # Hook installed by paddle_tpu.amp: (op_name, dtypes) -> target dtype or None.
 _autocast_hook: Optional[Callable] = None
 
+# Hook installed by paddle_tpu.jit during the state-discovery pass: receives
+# the list of leaf Tensors feeding each op so capture can lift concrete
+# tensors (params, buffers) into program inputs.
+_trace_recorder: Optional[Callable] = None
+
 
 def set_autocast_hook(fn: Optional[Callable]) -> None:
     global _autocast_hook
     _autocast_hook = fn
+
+
+_trace_out_recorder: Optional[Callable] = None
+
+# Sink dict for per-op call counting (amp.debugging.collect_operator_stats).
+_op_stats_sink: Optional[Dict[str, int]] = None
+
+
+def set_trace_recorder(fn: Optional[Callable]) -> None:
+    global _trace_recorder
+    _trace_recorder = fn
+
+
+def set_trace_out_recorder(fn: Optional[Callable]) -> None:
+    global _trace_out_recorder
+    _trace_out_recorder = fn
 
 
 def register_op(name: str, fwd: Callable, custom_vjp: Optional[Callable] = None,
@@ -133,7 +154,11 @@ def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
     """Execute one op eagerly with autograd tracking."""
     if op is None:
         op = _OPS[name]
+    if _op_stats_sink is not None:
+        _op_stats_sink[name] = _op_stats_sink.get(name, 0) + 1
     vals, leaves, treedef = _flatten_inputs(diff_inputs)
+    if _trace_recorder is not None:
+        _trace_recorder(leaves)
     vals, _ = _autocast_vals(name, vals)
 
     requires_grad = is_grad_enabled() and any(
@@ -152,6 +177,8 @@ def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
         if _flags.get_flag("check_nan_inf"):
             _check_nan_inf(name, outs_t)
         wrapped = tuple(Tensor._wrap(o, stop_gradient=True) for o in outs_t)
+        if _trace_out_recorder is not None:
+            _trace_out_recorder(wrapped)
         return wrapped if multi else wrapped[0]
 
     if op.custom_vjp is not None:
@@ -182,6 +209,8 @@ def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
         w._grad_node = node
         w._output_slot = i
         wrapped.append(w)
+    if _trace_out_recorder is not None:
+        _trace_out_recorder(wrapped)
     return tuple(wrapped) if multi else wrapped[0]
 
 

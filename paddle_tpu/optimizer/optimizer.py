@@ -24,6 +24,46 @@ from .lr import LRScheduler
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
            "RMSProp", "Adadelta", "Adamax", "Lamb"]
 
+import weakref
+
+# live-optimizer registry consumed by jit capture (paddle_tpu/jit/api.py):
+# optimizer accumulators/step counters become captured-program state.
+_optimizer_registry: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _live_optimizers():
+    return list(_optimizer_registry)
+
+
+def _donation_safe() -> bool:
+    """Donation must be off while the jit state-discovery pass records
+    pre-step buffer references for rollback."""
+    from ..ops import registry as _registry
+    return _registry._trace_recorder is None
+
+
+def _instance_update(opt, rule, value, grad, master, states, lr, wd, step):
+    """Shared jitted-apply path for optimizers with per-instance rules."""
+    donate = _donation_safe()
+    cache = getattr(opt, "_rule_jits", None)
+    if cache is None:
+        cache = opt._rule_jits = {}
+    jitted = cache.get(donate)
+    if jitted is None:
+        def apply(value, grad, master, states, lr, wd, step):
+            work = master if master is not None else value
+            grad = grad.astype(work.dtype)
+            new_work, new_states = rule(work, grad, states, lr, wd, step)
+            if master is not None:
+                return new_work.astype(value.dtype), new_work, new_states
+            return new_work, None, new_states
+        jitted = cache[donate] = jax.jit(
+            apply, static_argnames=("wd",),
+            donate_argnums=(0, 2, 3) if donate else ())
+    return jitted(value, grad, master, states,
+                  jnp.asarray(lr, jnp.float32), wd,
+                  jnp.asarray(step, jnp.float32))
+
 
 class Optimizer:
     _update_rule: Callable = None  # set by subclasses
@@ -44,6 +84,8 @@ class Optimizer:
         self._accumulators: Dict[str, Dict[int, jax.Array]] = defaultdict(dict)
         self._global_step = 0
         self._aux_hooks: List[Callable] = []
+        self._lr_override = None  # traced LR installed during jit capture
+        _optimizer_registry.add(self)
 
     @staticmethod
     def _wd_value(weight_decay):
@@ -78,6 +120,8 @@ class Optimizer:
 
     # ------------------------------------------------------------ lr
     def get_lr(self) -> float:
+        if self._lr_override is not None:
+            return self._lr_override
         if isinstance(self._lr, LRScheduler):
             return self._lr()
         return float(self._lr)
@@ -157,15 +201,17 @@ class Optimizer:
 
     def _update(self, value, grad, master, states, lr, wd, step):
         """Dispatch into the jitted rule; scalars ride as traced args so one
-        executable serves every step and LR schedule value."""
-        rule = type(self)._jitted_rule()
+        executable serves every step and LR schedule value.  Donation updates
+        param/state buffers in place in HBM except during jit state-discovery
+        (the recorder holds references for rollback)."""
+        rule = type(self)._jitted_rule(donate=_donation_safe())
         lr = jnp.asarray(lr, jnp.float32)
         step = jnp.asarray(step, jnp.float32)
         return rule(value, grad, master, states, lr, wd, step)
 
     @classmethod
     @functools.cache
-    def _jitted_rule(cls):
+    def _jitted_rule(cls, donate: bool = False):
         def apply(value, grad, master, states, lr, wd, step):
             work = master if master is not None else value
             grad = grad.astype(work.dtype)
@@ -174,7 +220,8 @@ class Optimizer:
             if master is not None:
                 return new_work.astype(value.dtype), new_work, new_states
             return new_work, None, new_states
-        return jax.jit(apply, static_argnames=("wd",), donate_argnums=(0, 2, 3))
+        return jax.jit(apply, static_argnames=("wd",),
+                       donate_argnums=(0, 2, 3) if donate else ())
 
     # ------------------------------------------------------------ misc
     def clear_grad(self, set_to_zero: bool = True):
@@ -261,21 +308,8 @@ class Momentum(Optimizer):
         self.__rule_jit = None
 
     def _update(self, value, grad, master, states, lr, wd, step):
-        if self.__rule_jit is None:
-            rule = self._update_rule.__func__
-
-            def apply(value, grad, master, states, lr, wd, step):
-                work = master if master is not None else value
-                grad = grad.astype(work.dtype)
-                new_work, new_states = rule(work, grad, states, lr, wd, step)
-                if master is not None:
-                    return new_work.astype(value.dtype), new_work, new_states
-                return new_work, None, new_states
-            self.__rule_jit = jax.jit(apply, static_argnames=("wd",),
-                                      donate_argnums=(0, 2, 3))
-        return self.__rule_jit(value, grad, master, states,
-                               jnp.asarray(lr, jnp.float32), wd,
-                               jnp.asarray(step, jnp.float32))
+        return _instance_update(self, self._update_rule.__func__, value, grad,
+                                master, states, lr, wd, step)
 
 
 class _AdamBase(Optimizer):
@@ -317,21 +351,8 @@ class _AdamBase(Optimizer):
         super()._apply_one(p, grad, lr, wd, l1)
 
     def _update(self, value, grad, master, states, lr, wd, step):
-        if self._rule_jit is None:
-            rule = self._rule
-
-            def apply(value, grad, master, states, lr, wd, step):
-                work = master if master is not None else value
-                grad = grad.astype(work.dtype)
-                new_work, new_states = rule(work, grad, states, lr, wd, step)
-                if master is not None:
-                    return new_work.astype(value.dtype), new_work, new_states
-                return new_work, None, new_states
-            self._rule_jit = jax.jit(apply, static_argnames=("wd",),
-                                     donate_argnums=(0, 2, 3))
-        return self._rule_jit(value, grad, master, states,
-                              jnp.asarray(lr, jnp.float32), wd,
-                              jnp.asarray(step, jnp.float32))
+        return _instance_update(self, self._rule, value, grad, master, states,
+                                lr, wd, step)
 
 
 class Adam(_AdamBase):

@@ -1,0 +1,137 @@
+"""AMP autocast + GradScaler tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+
+
+def test_autocast_o1_white_list():
+    x = paddle.randn([4, 4])
+    y = paddle.randn([4, 4])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, y)  # white-listed -> bf16
+        s = paddle.exp(out)        # black-listed -> back to fp32
+    assert str(out.dtype) == "bfloat16"
+    assert str(s.dtype) == "float32"
+    out2 = paddle.matmul(x, y)
+    assert str(out2.dtype) == "float32"  # outside ctx
+
+
+def test_autocast_o2_casts_most():
+    x = paddle.randn([4, 4])
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        out = x + x
+    assert str(out.dtype) == "bfloat16"
+
+
+def test_autocast_custom_lists():
+    x = paddle.randn([2, 2])
+    with amp.auto_cast(custom_white_list={"add"}, level="O1"):
+        out = x + x
+    assert str(out.dtype) == "bfloat16"
+    with amp.auto_cast(custom_black_list={"matmul"}, level="O1"):
+        out = paddle.matmul(x, x)
+    assert str(out.dtype) == "float32"
+
+
+def test_autocast_grads_fp32():
+    w = paddle.Parameter(np.random.rand(4, 4).astype(np.float32))
+    x = paddle.randn([2, 4])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(x, w)
+        loss = out.sum()
+    loss.backward()
+    # grads flow back to the fp32 master param in fp32
+    assert str(w.grad.dtype) == "float32"
+
+
+def test_amp_training_converges():
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    X = paddle.to_tensor(np.random.RandomState(0).rand(32, 4).astype("float32"))
+    Y = X.sum(axis=1, keepdim=True)
+    for _ in range(60):
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = nn.MSELoss()(net(X), Y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.item()) < 0.1
+
+
+def test_grad_scaler_scales_and_unscales():
+    p = paddle.Parameter(np.ones(2, np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=128.0)
+    loss = (p * paddle.to_tensor([1.0, 1.0])).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled.item()) == float(loss.item()) * 128.0
+    scaled.backward()
+    scaler.step(opt)
+    # after unscale, effective grad is 1.0 -> p = 1 - 0.1
+    np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-6)
+
+
+def test_grad_scaler_skips_on_inf():
+    p = paddle.Parameter(np.ones(1, np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    p.grad = paddle.to_tensor([np.inf])
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [1.0])  # skipped
+    assert scaler._scale == 2.0  # decreased
+
+
+def test_grad_scaler_dynamic_increase():
+    scaler = amp.GradScaler(init_loss_scaling=2.0, incr_every_n_steps=2)
+    p = paddle.Parameter(np.ones(1, np.float32))
+    opt = optimizer.SGD(learning_rate=0.0, parameters=[p])
+    for _ in range(2):
+        p.grad = paddle.to_tensor([1.0])
+        scaler.step(opt)
+    assert scaler._scale == 4.0
+
+
+def test_decorate_o2():
+    net = nn.Linear(4, 4)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
+    net, opt = amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    assert str(net.weight.dtype) == "bfloat16"
+    assert opt._multi_precision
+
+
+def test_check_numerics():
+    with pytest.raises(FloatingPointError):
+        amp.debugging.check_numerics(paddle.to_tensor([np.nan]), "op", "x")
+    amp.debugging.check_numerics(paddle.to_tensor([1.0]), "op", "x")
+
+
+def test_collect_operator_stats(capsys):
+    with amp.debugging.collect_operator_stats():
+        paddle.ones([2]) + paddle.ones([2])
+    out = capsys.readouterr().out
+    assert "add" in out
+
+
+def test_unscale_then_step_no_double_unscale():
+    p = paddle.Parameter(np.ones(1, np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=100.0)
+    loss = (p * 1.0).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(p.grad.numpy(), [1.0], rtol=1e-6)
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), [0.0], atol=1e-6)
+
+
+def test_decorate_keeps_norm_layers_fp32():
+    net = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+    opt = optimizer.SGD(0.1, parameters=net.parameters())
+    net, opt = amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    assert str(net[0].weight.dtype) == "bfloat16"
+    assert str(net[1].weight.dtype) == "float32"
+    assert str(net[1]._mean.dtype) == "float32"

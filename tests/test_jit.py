@@ -1,0 +1,185 @@
+"""jit.to_static whole-graph capture tests (gate 2: compiled == eager)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_inference_capture_matches_eager():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    static = paddle.jit.to_static(lambda x: net(x))
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(static(x).numpy(), net(x).numpy(), rtol=1e-5)
+
+
+def test_param_update_reflected():
+    net = nn.Linear(2, 2)
+    static = paddle.jit.to_static(lambda x: net(x))
+    x = paddle.ones([1, 2])
+    _ = static(x)
+    net.weight._value = net.weight._value * 0.0
+    net.bias._value = net.bias._value * 0.0
+    np.testing.assert_allclose(static(x).numpy(), np.zeros((1, 2)), atol=1e-7)
+
+
+def test_full_train_step_capture_parity():
+    """Gate 2: compiled train step (fwd+bwd+Adam) == eager bit-for-bit-ish."""
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+        opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+        return net, opt
+
+    X = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype("float32"))
+    Y = X.sum(axis=1, keepdim=True)
+    loss_fn = nn.MSELoss()
+
+    net_c, opt_c = build()
+
+    def train_step(x, y):
+        loss = loss_fn(net_c(x), y)
+        loss.backward()
+        opt_c.step()
+        opt_c.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(train_step)
+    compiled_losses = [float(step(X, Y).item()) for _ in range(50)]
+
+    net_e, opt_e = build()
+    eager_losses = []
+    for _ in range(50):
+        loss = loss_fn(net_e(X), Y)
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        eager_losses.append(float(loss.item()))
+
+    np.testing.assert_allclose(compiled_losses[-1], eager_losses[-1],
+                               rtol=1e-3, atol=1e-6)
+    assert compiled_losses[-1] < 0.05
+
+
+def test_bn_buffers_update_in_capture():
+    net = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+    static = paddle.jit.to_static(lambda x: net(x))
+    x = paddle.randn([8, 4])
+    before = net[1]._mean.numpy().copy()
+    static(x)
+    static(x)
+    assert not np.allclose(before, net[1]._mean.numpy())
+
+
+def test_rng_varies_per_call():
+    d = nn.Dropout(0.5)
+    static = paddle.jit.to_static(lambda x: d(x))
+    a = static(paddle.ones([200])).numpy()
+    b = static(paddle.ones([200])).numpy()
+    assert not np.array_equal(a, b)
+
+
+def test_retrace_on_shape_change():
+    net = nn.Linear(4, 2)
+    static = paddle.jit.to_static(lambda x: net(x))
+    assert static(paddle.ones([2, 4])).shape == [2, 2]
+    assert static(paddle.ones([5, 4])).shape == [5, 2]
+    assert len(static._cache) == 2
+
+
+def test_lr_schedule_inside_capture():
+    sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    p = paddle.Parameter(np.ones(1, np.float32))
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+
+    def s(x):
+        (p * x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        return x
+
+    ss = paddle.jit.to_static(s)
+    ss(paddle.ones([1]))
+    v1 = p.numpy()[0]
+    sched.step()
+    ss(paddle.ones([1]))
+    v2 = p.numpy()[0]
+    assert abs((1 - v1) - 0.1) < 1e-6
+    assert abs((v1 - v2) - 0.01) < 1e-6
+    assert opt._lr_override is None
+
+
+def test_grads_surface_without_clear():
+    q = paddle.Parameter(np.ones(2, np.float32))
+
+    def fwd_bwd(x):
+        (q * x).sum().backward()
+        return x
+
+    fb = paddle.jit.to_static(fwd_bwd)
+    fb(paddle.to_tensor([2.0, 3.0]))
+    np.testing.assert_allclose(q.grad.numpy(), [2.0, 3.0])
+
+
+def test_to_static_on_layer():
+    net = nn.Linear(3, 3)
+    ref = None
+    x = paddle.ones([1, 3])
+    ref = net(x).numpy()
+    net = paddle.jit.to_static(net)
+    np.testing.assert_allclose(net(x).numpy(), ref, rtol=1e-6)
+    assert isinstance(net.forward, paddle.jit.StaticFunction)
+
+
+def test_capture_with_kwargs_and_pytree_out():
+    net = nn.Linear(2, 2)
+
+    def f(x, scale=1.0):
+        out = net(x)
+        return {"out": out, "sum": out.sum()}
+
+    sf = paddle.jit.to_static(f)
+    res = sf(paddle.ones([1, 2]), scale=2.0)
+    assert set(res) == {"out", "sum"}
+    assert res["out"].shape == [1, 2]
+
+
+def test_compiled_multi_precision_train_step():
+    """Regression: master weights must start from param values, not zeros."""
+    from paddle_tpu import amp
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    net, opt = amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    X = paddle.to_tensor(np.random.RandomState(0).rand(16, 4).astype("float32"))
+    Y = X.sum(axis=1, keepdim=True)
+
+    def ts(x, y):
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = paddle.jit.to_static(ts)
+    l0 = float(step(X, Y).item())
+    l = l0
+    for _ in range(100):
+        l = float(step(X, Y).item())
+    assert np.isfinite(l) and l < l0 * 0.5
+
+
+def test_arg_tensor_grads_surface():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    w = paddle.Parameter(np.array([3.0, 4.0], np.float32))
+
+    def saliency(inp):
+        (inp * w).sum().backward()
+        return inp
+
+    sal = paddle.jit.to_static(saliency)
+    sal(x)
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
