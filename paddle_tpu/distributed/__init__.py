@@ -1,5 +1,30 @@
-"""paddle_tpu.distributed — built up across collective/fleet/auto_parallel.
-Parity target: `python/paddle/distributed/`."""
+"""paddle_tpu.distributed — collectives, fleet hybrid parallel, semi-auto
+parallel. Parity target: `python/paddle/distributed/`."""
 
 from . import env  # noqa: F401
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from . import mesh  # noqa: F401
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa: F401
+                         all_reduce, alltoall, alltoall_single, axis_context,
+                         barrier, broadcast, destroy_process_group, gather,
+                         get_group, irecv, is_initialized, isend, new_group,
+                         recv, reduce, reduce_scatter, scatter, send, stream,
+                         wait)
+from .parallel import DataParallel, init_parallel_env, shard_batch  # noqa: F401
+from . import fleet  # noqa: F401
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate,  # noqa: F401
+                            Shard, dtensor_from_fn, reshard, shard_layer,
+                            shard_optimizer, shard_tensor, unshard_dtensor)
+from . import sharding  # noqa: F401
+
+
+def get_mesh():
+    return mesh.get_mesh()
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Single-program SPMD makes per-process spawning unnecessary on TPU;
+    multi-host launch goes through paddle_tpu.distributed.launch."""
+    raise NotImplementedError(
+        "spawn: use `python -m paddle_tpu.distributed.launch` for multi-host;"
+        " single-host parallelism is SPMD over the device mesh")

@@ -1,0 +1,4 @@
+from .api import (dtensor_from_fn, reshard, shard_layer, shard_optimizer,  # noqa: F401
+                  shard_tensor, to_static, unshard_dtensor)
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import ProcessMesh  # noqa: F401

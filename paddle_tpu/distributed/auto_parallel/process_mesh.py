@@ -1,0 +1,88 @@
+"""ProcessMesh. Parity: `python/paddle/distributed/auto_parallel/
+process_mesh.py` / C++ `phi/core/distributed/auto_parallel/process_mesh.h`.
+
+Wraps (and can create) the global jax Mesh; `dim_names` become mesh axis
+names used by placements."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .. import mesh as _mesh
+
+__all__ = ["ProcessMesh"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.reshape(-1).tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def processes(self):
+        return self._process_ids
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, dim, process_id):
+        idx = self._process_ids.index(process_id)
+        coord = np.unravel_index(idx, tuple(self._shape))
+        return int(coord[self._dim_names.index(dim)] if isinstance(dim, str)
+                   else coord[dim])
+
+    def get_mesh_with_dim(self, dim_name):
+        return self
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def jax_mesh(self) -> Mesh:
+        """Materialize as a jax Mesh over the actual devices."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            picked = [devices[i % len(devices)] for i in self._process_ids]
+            arr = np.asarray(picked).reshape(tuple(self._shape))
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids),
+                     tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")

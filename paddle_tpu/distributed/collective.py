@@ -1,0 +1,423 @@
+"""Groups + functional collectives.
+
+Parity: `python/paddle/distributed/communication/` (all_reduce `:20`,
+group.py:22 Group) and the C++ ProcessGroup hierarchy
+(`fluid/distributed/collective/process_group.h:47`).
+
+TPU-native semantics: a Group names a mesh axis (or a sub-axis set).
+Collectives have two execution modes:
+
+* **inside shard_map / pipeline code** (an axis context is active): lower to
+  `jax.lax.psum/all_gather/ppermute/all_to_all` over the named axis — these
+  compile to ICI collectives;
+* **eager on global arrays**: values are jax Arrays laid out over the global
+  mesh; an all_reduce over axis X means "reduce the X-sharded/partial data",
+  executed as a tiny cached jitted program.  With world_size==1 / no mesh the
+  ops degrade to paddle's single-rank no-op semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.registry import dispatch as _d, register_op
+from . import mesh as _mesh
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "is_initialized",
+           "all_reduce", "all_gather", "all_gather_object", "reduce",
+           "reduce_scatter", "alltoall", "alltoall_single", "broadcast",
+           "scatter", "gather", "send", "recv", "isend", "irecv", "barrier",
+           "axis_context", "current_axis_for", "wait", "stream",
+           "destroy_process_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a named mesh axis (TPU-native ring)."""
+
+    _counter = 0
+
+    def __init__(self, axis: Optional[str] = None, ranks: Optional[List[int]] = None,
+                 gid: Optional[int] = None):
+        Group._counter += 1
+        self.id = gid if gid is not None else Group._counter
+        self.axis = axis
+        self._ranks = ranks
+
+    @property
+    def nranks(self) -> int:
+        if self.axis is not None:
+            return _mesh.axis_size(self.axis)
+        if self._ranks:
+            return len(self._ranks)
+        from .env import get_world_size
+        return get_world_size()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def ranks(self):
+        if self._ranks is not None:
+            return self._ranks
+        return list(range(self.nranks))
+
+    def get_group_rank(self, global_rank: int) -> int:
+        if self._ranks is not None and global_rank in self._ranks:
+            return self._ranks.index(global_rank)
+        return global_rank % max(self.nranks, 1)
+
+    @property
+    def rank(self):
+        from .env import get_rank
+        return self.get_group_rank(get_rank())
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis}, nranks={self.nranks})"
+
+
+_groups = {}
+_default_group: Optional[Group] = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        axes = _mesh.mesh_axes()
+        _default_group = Group(axis=axes[0] if len(axes) == 1 else None, gid=0)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis=None) -> Group:
+    g = Group(axis=axis, ranks=list(ranks) if ranks is not None else None)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_default_group()
+    return _groups[gid]
+
+
+def is_initialized() -> bool:
+    from . import env
+    return env.is_initialized()
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+    _groups.clear()
+
+
+# ------------------------------------------------------------ axis context
+# Active named axes (inside shard_map'd pipeline/parallel code). paddle's
+# ring-id plumbing is replaced by this stack.
+_axis_state = threading.local()
+
+
+class axis_context:
+    """Marks named mesh axes as live (code runs under shard_map over them)."""
+
+    def __init__(self, *axes: str):
+        self.axes = axes
+
+    def __enter__(self):
+        stack = getattr(_axis_state, "stack", None)
+        if stack is None:
+            stack = _axis_state.stack = []
+        stack.append(self.axes)
+        return self
+
+    def __exit__(self, *exc):
+        _axis_state.stack.pop()
+        return False
+
+
+def _active_axes() -> tuple:
+    stack = getattr(_axis_state, "stack", None)
+    out = ()
+    for axes in (stack or []):
+        out += axes
+    return out
+
+
+def current_axis_for(group: Optional[Group]) -> Optional[str]:
+    """Resolve which live named axis a collective over `group` targets."""
+    group = group or _get_default_group()
+    active = _active_axes()
+    if group.axis is not None and group.axis in active:
+        return group.axis
+    if group.axis is None and len(active) == 1:
+        return active[0]
+    return None
+
+
+# ------------------------------------------------------------ primitives
+_REDUCERS = {
+    ReduceOp.SUM: lambda x, ax: jax.lax.psum(x, ax),
+    ReduceOp.MAX: lambda x, ax: jax.lax.pmax(x, ax),
+    ReduceOp.MIN: lambda x, ax: jax.lax.pmin(x, ax),
+    ReduceOp.PROD: lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)),
+    ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
+}
+
+register_op("c_allreduce", lambda x, *, op, axis: _REDUCERS[op](x, axis))
+register_op("c_allgather", lambda x, *, axis, tiled:
+            jax.lax.all_gather(x, axis, tiled=tiled))
+register_op("c_reducescatter", lambda x, *, op, axis:
+            jax.lax.psum_scatter(x, axis, tiled=True))
+register_op("c_alltoall", lambda x, *, axis, split_axis, concat_axis:
+            jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                               concat_axis=concat_axis, tiled=True))
+register_op("c_ppermute", lambda x, *, axis, perm:
+            jax.lax.ppermute(x, axis, perm))
+register_op("c_broadcast_in_axis", lambda x, *, axis, src:
+            _broadcast_impl(x, axis, src))
+register_op("c_axis_index", lambda x, *, axis: jax.lax.axis_index(axis) + x * 0)
+
+
+def _broadcast_impl(x, axis, src):
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis)
+
+
+def _single_rank(group: Optional[Group]) -> bool:
+    group = group or _get_default_group()
+    return group.nranks <= 1
+
+
+# ------------------------------------------------------------ functional API
+def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """In-place all-reduce (paddle semantics: mutates `tensor`)."""
+    axis = current_axis_for(group)
+    if axis is not None:
+        out = _d("c_allreduce", (tensor,), {"op": op, "axis": axis})
+        tensor._value = out._value
+        tensor._grad_node = out._grad_node
+        tensor._output_slot = out._output_slot
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    if _single_rank(group):
+        return tensor
+    # eager global-array mode: data replicated per rank — reduce across the
+    # group axis of the mesh-sharded value
+    raise NotImplementedError(
+        "eager cross-process all_reduce outside an axis context needs the "
+        "multi-host runtime; wrap the step in jit/shard_map (recommended) ")
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # all ranks compute the reduction; paddle keeps result only on dst but
+    # on TPU the psum result is replicated — semantically a superset
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor,
+               group: Optional[Group] = None, sync_op: bool = True):
+    axis = current_axis_for(group)
+    group = group or _get_default_group()
+    if axis is not None:
+        out = _d("c_allgather", (tensor,), {"axis": axis, "tiled": False})
+        # out shape [nranks, *shape]: split into the list
+        from ..ops.manipulation import split, squeeze
+        parts = split(out, group.nranks, axis=0)
+        tensor_list.clear()
+        tensor_list.extend(squeeze(p, 0) for p in parts)
+        return tensor_list
+    if _single_rank(group):
+        tensor_list.clear()
+        tensor_list.append(tensor)
+        return tensor_list
+    raise NotImplementedError("eager cross-process all_gather: use jit/shard_map")
+
+
+def all_gather_into_tensor(out: Tensor, tensor: Tensor, group=None,
+                           sync_op=True):
+    axis = current_axis_for(group)
+    if axis is not None:
+        res = _d("c_allgather", (tensor,), {"axis": axis, "tiled": True})
+        out._value = res._value
+        return out
+    if _single_rank(group):
+        out._value = tensor._value
+        return out
+    raise NotImplementedError
+
+
+def all_gather_object(object_list: list, obj: Any, group=None):
+    if _single_rank(group):
+        object_list.clear()
+        object_list.append(obj)
+        return object_list
+    raise NotImplementedError("object collectives: use the host store")
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
+                   op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = current_axis_for(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat
+        src = concat(list(src), axis=0)
+    if axis is not None:
+        out = _d("c_reducescatter", (src,), {"op": op, "axis": axis})
+        tensor._value = out._value
+        tensor._grad_node = out._grad_node
+        tensor._output_slot = out._output_slot
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    if _single_rank(group):
+        tensor._value = src._value
+        return tensor
+    raise NotImplementedError
+
+
+def alltoall(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
+             group=None, sync_op=True):
+    axis = current_axis_for(group)
+    from ..ops.manipulation import split, squeeze, stack
+    if axis is not None:
+        x = stack(list(in_tensor_list), axis=0)
+        out = _d("c_alltoall", (x,), {"axis": axis, "split_axis": 0,
+                                      "concat_axis": 0})
+        group = group or _get_default_group()
+        parts = split(out, group.nranks, axis=0)
+        out_tensor_list.clear()
+        out_tensor_list.extend(squeeze(p, 0) for p in parts)
+        return out_tensor_list
+    if _single_rank(group):
+        out_tensor_list.clear()
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    raise NotImplementedError
+
+
+def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
+                    in_split_sizes=None, out_split_sizes=None, group=None,
+                    sync_op=True):
+    axis = current_axis_for(group)
+    if axis is not None:
+        out = _d("c_alltoall", (in_tensor,), {"axis": axis, "split_axis": 0,
+                                              "concat_axis": 0})
+        out_tensor._value = out._value
+        return out_tensor
+    if _single_rank(group):
+        out_tensor._value = in_tensor._value
+        return out_tensor
+    raise NotImplementedError
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    axis = current_axis_for(group)
+    if axis is not None:
+        group = group or _get_default_group()
+        src_local = group.get_group_rank(src)
+        out = _d("c_broadcast_in_axis", (tensor,), {"axis": axis,
+                                                    "src": src_local})
+        tensor._value = out._value
+        return tensor
+    if _single_rank(group):
+        return tensor
+    raise NotImplementedError
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    if _single_rank(group):
+        return object_list
+    raise NotImplementedError
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axis = current_axis_for(group)
+    if axis is not None:
+        from ..ops.manipulation import stack
+        x = stack(list(tensor_list), axis=0)
+        bcast = _d("c_broadcast_in_axis", (x,), {"axis": axis, "src": src})
+        idx = _d("c_axis_index", (Tensor(jnp.zeros((), jnp.int32)),),
+                 {"axis": axis})
+        out = bcast[idx]
+        tensor._value = out._value
+        return tensor
+    if _single_rank(group):
+        tensor._value = tensor_list[src]._value if tensor_list else tensor._value
+        return tensor
+    raise NotImplementedError
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is None:
+        gather_list = []
+    return all_gather(gather_list, tensor, group, sync_op)
+
+
+def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
+    """Point-to-point over a pipeline axis = ppermute (see fleet pp_utils)."""
+    axis = current_axis_for(group)
+    if axis is None:
+        if _single_rank(group):
+            return tensor
+        raise NotImplementedError("p2p outside axis context")
+    group = group or _get_default_group()
+    n = group.nranks
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = _d("c_ppermute", (tensor,), {"axis": axis, "perm": tuple(perm)})
+    tensor._pp_sendbuf = out  # consumed by the matching recv
+    return tensor
+
+
+def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    axis = current_axis_for(group)
+    if axis is None:
+        if _single_rank(group):
+            return tensor
+        raise NotImplementedError("p2p outside axis context")
+    raise NotImplementedError(
+        "use fleet pp_utils.p2p helpers inside pipeline schedules; raw "
+        "send/recv pairs don't compose under SPMD")
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor._value, "block_until_ready"):
+        tensor._value.block_until_ready()
+    return tensor
+
+
+class stream:
+    """paddle.distributed.stream namespace shim: on TPU all collectives are
+    compiler-scheduled; stream variants alias the sync API."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

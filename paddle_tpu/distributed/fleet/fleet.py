@@ -1,0 +1,151 @@
+"""fleet — hybrid-parallel entry points.
+
+Parity: `python/paddle/distributed/fleet/fleet.py:167` fleet.init,
+`fleet/model.py:32` distributed_model, `fleet/optimizer.py:96`
+distributed_optimizer + DistributedStrategy
+(`fleet/base/distributed_strategy.py:1765` hybrid_configs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ...optimizer.optimizer import Optimizer
+from ..env import get_rank, get_world_size
+from .pipeline_parallel import PipelineParallel
+from .pp_layers import PipelineLayer
+from .sharding import DygraphShardingOptimizer
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["DistributedStrategy", "init", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridParallelOptimizer", "worker_index", "worker_num",
+           "is_first_worker", "barrier_worker"]
+
+
+class DistributedStrategy:
+    """Typed strategy (the reference's protobuf DistributedStrategy)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.sharding_configs = {"stage": 1}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+_fleet_state = {"hcg": None, "strategy": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """Build the hybrid topology over the TPU mesh (fleet.init parity)."""
+    from .. import env as _env, parallel as _parallel
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+        dims=[hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+              hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+              hc.get("mp_degree", 1)])
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state["hcg"] = hcg
+    _fleet_state["strategy"] = strategy
+    _fleet_state["initialized"] = True
+    _env._mark_initialized()
+    return hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        raise RuntimeError("call fleet.init first")
+    return _fleet_state["hcg"]
+
+
+def distributed_model(model: Layer):
+    """Wrap by parallel degrees (reference wrap order `fleet/model.py:141`)."""
+    hcg = get_hybrid_communicate_group()
+    strategy = _fleet_state["strategy"]
+    if hcg.get_pipe_parallel_world_size() > 1:
+        if not isinstance(model, PipelineLayer):
+            raise TypeError("pp_degree>1 needs a PipelineLayer model")
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        from ..parallel import DataParallel
+        return DataParallel(model, find_unused_parameters=
+                            strategy.find_unused_parameters if strategy else False)
+    return model
+
+
+class HybridParallelOptimizer:
+    """Parity: `fleet/meta_optimizers/dygraph_optimizer/
+    hybrid_parallel_optimizer.py` — composes grad clipping across groups and
+    sharding stages around the inner optimizer.  Cross-group global-norm
+    reduction is GSPMD's job (grads live on the global mesh), so the
+    composition collapses to: apply sharding stage, then step."""
+
+    def __init__(self, optimizer: Optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._sharding = None
+        if hcg.get_sharding_parallel_world_size() > 1:
+            stage = strategy.sharding_configs.get("stage", 1)
+            self._sharding = DygraphShardingOptimizer(optimizer, hcg,
+                                                      stage=stage)
+
+    def step(self):
+        if self._sharding is not None:
+            self._sharding.step()
+        else:
+            self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        if not loss.stop_gradient:
+            loss.backward()
+        self.step()
+        return None, None
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+
+def distributed_optimizer(optimizer: Optimizer, strategy=None):
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   strategy or _fleet_state["strategy"])
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    return None

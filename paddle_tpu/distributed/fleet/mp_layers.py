@@ -1,0 +1,124 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Parity: `python/paddle/distributed/fleet/layers/mpu/mp_layers.py`
+(VocabParallelEmbedding `:47`, ColumnParallelLinear `:334`, RowParallelLinear
+`:541`, ParallelCrossEntropy `:742`).
+
+TPU-native: weights carry `NamedSharding` over the 'mp' mesh axis; the
+matmul/identity/allreduce dance of the reference's `_c_identity/_mp_allreduce`
+custom-grad ops is GSPMD's job — XLA inserts the all-reduce/all-gather where
+the sharding propagation demands, both eagerly (per-op jit) and in captured
+graphs.  The layer classes exist so user code and checkpoints match the
+reference; the sharding annotation is the whole implementation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from .. import mesh as _mesh
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "shard_param"]
+
+
+def shard_param(param, *spec):
+    """Attach a NamedSharding over the global mesh to a parameter's storage."""
+    m = _mesh.get_mesh()
+    if m is None:
+        return param
+    sh = NamedSharding(m, P(*spec))
+    param._value = jax.device_put(param._value, sh)
+    param._dist_attr = ("mesh", spec)
+    return param
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        # vocab dim sharded over mp: each rank holds a vocab shard; the
+        # gather's cross-shard fetch becomes an XLA collective
+        shard_param(self.weight, "mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        shard_param(self.weight, None, "mp")  # columns sharded
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+        if self.bias is not None:
+            shard_param(self.bias, "mp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        m = _mesh.get_mesh()
+        if self.gather_output and m is not None and _mesh.axis_size("mp") > 1:
+            # force replication of the mp-sharded output (all-gather)
+            repl = NamedSharding(m, P())
+            if out._is_traced():
+                out._value = jax.lax.with_sharding_constraint(out._value, repl)
+            else:
+                out._value = jax.device_put(out._value, repl)
+        return out
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        shard_param(self.weight, "mp", None)  # rows sharded
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None  # bias replicated (added after reduce)
+
+    def forward(self, x):
+        # contraction over the sharded dim -> GSPMD inserts the all-reduce
+        out = F.linear(x, self.weight, self.bias)
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over vocab-sharded logits.
+
+    The reference splits softmax across the mp group with masked local max /
+    sum + allreduces (`mp_ops.py _c_softmax_with_cross_entropy`).  Under GSPMD
+    the same fused cross_entropy expression on mp-sharded logits lowers to the
+    identical pattern (per-shard max/sum + all-reduce over mp), so this is a
+    thin wrapper."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,109 @@
+"""Host-level pipeline schedules (micro-batch loop + grad accumulation).
+
+Parity: `python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py`
+(PipelineParallel `:148`, forward_backward_pipeline `:458`,
+PipelineParallelWithInterleave `:986`).
+
+Execution note: this class preserves the reference's host-driven scheduling
+semantics (micro-batch slicing, schedule order, grad accumulation, loss
+averaging).  On TPU hardware the *fast* path is the SPMD schedule
+(spmd_pipeline.py) compiled into one program; this host loop is the eager /
+debugging path and the semantic reference — on a single chip the stages run
+back-to-back, which is exactly the pipeline's serial semantics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...framework.tensor import Tensor
+from ...ops import manipulation as _m
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
+
+
+class PipelineParallel:
+    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        acc = 1
+        if strategy is not None:
+            acc = strategy.pipeline_configs.get("accumulate_steps", 1)
+        self.accumulate_steps = acc
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.total_loss = None
+
+    # -- microbatch helpers
+    def _split_microbatches(self, data):
+        x, y = data
+        mbs = self.accumulate_steps
+        xs = _m.split(x, mbs, axis=0) if mbs > 1 else [x]
+        ys = _m.split(y, mbs, axis=0) if mbs > 1 else [y]
+        return xs, ys
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """F-then-B schedule with gradient accumulation (1F1B's arithmetic is
+        identical; ordering only matters for memory on the host path)."""
+        xs, ys = self._split_microbatches(data)
+        total = None
+        for x, y in zip(xs, ys):
+            out = self._layers.forward(x)
+            loss = self._layers._loss_fn(out, y)
+            if scaler is not None:
+                scaled = scaler.scale(loss / len(xs))
+                scaled.backward()
+            else:
+                (loss / len(xs)).backward()
+            total = loss if total is None else total + loss
+        self.total_loss = total / len(xs)
+        return self.total_loss
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        xs, ys = self._split_microbatches(data)
+        total = None
+        for x, y in zip(xs, ys):
+            out = self._layers.forward(x)
+            if compute_loss:
+                loss = self._layers._loss_fn(out, y)
+                total = loss if total is None else total + loss
+        return total / len(xs) if total is not None else None
+
+    # parity accessors
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Virtual-pipeline (interleaved) schedule: same arithmetic on the host
+    path; the SPMD path interleaves via stage-stacking with vpp chunks."""

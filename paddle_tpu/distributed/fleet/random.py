@@ -1,0 +1,77 @@
+"""RNG state tracker for tensor parallelism.
+
+Parity: `python/paddle/distributed/fleet/layers/mpu/random.py`
+(RNGStatesTracker + model_parallel_rng contexts → consistent dropout across
+TP ranks).  TPU-native: a named state is a fold_in of the mp axis index (or
+not) into the active key source — mp-local states differ per rank, global
+states match.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+from ...framework import random as _random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "determinate_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_.clear()
+        self.seeds_.clear()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already added")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already added")
+        self.states_[name] = _random.StatefulKeySource(seed)
+
+    def get_states_tracker(self):
+        return {n: s.get_state() for n, s in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, v in states.items():
+            if n in self.states_:
+                self.states_[n].set_state(v)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added yet")
+        with _random.key_source_guard(self.states_[name]):
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    from ..env import get_rank
+    global_seed = seed
+    local_seed = seed + 1024 + get_rank()
+    _tracker.reset()
+    _random.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def determinate_seed(name):
+    return 0

@@ -1,0 +1,197 @@
+"""Hybrid-parallel topology.
+
+Parity: `python/paddle/distributed/fleet/base/topology.py` (CommunicateTopology
+`:65`, HybridCommunicateGroup `:178`, dims ["data","pipe","sharding","sep",
+"model"] `:68`).
+
+TPU-native: the topology IS a `jax.sharding.Mesh` with axes ordered
+(pp, dp, sharding, sep, mp) — mp innermost so tensor-parallel collectives ride
+the highest-bandwidth ICI links; pp outermost so pipeline p2p crosses the slow
+links (the standard TPU layout, mirroring the reference's comm-group creation
+order at `topology.py:290`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .. import mesh as _mesh
+from ..collective import Group, new_group
+from ..env import get_rank, get_world_size
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        shape = tuple(dims)
+        self._world = int(np.prod(shape))
+        self._coords = np.indices(shape).reshape(len(shape), -1).T
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = [kwargs[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(coord, tuple(self._dims)))
+
+    def get_coord(self, rank):
+        return tuple(np.unravel_index(rank, tuple(self._dims)))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r in range(self._world)
+                if self.get_coord(r)[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along `axis_name` (reference get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != axis]
+        groups = {}
+        for r in range(self._world):
+            coord = self.get_coord(r)
+            key = tuple(coord[i] for i in others)
+            groups.setdefault(key, []).append(r)
+        return list(groups.values())
+
+
+# mesh axis order: pp outermost ... mp innermost
+_MESH_ORDER = ["pp", "dp", "sharding", "sep", "mp"]
+_NAME_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+             "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1):
+        if topology is not None:
+            dims = {_NAME_MAP[n]: topology.get_dim(n)
+                    for n in topology.get_hybrid_group_names()}
+            dp_degree = dims.get("dp", 1)
+            mp_degree = dims.get("mp", 1)
+            pp_degree = dims.get("pp", 1)
+            sharding_degree = dims.get("sharding", 1)
+            sep_degree = dims.get("sep", 1)
+        self._topo = topology
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+
+        sizes = {"pp": pp_degree, "dp": dp_degree, "sharding": sharding_degree,
+                 "sep": sep_degree, "mp": mp_degree}
+        mesh = _mesh.build_mesh(sizes)
+        _mesh.set_mesh(mesh)
+        self.mesh = mesh
+
+        self._dp_group = new_group(axis="dp")
+        self._mp_group = new_group(axis="mp")
+        self._pp_group = new_group(axis="pp")
+        self._sharding_group = new_group(axis="sharding")
+        self._sep_group = new_group(axis="sep")
+        self.global_rank = get_rank()
+
+    # ---- parallel mode
+    def get_parallel_mode(self):
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # ---- accessors (parity with HybridCommunicateGroup)
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_rank(self):
+        return self._dp_group.rank
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_rank(self):
+        return self._mp_group.rank
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_stage_id(self):
+        return self._pp_group.rank
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self):
+        return self._sharding_group.rank
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self):
+        return self._sep_group.rank
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return self._pp_group

@@ -1,0 +1,84 @@
+"""Global device-mesh state.
+
+TPU-native replacement for the reference's comm-context bookkeeping
+(`phi/core/distributed/comm_context_manager.h` ring-ids, ProcessGroup pools):
+all parallelism lives on ONE `jax.sharding.Mesh` over the pod slice, with
+named axes (dp/pp/sharding/sep/mp — same dims as `fleet/base/topology.py:68`).
+"Groups" are mesh axes; collectives are XLA ops over those axes; no ring-id
+bookkeeping exists because named axes replace it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["build_mesh", "get_mesh", "set_mesh", "axis_size", "mesh_axes",
+           "named_sharding", "replicated", "PartitionSpec"]
+
+_state = threading.local()
+_global_mesh: Optional[Mesh] = None
+_lock = threading.RLock()
+
+
+def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh with named axis sizes, e.g. {"dp": 2, "mp": 4}.
+
+    Axis sizes must multiply to the device count; an axis size of -1 absorbs
+    the remainder (like paddle's degree inference in hybrid_configs)."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n = len(devices)
+    sizes = dict(axes)
+    unknown = [k for k, v in sizes.items() if v in (-1, 0, None)]
+    known = int(np.prod([v for v in sizes.values() if v and v > 0])) or 1
+    if unknown:
+        if n % known:
+            raise ValueError(f"device count {n} not divisible by {known}")
+        fill = n // known
+        if len(unknown) > 1:
+            raise ValueError("at most one axis may be -1")
+        sizes[unknown[0]] = fill
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(
+            f"mesh axes {sizes} multiply to {total} but there are {n} devices")
+    arr = np.asarray(devices).reshape(tuple(sizes.values()))
+    return Mesh(arr, tuple(sizes.keys()))
+
+
+def set_mesh(mesh: Mesh) -> Mesh:
+    global _global_mesh
+    with _lock:
+        _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+def mesh_axes() -> Tuple[str, ...]:
+    m = get_mesh()
+    return tuple(m.axis_names) if m is not None else ()
+
+
+def axis_size(axis: str) -> int:
+    m = get_mesh()
+    if m is None or axis not in m.axis_names:
+        return 1
+    return m.shape[axis]
+
+
+def named_sharding(*spec) -> NamedSharding:
+    m = get_mesh()
+    if m is None:
+        raise RuntimeError("no global mesh; call fleet.init or build_mesh first")
+    return NamedSharding(m, PartitionSpec(*spec))
+
+
+def replicated() -> NamedSharding:
+    return named_sharding()

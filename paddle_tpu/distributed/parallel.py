@@ -1,0 +1,127 @@
+"""init_parallel_env + DataParallel.
+
+Parity: `python/paddle/distributed/parallel.py` (init_parallel_env `:943`,
+DataParallel `:202` + C++ EagerReducer
+`fluid/distributed/collective/reducer.h:88`).
+
+TPU-native DataParallel: parameters stay replicated on the mesh; input
+batches are sharded over the 'dp' axis (shard_batch); the gradient
+all-reduce the reference implements with bucketed NCCL calls is inserted by
+GSPMD when the sharded-batch loss is differentiated — eagerly per-op, or
+fused inside a captured train step.  no_sync() suppresses the constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import env as _env
+from . import mesh as _mesh
+
+__all__ = ["init_parallel_env", "DataParallel", "shard_batch", "ParallelEnv"]
+
+from .env import ParallelEnv  # noqa: F401  (re-export)
+
+
+def init_parallel_env(backend: Optional[str] = None):
+    """Bootstrap the distributed runtime.
+
+    Single-host (tests, 1 chip): builds a trivial mesh over local devices.
+    Multi-host: jax.distributed.initialize from the launcher env
+    (coordinator address replaces the reference's TCPStore rendezvous)."""
+    import os
+    if "PADDLE_MASTER" in os.environ or "COORDINATOR_ADDRESS" in os.environ:
+        addr = os.environ.get("COORDINATOR_ADDRESS") or \
+            os.environ.get("PADDLE_MASTER")
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    if _mesh.get_mesh() is None:
+        _mesh.set_mesh(_mesh.build_mesh({"dp": -1}))
+    _env._mark_initialized()
+    return _env.ParallelEnv()
+
+
+def shard_batch(tensor: Tensor, axis: str = "dp", dim: int = 0) -> Tensor:
+    """Lay a batch out over a mesh axis (the DP input split)."""
+    m = _mesh.get_mesh()
+    if m is None or axis not in m.axis_names or m.shape[axis] <= 1:
+        return tensor
+    spec = [None] * tensor.ndim
+    spec[dim] = axis
+    sh = NamedSharding(m, P(*spec))
+    if tensor._is_traced():
+        tensor._value = jax.lax.with_sharding_constraint(tensor._value, sh)
+    else:
+        tensor._value = jax.device_put(tensor._value, sh)
+    return tensor
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._sync = True
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        if self._sync:
+            inputs = tuple(shard_batch(i) if isinstance(i, Tensor) else i
+                           for i in inputs)
+            kwargs = {k: shard_batch(v) if isinstance(v, Tensor) else v
+                      for k, v in kwargs.items()}
+        return self._layers(*inputs, **kwargs)
+
+    class _NoSync:
+        def __init__(self, dp):
+            self.dp = dp
+
+        def __enter__(self):
+            self.dp._sync = False
+            return self
+
+        def __exit__(self, *exc):
+            self.dp._sync = True
+            return False
+
+    def no_sync(self):
+        """Within this context batches are NOT dp-sharded, so no gradient
+        all-reduce is induced (grad accumulation then happens locally)."""
+        return DataParallel._NoSync(self)
+
+    # transparent delegation
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

@@ -1,0 +1,13 @@
+"""paddle.distributed.sharding namespace (group_sharded_parallel entry).
+Parity: `python/paddle/distributed/sharding/group_sharded.py`."""
+
+from ..fleet.sharding import group_sharded_parallel  # noqa: F401
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ...framework.io import save
+    import os
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

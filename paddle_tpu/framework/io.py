@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load.
+
+Parity: `python/paddle/framework/io.py:723,:960` — pickled state dicts with
+Tensors converted to numpy on save and restored as Tensors on load.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PDTPU1\n"
+
+
+def _to_host(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return {"__tensor__": True, "data": np.asarray(obj),
+                    "stop_gradient": True}
+    except ImportError:
+        pass
+    return obj
+
+
+def _from_host(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            return Tensor(obj["data"], stop_gradient=obj.get("stop_gradient",
+                                                             True))
+        return {k: _from_host(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_host(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _from_host(obj, return_numpy)

@@ -150,6 +150,15 @@ class Tensor:
         if raw_grad.dtype != self._value.dtype and jnp.issubdtype(
                 self._value.dtype, jnp.floating):
             raw_grad = raw_grad.astype(self._value.dtype)
+        # distributed invariant: grad layout follows the parameter layout
+        # (the reference stores grads with the param's dist_attr)
+        from jax.sharding import NamedSharding
+        if (isinstance(raw_grad, jax.Array)
+                and not isinstance(raw_grad, jax.core.Tracer)
+                and isinstance(getattr(self._value, "sharding", None),
+                               NamedSharding)
+                and raw_grad.sharding != self._value.sharding):
+            raw_grad = jax.device_put(raw_grad, self._value.sharding)
         if self._grad is None:
             self._grad = Tensor._wrap(raw_grad)
         else:

@@ -1,0 +1,4 @@
+"""paddle.incubate namespace: fused ops + experimental features.
+Parity: `python/paddle/incubate/` (fused_rope, fused_rms_norm, MoE ...)."""
+
+from . import nn  # noqa: F401

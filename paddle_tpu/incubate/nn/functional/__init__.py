@@ -1,0 +1,115 @@
+"""Fused incubate functionals.
+
+Parity: `python/paddle/incubate/nn/functional/` — fused_rotary_position_
+embedding (ref `fused_rope_kernel.cu`), fused_rms_norm, fused_layer_norm,
+swiglu.  On TPU these are single fused XLA expressions (+ Pallas variants for
+the attention path); XLA's fusion makes the "fused" prefix literal."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.tensor import Tensor
+from ....ops.registry import dispatch as _d, register_op
+from ....nn.functional.norm import rms_norm as fused_rms_norm  # noqa: F401
+from ....nn.functional.norm import layer_norm as fused_layer_norm  # noqa: F401
+
+__all__ = ["fused_rotary_position_embedding", "rope", "swiglu",
+           "fused_rms_norm", "fused_layer_norm", "fused_bias_act",
+           "fused_linear", "fused_multi_head_attention"]
+
+
+def _rope_impl(q, k, v, cos, sin, *, use_neox):
+    def rot(x):
+        if x is None:
+            return None
+        # x: [B, S, H, D]
+        if use_neox:
+            x1, x2 = jnp.split(x, 2, axis=-1)
+            rx = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rx = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+        return x * cos + rx * sin
+    return tuple(r for r in (rot(q), rot(k), rot(v)) if r is not None) \
+        if (k is not None or v is not None) else rot(q)
+
+
+register_op("fused_rope", _rope_impl, tags=("fused",))
+
+
+def _default_cos_sin(seq_len, head_dim, dtype, use_neox, base=10000.0):
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                          / head_dim))
+    freqs = jnp.outer(pos, inv)  # [S, D/2]
+    if use_neox:
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+    else:
+        emb = jnp.repeat(freqs, 2, axis=-1)
+    return (jnp.cos(emb)[None, :, None, :].astype(dtype),
+            jnp.sin(emb)[None, :, None, :].astype(dtype))
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding parity;
+    layout [batch, seq, heads, head_dim]."""
+    if cos is None or sin is None:
+        cos_v, sin_v = _default_cos_sin(q.shape[1], q.shape[-1],
+                                        q._value.dtype, use_neox_rotary_style,
+                                        rotary_emb_base)
+        cos = Tensor._wrap(cos_v)
+        sin = Tensor._wrap(sin_v)
+    outs = _d("fused_rope", (q, k, v, cos, sin),
+              {"use_neox": bool(use_neox_rotary_style)})
+    if isinstance(outs, tuple):
+        res = list(outs)
+        while len(res) < 3:
+            res.append(None)
+        return tuple(res[:3])
+    return outs, None, None
+
+
+rope = fused_rotary_position_embedding
+
+register_op("swiglu", lambda x, y: jax.nn.silu(x) * y if y is not None
+            else _swiglu_single(x), tags=("fused",))
+
+
+def _swiglu_single(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(a) * b
+
+
+def swiglu(x, y=None, name=None):
+    return _d("swiglu", (x, y), {})
+
+
+register_op("fused_bias_act", lambda x, bias, *, act:
+            getattr(jax.nn, act)(x + bias if bias is not None else x),
+            tags=("fused",))
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", name=None, **kw):
+    act = {"gelu": "gelu", "relu": "relu", "silu": "silu",
+           "swiglu": "silu"}.get(act_method, act_method)
+    return _d("fused_bias_act", (x, bias), {"act": act})
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ....nn import functional as F
+    from ....ops.linalg import matmul
+    if transpose_weight:
+        return matmul(x, weight, transpose_y=True) + (bias if bias is not None
+                                                      else 0.0)
+    return F.linear(x, weight, bias)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "fused_multi_head_attention: use nn.MultiHeadAttention (SDPA/Pallas "
+        "path) — kept for API discovery")

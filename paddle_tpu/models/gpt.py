@@ -1,0 +1,205 @@
+"""GPT model family (decoder-only transformer, GPT-2/3 style).
+
+Parity target: the reference ecosystem's GPT pretraining path (Fleet hybrid
+GPT in PaddleNLP driven by the fleet APIs surveyed in SURVEY.md §3.4; the
+attention fast path replaces `fused_multi_transformer_op.cu` /
+`flash_attn_kernel.cu` with the Pallas/SDPA kernel).
+
+TPU-first design:
+* pre-LN blocks, bias-full GPT-3 parameterization;
+* attention through F.scaled_dot_product_attention (Pallas flash kernel on
+  TPU, fused XLA softmax elsewhere);
+* optional tensor parallelism: with a live mesh ('mp' axis >1) the QKV/MLP
+  weights are laid out column/row-parallel via NamedSharding;
+* jax.checkpoint-able blocks for remat (`use_recompute`).
+
+Configs mirror the BASELINE ladder: gpt3_tiny/med for tests, gpt3_1p3b,
+gpt3_6p7b for the MFU runs.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..ops import creation, manipulation as _m
+from ..incubate.nn.functional import fused_rotary_position_embedding
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt3_tiny",
+           "gpt3_124m", "gpt3_350m", "gpt3_1p3b", "gpt3_6p7b"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    dropout: float = 0.0
+    use_recompute: bool = False
+    tensor_parallel: bool = False
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        self.hidden = cfg.hidden_size
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.qkv = ColumnParallelLinear(cfg.hidden_size,
+                                            3 * cfg.hidden_size,
+                                            gather_output=False)
+            self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                          input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+            self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout = cfg.dropout
+
+    def forward(self, x, kv_cache=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x)
+        qkv = _m.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = _m.unbind(qkv, axis=2)
+        if kv_cache is not None:
+            pk, pv = kv_cache
+            k = _m.concat([pk, k], axis=1)
+            v = _m.concat([pv, v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        out = F.scaled_dot_product_attention(
+            q, k, v, dropout_p=self.dropout, is_causal=True,
+            training=self.training)
+        out = _m.reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.fc1 = ColumnParallelLinear(cfg.hidden_size,
+                                            cfg.intermediate_size,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(cfg.intermediate_size,
+                                         cfg.hidden_size,
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+            self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        x = x + self.dropout(self.mlp(self.ln2(x)))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import VocabParallelEmbedding
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape[0], input_ids.shape[1]
+        pos = creation.arange(s, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.blocks:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        # tied output head (reads the embedding weight)
+        self._tied = True
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        from ..ops.linalg import matmul
+        return matmul(h, self.gpt.wte.weight, transpose_y=True)
+
+    def compute_loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            _m.reshape(logits, [-1, self.cfg.vocab_size]),
+            _m.reshape(labels, [-1]))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len=None) -> float:
+        """~6N + attention flops per token (fwd+bwd), standard MFU accounting."""
+        n = self.num_params()
+        s = seq_len or self.cfg.max_seq_len
+        attn = 12 * self.cfg.num_layers * self.cfg.hidden_size * s
+        return 6.0 * n + attn
+
+
+def gpt3_tiny(**kw):
+    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                     num_heads=4, max_seq_len=256, **kw)
+
+
+def gpt3_124m(**kw):
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                     max_seq_len=1024, **kw)
+
+
+def gpt3_350m(**kw):
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                     max_seq_len=1024, **kw)
+
+
+def gpt3_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_seq_len=2048, **kw)
+
+
+def gpt3_6p7b(**kw):
+    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                     max_seq_len=2048, **kw)

@@ -1,0 +1,196 @@
+"""Llama-2 model family (BASELINE config 5: Llama-2 7B semi-auto parallel).
+
+Architecture: RMSNorm pre-norm, SwiGLU MLP, rotary embeddings, no biases —
+matching the reference ecosystem's `semi_auto_llama.py`
+(`test/auto_parallel/hybrid_strategy/semi_auto_llama.py`).  Attention runs
+through the SDPA/Pallas path; RoPE through the fused rope op."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..incubate.nn.functional import fused_rotary_position_embedding, swiglu
+from ..nn import functional as F
+from ..ops import creation, manipulation as _m
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
+           "llama2_7b", "llama2_13b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 0  # 0 -> same as num_heads (MHA); else GQA
+    intermediate_size: int = 11008
+    max_seq_len: int = 4096
+    rms_eps: float = 1e-6
+    rope_base: float = 10000.0
+    use_recompute: bool = False
+    tensor_parallel: bool = False
+
+    def __post_init__(self):
+        if self.num_kv_heads == 0:
+            self.num_kv_heads = self.num_heads
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        h, kvh = cfg.num_heads, cfg.num_kv_heads
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            mk = lambda i, o: ColumnParallelLinear(i, o, has_bias=False,
+                                                   gather_output=False)
+            self.q_proj = mk(cfg.hidden_size, h * self.head_dim)
+            self.k_proj = mk(cfg.hidden_size, kvh * self.head_dim)
+            self.v_proj = mk(cfg.hidden_size, kvh * self.head_dim)
+            self.o_proj = RowParallelLinear(h * self.head_dim, cfg.hidden_size,
+                                            has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(cfg.hidden_size, h * self.head_dim,
+                                    bias_attr=False)
+            self.k_proj = nn.Linear(cfg.hidden_size, kvh * self.head_dim,
+                                    bias_attr=False)
+            self.v_proj = nn.Linear(cfg.hidden_size, kvh * self.head_dim,
+                                    bias_attr=False)
+            self.o_proj = nn.Linear(h * self.head_dim, cfg.hidden_size,
+                                    bias_attr=False)
+
+    def forward(self, x, kv_cache=None):
+        cfg = self.cfg
+        b, s = x.shape[0], x.shape[1]
+        q = _m.reshape(self.q_proj(x), [b, s, cfg.num_heads, self.head_dim])
+        k = _m.reshape(self.k_proj(x), [b, s, cfg.num_kv_heads, self.head_dim])
+        v = _m.reshape(self.v_proj(x), [b, s, cfg.num_kv_heads, self.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, use_neox_rotary_style=True,
+            rotary_emb_base=cfg.rope_base)
+        if kv_cache is not None:
+            pk, pv = kv_cache
+            k = _m.concat([pk, k], axis=1)
+            v = _m.concat([pv, v], axis=1)
+        if cfg.num_kv_heads != cfg.num_heads:  # GQA: repeat kv heads
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = _m.repeat_interleave(k, rep, axis=2)
+            v = _m.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                             training=self.training)
+        out = _m.reshape(out, [b, s, cfg.num_heads * self.head_dim])
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.gate_proj = ColumnParallelLinear(cfg.hidden_size,
+                                                  cfg.intermediate_size,
+                                                  has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(cfg.hidden_size,
+                                                cfg.intermediate_size,
+                                                has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(cfg.intermediate_size,
+                                               cfg.hidden_size,
+                                               has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                       bias_attr=False)
+            self.up_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                     bias_attr=False)
+            self.down_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                       bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            from ..distributed.fleet import VocabParallelEmbedding
+            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                       cfg.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList([LlamaBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids):
+        return self.lm_head(self.model(input_ids))
+
+    def compute_loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            _m.reshape(logits, [-1, self.cfg.vocab_size]),
+            _m.reshape(labels, [-1]))
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len=None) -> float:
+        n = self.num_params()
+        s = seq_len or self.cfg.max_seq_len
+        attn = 12 * self.cfg.num_layers * self.cfg.hidden_size * s
+        return 6.0 * n + attn
+
+
+def llama_tiny(**kw):
+    return LlamaConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                       num_heads=4, intermediate_size=384, max_seq_len=256,
+                       **kw)
+
+
+def llama2_7b(**kw):
+    return LlamaConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                       intermediate_size=11008, max_seq_len=4096, **kw)
+
+
+def llama2_13b(**kw):
+    return LlamaConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                       intermediate_size=13824, max_seq_len=4096, **kw)
