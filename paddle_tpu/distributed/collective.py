@@ -176,15 +176,34 @@ _REDUCERS = {
     ReduceOp.SUM: lambda x, ax: jax.lax.psum(x, ax),
     ReduceOp.MAX: lambda x, ax: jax.lax.pmax(x, ax),
     ReduceOp.MIN: lambda x, ax: jax.lax.pmin(x, ax),
-    ReduceOp.PROD: lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)),
+    # exact product (exp∘psum∘log breaks on zeros/negatives)
+    ReduceOp.PROD: lambda x, ax: jnp.prod(jax.lax.all_gather(x, ax), axis=0),
     ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
 }
 
 register_op("c_allreduce", lambda x, *, op, axis: _REDUCERS[op](x, axis))
 register_op("c_allgather", lambda x, *, axis, tiled:
             jax.lax.all_gather(x, axis, tiled=tiled))
+def _reducescatter_impl(x, op, axis):
+    if op == ReduceOp.SUM:
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+    if op == ReduceOp.AVG:
+        return jax.lax.psum_scatter(x, axis, tiled=True) / \
+            jax.lax.axis_size(axis)
+    # MAX/MIN/PROD: full reduce then slice out this rank's tile
+    n = jax.lax.axis_size(axis)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"reduce_scatter: dim0 {x.shape[0]} not divisible by group "
+            f"size {n}")
+    full = _REDUCERS[op](x, axis)
+    tile = x.shape[0] // n
+    idx = jax.lax.axis_index(axis)
+    return jax.lax.dynamic_slice_in_dim(full, idx * tile, tile, axis=0)
+
+
 register_op("c_reducescatter", lambda x, *, op, axis:
-            jax.lax.psum_scatter(x, axis, tiled=True))
+            _reducescatter_impl(x, op, axis))
 register_op("c_alltoall", lambda x, *, axis, split_axis, concat_axis:
             jax.lax.all_to_all(x, axis, split_axis=split_axis,
                                concat_axis=concat_axis, tiled=True))

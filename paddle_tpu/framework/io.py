@@ -47,6 +47,13 @@ def _from_host(obj, return_numpy=False):
         return {k: _from_host(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return type(obj)(_from_host(v, return_numpy) for v in obj)
+    if isinstance(obj, np.ndarray) and not return_numpy \
+            and obj.dtype.kind in "biufc" and obj.dtype.itemsize <= 4:
+        # upstream paddle.save pickles bare numpy arrays in state dicts;
+        # match reference load semantics by returning Tensors. 64-bit
+        # arrays pass through as numpy: x32 canonicalization would
+        # silently narrow them (int64 ids, float64 stats)
+        return Tensor(obj, stop_gradient=True)
     return obj
 
 

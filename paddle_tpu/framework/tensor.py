@@ -355,7 +355,15 @@ class Parameter(Tensor):
     """
     __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
 
+    _name_counter = 0
+
     def __init__(self, data=None, dtype=None, name=None, trainable: bool = True):
+        if name is None:
+            # deterministic creation-order name (reference EagerParamBase
+            # auto-names via a global unique_name counter) so optimizer
+            # checkpoints keyed by param name are stable across processes
+            name = f"param_{Parameter._name_counter}"
+            Parameter._name_counter += 1
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
         self.persistable = True
         self.optimize_attr = {"learning_rate": 1.0}
@@ -366,6 +374,8 @@ class Parameter(Tensor):
     @classmethod
     def _wrap(cls, value, stop_gradient: bool = False):
         t = super()._wrap.__func__(cls, value, stop_gradient)
+        t.name = f"param_{Parameter._name_counter}"
+        Parameter._name_counter += 1
         t.persistable = True
         t.optimize_attr = {"learning_rate": 1.0}
         t.regularizer = None

@@ -139,9 +139,10 @@ def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
 
 
 register_op("smooth_l1_loss", lambda x, y, *, reduction, delta:
-            _reduce_loss(jnp.where(jnp.abs(x - y) < delta,
-                                   0.5 * jnp.square(x - y) / delta,
-                                   jnp.abs(x - y) - 0.5 * delta), reduction))
+            _reduce_loss(jnp.where(jnp.abs(x - y) <= delta,
+                                   0.5 * jnp.square(x - y),
+                                   delta * (jnp.abs(x - y) - 0.5 * delta)),
+                         reduction))
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ...ops.registry import dispatch as _d, register_op
 
@@ -92,12 +91,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         out = _d("batch_norm_apply",
                  (x, weight, bias, batch_mean, batch_var),
                  {"eps": float(epsilon), "channel_axis": channel_axis})
-        # update running stats (unbiased var like the reference kernel);
-        # expressed through dispatched Tensor ops so jit capture records the
-        # buffers as program state (not baked constants)
+        # update running stats (biased batch variance, matching the
+        # reference batch_norm_kernel.cc update rule); expressed through
+        # dispatched Tensor ops so jit capture records the buffers as
+        # program state (not baked constants)
         from ...framework.dygraph import no_grad
-        n = int(np.prod([x.shape[i] for i in axes]))
-        unbias = n / max(n - 1, 1)
         with no_grad():
             if running_mean is not None:
                 new_mean = running_mean * momentum + batch_mean * (1 - momentum)
@@ -107,7 +105,7 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                     running_mean._value.dtype)
             if running_var is not None:
                 new_var = running_var * momentum + \
-                    batch_var * ((1 - momentum) * unbias)
+                    batch_var * (1 - momentum)
                 running_var._value = new_var._value.astype(
                     running_var._value.dtype)
         return out

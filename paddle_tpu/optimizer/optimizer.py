@@ -246,30 +246,74 @@ class Optimizer:
         return out
 
     def state_dict(self):
+        # keys follow the reference format: "<param_name>_<accumulator>"
+        # (`python/paddle/optimizer/optimizer.py` keys accumulators by the
+        # parameter's name) so checkpoints survive parameter reordering
+        import warnings
         out = {"LR_Scheduler": self._lr.state_dict()
                if isinstance(self._lr, LRScheduler) else {},
                "global_step": self._global_step}
         for name, store in self._accumulators.items():
-            for i, p in enumerate(self._parameter_list):
+            for p in self._parameter_list:
                 if id(p) in store:
-                    out[f"{name}_{i}"] = Tensor._wrap(store[id(p)])
+                    key = f"{p.name}_{name}"
+                    if key in out:
+                        warnings.warn(
+                            f"optimizer.state_dict: duplicate parameter "
+                            f"name {p.name!r}; state for one of them is "
+                            f"overwritten — give parameters unique names")
+                    out[key] = Tensor._wrap(store[id(p)])
         return out
 
+    def _known_state_names(self):
+        names = set(self._state_names) | set(self._accumulators)
+        names.add("master_weight")
+        return names
+
     def set_state_dict(self, state):
+        import warnings
         import numpy as np
         if isinstance(self._lr, LRScheduler) and state.get("LR_Scheduler"):
             self._lr.set_state_dict(state["LR_Scheduler"])
         self._global_step = int(state.get("global_step", 0))
+        params = self._parameter_list
+        by_name = {p.name: p for p in params}
+        accs = self._known_state_names()
         for key, v in state.items():
             if key in ("LR_Scheduler", "global_step"):
                 continue
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            # name-keyed format: "<param_name>_<accumulator>"; exact match on
+            # both halves so a param name that prefixes another can't steal
+            # its state, and unknown accumulators aren't silently created
+            matched = False
+            for acc in accs:
+                if key.endswith("_" + acc):
+                    p = by_name.get(key[:-len(acc) - 1])
+                    if p is not None:
+                        if acc != "master_weight" and \
+                                tuple(val.shape) != tuple(p.shape):
+                            warnings.warn(
+                                f"optimizer.set_state_dict: {key!r} shape "
+                                f"{tuple(val.shape)} does not match param "
+                                f"{p.name} shape {tuple(p.shape)}; skipping")
+                            matched = True
+                            break
+                        self._accumulators.setdefault(acc, {})[id(p)] = val
+                        matched = True
+                        break
+            if matched:
+                continue
+            # legacy positional format: "<accumulator>_<index>"
             name, _, idx = key.rpartition("_")
             try:
-                p = self._parameter_list[int(idx)]
+                p = params[int(idx)]
             except (ValueError, IndexError):
+                warnings.warn(
+                    f"optimizer.set_state_dict: unmatched key {key!r} "
+                    f"(no parameter/accumulator for it); skipping")
                 continue
-            val = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
-            self._accumulators[name][id(p)] = val
+            self._accumulators.setdefault(name, {})[id(p)] = val
 
 
 class SGD(Optimizer):
