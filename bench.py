@@ -1,0 +1,217 @@
+"""Benchmark driver.  Prints ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline: GPT-124M (BASELINE.md rung for single-chip LM training) — a full
+train step (fwd + loss + bwd + Adam) captured by `paddle_tpu.jit.to_static`
+into one donated XLA program, run on the real chip, reported as tokens/sec.
+`vs_baseline` = achieved MFU / 0.45 (the BASELINE.json north-star MFU).
+
+Secondary rungs (stderr, one JSON line each): LeNet jitted step (BASELINE
+rung 1), eager dispatch overhead microbench (SURVEY §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(obj):
+    print(json.dumps(obj), file=sys.stderr, flush=True)
+
+
+def marginal_step_s(run_steps, sync_read, n1=3, n2=13):
+    """Marginal per-step wall time via work-delta: time(n2 steps) minus
+    time(n1 steps), each ending in a forced host read of a small output.
+    Robust against async dispatch queues that let `block_until_ready`
+    return before remote completion (observed through the device tunnel)."""
+    def timed(n):
+        t0 = time.perf_counter()
+        run_steps(n)
+        np.asarray(sync_read())  # host materialization = full dependency sync
+        return time.perf_counter() - t0
+    t_a = timed(n1)
+    t_b = timed(n2)
+    return max(t_b - t_a, 1e-9) / (n2 - n1)
+
+
+def peak_flops(device) -> float:
+    """bf16 peak FLOP/s per chip by device kind (public spec sheets)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "tpu v5 lite": 197e12,   # v5e
+        "tpu v5e": 197e12,
+        "tpu v5": 459e12,        # v5p
+        "tpu v5p": 459e12,
+        "tpu v4": 275e12,
+        "tpu v6 lite": 918e12,   # v6e (Trillium)
+        "tpu v6e": 918e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12 if "tpu" in kind else 2e12  # conservative default / CPU
+
+
+def bench_gpt124m():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m
+    from paddle_tpu.jit import to_static
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    B, S = (4, 1024) if on_tpu else (2, 256)
+
+    paddle.seed(0)
+    cfg = gpt3_124m() if on_tpu else gpt3_124m()
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def train_step(ids, labels):
+        with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+            loss = model.compute_loss(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+
+    # warmup/compile
+    t0 = time.perf_counter()
+    loss = step(ids, labels)
+    np.asarray(loss._value)
+    compile_s = time.perf_counter() - t0
+
+    def run_steps(n):
+        nonlocal loss
+        for _ in range(n):
+            loss = step(ids, labels)
+
+    dt = marginal_step_s(run_steps,
+                         lambda: model.gpt.ln_f.bias._value,
+                         *((3, 13) if on_tpu else (1, 3)))
+    tokens_per_sec = B * S / dt
+    fpt = model.flops_per_token(S)
+    mfu = tokens_per_sec * fpt / peak_flops(dev)
+    log({"bench": "gpt124m_train", "device": str(dev.device_kind),
+         "batch": B, "seq": S, "step_ms": round(dt * 1e3, 2),
+         "compile_s": round(compile_s, 1),
+         "tokens_per_sec": round(tokens_per_sec, 1),
+         "flops_per_token": fpt, "mfu": round(mfu, 4),
+         "loss": float(loss.item())})
+    return tokens_per_sec, mfu
+
+
+def bench_lenet():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.jit import to_static
+
+    paddle.seed(0)
+    model = LeNet()
+    opt = optimizer.Momentum(learning_rate=0.01,
+                             parameters=model.parameters())
+    lossf = nn.CrossEntropyLoss()
+
+    def train_step(x, y):
+        loss = lossf(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    B = 256
+    x = paddle.to_tensor(rng.rand(B, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (B,)).astype(np.int32))
+
+    def run_eager(n):
+        for _ in range(n):
+            train_step(x, y)
+
+    sync = lambda: model.parameters()[0]._value
+    train_step(x, y)  # warm caches
+    eager_dt = marginal_step_s(run_eager, sync, 1, 4)
+
+    step = to_static(train_step)
+    step(x, y)  # compile
+    np.asarray(sync())
+
+    def run_jit(n):
+        for _ in range(n):
+            step(x, y)
+
+    jit_dt = marginal_step_s(run_jit, sync, 5, 30)
+    log({"bench": "lenet_train", "batch": B,
+         "eager_imgs_per_sec": round(B / eager_dt, 1),
+         "jit_imgs_per_sec": round(B / jit_dt, 1),
+         "jit_step_ms": round(jit_dt * 1e3, 3)})
+
+
+def bench_dispatch():
+    """Eager per-op dispatch overhead: chained small adds vs raw jax."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    ja = jnp.ones((4, 4), jnp.float32)
+    n = 300
+    # warm
+    b = a
+    for _ in range(5):
+        b = b + a
+    b._value.block_until_ready()
+    t0 = time.perf_counter()
+    b = a
+    for _ in range(n):
+        b = b + a
+    b._value.block_until_ready()
+    eager_ops = n / (time.perf_counter() - t0)
+    jb = ja
+    for _ in range(5):
+        jb = jb + ja
+    jb.block_until_ready()
+    t0 = time.perf_counter()
+    jb = ja
+    for _ in range(n):
+        jb = jb + ja
+    jb.block_until_ready()
+    raw_ops = n / (time.perf_counter() - t0)
+    log({"bench": "dispatch_overhead", "eager_ops_per_sec": round(eager_ops),
+         "raw_jax_ops_per_sec": round(raw_ops),
+         "overhead_ratio": round(raw_ops / eager_ops, 2)})
+
+
+def main():
+    try:
+        bench_dispatch()
+    except Exception as e:  # noqa: BLE001
+        log({"bench": "dispatch_overhead", "error": repr(e)})
+    try:
+        bench_lenet()
+    except Exception as e:  # noqa: BLE001
+        log({"bench": "lenet_train", "error": repr(e)})
+    tokens_per_sec, mfu = bench_gpt124m()
+    print(json.dumps({
+        "metric": "gpt124m_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

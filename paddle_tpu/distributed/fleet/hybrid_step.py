@@ -1,0 +1,488 @@
+"""Hybrid-parallel (dp x mp x pp, + Megatron-SP, + ZeRO) SPMD train step.
+
+This is the TPU-native counterpart of the reference's Fleet hybrid training
+path (`fleet/fleet.py:167` + `fleet/meta_parallel/pipeline_parallel.py:458`
+forward_backward_pipeline + `fleet/layers/mpu/mp_layers.py` +
+`fleet/meta_parallel/sharding/dygraph_sharding_optimizer.py:44`): ONE jitted
+SPMD program over a `jax.sharding.Mesh` with axes (pp, dp, mp) that runs
+
+* **PP**  — the microbatch pipeline with `lax.ppermute` moving activations
+  over the pp axis (compiles to ICI collective-permute). Only per-microbatch
+  *scalars* (the loss) cross stages outside the schedule; activations flow
+  strictly neighbor-to-neighbor.
+* **TP**  — Megatron column/row-parallel QKV/MLP with explicit `psum` /
+  `psum_scatter` over the mp axis (reference `mp_layers.py:334,:541`) and a
+  vocab-parallel embedding + parallel softmax cross-entropy
+  (reference `mp_layers.py:47,:742`).
+* **SP**  — Megatron-style sequence parallelism fused with TP (reference
+  `fleet/utils/sequence_parallel_utils.py:85-395`): activations between the
+  TP blocks are sharded over the *sequence* dim on the mp axis; entering a
+  TP region all-gathers the sequence, leaving it reduce-scatters — so the
+  LayerNorm/residual work and memory are 1/mp per rank.
+* **DP + ZeRO-1** — batch sharded over dp; gradients all-reduced over dp;
+  optimizer (Adam) state sharded over dp (reference
+  `dygraph_sharding_optimizer.py:44`): each dp rank updates 1/dp of every
+  parameter and all-gathers the result.
+* **remat** — each pipeline stage runs under `jax.checkpoint`, bounding
+  live activations to one microbatch per stage (the 1F1B memory profile;
+  reference `passes/pipeline_scheduler_pass/pipeline_1f1b.py`).
+
+Backward is jax AD *through the whole schedule* — every collective has an
+exact transpose (ppermute -> reverse permute, psum_scatter <-> all_gather),
+so the backward pipeline and the TP/SP gradient collectives fall out of the
+forward description.
+
+The serial functions (`serial_forward`, `serial_train_step`) implement the
+identical math without collectives; tests assert loss parity to ~1e-4.
+Expert parallelism lives in `paddle_tpu.incubate.moe` (separate module).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "HybridConfig", "init_gpt_params", "stack_for_pipeline",
+    "hybrid_param_specs", "init_zero_state", "make_hybrid_train_step",
+    "serial_train_step", "serial_forward",
+]
+
+
+@dataclass
+class HybridConfig:
+    vocab_size: int = 128
+    hidden_size: int = 64
+    num_layers: int = 4
+    num_heads: int = 4
+    seq_len: int = 32
+    intermediate_size: int = 0
+    # parallel degrees
+    pp: int = 2
+    mp: int = 2
+    dp: int = 2
+    n_microbatches: int = 2
+    sequence_parallel: bool = True
+    remat: bool = True
+    # optimizer
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.intermediate_size == 0:
+            self.intermediate_size = 4 * self.hidden_size
+        assert self.num_layers % self.pp == 0
+        assert self.num_heads % self.mp == 0
+        assert self.hidden_size % self.num_heads == 0
+        assert self.vocab_size % self.mp == 0
+        if self.sequence_parallel:
+            assert self.seq_len % self.mp == 0
+
+    @property
+    def layers_per_stage(self):
+        return self.num_layers // self.pp
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+# --------------------------------------------------------------------------
+# parameter init (serial layout) and pipeline stacking
+# --------------------------------------------------------------------------
+
+def init_gpt_params(key, cfg: HybridConfig) -> Dict[str, Any]:
+    """Serial GPT parameter pytree: blocks as stacked [L, ...] leaves."""
+    H, I, V, S, L = (cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size,
+                     cfg.seq_len, cfg.num_layers)
+    ks = jax.random.split(key, 8)
+    std = 0.02
+    dt = cfg.dtype
+
+    def nrm(k, shape, scale=std):
+        return (jax.random.normal(k, shape) * scale).astype(dt)
+
+    blocks = {
+        "ln1_g": jnp.ones((L, H), dt), "ln1_b": jnp.zeros((L, H), dt),
+        "wqkv": nrm(ks[0], (L, H, 3 * H)), "bqkv": jnp.zeros((L, 3 * H), dt),
+        "wproj": nrm(ks[1], (L, H, H), std / math.sqrt(2 * L)),
+        "bproj": jnp.zeros((L, H), dt),
+        "ln2_g": jnp.ones((L, H), dt), "ln2_b": jnp.zeros((L, H), dt),
+        "wfc1": nrm(ks[2], (L, H, I)), "bfc1": jnp.zeros((L, I), dt),
+        "wfc2": nrm(ks[3], (L, I, H), std / math.sqrt(2 * L)),
+        "bfc2": jnp.zeros((L, H), dt),
+    }
+    return {
+        "blocks": blocks,
+        "wte": nrm(ks[4], (V, H)),
+        "wpe": nrm(ks[5], (S, H)),
+        "lnf_g": jnp.ones((H,), dt), "lnf_b": jnp.zeros((H,), dt),
+        "head": nrm(ks[6], (H, V)),
+    }
+
+
+def stack_for_pipeline(params: Dict[str, Any], cfg: HybridConfig):
+    """Reshape block leaves [L, ...] -> [pp, L/pp, ...] (leading pp dim)."""
+    out = dict(params)
+    out["blocks"] = {
+        k: v.reshape((cfg.pp, cfg.layers_per_stage) + v.shape[1:])
+        for k, v in params["blocks"].items()}
+    return out
+
+
+def hybrid_param_specs(cfg: HybridConfig) -> Dict[str, Any]:
+    """PartitionSpec tree matching `stack_for_pipeline` output.
+
+    TP layout mirrors the reference mp_layers: qkv/fc1 column-parallel
+    (out-dim on mp), proj/fc2 row-parallel (in-dim on mp), embedding
+    vocab-parallel, LM head column-parallel over vocab."""
+    return {
+        "blocks": {
+            "ln1_g": P("pp"), "ln1_b": P("pp"),
+            "wqkv": P("pp", None, None, "mp"), "bqkv": P("pp", None, "mp"),
+            "wproj": P("pp", None, "mp", None), "bproj": P("pp"),
+            "ln2_g": P("pp"), "ln2_b": P("pp"),
+            "wfc1": P("pp", None, None, "mp"), "bfc1": P("pp", None, "mp"),
+            "wfc2": P("pp", None, "mp", None), "bfc2": P("pp"),
+        },
+        "wte": P("mp", None),
+        "wpe": P(),
+        "lnf_g": P(), "lnf_b": P(),
+        "head": P(None, "mp"),
+    }
+
+
+def _spec_axes(spec: P):
+    return tuple(a for a in spec if a is not None)
+
+
+def _flatten_with_specs(tree, specs):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    spec_leaves = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    assert len(leaves) == len(spec_leaves)
+    return leaves, spec_leaves, treedef
+
+
+def init_zero_state(stacked: Dict[str, Any], specs: Dict[str, Any],
+                    mesh: Mesh) -> Tuple[Any, Any, Any]:
+    """Adam (m, v) with every leaf flattened and sharded over dp (ZeRO-1).
+
+    For a param leaf with global shape G and spec axes A, the local shard
+    has F = prod(G / sizes(A)) elements; the opt leaf's global shape is
+    [sizes(A)..., dp*ceil(F/dp)] with spec P(*A, 'dp') — so inside
+    shard_map each device holds exactly its own [Fp/dp] slice.
+    Returns (m, v, opt_specs) with m/v/opt_specs matching `stacked`'s
+    structure."""
+    dp = mesh.shape["dp"]
+    leaves, spec_leaves, treedef = _flatten_with_specs(stacked, specs)
+
+    def leaf_state(p, spec):
+        axes = _spec_axes(spec)
+        local_shape = list(p.shape)
+        for i, a in enumerate(spec):
+            if a is not None:
+                local_shape[i] //= mesh.shape[a]
+        F = int(np.prod(local_shape))
+        Fp = dp * ((F + dp - 1) // dp)
+        gshape = tuple(mesh.shape[a] for a in axes) + (Fp,)
+        return jnp.zeros(gshape, p.dtype)
+
+    m = [leaf_state(p, s) for p, s in zip(leaves, spec_leaves)]
+    opt_spec_leaves = [P(*_spec_axes(s), "dp") for s in spec_leaves]
+    un = jax.tree_util.tree_unflatten
+    return (un(treedef, m), un(treedef, [jnp.copy(x) for x in m]),
+            un(treedef, opt_spec_leaves))
+
+
+# --------------------------------------------------------------------------
+# model math (shared by serial and SPMD paths)
+# --------------------------------------------------------------------------
+
+def _layer_norm(x, g, b, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _attention(q, k, v):
+    # q,k,v: [B, S, nh, hd] -> [B, S, nh, hd], causal
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    S = q.shape[1]
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False):
+    """One pre-LN transformer block.  Serial when mp_axis is None.
+
+    With seq_parallel, x enters/leaves sequence-sharded [B, S/mp, H]; the
+    TP regions (QKV..proj, FC1..FC2) see the full sequence via all-gather
+    in / reduce-scatter out (the AllGatherOp/ReduceScatterOp pair of
+    `sequence_parallel_utils.py:85-137`, as plain XLA collectives whose
+    transposes give the backward)."""
+    take = lambda leaf: p[leaf][lidx]
+
+    def enter_tp(h):  # [B, s, H] -> [B, S, H]
+        if seq_parallel:
+            return jax.lax.all_gather(h, mp_axis, axis=1, tiled=True)
+        return h
+
+    def leave_tp(h):  # row-parallel output: sum partials, re-shard seq
+        if seq_parallel:
+            return jax.lax.psum_scatter(h, mp_axis, scatter_dimension=1,
+                                        tiled=True)
+        if mp_axis is not None:
+            return jax.lax.psum(h, mp_axis)
+        return h
+
+    B = x.shape[0]
+    h = _layer_norm(x, take("ln1_g"), take("ln1_b"))
+    h = enter_tp(h)
+    S = h.shape[1]
+    # wqkv's 3H output dim is laid out [nh, 3, hd] (per-head q,k,v
+    # contiguous, Megatron-style) so an mp column-shard is whole heads
+    qkv = h @ take("wqkv") + take("bqkv")      # [B, S, 3*H/mp]
+    qkv = qkv.reshape(B, S, nh_local, 3, -1)
+    q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+    a = _attention(q, k, v).reshape(B, S, -1)
+    a = leave_tp(a @ take("wproj"))
+    x = x + a + take("bproj")
+    h = _layer_norm(x, take("ln2_g"), take("ln2_b"))
+    h = enter_tp(h)
+    f = jax.nn.gelu(h @ take("wfc1") + take("bfc1"), approximate=True)
+    f = leave_tp(f @ take("wfc2"))
+    return x + f + take("bfc2")
+
+
+def _lm_loss(logits, labels, *, mp_axis=None, vstart=0):
+    """Causal-LM loss over logits [B, S, V(/mp)]; ignores the last position.
+
+    With mp_axis set this is the parallel softmax cross-entropy of
+    `mp_layers.py:742` ParallelCrossEntropy: logits stay vocab-sharded and
+    only [B, S] reductions cross the mp axis."""
+    logits = logits.astype(jnp.float32)
+    # max subtraction is gradient-neutral in logsumexp -> stop_gradient
+    # (pmax has no transpose rule, and none is needed)
+    mx = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    if mp_axis is not None:
+        mx = jax.lax.stop_gradient(jax.lax.pmax(mx, mp_axis))
+    se = jnp.sum(jnp.exp(logits - mx), axis=-1)
+    if mp_axis is not None:
+        se = jax.lax.psum(se, mp_axis)
+    logz = jnp.squeeze(mx, -1) + jnp.log(se)          # [B, S]
+    Vloc = logits.shape[-1]
+    loc = labels - vstart
+    in_range = (loc >= 0) & (loc < Vloc)
+    tgt = jnp.take_along_axis(
+        logits, jnp.clip(loc, 0, Vloc - 1)[..., None], axis=-1)[..., 0]
+    tgt = jnp.where(in_range, tgt, 0.0)
+    if mp_axis is not None:
+        tgt = jax.lax.psum(tgt, mp_axis)
+    nll = logz - tgt                                   # [B, S]
+    mask = jnp.arange(nll.shape[1]) < nll.shape[1] - 1
+    return jnp.sum(nll * mask) / jnp.sum(mask) / nll.shape[0]
+
+
+# --------------------------------------------------------------------------
+# serial reference path
+# --------------------------------------------------------------------------
+
+def serial_forward(params, ids, cfg: HybridConfig):
+    """ids [B, S] -> mean causal-LM loss (labels = ids shifted left)."""
+    S = ids.shape[1]
+    x = params["wte"][ids] + params["wpe"][:S]
+    for l in range(cfg.num_layers):
+        x = _block(params["blocks"], x, l, cfg.num_heads)
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    logits = x @ params["head"]
+    labels = jnp.roll(ids, -1, axis=1)
+    return _lm_loss(logits, labels)
+
+
+def _adam_math(p, g, m, v, step, cfg: HybridConfig):
+    m2 = cfg.beta1 * m + (1 - cfg.beta1) * g
+    v2 = cfg.beta2 * v + (1 - cfg.beta2) * jnp.square(g)
+    mh = m2 / (1 - cfg.beta1 ** step)
+    vh = v2 / (1 - cfg.beta2 ** step)
+    return p - cfg.learning_rate * mh / (jnp.sqrt(vh) + cfg.eps), m2, v2
+
+
+def serial_train_step(params, m, v, step, ids, cfg: HybridConfig):
+    """One Adam step on the serial model; ids [M, B, S] (same microbatch
+    grouping as the pipeline so loss parity is exact)."""
+    M = cfg.n_microbatches
+
+    def loss_fn(ps):
+        per_mb = jnp.stack([serial_forward(ps, ids[i], cfg)
+                            for i in range(M)])
+        return jnp.mean(per_mb)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    m_leaves = jax.tree_util.tree_leaves(m)
+    v_leaves = jax.tree_util.tree_leaves(v)
+    new_p, new_m, new_v = [], [], []
+    for p, g, mm, vv in zip(leaves, g_leaves, m_leaves, v_leaves):
+        p2, m2, v2 = _adam_math(p, g, mm, vv, step, cfg)
+        new_p.append(p2); new_m.append(m2); new_v.append(v2)
+    un = jax.tree_util.tree_unflatten
+    return (loss, un(treedef, new_p), un(treedef, new_m),
+            un(treedef, new_v))
+
+
+# --------------------------------------------------------------------------
+# SPMD hybrid step
+# --------------------------------------------------------------------------
+
+def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
+    """Build the jitted hybrid train step over mesh axes (pp, dp, mp).
+
+    Returns step(stacked_params, m, v, step_no, ids) -> (loss, params, m, v)
+    where ids is [M, B, S] int32 (dp-sharded on B) and step_no is the
+    1-based Adam step (float).  All parallelism happens inside ONE shard_map;
+    XLA's latency-hiding scheduler overlaps the ppermutes and TP collectives
+    with compute."""
+    specs = hybrid_param_specs(cfg)
+    PP, MP, DP = cfg.pp, cfg.mp, cfg.dp
+    M = cfg.n_microbatches
+    nh_local = cfg.num_heads // MP
+    Vloc = cfg.vocab_size // MP
+    sp = cfg.sequence_parallel
+
+    # opt-state specs (structure-matched to params)
+    shapes = jax.eval_shape(
+        lambda k: stack_for_pipeline(init_gpt_params(k, cfg), cfg),
+        jax.random.key(0))
+    _, _, opt_specs = init_zero_state(shapes, specs, mesh)
+
+    spec_leaves = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+
+    def device_fn(params, m, v, step_no, ids_local):
+        pp_i = jax.lax.axis_index("pp")
+        mp_i = jax.lax.axis_index("mp")
+        dp_i = jax.lax.axis_index("dp")
+        # drop the unit leading pp dim of the local stage-param shards
+        local = dict(params)
+        local["blocks"] = {k: leaf[0]
+                           for k, leaf in params["blocks"].items()}
+
+        def embed(ps, ids):  # [B, S] -> [B, S(/mp), H], vocab-parallel
+            loc = ids - mp_i * Vloc
+            ok = (loc >= 0) & (loc < Vloc)
+            e = jnp.where(ok[..., None],
+                          jnp.take(ps["wte"], jnp.clip(loc, 0, Vloc - 1),
+                                   axis=0), 0.0)
+            if sp:
+                e = jax.lax.psum_scatter(e, "mp", scatter_dimension=1,
+                                         tiled=True)
+                s = e.shape[1]
+                pos = jax.lax.dynamic_slice_in_dim(
+                    ps["wpe"], mp_i * s, s, axis=0)
+            else:
+                e = jax.lax.psum(e, "mp")
+                pos = ps["wpe"][:ids.shape[1]]
+            return e + pos
+
+        def stage(ps, h):
+            for l in range(cfg.layers_per_stage):
+                h = _block(ps["blocks"], h, l, nh_local, mp_axis="mp",
+                           seq_parallel=sp)
+            return h
+
+        stage_fn = jax.checkpoint(stage) if cfg.remat else stage
+
+        def head_loss(ps, h, labels):
+            h = _layer_norm(h, ps["lnf_g"], ps["lnf_b"])
+            if sp:
+                h = jax.lax.all_gather(h, "mp", axis=1, tiled=True)
+            logits = h @ ps["head"]
+            return _lm_loss(logits, labels, mp_axis="mp",
+                            vstart=mp_i * Vloc)
+
+        labels_all = jnp.roll(ids_local, -1, axis=2)     # [M, b, S]
+
+        def loss_fn(ps):
+            B, S = ids_local.shape[1], ids_local.shape[2]
+            s = S // MP if sp else S
+            carry = jnp.zeros((B, s, cfg.hidden_size), cfg.dtype)
+            loss_acc = jnp.zeros((), jnp.float32)
+            perm = [(i, (i + 1) % PP) for i in range(PP)]
+            for t in range(M + PP - 1):
+                feed = jnp.clip(t, 0, M - 1)
+                h_in = jnp.where(pp_i == 0, embed(ps, ids_local[feed]),
+                                 carry)
+                h_out = stage_fn(ps, h_in)
+                mb = t - (PP - 1)
+                lab = labels_all[jnp.clip(mb, 0, M - 1)]
+                l = head_loss(ps, h_out, lab)
+                valid = (pp_i == PP - 1) & (mb >= 0) & (mb < M)
+                loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+                carry = jax.lax.ppermute(h_out, "pp", perm)
+            total = jax.lax.psum(loss_acc / M, "pp")
+            return jax.lax.pmean(total, "dp")
+
+        loss, grads = jax.value_and_grad(loss_fn)(local)
+
+        # restore the stacked layout on block grads
+        g_stacked = dict(grads)
+        g_stacked["blocks"] = {k: leaf[None]
+                               for k, leaf in grads["blocks"].items()}
+
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = jax.tree_util.tree_leaves(g_stacked)
+        m_leaves = jax.tree_util.tree_leaves(m)
+        v_leaves = jax.tree_util.tree_leaves(v)
+
+        new_p, new_m, new_v = [], [], []
+        for p, g, mm, vv, spec in zip(p_leaves, g_leaves, m_leaves,
+                                      v_leaves, spec_leaves):
+            # gradients: sum the per-rank contributions over every mesh
+            # axis the leaf is NOT sharded on (GSPMD's replica all-reduce,
+            # done explicitly)
+            for ax in ("pp", "dp", "mp"):
+                if ax not in _spec_axes(spec):
+                    g = jax.lax.psum(g, ax)
+            # ZeRO-1 Adam: update only this dp rank's 1/dp slice, then
+            # all-gather the updated parameter
+            shp, F = p.shape, p.size
+            k = mm.size                                   # Fp/dp (local)
+            flat_p = jnp.pad(p.reshape(-1), (0, DP * k - F))
+            flat_g = jnp.pad(g.reshape(-1), (0, DP * k - F))
+            psh = jax.lax.dynamic_slice(flat_p, (dp_i * k,), (k,))
+            gsh = jax.lax.dynamic_slice(flat_g, (dp_i * k,), (k,))
+            p2sh, m2, v2 = _adam_math(psh, gsh, mm.reshape(-1),
+                                      vv.reshape(-1), step_no, cfg)
+            p2 = jax.lax.all_gather(p2sh, "dp", tiled=True)
+            new_p.append(p2[:F].reshape(shp))
+            new_m.append(m2.reshape(mm.shape))
+            new_v.append(v2.reshape(vv.shape))
+
+        un = jax.tree_util.tree_unflatten
+        return (loss, un(treedef, new_p), un(treedef, new_m),
+                un(treedef, new_v))
+
+    # check_vma=False: the updated params ARE dp-replicated (grads are
+    # psum'd over dp before the update and shards all-gathered after), but
+    # the static varying-axes analysis can't prove it through all_gather
+    mapped = jax.shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(specs, opt_specs, opt_specs, P(), P(None, "dp", None)),
+        out_specs=(P(), specs, opt_specs, opt_specs),
+        check_vma=False)
+    return jax.jit(mapped)

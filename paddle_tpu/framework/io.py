@@ -48,7 +48,8 @@ def _from_host(obj, return_numpy=False):
     if isinstance(obj, (list, tuple)):
         return type(obj)(_from_host(v, return_numpy) for v in obj)
     if isinstance(obj, np.ndarray) and not return_numpy \
-            and obj.dtype.kind in "biufc" and obj.dtype.itemsize <= 4:
+            and (obj.dtype.kind in "biuf" and obj.dtype.itemsize <= 4
+                 or obj.dtype == np.complex64):
         # upstream paddle.save pickles bare numpy arrays in state dicts;
         # match reference load semantics by returning Tensors. 64-bit
         # arrays pass through as numpy: x32 canonicalization would

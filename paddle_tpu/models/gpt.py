@@ -129,12 +129,22 @@ class GPTModel(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
+        # GPT-2/3 parameterization: embeddings ~ N(0, 0.02) (the Embedding
+        # layer default of N(0, 1) puts the tied-head logits and the
+        # initial loss way off scale); passed as weight_attr so init runs
+        # before VocabParallelEmbedding shards the table
+        from .. import ParamAttr
+        from ..nn.initializer import Normal
+        emb_attr = lambda: ParamAttr(initializer=Normal(0.0, 0.02))
         if cfg.tensor_parallel:
             from ..distributed.fleet import VocabParallelEmbedding
-            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size)
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                              weight_attr=emb_attr())
         else:
-            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
-        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+            self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                    weight_attr=emb_attr())
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                weight_attr=emb_attr())
         self.drop = nn.Dropout(cfg.dropout)
         self.blocks = nn.LayerList([GPTBlock(cfg)
                                     for _ in range(cfg.num_layers)])

@@ -291,8 +291,7 @@ class Optimizer:
                 if key.endswith("_" + acc):
                     p = by_name.get(key[:-len(acc) - 1])
                     if p is not None:
-                        if acc != "master_weight" and \
-                                tuple(val.shape) != tuple(p.shape):
+                        if tuple(val.shape) != tuple(p.shape):
                             warnings.warn(
                                 f"optimizer.set_state_dict: {key!r} shape "
                                 f"{tuple(val.shape)} does not match param "
