@@ -68,7 +68,7 @@ def bench_gpt124m():
     B, S = (4, 1024) if on_tpu else (2, 256)
 
     paddle.seed(0)
-    cfg = gpt3_124m() if on_tpu else gpt3_124m()
+    cfg = gpt3_124m()
     model = GPTForCausalLM(cfg)
     model.train()
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())

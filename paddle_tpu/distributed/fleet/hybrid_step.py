@@ -50,7 +50,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
     "HybridConfig", "init_gpt_params", "stack_for_pipeline",
-    "hybrid_param_specs", "init_zero_state", "make_hybrid_train_step",
+    "hybrid_param_specs", "init_zero_state", "zero_state_specs",
+    "make_hybrid_train_step",
     "serial_train_step", "serial_forward",
 ]
 
@@ -171,6 +172,15 @@ def _flatten_with_specs(tree, specs):
         specs, is_leaf=lambda x: isinstance(x, P))[0]
     assert len(leaves) == len(spec_leaves)
     return leaves, spec_leaves, treedef
+
+
+def zero_state_specs(specs: Dict[str, Any]):
+    """Opt-state PartitionSpec tree (P(*param_axes, 'dp') per leaf) without
+    materializing any state arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        specs, is_leaf=lambda x: isinstance(x, P))
+    return jax.tree_util.tree_unflatten(
+        treedef, [P(*_spec_axes(s), "dp") for s in leaves])
 
 
 def init_zero_state(stacked: Dict[str, Any], specs: Dict[str, Any],
@@ -365,10 +375,7 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     sp = cfg.sequence_parallel
 
     # opt-state specs (structure-matched to params)
-    shapes = jax.eval_shape(
-        lambda k: stack_for_pipeline(init_gpt_params(k, cfg), cfg),
-        jax.random.key(0))
-    _, _, opt_specs = init_zero_state(shapes, specs, mesh)
+    opt_specs = zero_state_specs(specs)
 
     spec_leaves = jax.tree_util.tree_flatten(
         specs, is_leaf=lambda x: isinstance(x, P))[0]

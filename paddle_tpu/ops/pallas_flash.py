@@ -1,0 +1,379 @@
+"""FlashAttention-2 as Pallas TPU kernels (forward + backward).
+
+Role of the reference's CUDA flash attention
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu` + vendored
+`third_party/flashattn`, and the fused path of
+`fused_multi_transformer_op.cu`): attention computed blockwise in VMEM so
+the [S, S] score matrix never materializes in HBM.
+
+Layout follows paddle's flash-attn API: q, k, v are [B, S, nh, hd].
+
+Kernel structure (the canonical TPU pattern — the *last* grid dimension is
+sequential on TPU, so the online-softmax state lives in VMEM scratch across
+k-block steps):
+
+* forward: grid (B*nh, Sq/BQ, Sk/BK); scratch (m, l, acc); causal blocks
+  above the diagonal are skipped (`pl.when`), the diagonal block is masked
+  with `broadcasted_iota`.  Outputs out and the logsumexp rows (for bwd).
+* backward dq: grid (B*nh, Sq/BQ, Sk/BK), accumulates dq over k blocks.
+* backward dkv: grid (B*nh, Sk/BK, Sq/BQ), accumulates dk/dv over q blocks.
+  Uses the FlashAttention-2 identity ds = p * (dp - D), D = rowsum(dO * O),
+  so no second softmax pass is needed.
+
+All matmuls run on the MXU with f32 accumulation (`preferred_element_type`);
+bf16 inputs stay bf16 in HBM.  On non-TPU backends the same kernels run
+under the Pallas interpreter (CPU CI), selected automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled builds; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["flash_attention", "flash_attention_fwd", "supported"]
+
+_NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def supported(q_shape, dtype=None) -> bool:
+    """Kernel applicability: seq a multiple of the block, MXU-friendly hd."""
+    if len(q_shape) != 4:
+        return False
+    _, S, _, hd = q_shape
+    bq = min(128, S)
+    return S % bq == 0 and S % 8 == 0 and S >= 8 and hd in (64, 128, 256)
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * bq
+    k_start = ki * bk
+
+    # causal: skip blocks strictly above the diagonal
+    run = True if not causal else (k_start <= q_start + bq - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[:, :]                       # [bq, hd]
+        k = k_ref[:, :]                       # [bk, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_scr[:, 0]                         # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])              # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)              # [bq]
+        l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
+        v = v_ref[:, :]                        # [bk, hd]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bq, hd]
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:, :] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
+        # lse rows broadcast across a 128-lane dim (Mosaic tile alignment,
+        # same layout as jax's reference flash kernel)
+        lse_ref[:, :] = m_scr[:, :] + jnp.broadcast_to(
+            jnp.log(l_safe)[:, None], lse_ref.shape)
+
+
+def _bnsh(x):
+    return jnp.transpose(x, (0, 2, 1, 3))  # [B, S, nh, hd] -> [B, nh, S, hd]
+
+
+def _pick_block(S, target):
+    """Largest block <= target that divides S (halving; terminates at <=128
+    because `supported` requires S % min(128, S) == 0)."""
+    b = min(target, S)
+    while S % b:
+        b //= 2
+    return b
+
+
+def flash_attention_fwd(q, k, v, causal=False, interpret=None,
+                        block_q=512, block_k=1024):
+    """Returns (out, lse); out [B, S, nh, hd], lse [B, nh, S, 128]
+    (float32, rows broadcast across the 128-lane dim).
+
+    Kernels run in BNSH layout so blocks are rank-2 [block, hd] after
+    squeezing the (batch, head) dims — Mosaic's lane/sublane alignment
+    applies to the (seq, hd) dims, which are tile-friendly."""
+    if interpret is None:
+        interpret = _interpret_default()
+    B, S, nh, hd = q.shape
+    Sk = k.shape[1]
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(Sk, block_k)
+    nq, nk = S // bq, Sk // bk
+    scale = 1.0 / math.sqrt(hd)
+
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk, nk=nk)
+    grid = (B * nh, nq, nk)
+
+    def qmap(bh, qi, ki):
+        return (bh // nh, bh % nh, qi, 0)
+
+    def kmap(bh, qi, ki):
+        return (bh // nh, bh % nh, ki, 0)
+
+    def lsemap4(bh, qi, ki):
+        return (bh // nh, bh % nh, qi, 0)
+
+    out, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, bq, hd), qmap),
+            pl.BlockSpec((None, None, bk, hd), kmap),
+            pl.BlockSpec((None, None, bk, hd), kmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, bq, hd), qmap),
+            pl.BlockSpec((None, None, bq, 128), lsemap4),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nh, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, nh, S, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_bnsh(q), _bnsh(k), _bnsh(v))
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   dq_scr, *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    run = True if not causal else (k_start <= q_start + bq - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        do = do_ref[:, :].astype(jnp.float32)
+        lse = lse_ref[:, 0:1]                  # [bq, 1]
+        # D = rowsum(dO * O) (FlashAttention-2), computed on the block
+        delta = jnp.sum(do * o_ref[:, :].astype(jnp.float32), axis=1,
+                        keepdims=True)         # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                         # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[:, :] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bk, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * bq
+    k_start = ki * bk
+    run = True if not causal else (k_start <= q_start + bq - 1)
+
+    @pl.when(run)
+    def _():
+        q = q_ref[:, :]
+        k = k_ref[:, :]
+        v = v_ref[:, :]
+        do = do_ref[:, :].astype(jnp.float32)
+        lse = lse_ref[:, 0:1]                  # [bq, 1]
+        delta = jnp.sum(do * o_ref[:, :].astype(jnp.float32), axis=1,
+                        keepdims=True)         # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                         # [bq, bk]
+        # dv += p^T @ do
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bk, hd]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [bq, bk]
+        ds = p * (dp - delta) * scale                # [bq, bk]
+        # dk += ds^T @ q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[:, :] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:, :] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(causal, interpret, res, g, block_q=512, block_k=512):
+    q, k, v, out, lse = res
+    if interpret is None:
+        interpret = _interpret_default()
+    B, S, nh, hd = q.shape
+    Sk = k.shape[1]
+    bq = _pick_block(S, block_q)
+    bk = _pick_block(Sk, block_k)
+    nq, nk = S // bq, Sk // bk
+    scale = 1.0 / math.sqrt(hd)
+
+    qb, kb, vb = _bnsh(q), _bnsh(k), _bnsh(v)
+    ob, gb = _bnsh(out), _bnsh(g)
+
+    def qmap(bh, qi, ki):
+        return (bh // nh, bh % nh, qi, 0)
+
+    def kmap(bh, qi, ki):
+        return (bh // nh, bh % nh, ki, 0)
+
+    def rowmap(bh, qi, ki):
+        return (bh // nh, bh % nh, qi, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(B * nh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((None, None, bq, hd), qmap),
+            pl.BlockSpec((None, None, bk, hd), kmap),
+            pl.BlockSpec((None, None, bk, hd), kmap),
+            pl.BlockSpec((None, None, bq, hd), qmap),
+            pl.BlockSpec((None, None, bq, hd), qmap),
+            pl.BlockSpec((None, None, bq, 128), rowmap),
+        ],
+        out_specs=pl.BlockSpec((None, None, bq, hd), qmap),
+        out_shape=jax.ShapeDtypeStruct((B, nh, S, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, ob, gb, lse)
+
+    # dkv: grid ordered (bh, ki, qi) — q is the sequential axis
+    def kmap2(bh, ki, qi):
+        return (bh // nh, bh % nh, ki, 0)
+
+    def qmap2(bh, ki, qi):
+        return (bh // nh, bh % nh, qi, 0)
+
+    def rowmap2(bh, ki, qi):
+        return (bh // nh, bh % nh, qi, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(B * nh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((None, None, bq, hd), qmap2),
+            pl.BlockSpec((None, None, bk, hd), kmap2),
+            pl.BlockSpec((None, None, bk, hd), kmap2),
+            pl.BlockSpec((None, None, bq, hd), qmap2),
+            pl.BlockSpec((None, None, bq, hd), qmap2),
+            pl.BlockSpec((None, None, bq, 128), rowmap2),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, bk, hd), kmap2),
+            pl.BlockSpec((None, None, bk, hd), kmap2),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nh, Sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, nh, Sk, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, hd), jnp.float32),
+            pltpu.VMEM((bk, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, ob, gb, lse)
+    tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    return tr(dq), tr(dk), tr(dv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, interpret=None):
+    """Flash attention; q, k, v: [B, S, nh, hd] -> [B, S, nh, hd]."""
+    out, _ = flash_attention_fwd(q, k, v, causal, interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, interpret):
+    out, lse = flash_attention_fwd(q, k, v, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+flash_attention.defvjp(_fa_fwd, _flash_bwd)

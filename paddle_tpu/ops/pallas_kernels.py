@@ -1,13 +1,16 @@
-"""Pallas TPU kernels (flash attention etc.).
+"""Pallas TPU kernel dispatch (flash attention).
 
 Role of the reference's hand-fused CUDA kernels
-(`phi/kernels/gpu/flash_attn_kernel.cu`, `fusion/gpu/fused_rope_kernel.cu`,
-`fused_layernorm_kernel.cu`): ops XLA won't fuse optimally get hand-written
-TPU kernels.  Each kernel has an XLA fallback so the same model code runs on
-the CPU test mesh.
+(`phi/kernels/gpu/flash_attn_kernel.cu`, `fusion/gpu/` fused ops): ops XLA
+won't fuse optimally get hand-written TPU kernels.  The actual kernels live
+in `pallas_flash.py`; this module gates applicability and registers the
+dispatched op so the eager tape engine differentiates through the kernel's
+custom VJP.
 
-Availability gating: kernels require a real TPU backend and MXU-friendly
-shapes (head_dim multiple of 128 preferred); otherwise callers fall back.
+Gating: the kernel path is taken on a real TPU backend with supported
+shapes (seq divisible by the block, head_dim in {64, 128, 256}), no
+attention mask, and no dropout; anything else falls back to the fused XLA
+softmax(QK^T)V path, so the same model code runs on the CPU test mesh.
 """
 
 from __future__ import annotations
@@ -15,7 +18,13 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
+
+from .registry import dispatch as _d, register_op
+
+try:
+    from . import pallas_flash
+except ImportError:  # pragma: no cover - jax build without pallas
+    pallas_flash = None
 
 __all__ = ["flash_attention", "flash_attention_available"]
 
@@ -23,31 +32,38 @@ __all__ = ["flash_attention", "flash_attention_available"]
 @functools.cache
 def _on_tpu() -> bool:
     try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
         return False
 
 
 def flash_attention_available(q, k, v, mask=None) -> bool:
+    if pallas_flash is None or getattr(pallas_flash, "pltpu", None) is None:
+        return False
     if mask is not None:
         return False
     if not _on_tpu():
         return False
-    head_dim = q.shape[-1]
-    seq = q.shape[1]
-    # block sizes need seq multiple of 128 and head_dim in MXU-friendly range
-    return head_dim % 128 == 0 and seq % 128 == 0
+    if q.shape[1] != k.shape[1]:
+        return False  # cross/cached attention: fall back for now
+    return pallas_flash.supported(tuple(q.shape))
+
+
+if pallas_flash is not None:
+    register_op("flash_attention",
+                lambda q, k, v, *, causal: pallas_flash.flash_attention(
+                    q, k, v, causal, None),
+                tags=("mxu", "fused", "pallas"))
 
 
 def flash_attention(q, k, v, causal=False, dropout_p=0.0):
-    """Pallas flash-attention (forward); falls back to fused XLA if the
-    kernel can't apply.  Dropout inside the kernel is not yet supported —
-    callers pass dropout_p=0 or use the XLA path."""
+    """Pallas flash-attention on [B, S, nh, hd] Tensors; differentiable
+    through the kernel's custom VJP (FlashAttention-2 backward kernels).
+
+    Dropout inside the kernel is not supported — callers with dropout take
+    the XLA path (`flash_attention_available` returns False is enforced by
+    the caller passing dropout_p=0)."""
     from ..nn.functional.attention import sdpa_xla
     if dropout_p > 0.0 or not flash_attention_available(q, k, v):
         return sdpa_xla(q, k, v, None, dropout_p, causal, None, True)
-    try:
-        from .pallas_flash import flash_attention_fwd
-    except ImportError:
-        return sdpa_xla(q, k, v, None, 0.0, causal, None, True)
-    return flash_attention_fwd(q, k, v, causal=causal)
+    return _d("flash_attention", (q, k, v), {"causal": bool(causal)})
