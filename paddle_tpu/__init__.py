@@ -60,6 +60,8 @@ from . import regularizer  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from .param_attr import ParamAttr  # noqa: F401,E402
 from . import jit  # noqa: F401,E402
+from . import autograd  # noqa: F401,E402
+from .autograd import grad  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402

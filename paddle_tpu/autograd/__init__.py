@@ -1,0 +1,303 @@
+"""User-facing autograd extension points.
+
+Parity targets:
+* ``PyLayer`` — `python/paddle/autograd/py_layer.py:29` (custom forward /
+  backward with a context object, integrated with the eager tape via a
+  dedicated GradNode, like `fluid/eager/pylayer/py_layer_node.h`).
+* ``grad`` — `python/paddle/base/dygraph/base.py:595` (multi-output
+  partial grad without touching ``.grad``; double grad via
+  ``create_graph=True`` — the engine re-dispatches each vjp as an op so
+  gradients carry their own tape, the role of `fluid/eager/general_grad.h`).
+* ``jacobian`` / ``hessian`` — `python/paddle/autograd/autograd.py`.
+* ``saved_tensors_hooks`` — `python/paddle/autograd/saved_tensors_hooks.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import autograd_engine as _engine
+from ..framework.dygraph import no_grad
+from ..framework.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "grad", "backward", "jacobian",
+           "hessian", "saved_tensors_hooks", "no_grad"]
+
+
+# --------------------------------------------------------------------------
+# PyLayer
+# --------------------------------------------------------------------------
+
+_saved_tensor_hooks: List[tuple] = []  # (pack, unpack) stack
+
+
+class saved_tensors_hooks:
+    """Context manager transforming tensors saved for backward.
+
+    ``pack(tensor) -> obj`` runs at save time, ``unpack(obj) -> tensor`` at
+    use time (reference `autograd/saved_tensors_hooks.py`)."""
+
+    def __init__(self, pack_hook: Callable, unpack_hook: Callable):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_tensor_hooks.pop()
+        return False
+
+
+class PyLayerContext:
+    """Context handed to PyLayer.forward/backward (ref py_layer.py:29)."""
+
+    def __init__(self):
+        self._saved: List[Any] = []
+        self._unpack: Optional[Callable] = None
+        self.not_inplace_tensors = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        if _saved_tensor_hooks:
+            pack, unpack = _saved_tensor_hooks[-1]
+            # remember which entries went through pack so unpack always
+            # runs for them (a pack may itself return a Tensor, e.g. a
+            # bf16-compressed copy)
+            self._saved = [(pack(t), True) if isinstance(t, Tensor)
+                           else (t, False) for t in tensors]
+            self._unpack = unpack
+        else:
+            self._saved = [(t, False) for t in tensors]
+
+    def saved_tensor(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(o) if packed else o
+                         for o, packed in self._saved)
+        return tuple(o for o, _ in self._saved)
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerGradNode(_engine.GradNode):
+    """Tape node calling the user's backward (ref
+    `fluid/eager/pylayer/py_layer_node.h` GradNodePyLayer)."""
+
+    wants_tensors = True
+
+    def __init__(self, layer_cls, ctx, num_outputs):
+        super().__init__(num_outputs)
+        self.op_name = f"py_layer[{layer_cls.__name__}]"
+        self._cls = layer_cls
+        self._ctx = ctx
+
+    def apply(self, out_grads):
+        ctx = self._ctx
+        if ctx is None:
+            raise RuntimeError(
+                f"{self.op_name} backward already released; use "
+                "backward(retain_graph=True) to backprop twice.")
+        if ctx._materialize_grads:
+            grads = []
+            for g, meta in zip(out_grads, self.out_meta):
+                if g is None and meta is not None and \
+                        jnp.issubdtype(meta[1], jnp.floating):
+                    g = Tensor._wrap(jnp.zeros(meta[0], meta[1]))
+                grads.append(g)
+        else:
+            grads = list(out_grads)
+        res = self._cls.backward(ctx, *grads)
+        if not isinstance(res, (list, tuple)):
+            res = (res,)
+        n_edges = len(self.next_edges)
+        if len(res) != n_edges:
+            raise ValueError(
+                f"{self.op_name}.backward returned {len(res)} gradients "
+                f"for {n_edges} differentiable inputs")
+        return list(res)
+
+    def release(self):
+        self._ctx = None
+
+
+class PyLayer:
+    """Custom autograd function (reference `autograd/py_layer.py:29`).
+
+    Subclass with ``forward(ctx, ...)`` and ``backward(ctx, *grads)``
+    staticmethods; call via ``apply``.  backward receives one grad per
+    forward output and must return one grad (or None) per Tensor input,
+    in order."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.dygraph import is_grad_enabled
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        # run the user's forward with grad disabled: the custom backward
+        # REPLACES the inner graph (reference detaches forward outputs)
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (list, tuple))
+        outs_t = tuple(outs) if multi else (outs,)
+
+        if not needs_grad:
+            return outs if multi else outs_t[0]
+
+        node = PyLayerGradNode(cls, ctx, len(outs_t))
+        edges = []
+        for t in tensor_inputs:
+            if t.stop_gradient:
+                edges.append(None)
+            elif t._grad_node is not None:
+                edges.append(_engine.Edge(t._grad_node, t._output_slot))
+            else:
+                edges.append(_engine.Edge(t._get_accum_node(), 0))
+        node.next_edges = edges
+
+        wrapped = []
+        for i, o in enumerate(outs_t):
+            if isinstance(o, Tensor):
+                w = Tensor._wrap(o._value, stop_gradient=False)
+                node.out_meta[i] = (tuple(o._value.shape), o._value.dtype)
+                w._grad_node = node
+                w._output_slot = i
+                wrapped.append(w)
+            else:
+                wrapped.append(o)
+        return tuple(wrapped) if multi else wrapped[0]
+
+
+# --------------------------------------------------------------------------
+# paddle.grad
+# --------------------------------------------------------------------------
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None) -> List[Optional[Tensor]]:
+    """Compute grads of ``outputs`` w.r.t. ``inputs`` without writing
+    ``.grad`` (reference `base/dygraph/base.py:595`)."""
+    if not only_inputs:
+        raise NotImplementedError("only_inputs=False is not supported "
+                                  "(matches reference deprecation)")
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    grad_outputs = _as_list(grad_outputs) or [None] * len(outputs)
+    if len(grad_outputs) != len(outputs):
+        raise ValueError("grad_outputs length must match outputs")
+
+    seeds = []
+    for o, g in zip(outputs, grad_outputs):
+        if g is None:
+            seeds.append(jnp.ones(o.shape, o._value.dtype))
+        else:
+            seeds.append(g._value if isinstance(g, Tensor) else g)
+
+    capture = {}
+    for idx, t in enumerate(inputs):
+        if t._grad_node is not None:
+            key = (id(t._grad_node), t._output_slot)
+        else:
+            key = (id(t._get_accum_node()), 0)
+        capture[key] = idx
+
+    retain = retain_graph if retain_graph is not None else create_graph
+    captured = _engine.run_backward(outputs, seeds, retain_graph=retain,
+                                    create_graph=create_graph,
+                                    capture=capture, accumulate=False)
+    results: List[Optional[Tensor]] = []
+    for idx, t in enumerate(inputs):
+        g = captured.get(idx)
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    f"input {idx} is unreachable from outputs; pass "
+                    "allow_unused=True to return None for it")
+            results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)
+        else:
+            results.append(Tensor._wrap(g, stop_gradient=not create_graph))
+    return results
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward: multi-tensor backward (ref
+    `autograd/backward_mode.py`)."""
+    tensors = _as_list(tensors)
+    grad_tensors = _as_list(grad_tensors) or [None] * len(tensors)
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            seeds.append(jnp.ones(t.shape, t._value.dtype))
+        else:
+            seeds.append(g._value if isinstance(g, Tensor) else g)
+    _engine.run_backward(tensors, seeds, retain_graph=retain_graph)
+
+
+# --------------------------------------------------------------------------
+# jacobian / hessian (function-transform style, computed with jax AD)
+# --------------------------------------------------------------------------
+
+def _tensorize_fn(func):
+    def pure(*vals):
+        args = [Tensor._wrap(v, stop_gradient=False) for v in vals]
+        out = func(*args)
+        return out._value if isinstance(out, Tensor) else out
+    return pure
+
+
+def jacobian(func, xs, create_graph=False, batch_axis=None):
+    """Jacobian of ``func`` at ``xs`` (ref `autograd/autograd.py` Jacobian).
+
+    func: callable taking Tensor(s) and returning one Tensor; xs: Tensor or
+    list of Tensors.  Returns jax-computed Jacobian(s) as Tensor(s)."""
+    if create_graph:
+        raise NotImplementedError(
+            "jacobian(create_graph=True): results are computed with jax AD "
+            "outside the eager tape; differentiate a function of them with "
+            "paddle.grad(..., create_graph=True) instead")
+    xs_list = _as_list(xs)
+    vals = [x._value for x in xs_list]
+    jac = jax.jacrev(_tensorize_fn(func), argnums=tuple(range(len(vals))))(
+        *vals)
+    out = [Tensor._wrap(j) for j in jac]
+    return out if isinstance(xs, (list, tuple)) else out[0]
+
+
+def hessian(func, xs, create_graph=False, batch_axis=None):
+    """Hessian of scalar-valued ``func`` at ``xs``."""
+    if create_graph:
+        raise NotImplementedError(
+            "hessian(create_graph=True) is not supported; see jacobian")
+    xs_list = _as_list(xs)
+    vals = [x._value for x in xs_list]
+    hess = jax.hessian(_tensorize_fn(func), argnums=tuple(range(len(vals))))(
+        *vals)
+    if not isinstance(xs, (list, tuple)):
+        return Tensor._wrap(hess[0][0] if isinstance(hess, tuple) else hess)
+    return jax.tree_util.tree_map(Tensor._wrap, hess)

@@ -12,6 +12,7 @@ from .pipeline_parallel import (PipelineParallel,  # noqa: F401
                                 PipelineParallelWithInterleave)
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .random import get_rng_state_tracker  # noqa: F401
+from .recompute import recompute  # noqa: F401
 from .sharding import (DygraphShardingOptimizer,  # noqa: F401
                        GroupShardedOptimizerStage2, group_sharded_parallel)
 from .spmd_pipeline import pipeline_forward, stack_stage_params  # noqa: F401

@@ -1,0 +1,102 @@
+"""Activation recomputation (gradient checkpointing) for eager layers.
+
+Parity: `python/paddle/distributed/fleet/utils/__init__.py` recompute /
+`fleet/recompute/recompute.py` RecomputeFunction.
+
+TPU-native design: instead of a PyLayer that re-runs Python in backward
+(whose duplicated compute XLA would CSE away under jit), the region is
+dispatched as ONE op whose forward is ``jax.checkpoint`` of the traced
+region.  jax inserts optimization barriers, so the recompute survives XLA
+CSE both eagerly and inside `jit.to_static` capture, and the vjp saves
+only the region inputs — the 1F1B-style activation-memory bound.
+
+Constraints (same spirit as the reference's): the region must be
+functional — in-place mutation of buffers (e.g. BatchNorm running stats)
+inside a recomputed region is dropped; RNG draws are captured at trace
+time so forward and recompute see identical randomness.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+
+from ...framework.dygraph import no_grad
+from ...framework.tensor import Tensor
+from ...ops import registry
+
+__all__ = ["recompute"]
+
+
+def _discover_leaves(fn, args, kwargs) -> List[Tensor]:
+    """Find closure Tensors (params/buffers) the region reads, by running
+    it once under the dispatch recorder (the jit.to_static state-discovery
+    trick)."""
+    seen: List[Tensor] = []
+    seen_ids = set()
+    arg_ids = {id(a) for a in jax.tree_util.tree_leaves(
+        list(args), is_leaf=lambda x: isinstance(x, Tensor))
+        if isinstance(a, Tensor)}
+
+    def on_inputs(leaves):
+        for t in leaves:
+            if t is None or id(t) in seen_ids or id(t) in arg_ids:
+                continue
+            seen_ids.add(id(t))
+            seen.append(t)
+
+    prev = registry._trace_recorder
+    registry.set_trace_recorder(on_inputs)
+    try:
+        with no_grad():
+            fn(*args, **kwargs)
+    finally:
+        registry.set_trace_recorder(prev)
+    return seen
+
+
+def _is_jax_value(v) -> bool:
+    return isinstance(v, jax.Array) or hasattr(v, "aval")
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True,
+              use_reentrant: bool = True, **kwargs) -> Any:
+    """Run ``function(*args)`` with activation recomputation in backward.
+
+    function: a Layer or any callable over Tensors.  Gradients flow to both
+    the Tensor arguments and the parameters/closure Tensors read inside."""
+    from ...nn import Layer
+
+    if isinstance(function, Layer):
+        closure = [p for p in function.parameters() if p is not None]
+    else:
+        closure = _discover_leaves(function, args, kwargs)
+    n_args = len(args)
+
+    def fwd(*structured, **_static):
+        # dispatch has substituted raw values for Tensors inside the
+        # original arg structures; structured = (*args, *closure_values)
+        s_args, s_closure = structured[:n_args], structured[n_args:]
+
+        def pure(pa, pc):
+            wrapped = jax.tree_util.tree_map(
+                lambda v: Tensor._wrap(v) if _is_jax_value(v) else v, pa)
+            saved = [(t, t._value) for t in closure]
+            try:
+                for t, v in zip(closure, pc):
+                    t._value = v
+                with no_grad():
+                    out = function(*wrapped, **kwargs)
+            finally:
+                for t, v in saved:
+                    t._value = v
+            if isinstance(out, (list, tuple)):
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._value if isinstance(out, Tensor) else out
+
+        return jax.checkpoint(pure)(s_args, s_closure)
+
+    op = registry.OpDef("recompute_region", fwd, None, ("fused",))
+    return registry.dispatch(op.name, list(args) + closure, {}, op)

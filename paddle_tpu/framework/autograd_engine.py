@@ -42,6 +42,9 @@ class GradNode:
     """Base grad node: maps output-cotangents -> input-cotangents."""
 
     op_name: str = "unknown"
+    # PyLayer-style nodes take/return Tensors (user-facing backward fns);
+    # plain nodes flow raw jax arrays.
+    wants_tensors: bool = False
 
     def __init__(self, num_outputs: int):
         self.num_outputs = num_outputs
@@ -60,21 +63,33 @@ class GradNode:
 
 
 class OpGradNode(GradNode):
-    """Grad node for a registered op; holds the vjp closure + static attrs."""
+    """Grad node for a registered op; holds the vjp closure + static attrs.
 
-    __slots__ = ("vjp_fn", "input_treedef", "op_name")
+    ``primal_vals``/``make_vjp`` retain the forward inputs and a way to
+    re-linearize at traced primals — the role of the reference's
+    TensorWrapper saves (`fluid/eager/tensor_wrapper.h`), needed so
+    ``create_graph=True`` can differentiate the backward w.r.t. the
+    primals (jax.vjp closures treat residuals as constants)."""
 
-    def __init__(self, op_name: str, num_outputs: int, vjp_fn: Callable):
+    __slots__ = ("vjp_fn", "input_treedef", "op_name", "tuple_out",
+                 "primal_vals", "make_vjp")
+
+    def __init__(self, op_name: str, num_outputs: int, vjp_fn: Callable,
+                 tuple_out: bool = False, primal_vals=None, make_vjp=None):
         super().__init__(num_outputs)
         self.op_name = op_name
         self.vjp_fn = vjp_fn
+        # a fwd returning a 1-tuple still needs a tuple cotangent
+        self.tuple_out = tuple_out or num_outputs > 1
+        self.primal_vals = primal_vals
+        self.make_vjp = make_vjp
 
     def apply(self, out_grads: List[Any]) -> List[Optional[Any]]:
         if self.vjp_fn is None:
             raise RuntimeError(
                 f"Grad node for op '{self.op_name}' was already released. "
                 "Call backward(retain_graph=True) to backprop twice.")
-        cot = out_grads[0] if self.num_outputs == 1 else tuple(out_grads)
+        cot = tuple(out_grads) if self.tuple_out else out_grads[0]
         in_grads = self.vjp_fn(cot)
         out: List[Optional[Any]] = []
         for g in in_grads:
@@ -83,6 +98,8 @@ class OpGradNode(GradNode):
 
     def release(self):
         self.vjp_fn = None
+        self.primal_vals = None
+        self.make_vjp = None
 
 
 def _drop_float0(g):
@@ -125,26 +142,126 @@ class GradAccumulationNode(GradNode):
 def _zeros_cotangent(meta):
     """Zero cotangent for an output that received no gradient.
 
-    Integer/bool outputs take float0 cotangents (jax.vjp's convention for
-    non-differentiable values)."""
+    Integer/bool (and float0-typed) outputs take float0 cotangents
+    (jax.vjp's convention for non-differentiable values)."""
     shape, dtype = meta
-    if jnp.issubdtype(dtype, jnp.integer) or dtype == jnp.bool_:
+    if dtype == jax.dtypes.float0 or jnp.issubdtype(dtype, jnp.integer) \
+            or dtype == jnp.bool_:
         import numpy as _np
         return _np.zeros(shape, jax.dtypes.float0)
     return jnp.zeros(shape, dtype)
 
 
+def _unwrap(g):
+    from .tensor import Tensor
+    return g._value if isinstance(g, Tensor) else g
+
+
+def _wrap_grad(g, create_graph: bool):
+    """Tensor-ify a cotangent for Tensor-flowing modes."""
+    from .tensor import Tensor
+    if g is None or isinstance(g, Tensor):
+        return g
+    dt = getattr(g, "dtype", None)
+    if dt is not None and dt == jax.dtypes.float0:
+        return None
+    return Tensor._wrap(g, stop_gradient=not create_graph)
+
+
+def _dispatch_vjp(node: "OpGradNode", out_grads: List[Any]):
+    """create_graph mode: re-linearize the op at its primals as a function
+    of (primals, cotangents) so the produced gradients carry a tape that
+    reaches both — the role of the reference's generated higher-order
+    GradNodes (`fluid/eager/api/generated/.../backwards/`, `fluid/prim`
+    double-grad composites)."""
+    from .tensor import Tensor
+
+    if node.make_vjp is None or node.primal_vals is None:
+        raise RuntimeError(
+            f"create_graph through '{node.op_name}' requires its primal "
+            "saves; the node was released (use retain_graph=True) or the "
+            "op does not retain primals")
+
+    n_in = len(node.primal_vals)
+    # float0 cotangents (non-differentiable output slots) stay raw arrays —
+    # they can't be Tensors and take no edges
+    cot_items = []
+    for g in out_grads:
+        if isinstance(g, Tensor) or \
+                getattr(g, "dtype", None) == jax.dtypes.float0:
+            cot_items.append(g)
+        else:
+            cot_items.append(_wrap_grad(g, True))
+
+    def combined(*all_vals):
+        vals, cots = all_vals[:n_in], all_vals[n_in:]
+        _, vjp = node.make_vjp(list(vals))
+        cot = tuple(cots) if node.tuple_out else cots[0]
+        return tuple(vjp(cot))
+
+    cot_vals = [t._value if isinstance(t, Tensor) else t for t in cot_items]
+    new_outs, new_vjp = jax.vjp(combined, *node.primal_vals, *cot_vals)
+
+    new_node = OpGradNode(
+        f"grad[{node.op_name}]", len(new_outs), new_vjp, tuple_out=True,
+        primal_vals=list(node.primal_vals) + cot_vals,
+        make_vjp=lambda vals: jax.vjp(combined, *vals))
+    edges = list(node.next_edges)
+    for t in cot_items:
+        if not isinstance(t, Tensor) or t.stop_gradient:
+            edges.append(None)
+        elif t._grad_node is not None:
+            edges.append(Edge(t._grad_node, t._output_slot))
+        else:
+            edges.append(Edge(t._get_accum_node(), 0))
+    new_node.next_edges = edges
+
+    wrapped: List[Optional[Any]] = []
+    for i, o in enumerate(new_outs):
+        # record meta for every slot (incl. float0) so a second backward
+        # can materialize structure-matching zero cotangents
+        new_node.out_meta[i] = (tuple(o.shape), o.dtype)
+        if getattr(o, "dtype", None) == jax.dtypes.float0:
+            wrapped.append(None)
+            continue
+        w = Tensor._wrap(o, stop_gradient=False)
+        w._grad_node = new_node
+        w._output_slot = i
+        wrapped.append(w)
+    return wrapped
+
+
 def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
-                 retain_graph: bool = False) -> None:
-    """The engine loop — reference: egr::RunBackward (`fluid/eager/backward.cc:105`)."""
+                 retain_graph: bool = False, create_graph: bool = False,
+                 capture: Optional[dict] = None,
+                 accumulate: bool = True) -> Optional[dict]:
+    """The engine loop — reference: egr::RunBackward (`fluid/eager/backward.cc:105`).
+
+    capture: {(id(node), slot): key} — record the fully-accumulated
+    cotangent arriving at that (node, slot) into the returned dict (the
+    mechanism behind ``paddle.grad``; reference `general_grad.h`).
+    create_graph: flow cotangents as Tensors and apply each vjp as a
+    dispatched op so gradients themselves are differentiable.
+    accumulate: write leaf ``.grad`` (False for ``paddle.grad`` /
+    only_inputs semantics).
+    """
+    captured: dict = {}
     # 1. Seed output grads per (node, slot).
     pending: dict = defaultdict(dict)  # node -> {slot: grad}
     roots: List[GradNode] = []
+    if create_graph:
+        grad_tensors = [_wrap_grad(g, True) for g in grad_tensors]
     for t, g in zip(tensors, grad_tensors):
         node, slot = t._grad_node, t._output_slot
         if node is None:
-            if not t.stop_gradient:
-                t._accumulate_grad(g)
+            if capture is not None and not t.stop_gradient:
+                # grad() on a leaf output: gradient is the seed itself
+                accum = t._get_accum_node()
+                key = capture.get((id(accum), 0))
+                if key is not None:
+                    captured[key] = g
+            if accumulate and not t.stop_gradient:
+                t._accumulate_grad(_unwrap(g))
             continue
         slots = pending[node]
         slots[slot] = g if slot not in slots else slots[slot] + g
@@ -152,7 +269,7 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
             roots.append(node)
 
     if not roots:
-        return
+        return captured if capture is not None else None
 
     # 2. In-degree map via BFS over edges (`backward.cc:23` getInDegreeMap).
     indeg: dict = defaultdict(int)
@@ -160,16 +277,34 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
     queue = deque(roots)
     visited.update(id(n) for n in roots)
     nodes_by_id = {id(n): n for n in roots}
+    parents: dict = defaultdict(list)  # child id -> parent ids
     while queue:
         node = queue.popleft()
         for edge in node.next_edges:
             if edge is None:
                 continue
             indeg[id(edge.node)] += 1
+            parents[id(edge.node)].append(id(node))
             if id(edge.node) not in visited:
                 visited.add(id(edge.node))
                 nodes_by_id[id(edge.node)] = edge.node
                 queue.append(edge.node)
+
+    # 2b. Prune for paddle.grad: only nodes on a path from the outputs to a
+    # requested input do real work (reference `general_grad.h` subgraph
+    # selection); the rest just forward None to unblock dependencies.
+    useful = None
+    if capture is not None and not accumulate:
+        useful = set()
+        upq = deque(nid for nid, _ in capture.keys() if nid in visited
+                    or nid in parents)
+        useful.update(upq)
+        while upq:
+            nid = upq.popleft()
+            for pid in parents.get(nid, ()):
+                if pid not in useful:
+                    useful.add(pid)
+                    upq.append(pid)
 
     # 3. Ready-queue walk.
     ready = deque(n for n in roots if indeg[id(n)] == 0)
@@ -181,7 +316,11 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
         processed.add(id(node))
 
         slot_grads = pending.pop(node, {})
-        if not slot_grads and not isinstance(node, GradAccumulationNode):
+        if useful is not None and id(node) not in useful:
+            in_grads = [None] * len(node.next_edges)
+            if not retain_graph:
+                node.release()
+        elif not slot_grads and not isinstance(node, GradAccumulationNode):
             # No real gradient reached this node (e.g. only float0 paths):
             # propagate None but still unblock downstream nodes.
             in_grads = [None] * len(node.next_edges)
@@ -192,6 +331,8 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
                 if g is None and node.out_meta[i] is not None and not isinstance(
                         node, GradAccumulationNode):
                     g = _zeros_cotangent(node.out_meta[i])
+                    if create_graph:
+                        g = _wrap_grad(g, True)
                 for hook in node.grad_hooks[i]:
                     res = hook(g)
                     if res is not None:
@@ -199,14 +340,36 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
                 # AMP: a consumer computing in fp32 sends fp32 cotangents to a
                 # low-precision producer — cast to the node's output dtype
                 meta = node.out_meta[i]
-                if g is not None and meta is not None and \
-                        hasattr(g, "dtype") and g.dtype != meta[1] and \
+                gd = getattr(g, "dtype", None)
+                if g is not None and meta is not None and gd is not None \
+                        and gd != meta[1] and \
                         jnp.issubdtype(meta[1], jnp.floating) and \
-                        g.dtype != jax.dtypes.float0:
+                        gd != jax.dtypes.float0:
                     g = g.astype(meta[1])
                 out_grads.append(g)
 
-            in_grads = node.apply(out_grads)
+            if capture is not None:
+                for i in range(node.num_outputs):
+                    key = capture.get((id(node), i))
+                    if key is not None:
+                        captured[key] = out_grads[i]
+
+            if isinstance(node, GradAccumulationNode):
+                if accumulate:
+                    in_grads = node.apply([_unwrap(out_grads[0])])
+                else:
+                    in_grads = []
+            elif create_graph and isinstance(node, OpGradNode):
+                in_grads = _dispatch_vjp(node, out_grads)
+            elif node.wants_tensors:
+                in_grads = node.apply([
+                    _wrap_grad(g, create_graph) for g in out_grads])
+                if not create_graph:
+                    in_grads = [_unwrap(g) for g in in_grads]
+            else:
+                in_grads = node.apply([_unwrap(g) for g in out_grads])
+                if create_graph:
+                    in_grads = [_wrap_grad(g, False) for g in in_grads]
             if not retain_graph:
                 node.release()
 
@@ -230,4 +393,8 @@ def run_backward(tensors: Sequence, grad_tensors: Sequence[Optional[Any]],
     # because some producer was unreachable — shouldn't happen, but be safe).
     for node, slots in list(pending.items()):
         if isinstance(node, GradAccumulationNode) and indeg[id(node)] <= 0:
-            node.apply([slots.get(0)])
+            if capture is not None and (id(node), 0) in capture:
+                captured[capture[(id(node), 0)]] = slots.get(0)
+            if accumulate:
+                node.apply([_unwrap(slots.get(0))])
+    return captured if capture is not None else None

@@ -155,8 +155,13 @@ class GPTModel(nn.Layer):
         pos = creation.arange(s, dtype="int32")
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for block in self.blocks:
-            x = block(x)
+        if self.cfg.use_recompute and self.training:
+            from ..distributed.fleet import recompute
+            for block in self.blocks:
+                x = recompute(block, x)
+        else:
+            for block in self.blocks:
+                x = block(x)
         return self.ln_f(x)
 
 

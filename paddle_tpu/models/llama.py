@@ -148,8 +148,13 @@ class LlamaModel(nn.Layer):
 
     def forward(self, input_ids):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x)
+        if self.cfg.use_recompute and self.training:
+            from ..distributed.fleet import recompute
+            for layer in self.layers:
+                x = recompute(layer, x)
+        else:
+            for layer in self.layers:
+                x = layer(x)
         return self.norm(x)
 
 

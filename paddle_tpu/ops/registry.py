@@ -183,15 +183,18 @@ def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
 
     if op.custom_vjp is not None:
         outs, vjp_fn = op.custom_vjp(treedef, vals, static)
+        make_vjp = lambda v: op.custom_vjp(treedef, v, static)  # noqa: E731
     else:
         outs, vjp_fn = jax.vjp(fn_flat, *vals)
+        make_vjp = lambda v: jax.vjp(fn_flat, *v)  # noqa: E731
 
     multi = isinstance(outs, tuple)
     outs_t = tuple(outs) if multi else (outs,)
     if _flags.get_flag("check_nan_inf"):
         _check_nan_inf(name, outs_t)
 
-    node = _engine.OpGradNode(name, len(outs_t), vjp_fn)
+    node = _engine.OpGradNode(name, len(outs_t), vjp_fn, tuple_out=multi,
+                              primal_vals=vals, make_vjp=make_vjp)
     edges: List[Optional[_engine.Edge]] = []
     for t in leaves:
         if t is None or t.stop_gradient:

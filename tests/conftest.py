@@ -25,3 +25,14 @@ def _seed_everything():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture()
+def hybrid_mesh():
+    """dp2 x mp2 x sharding2 hybrid topology over the 8-device CPU mesh."""
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 2,
+                               "sep_degree": 1}
+    yield fleet.init(is_collective=True, strategy=strategy)
