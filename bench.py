@@ -143,8 +143,9 @@ def bench_lenet():
             train_step(x, y)
 
     sync = lambda: model.parameters()[0]._value
-    train_step(x, y)  # warm caches
-    eager_dt = marginal_step_s(run_eager, sync, 1, 4)
+    run_eager(2)  # warm vjp/trace caches fully before timing
+    np.asarray(sync())
+    eager_dt = marginal_step_s(run_eager, sync, 2, 8)
 
     step = to_static(train_step)
     step(x, y)  # compile
