@@ -16,6 +16,8 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate,  # noqa:
                             Shard, dtensor_from_fn, reshard, shard_layer,
                             shard_optimizer, shard_tensor, unshard_dtensor)
 from . import sharding  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 
 
 def get_mesh():

@@ -1,0 +1,17 @@
+"""Distributed (sharded) checkpoint: save/load with reshard-on-load.
+
+Parity: `python/paddle/distributed/checkpoint/` — save_state_dict
+(`save_state_dict.py:104`), load_state_dict (`load_state_dict.py:377`),
+Metadata (`metadata.py:20`).
+"""
+
+from .load_state_dict import load_metadata, load_state_dict
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
+from .save_state_dict import save_state_dict, wait_async_save
+from .utils import flatten_state_dict, unflatten_state_dict
+
+__all__ = [
+    "save_state_dict", "load_state_dict", "load_metadata", "wait_async_save",
+    "Metadata", "LocalTensorMetadata", "LocalTensorIndex",
+    "flatten_state_dict", "unflatten_state_dict",
+]

@@ -1,0 +1,55 @@
+"""Checkpoint metadata schema.
+
+Parity: `python/paddle/distributed/checkpoint/metadata.py:20` —
+LocalTensorMetadata (global_offset + local_shape of one saved piece),
+LocalTensorIndex (identity of a piece), Metadata (the global manifest).
+
+The TPU build adds `dtype` to LocalTensorMetadata so load can cast, and a
+`global_shape` map so load can validate targets without opening data files.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+
+@dataclass
+class LocalTensorMetadata:
+    """Location of one saved piece inside the global tensor."""
+    global_offset: Tuple[int, ...]
+    local_shape: Tuple[int, ...]
+    dtype: str = "float32"
+
+
+@dataclass(frozen=True)
+class LocalTensorIndex:
+    """Identifier of one saved piece: (flat key, global offset)."""
+    tensor_key: str
+    global_offset: Tuple[int, ...]
+
+
+@dataclass
+class Metadata:
+    # flat key -> all pieces that tile the global tensor
+    state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = field(
+        default_factory=dict)
+    # piece identity -> data file that holds it
+    storage_metadata: Dict[LocalTensorIndex, str] = field(default_factory=dict)
+    # flat key -> original nested key path
+    flat_mapping: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    # flat key -> global shape (validation / full assembly)
+    global_shape: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+
+    def merge(self, other: "Metadata") -> "Metadata":
+        for k, pieces in other.state_dict_metadata.items():
+            mine = self.state_dict_metadata.setdefault(k, [])
+            seen = {(tuple(p.global_offset), tuple(p.local_shape))
+                    for p in mine}
+            for p in pieces:
+                if (tuple(p.global_offset), tuple(p.local_shape)) not in seen:
+                    mine.append(p)
+        self.storage_metadata.update(other.storage_metadata)
+        self.flat_mapping.update(other.flat_mapping)
+        self.global_shape.update(other.global_shape)
+        return self

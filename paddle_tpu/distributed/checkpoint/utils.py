@@ -1,0 +1,78 @@
+"""Checkpoint helpers: state-dict flattening and slice arithmetic.
+
+Parity: `python/paddle/distributed/checkpoint/utils.py` (flatten_state_dict)
+plus the piece-intersection math the reference keeps in
+`load_state_dict.py` (ReadItem computation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+FLAT_SEP = "."
+
+
+def flatten_state_dict(state_dict: Dict) -> Tuple[Dict[str, Any],
+                                                  Dict[str, Tuple[str, ...]]]:
+    """Flatten arbitrarily nested dicts to {dotted_key: leaf}.
+
+    Returns (flat, mapping) where mapping records the original key path for
+    each flat key so load can restore nesting.
+    """
+    flat: Dict[str, Any] = {}
+    mapping: Dict[str, Tuple[str, ...]] = {}
+
+    def visit(prefix: Tuple[str, ...], node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                visit(prefix + (str(k),), v)
+        else:
+            key = FLAT_SEP.join(prefix)
+            if key in flat:
+                raise ValueError(f"duplicate flat key {key!r} in state_dict")
+            flat[key] = node
+            mapping[key] = prefix
+        return None
+
+    visit((), state_dict)
+    return flat, mapping
+
+
+def unflatten_state_dict(flat: Dict[str, Any],
+                         mapping: Dict[str, Tuple[str, ...]]) -> Dict:
+    out: Dict = {}
+    for key, val in flat.items():
+        path = mapping.get(key, (key,))
+        node = out
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = val
+    return out
+
+
+def offset_of(index: Tuple[slice, ...], shape: Tuple[int, ...]):
+    """Global offset of an addressable-shard index (tuple of slices)."""
+    return tuple((sl.start or 0) for sl in index)
+
+
+def copy_intersection(dst: np.ndarray, dst_offset, src: np.ndarray,
+                      src_offset) -> int:
+    """Copy the overlap of two global-coordinate boxes; returns copied elems.
+
+    dst occupies [dst_offset, dst_offset+dst.shape); src likewise.  The
+    intersection (if any) is copied from src into dst in place.
+    """
+    if dst.ndim == 0:
+        dst[...] = src
+        return 1
+    lo = [max(a, b) for a, b in zip(dst_offset, src_offset)]
+    hi = [min(a + s, b + t) for a, s, b, t in
+          zip(dst_offset, dst.shape, src_offset, src.shape)]
+    if any(h <= l for l, h in zip(lo, hi)):
+        return 0
+    dst_sl = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, dst_offset))
+    src_sl = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, src_offset))
+    dst[dst_sl] = src[src_sl]
+    return int(np.prod([h - l for l, h in zip(lo, hi)]))
