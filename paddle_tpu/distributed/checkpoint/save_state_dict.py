@@ -72,12 +72,24 @@ def save_state_dict(state_dict: Dict, path: str,
     flat, mapping = flatten_state_dict(state_dict)
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
+    wait_async_save()  # serialize vs this process's earlier async writes
     if rank == coordinator_rank:
-        # drop stale artifacts so a re-save with fewer ranks (or a different
-        # state dict) can't merge with a previous checkpoint's leftovers
+        # drop stale artifacts from a previous bigger job so a re-save with
+        # fewer ranks can't merge with leftovers; only files no *current*
+        # rank will rewrite are touched, so this cannot race other ranks'
+        # in-flight writes on a shared filesystem
+        n_proc = jax.process_count()
         for f in os.listdir(path):
-            if f.endswith((".distcp", ".metadata")):
+            head = f.split("_")[0].split(".")[0]
+            if f.endswith((".distcp", ".metadata")) and head.isdigit() \
+                    and int(head) >= n_proc:
                 os.remove(os.path.join(path, f))
+    # our own files are rewritten below; a same-rank stale .distcp with no
+    # metadata entry is unreachable at load (reads are manifest-driven), but
+    # if this rank now has nothing to write the old data file must go
+    own_data = os.path.join(path, _data_file(rank))
+    if os.path.exists(own_data):
+        os.remove(own_data)
 
     md = Metadata(flat_mapping=mapping)
     file_name = _data_file(rank)

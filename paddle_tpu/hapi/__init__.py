@@ -1,0 +1,7 @@
+"""High-level API.  Parity: `python/paddle/hapi/`."""
+
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+
+__all__ = ["Model", "callbacks", "summary"]

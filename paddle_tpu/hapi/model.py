@@ -1,0 +1,359 @@
+"""High-level Model API: prepare / fit / evaluate / predict / save / load.
+
+Parity: `python/paddle/hapi/model.py` — Model (`:1052`), train_batch
+(`:1194`), eval_batch (`:1251`), predict_batch (`:1307`), save (`:1356`),
+load (`:1423`), prepare (`:1670`), fit (`:1750`), evaluate (`:1999`),
+predict (`:2110`), summary (`:2376`).
+
+TPU-native: the reference splits into Dynamic/StaticGraphAdapter; here there
+is one path — the train/eval steps are captured by `paddle_tpu.jit.to_static`
+into a single donated XLA program per mode (prepare(jit_compile=True), the
+default), with metrics computed on the step outputs outside the graph.  Set
+jit_compile=False for pure eager debugging.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .. import io as paddle_io
+from ..framework import io as framework_io
+from ..framework.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def to_list(value):
+    if value is None:
+        return []
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+class Model:
+    """An trainable/inferable instance wrapping a `Layer`."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._jit_compile = True
+        self._compiled = {}
+        self.stop_training = False
+        self._save_dir = None
+        self.mode = "train"
+        self._pending_accum = False
+
+    # ------------------------------------------------------------------ prep
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile: bool = True):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer)
+                                     or callable(loss)):
+            raise TypeError("loss must be a Layer or a callable")
+        self._loss = loss
+        self._metrics = to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric must be a paddle.metric.Metric, "
+                                f"got {type(m).__name__}")
+        self._jit_compile = jit_compile
+        self._compiled = {}
+        if amp_configs is not None:
+            raise NotImplementedError(
+                "amp_configs: wrap the optimizer/loss with paddle_tpu.amp "
+                "auto_cast/GradScaler instead (Model-level AMP planned)")
+
+    # ----------------------------------------------------------------- steps
+    def _mode_fn(self, mode):
+        """The raw (uncompiled) step function for `mode`."""
+        if mode == "train":
+            def step(*args):
+                n_in = self._n_inputs
+                ins, labs = args[:n_in], args[n_in:]
+                outputs = to_list(self.network(*ins))
+                loss = self._loss(*(outputs + list(labs)))
+                loss.backward()
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                return [loss] + outputs
+        elif mode == "accumulate":  # train_batch(update=False)
+            def step(*args):
+                n_in = self._n_inputs
+                ins, labs = args[:n_in], args[n_in:]
+                outputs = to_list(self.network(*ins))
+                loss = self._loss(*(outputs + list(labs)))
+                loss.backward()
+                return [loss] + outputs
+        elif mode == "eval":
+            def step(*args):
+                n_in = self._n_inputs
+                ins, labs = args[:n_in], args[n_in:]
+                outputs = to_list(self.network(*ins))
+                res = list(outputs)
+                if self._loss is not None:
+                    res = [self._loss(*(outputs + list(labs)))] + res
+                return res
+        else:
+            def step(*args):
+                return to_list(self.network(*args))
+        return step
+
+    def _run_step(self, mode, inputs, labels):
+        inputs = [_as_tensor(x) for x in to_list(inputs)]
+        labels = [_as_tensor(y) for y in to_list(labels)]
+        self._n_inputs = len(inputs)
+        if mode in ("train", "accumulate"):
+            self.network.train()
+        else:
+            self.network.eval()
+        key = (mode, len(inputs), len(labels))
+        # grad accumulation mutates .grad across calls, which lives outside
+        # the captured program state — run it (and the step consuming it)
+        # eagerly; steady-state update=True training stays compiled
+        eager_needed = mode == "accumulate" or \
+            (mode == "train" and self._pending_accum)
+        if self._jit_compile and not eager_needed:
+            if key not in self._compiled:
+                from ..jit import to_static
+                self._compiled[key] = to_static(self._mode_fn(mode))
+            fn = self._compiled[key]
+        else:
+            fn = self._mode_fn(mode)
+        self._pending_accum = mode == "accumulate"
+        return fn(*(inputs + labels)), labels
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimizer step (update=False: accumulate grads only);
+        returns (loss_numpy, [metric results])."""
+        if self._optimizer is None or self._loss is None:
+            raise RuntimeError("call prepare(optimizer=..., loss=...) first")
+        res, labs = self._run_step("train" if update else "accumulate",
+                                   inputs, labels)
+        loss, outputs = res[0], res[1:]
+        metrics = self._update_metrics(outputs, labs)
+        return np.asarray(loss._value), metrics
+
+    def eval_batch(self, inputs, labels=None):
+        res, labs = self._run_step("eval", inputs, labels)
+        if self._loss is not None:
+            loss, outputs = res[0], res[1:]
+            metrics = self._update_metrics(outputs, labs)
+            return np.asarray(loss._value), metrics
+        return None, self._update_metrics(res, labs)
+
+    def predict_batch(self, inputs):
+        res, _ = self._run_step("predict", inputs, [])
+        return [np.asarray(o._value) for o in res]
+
+    def _update_metrics(self, outputs, labels):
+        results = []
+        for m in self._metrics:
+            computed = m.compute(*(list(outputs) + list(labels)))
+            results.append(m.update(*to_list(computed)))
+        return results
+
+    # ------------------------------------------------------------- save/load
+    def _remap_opt_state(self, sd, to_structured: bool):
+        """Translate optimizer accumulator keys between this process's
+        auto-generated parameter names ("param_37_moment1") and the
+        network's stable structured names ("fc.0.weight@moment1"), so a
+        .pdopt saved by one process restores into a freshly built model."""
+        struct = {id(p): k for k, p in self.network.state_dict().items()}
+        by_struct = {k: p for k, p in self.network.state_dict().items()}
+        accs = self._optimizer._known_state_names() | {"master_weight"}
+        out = {}
+        for key, v in sd.items():
+            if key in ("LR_Scheduler", "global_step"):
+                out[key] = v
+                continue
+            mapped = None
+            if to_structured:
+                for acc in accs:
+                    if key.endswith("_" + acc):
+                        pname = key[:-len(acc) - 1]
+                        for p in self.network.parameters():
+                            if p.name == pname and id(p) in struct:
+                                mapped = f"{struct[id(p)]}@{acc}"
+                                break
+                        break
+            elif "@" in key:
+                sname, acc = key.rsplit("@", 1)
+                p = by_struct.get(sname)
+                if p is not None:
+                    mapped = f"{p.name}_{acc}"
+            out[mapped or key] = v
+        return out
+
+    def save(self, path: str, training: bool = True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        framework_io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            framework_io.save(
+                self._remap_opt_state(self._optimizer.state_dict(), True),
+                path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False,
+             reset_optimizer: bool = False):
+        params = framework_io.load(path + ".pdparams")
+        if skip_mismatch:
+            own = self.network.state_dict()
+            params = {k: v for k, v in params.items()
+                      if k in own and tuple(np.asarray(
+                          v._value if isinstance(v, Tensor) else v).shape)
+                      == tuple(own[k].shape)}
+        self.network.set_state_dict(params)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(
+                self._remap_opt_state(framework_io.load(opt_path), False))
+        self._compiled = {}  # new weights invalidate donated buffers
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    # ------------------------------------------------------------------- fit
+    def _make_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        if data is None or isinstance(data, paddle_io.DataLoader):
+            return data
+        return paddle_io.DataLoader(data, batch_size=batch_size,
+                                    shuffle=shuffle, drop_last=drop_last,
+                                    num_workers=num_workers)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        assert train_data is not None, "train_data must be given"
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         drop_last, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False, False,
+                                        num_workers)
+        self._save_dir = save_dir
+        steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=self._metrics_name())
+
+        self.stop_training = False
+        logs = {}
+        cbks.on_train_begin({})
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch, {})
+            logs = self._run_one_epoch(train_loader, cbks, "train")
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbks)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            names.extend(to_list(m.name()))
+        return names
+
+    def _split_batch(self, batch):
+        batch = to_list(batch)
+        if (self._loss is None and not self._metrics) or len(batch) < 2:
+            return batch, []
+        # convention: last element(s) are labels; single label by default
+        n_lab = len(to_list(self._labels)) if self._labels else 1
+        return batch[:-n_lab], batch[-n_lab:]
+
+    def _run_one_epoch(self, loader, cbks, mode):
+        logs = {}
+        for m in self._metrics:
+            m.reset()
+        for step, batch in enumerate(loader):
+            inputs, labels = self._split_batch(batch)
+            getattr(cbks, f"on_{mode}_batch_begin")(step, logs)
+            if mode == "train":
+                loss, metrics = self.train_batch(inputs, labels)
+                logs["loss"] = float(np.asarray(loss).reshape(-1)[0])
+            else:
+                loss, metrics = self.eval_batch(inputs, labels)
+                if loss is not None:
+                    logs["loss"] = float(np.asarray(loss).reshape(-1)[0])
+            for m, res in zip(self._metrics, metrics):
+                for name, val in zip(to_list(m.name()), to_list(res)):
+                    logs[name] = val
+            bs = inputs[0].shape[0] if inputs and inputs[0].shape else 1
+            logs["batch_size"] = bs
+            getattr(cbks, f"on_{mode}_batch_end")(step, logs)
+        # end-of-epoch accumulated metric values
+        for m in self._metrics:
+            for name, val in zip(to_list(m.name()), to_list(m.accumulate())):
+                logs[name] = val
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _callbacks=None):
+        loader = self._make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        if _callbacks is not None:
+            cbks = _callbacks
+        else:
+            cbks = config_callbacks(callbacks, model=self, epochs=1,
+                                    steps=steps, log_freq=log_freq,
+                                    verbose=verbose,
+                                    metrics=self._metrics_name())
+        cbks.on_eval_begin({"steps": steps})
+        logs = self._run_one_epoch(loader, cbks, "eval")
+        if _callbacks is None:
+            cbks.on_eval_end(logs)
+        return {k: v for k, v in logs.items() if k != "batch_size"}
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, False,
+                                   num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[])
+        cbks.on_predict_begin({})
+        outputs = None
+        for step, batch in enumerate(loader):
+            batch = to_list(batch)
+            batch, _ = self._split_batch(batch)  # drop trailing labels
+            cbks.on_predict_batch_begin(step, {})
+            outs = self.predict_batch(batch)
+            if outputs is None:
+                outputs = [[] for _ in outs]
+            for slot, o in zip(outputs, outs):
+                slot.append(o)
+            cbks.on_predict_batch_end(step, {})
+        cbks.on_predict_end({})
+        if outputs is None:
+            return []
+        if stack_outputs:
+            return [np.concatenate(slot, axis=0) for slot in outputs]
+        return outputs
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network)

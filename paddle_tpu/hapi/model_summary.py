@@ -1,0 +1,39 @@
+"""Model summary: per-layer parameter table.
+
+Parity: `python/paddle/hapi/model_summary.py` (`summary`), simplified to a
+static parameter walk (no forward hooks needed to count params).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def summary(net: Layer, input_size=None, dtypes=None, verbose=1):
+    rows = []
+    total = trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        own = [p for p in layer.parameters(include_sublayers=False)]
+        if not own:
+            continue
+        n = int(sum(np.prod(p.shape) for p in own))
+        t = int(sum(np.prod(p.shape) for p in own if not p.stop_gradient))
+        rows.append((name or type(layer).__name__,
+                     type(layer).__name__, n))
+        total += n
+        trainable += t
+    if verbose:
+        w = max((len(r[0]) for r in rows), default=10) + 2
+        print(f"{'Layer':<{w}}{'Type':<24}{'Params':>12}")
+        print("-" * (w + 36))
+        for name, ty, n in rows:
+            print(f"{name:<{w}}{ty:<24}{n:>12,}")
+        print("-" * (w + 36))
+        print(f"Total params: {total:,}")
+        print(f"Trainable params: {trainable:,}")
+        print(f"Non-trainable params: {total - trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,5 @@
+"""Evaluation metrics.  Parity: `python/paddle/metric/__init__.py`."""
+
+from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]

@@ -1,0 +1,216 @@
+"""Metric base class and the standard classification metrics.
+
+Parity: `python/paddle/metric/metrics.py` — Metric (`:34`, the
+reset/update/accumulate/compute protocol), Accuracy (`:183`), Precision
+(`:333`), Recall (`:462`), Auc (`:594`), functional accuracy (`:772`).
+
+TPU-native split of labor: `compute()` runs on device (jnp, fusable into a
+jitted eval step and cheap to transfer — e.g. Accuracy.compute returns a
+small correct/top-k boolean block), `update()` accumulates on host numpy
+between steps.  This mirrors the reference's design intent (compute on the
+device graph, update in Python) rather than its implementation.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _to_np(x) -> np.ndarray:
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    return np.asarray(x)
+
+
+class Metric(metaclass=abc.ABCMeta):
+    """Stateful streaming metric: reset() -> update()* -> accumulate()."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Device-side preprocessing of (pred, label); defaults to identity.
+
+        Whatever this returns is passed (as host arrays) to `update`.
+        """
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy.  Parity: `metrics.py:183`."""
+
+    def __init__(self, topk: Union[int, Sequence[int]] = (1,), name=None,
+                 *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._init_name(name)
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        """Per-sample correctness of the top-maxk predictions (device)."""
+        p = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+        l = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+        idx = jnp.argsort(p, axis=-1)[..., ::-1][..., :self.maxk]
+        if l.ndim == p.ndim:  # one-hot / column labels
+            l = jnp.argmax(l, axis=-1) if l.shape[-1] == p.shape[-1] \
+                else l.squeeze(-1)
+        return (idx == l[..., None]).astype(jnp.float32)
+
+    def update(self, correct, *args):
+        c = _to_np(correct)
+        num = c.shape[0] if c.ndim else 1
+        self.total_samples += num
+        for i, k in enumerate(self.topk):
+            self.correct_k[i] += float(c[..., :k].sum())
+        return (np.array([ck / max(self.total_samples, 1)
+                          for ck in self.correct_k])
+                if len(self.topk) > 1 else
+                self.correct_k[0] / max(self.total_samples, 1))
+
+    def reset(self):
+        self.total_samples = 0
+        self.correct_k = [0.0 for _ in self.topk]
+
+    def accumulate(self):
+        res = [ck / max(self.total_samples, 1) for ck in self.correct_k]
+        return res if len(res) > 1 else res[0]
+
+    def _init_name(self, name):
+        name = name or "acc"
+        self._name = [f"{name}_top{k}" for k in self.topk] \
+            if len(self.topk) > 1 else [name]
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    """Binary precision = tp / (tp + fp).  Parity: `metrics.py:333`."""
+
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = (_to_np(preds) > 0.5).astype(np.int64).reshape(-1)
+        l = _to_np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall = tp / (tp + fn).  Parity: `metrics.py:462`."""
+
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = (_to_np(preds) > 0.5).astype(np.int64).reshape(-1)
+        l = _to_np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via histogram buckets.  Parity: `metrics.py:594`."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        super().__init__()
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        """preds: (N, 2) class probabilities or (N,) positive scores."""
+        p = _to_np(preds)
+        pos_prob = p[:, 1] if p.ndim == 2 else p.reshape(-1)
+        l = _to_np(labels).reshape(-1).astype(np.int64)
+        bucket = np.clip((pos_prob * self._num_thresholds).astype(np.int64),
+                         0, self._num_thresholds)
+        np.add.at(self._stat_pos, bucket, (l == 1).astype(np.int64))
+        np.add.at(self._stat_neg, bucket, (l == 0).astype(np.int64))
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self._num_thresholds + 1, np.int64)
+
+    @staticmethod
+    def trapezoid_area(x1, x2, y1, y2):
+        return abs(x1 - x2) * (y1 + y2) / 2.0
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        # walk thresholds from high to low, accumulating TP/FP counts
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += self.trapezoid_area(tot_neg, new_neg, tot_pos, new_pos)
+            tot_pos, tot_neg = new_pos, new_neg
+        return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy.  Parity: `metrics.py:772`."""
+    p = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    l = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    idx = jnp.argsort(p, axis=-1)[..., ::-1][..., :k]
+    if l.ndim == p.ndim:
+        l = l.squeeze(-1)
+    hit = (idx == l[..., None]).any(axis=-1)
+    return Tensor._wrap(jnp.mean(hit.astype(jnp.float32)))

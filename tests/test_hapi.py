@@ -1,0 +1,240 @@
+"""hapi Model + metric + callbacks.
+
+Mirrors the reference's `test/legacy_test/test_model.py` strategy: train
+LeNet on synthetic MNIST-shaped data via Model.fit, check accuracy improves,
+save/load round trip, callbacks fire, metrics match hand computation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi.callbacks import Callback, EarlyStopping, ReduceLROnPlateau
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall, accuracy
+
+
+class TinyDataset(paddle.io.Dataset):
+    """Linearly separable 2-class blobs, 10 classes worth of images."""
+
+    def __init__(self, n=64, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = (rng.rand(n) * 10).astype(np.int32) % 10
+        self.x = rng.rand(n, 1, 28, 28).astype(np.float32) * 0.1
+        for i in range(n):  # class-coded bright stripe makes it learnable
+            r = int(self.y[i]) * 2
+            self.x[i, :, r:r + 3, :] += 1.0
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+# ---------------------------------------------------------------- metrics
+def test_accuracy_metric_matches_numpy():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1], [0.2, 0.3, 0.5]],
+                    np.float32)
+    label = np.array([1, 2, 2], np.int32)
+    m.update(m.compute(paddle.to_tensor(pred), paddle.to_tensor(label)))
+    top1, top2 = m.accumulate()
+    assert top1 == pytest.approx(2 / 3)
+    assert top2 == pytest.approx(3 / 3)
+    assert m.name() == ["acc_top1", "acc_top2"]
+    # functional form
+    f = accuracy(paddle.to_tensor(pred), paddle.to_tensor(label), k=1)
+    assert float(np.asarray(f._value)) == pytest.approx(2 / 3)
+
+
+def test_precision_recall():
+    p = Precision()
+    r = Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7], np.float32)
+    labels = np.array([1, 0, 1, 1], np.int32)
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert p.accumulate() == pytest.approx(2 / 3)   # tp=2 fp=1
+    assert r.accumulate() == pytest.approx(2 / 3)   # tp=2 fn=1
+
+
+def test_auc_perfect_classifier():
+    m = Auc()
+    preds = np.stack([1 - np.array([0.9, 0.8, 0.1, 0.2]),
+                      np.array([0.9, 0.8, 0.1, 0.2])], axis=1)
+    labels = np.array([1, 1, 0, 0], np.int32)
+    m.update(preds, labels)
+    assert m.accumulate() == pytest.approx(1.0)
+    m.reset()
+    assert m.accumulate() == 0.0
+
+
+# ------------------------------------------------------------------ model
+def _prepared_model(jit_compile=True, lr=0.002):
+    net = paddle.vision.models.LeNet()
+    model = paddle.Model(net)
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss(),
+                  metrics=Accuracy(), jit_compile=jit_compile)
+    return model
+
+
+@pytest.mark.parametrize("jit_compile", [True, False])
+def test_model_fit_learns(jit_compile):
+    paddle.seed(42)
+    model = _prepared_model(jit_compile)
+    logs = model.fit(TinyDataset(64), batch_size=16, epochs=4, verbose=0,
+                     shuffle=True)
+    assert logs["acc"] > 0.5, f"LeNet failed to learn: {logs}"
+    assert logs["loss"] < 2.0
+
+
+def test_model_evaluate_and_predict():
+    paddle.seed(0)
+    model = _prepared_model()
+    model.fit(TinyDataset(64), batch_size=16, epochs=3, verbose=0)
+    res = model.evaluate(TinyDataset(32, seed=1), batch_size=16, verbose=0)
+    assert "loss" in res and "acc" in res
+    outs = model.predict(TinyDataset(8, seed=2), batch_size=4,
+                         stack_outputs=True, verbose=0)
+    assert outs[0].shape == (8, 10)
+
+
+def test_model_save_load_round_trip(tmp_path):
+    paddle.seed(0)
+    model = _prepared_model()
+    model.fit(TinyDataset(32), batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "mnist")
+    model.save(path)
+    assert os.path.exists(path + ".pdparams")
+    assert os.path.exists(path + ".pdopt")
+
+    model2 = _prepared_model()
+    model2.load(path)
+    x = paddle.to_tensor(np.zeros((2, 1, 28, 28), np.float32))
+    np.testing.assert_allclose(np.asarray(model.predict_batch([x])[0]),
+                               np.asarray(model2.predict_batch([x])[0]),
+                               rtol=1e-5)
+
+
+def test_callbacks_fire_in_order():
+    events = []
+
+    class Recorder(Callback):
+        def on_train_begin(self, logs=None): events.append("train_begin")
+        def on_epoch_begin(self, epoch, logs=None): events.append("epoch_begin")
+        def on_train_batch_end(self, step, logs=None): events.append("batch")
+        def on_epoch_end(self, epoch, logs=None): events.append("epoch_end")
+        def on_train_end(self, logs=None): events.append("train_end")
+
+    model = _prepared_model()
+    model.fit(TinyDataset(32), batch_size=16, epochs=2, verbose=0,
+              callbacks=[Recorder()])
+    assert events[0] == "train_begin" and events[-1] == "train_end"
+    assert events.count("epoch_begin") == 2
+    assert events.count("batch") == 4
+
+
+def test_early_stopping_stops():
+    model = _prepared_model(lr=0.0)  # lr=0 -> no improvement ever
+    es = EarlyStopping(monitor="loss", patience=0, verbose=0,
+                       save_best_model=False)
+    model.fit(TinyDataset(32), eval_data=TinyDataset(16, seed=1),
+              batch_size=16, epochs=6, verbose=0, callbacks=[es])
+    assert model.stop_training
+    assert es.wait_epoch > es.patience
+
+
+def test_reduce_lr_on_plateau():
+    model = _prepared_model(lr=0.1)
+    # lr won't improve with lr=0 updates; force plateau by zero LR after prep
+    model._optimizer.set_lr(0.1)
+    cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1, verbose=0)
+    cb.set_model(model)
+    cb.on_eval_end({"loss": 1.0})
+    cb.on_eval_end({"loss": 1.0})  # patience hit -> lr halves
+    assert model._optimizer.get_lr() == pytest.approx(0.05)
+
+
+def test_model_checkpoint_saves(tmp_path):
+    model = _prepared_model()
+    model.fit(TinyDataset(32), batch_size=16, epochs=2, verbose=0,
+              save_dir=str(tmp_path))
+    assert os.path.exists(str(tmp_path / "0.pdparams"))
+    assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+
+def test_optimizer_state_survives_load_into_fresh_model(tmp_path):
+    """Accumulators must restore even though the second model's params get
+    different auto-generated names (structured-name remapping)."""
+    model = _prepared_model()
+    model.fit(TinyDataset(32), batch_size=16, epochs=1, verbose=0)
+    path = str(tmp_path / "m")
+    model.save(path)
+
+    model2 = _prepared_model()
+    model2.load(path)
+    accs = model2._optimizer._accumulators
+    n_restored = sum(len(v) for v in accs.values())
+    assert n_restored >= 2 * len(model2.parameters()), \
+        f"Adam moments not restored: {n_restored}"
+    assert model2._optimizer._global_step == model._optimizer._global_step
+
+
+def test_lr_scheduler_callback_steps():
+    net = paddle.vision.models.LeNet()
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    opt = paddle.optimizer.Adam(learning_rate=sched,
+                                parameters=net.parameters())
+    model = paddle.Model(net)
+    model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+    model.fit(TinyDataset(32), batch_size=16, epochs=1, verbose=0)
+    # 2 train steps with by_step scheduler: 0.1 -> 0.05 -> 0.025
+    assert opt.get_lr() == pytest.approx(0.1 * 0.5 ** 2)
+
+
+def test_train_batch_grad_accumulation():
+    paddle.seed(0)
+    model = _prepared_model(jit_compile=True)
+    x = np.random.RandomState(0).rand(8, 1, 28, 28).astype(np.float32)
+    y = (np.arange(8) % 10).astype(np.int32)
+    model.train_batch([x], [y], update=False)  # accumulate only
+    g = model.parameters()[0].grad
+    assert g is not None and float(np.abs(np.asarray(g._value)).sum()) > 0
+    before = np.asarray(model.parameters()[0]._value).copy()
+    model.train_batch([x], [y], update=True)
+    after = np.asarray(model.parameters()[0]._value)
+    assert not np.allclose(before, after)
+    # grads cleared after the consuming step
+    g2 = model.parameters()[0].grad
+    assert g2 is None or float(np.abs(np.asarray(g2._value)).sum()) == 0
+
+
+def test_load_skip_mismatch(tmp_path):
+    model = _prepared_model()
+    path = str(tmp_path / "m")
+    model.save(path)
+    net2 = paddle.nn.Linear(4, 4)  # totally different architecture
+    before = np.asarray(net2.weight._value).copy()
+    m2 = paddle.Model(net2)
+    m2.load(path, skip_mismatch=True)  # must not raise
+    np.testing.assert_array_equal(np.asarray(net2.weight._value), before)
+
+
+def test_fit_zero_epochs_is_noop():
+    model = _prepared_model()
+    logs = model.fit(TinyDataset(16), batch_size=8, epochs=0, verbose=0)
+    assert logs == {}
+
+
+def test_summary_counts_params():
+    net = paddle.vision.models.LeNet()
+    info = paddle.summary(net)
+    want = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert info["total_params"] == want
+    assert info["trainable_params"] == want
