@@ -136,7 +136,8 @@ class Model:
             fn = self._compiled[key]
         else:
             fn = self._mode_fn(mode)
-        self._pending_accum = mode == "accumulate"
+        if mode in ("train", "accumulate"):
+            self._pending_accum = mode == "accumulate"
         return fn(*(inputs + labels)), labels
 
     def train_batch(self, inputs, labels=None, update=True):
@@ -175,8 +176,9 @@ class Model:
         auto-generated parameter names ("param_37_moment1") and the
         network's stable structured names ("fc.0.weight@moment1"), so a
         .pdopt saved by one process restores into a freshly built model."""
-        struct = {id(p): k for k, p in self.network.state_dict().items()}
-        by_struct = {k: p for k, p in self.network.state_dict().items()}
+        state = self.network.state_dict()
+        by_pname = {p.name: k for k, p in state.items()}
+        by_struct = state
         accs = self._optimizer._known_state_names() | {"master_weight"}
         out = {}
         for key, v in sd.items():
@@ -187,11 +189,9 @@ class Model:
             if to_structured:
                 for acc in accs:
                     if key.endswith("_" + acc):
-                        pname = key[:-len(acc) - 1]
-                        for p in self.network.parameters():
-                            if p.name == pname and id(p) in struct:
-                                mapped = f"{struct[id(p)]}@{acc}"
-                                break
+                        sname = by_pname.get(key[:-len(acc) - 1])
+                        if sname is not None:
+                            mapped = f"{sname}@{acc}"
                         break
             elif "@" in key:
                 sname, acc = key.rsplit("@", 1)
@@ -279,6 +279,11 @@ class Model:
 
     def _split_batch(self, batch):
         batch = to_list(batch)
+        if self._inputs is not None:
+            # explicit input spec: everything after the declared inputs is
+            # labels (mirrors the reference's inputs/labels adapters)
+            n_in = len(to_list(self._inputs))
+            return batch[:n_in], batch[n_in:]
         if (self._loss is None and not self._metrics) or len(batch) < 2:
             return batch, []
         # convention: last element(s) are labels; single label by default

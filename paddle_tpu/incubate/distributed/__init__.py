@@ -1,0 +1,1 @@
+"""Parity: `python/paddle/incubate/distributed/`."""

@@ -1,0 +1,193 @@
+"""MoE gates: naive top-k, Switch (top-1), GShard (top-2).
+
+Parity: `python/paddle/incubate/distributed/models/moe/gate/` —
+BaseGate (`base_gate.py:25`), NaiveGate (`naive_gate.py:28`), SwitchGate
+(`switch_gate.py:31`), GShardGate (`gshard_gate.py:31`).
+
+TPU-native formulation: instead of the reference's index/scatter dispatch
+(count/sort positions, `_local_scatter`/`MoEScatter`), gates emit a
+*fixed-capacity* dispatch — differentiable combine weights of shape
+(tokens, experts, capacity) plus the boolean dispatch mask.  Everything
+downstream is dense einsum over static shapes (the GShard formulation),
+which XLA tiles onto the MXU and lowers to an all-to-all when the expert
+axis is sharded.  Tokens past an expert's capacity are dropped (combine
+weight 0), matching the reference's capacity semantics.
+"""
+
+from __future__ import annotations
+
+import math
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.layer.layers import Layer
+import paddle_tpu.nn.functional as F
+
+__all__ = ["BaseGate", "NaiveGate", "SwitchGate", "GShardGate", "capacity"]
+
+
+def capacity(num_tokens: int, num_experts: int, top_k: int,
+             capacity_factor: float, min_capacity: int = 4) -> int:
+    cap = int(math.ceil(top_k * num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot_f(idx, depth):
+    return paddle.one_hot(idx, depth)
+
+
+def _positions_in_expert(mask, offset=None):
+    """Running slot index of each routed token inside its expert's buffer.
+
+    mask: (T, E) 0/1 for this routing choice.  offset: (E,) slots already
+    taken by higher-priority choices.  Returns float (T, E) positions.
+    """
+    pos = paddle.cumsum(mask, axis=0) - mask  # exclusive cumsum over tokens
+    if offset is not None:
+        pos = pos + paddle.unsqueeze(offset, 0)
+    return pos
+
+
+class BaseGate(Layer):
+    """Protocol: forward(logits_or_x) -> (combine, dispatch_mask, aux_loss).
+
+    combine: float (T, E, C) — differentiable mixing weights.
+    dispatch_mask: float 0/1 (T, E, C) — which buffer slot a token fills.
+    aux_loss: scalar Tensor (0 when the gate defines none).
+    """
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 top_k: int = 2):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.loss = None
+
+    def set_loss(self, loss):
+        self.loss = loss
+
+    def get_loss(self, clear: bool = True):
+        loss, self.loss = self.loss, (None if clear else self.loss)
+        return loss
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax routing with fixed capacity, no aux loss.
+
+    Parity: `naive_gate.py:28` (scores + top-k), recast as dense dispatch.
+    """
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity_factor: float = 1.0, min_capacity: int = 4):
+        super().__init__(d_model, num_expert, world_size, top_k)
+        self.gate = paddle.nn.Linear(d_model, self.tot_expert)
+        self.capacity_factor = capacity_factor
+        self.min_capacity = min_capacity
+
+    def _route(self, gates, cap, second_keep=None):
+        """Shared fixed-capacity top-k routing.
+
+        gates: (T, E) softmax probabilities.  second_keep: optional (T,)
+        0/1 mask applied to the 2nd routing choice (GShard random routing).
+        Returns (combine, dispatch, fraction_routed_per_expert (E,),
+        mean_gate_per_expert (E,)).
+        """
+        E = self.tot_expert
+        _, idx = paddle.topk(gates, k=self.top_k, axis=-1)  # (T, k)
+        masks = []
+        taken = None  # (E,) slots consumed by higher-priority choices
+        combine = None
+        dispatch = None
+        for i in range(self.top_k):
+            m = _one_hot_f(idx[:, i], E)                       # (T, E)
+            if i == 1 and second_keep is not None:
+                m = m * paddle.unsqueeze(second_keep, -1)
+            pos = _positions_in_expert(m, taken)               # (T, E)
+            keep = paddle.cast(pos < float(cap), "float32")
+            m_kept = m * keep
+            slot = paddle.cast(pos, "int64")                   # (T, E)
+            # (T, E, C): one-hot of slot, zeroed where not kept/routed
+            oh = _one_hot_f(paddle.clip(slot, 0, cap - 1), cap)
+            oh = oh * paddle.unsqueeze(m_kept, -1)
+            w = paddle.unsqueeze(gates * m_kept, -1) * oh      # weighted slot
+            combine = w if combine is None else combine + w
+            dispatch = oh if dispatch is None else dispatch + oh
+            counts = paddle.sum(m, axis=0)                     # include drops
+            taken = counts if taken is None else taken + counts
+            masks.append(m)
+        # renormalize the kept top-k weights per token (GShard practice)
+        denom = paddle.clip(paddle.sum(combine, axis=[1, 2], keepdim=True),
+                            min=1e-9)
+        combine = combine / denom
+        frac = paddle.mean(masks[0], axis=0)     # top-1 routing fraction
+        mean_gate = paddle.mean(gates, axis=0)
+        return combine, dispatch, frac, mean_gate
+
+    def forward(self, x):
+        T = x.shape[0]
+        cap = capacity(T, self.tot_expert, self.top_k, self.capacity_factor,
+                       self.min_capacity)
+        gates = F.softmax(self.gate(x), axis=-1)
+        combine, dispatch, _, _ = self._route(gates, cap)
+        aux = paddle.zeros([], dtype="float32")
+        self.set_loss(aux)
+        return combine, dispatch, aux
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 routing with the Switch-Transformer load-balance loss.
+
+    Parity: `switch_gate.py:31` — loss = E * sum_e(frac_e * mean_gate_e).
+    """
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1,
+                 capacity_factor=1.0, min_capacity=4, group=None):
+        assert top_k == 1, "SwitchGate routes top-1"
+        super().__init__(d_model, num_expert, world_size, 1,
+                         capacity_factor, min_capacity)
+
+    def forward(self, x):
+        T = x.shape[0]
+        cap = capacity(T, self.tot_expert, 1, self.capacity_factor,
+                       self.min_capacity)
+        gates = F.softmax(self.gate(x), axis=-1)
+        combine, dispatch, frac, mean_gate = self._route(gates, cap)
+        aux = paddle.sum(frac * mean_gate) * float(self.tot_expert)
+        self.set_loss(aux)
+        return combine, dispatch, aux
+
+
+class GShardGate(NaiveGate):
+    """Top-2 routing with the GShard aux loss and capacity.
+
+    Parity: `gshard_gate.py:31`.
+    """
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert top_k == 2, "GShardGate routes top-2"
+        super().__init__(d_model, num_expert, world_size, 2,
+                         capacity_factor=capacity[0] / 2.0)
+        # reference capacity tuple is (train, eval) multiples of tokens/E
+        self._cap_train, self._cap_eval = capacity
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        T = x.shape[0]
+        factor = self._cap_train if self.training else self._cap_eval
+        cap = max(int(math.ceil(T / self.tot_expert * factor)), 4)
+        gates = F.softmax(self.gate(x), axis=-1)
+        second_keep = None
+        if self.random_routing and self.training:
+            # GShard: route to the 2nd expert with probability 2*g2, i.e.
+            # drop it when its weight is too small to matter
+            g2 = paddle.topk(gates, k=2, axis=-1)[0][:, 1]
+            second_keep = paddle.cast(
+                2.0 * g2 > paddle.rand([T], dtype="float32"), "float32")
+        combine, dispatch, frac, mean_gate = self._route(
+            gates, cap, second_keep)
+        aux = paddle.sum(frac * mean_gate) * float(self.tot_expert)
+        self.set_loss(aux)
+        return combine, dispatch, aux

@@ -1,0 +1,122 @@
+"""MoE layer with expert parallelism.
+
+Parity: `python/paddle/incubate/distributed/models/moe/moe_layer.py:263`
+(MoELayer), `:99/:149` (MoEScatter/MoEGather — replaced by dense einsum
+dispatch), `utils.py` (prepare_forward — replaced by the gate's fixed
+capacity).
+
+TPU-native: the reference scatters tokens with index ops and moves them
+between ranks with an explicit NCCL all-to-all (`global_scatter/gather`).
+Here dispatch/combine are einsums over a fixed-capacity buffer
+(T,E,C)x(T,M)->(E,C,M); experts run as one batched einsum over stacked
+weights (E,M,H)/(E,H,M) so the MXU sees large matmuls; when the stacked
+expert dim is sharded over an `ep` mesh axis, GSPMD lowers the dispatch
+einsum to the same all-to-all the reference codes by hand — and it rides
+ICI inside a jit program instead of going through host NCCL calls.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.layer.layers import Layer
+import paddle_tpu.nn.functional as F
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["ExpertMLP", "MoELayer"]
+
+
+class ExpertMLP(Layer):
+    """E parallel two-layer MLPs with stacked weights.
+
+    Weights are (E, d_model, d_hidden) / (E, d_hidden, d_model) so the whole
+    expert computation is two einsums; shard dim 0 over the `ep` mesh axis
+    for expert parallelism.
+    """
+
+    def __init__(self, num_expert: int, d_model: int, d_hidden: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.num_expert = num_expert
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        scale1 = 1.0 / math.sqrt(d_model)
+        scale2 = 1.0 / math.sqrt(d_hidden)
+        self.w1 = self.create_parameter(
+            [num_expert, d_model, d_hidden],
+            default_initializer=paddle.nn.initializer.Uniform(-scale1, scale1))
+        self.b1 = self.create_parameter(
+            [num_expert, 1, d_hidden],
+            default_initializer=paddle.nn.initializer.Constant(0.0))
+        self.w2 = self.create_parameter(
+            [num_expert, d_hidden, d_model],
+            default_initializer=paddle.nn.initializer.Uniform(-scale2, scale2))
+        self.b2 = self.create_parameter(
+            [num_expert, 1, d_model],
+            default_initializer=paddle.nn.initializer.Constant(0.0))
+        self.act = getattr(F, activation)
+
+    def forward(self, x):
+        """x: (E, C, d_model) -> (E, C, d_model), batched over experts."""
+        h = paddle.einsum("ecm,emh->ech", x, self.w1) + self.b1
+        h = self.act(h)
+        return paddle.einsum("ech,ehm->ecm", h, self.w2) + self.b2
+
+
+class MoELayer(Layer):
+    """Mixture-of-experts layer: gate -> dispatch -> experts -> combine.
+
+    Parity: `moe_layer.py:263`.  `gate` may be a BaseGate instance or one of
+    the strings "naive"/"switch"/"gshard"; `experts` may be an ExpertMLP
+    (recommended, shardable) or a list of per-token Layers applied via
+    stacking is NOT supported — build an ExpertMLP instead (the reference's
+    per-expert Layer list maps to stacked weights on TPU).
+
+    After each forward the gate's aux loss is available as `self.l_aux`
+    (add it to the training loss, as the reference's MoELayer callers do).
+    """
+
+    def __init__(self, d_model: int, experts: Optional[ExpertMLP] = None,
+                 gate: "BaseGate | str" = "gshard", num_expert: int = None,
+                 d_hidden: int = None, top_k: int = 2,
+                 capacity_factor: float = 1.25, moe_group=None,
+                 mp_group=None, **gate_kwargs):
+        super().__init__()
+        if experts is None:
+            assert num_expert and d_hidden, \
+                "give experts= or (num_expert=, d_hidden=)"
+            experts = ExpertMLP(num_expert, d_model, d_hidden)
+        self.experts = experts
+        E = experts.num_expert
+        if isinstance(gate, str):
+            if gate == "naive":
+                gate = NaiveGate(d_model, E, top_k=top_k,
+                                 capacity_factor=capacity_factor,
+                                 **gate_kwargs)
+            elif gate == "switch":
+                gate = SwitchGate(d_model, E,
+                                  capacity_factor=capacity_factor,
+                                  **gate_kwargs)
+            elif gate == "gshard":
+                gate = GShardGate(d_model, E, **gate_kwargs)
+            else:
+                raise ValueError(f"unknown gate {gate!r}")
+        self.gate = gate
+        self.l_aux = None
+
+    def forward(self, x):
+        """x: (..., d_model); routing flattens all leading dims to tokens."""
+        orig_shape = x.shape
+        d_model = orig_shape[-1]
+        xt = paddle.reshape(x, [-1, d_model])                  # (T, M)
+        combine, dispatch, aux = self.gate(xt)                 # (T,E,C) x2
+        self.l_aux = aux
+        expert_in = paddle.einsum("tec,tm->ecm", dispatch, xt)
+        expert_out = self.experts(expert_in)                   # (E, C, M)
+        out = paddle.einsum("tec,ecm->tm", combine, expert_out)
+        return paddle.reshape(out, orig_shape)

@@ -87,10 +87,8 @@ class Accuracy(Metric):
         self.total_samples += num
         for i, k in enumerate(self.topk):
             self.correct_k[i] += float(c[..., :k].sum())
-        return (np.array([ck / max(self.total_samples, 1)
-                          for ck in self.correct_k])
-                if len(self.topk) > 1 else
-                self.correct_k[0] / max(self.total_samples, 1))
+        res = [ck / max(self.total_samples, 1) for ck in self.correct_k]
+        return res if len(self.topk) > 1 else res[0]
 
     def reset(self):
         self.total_samples = 0
