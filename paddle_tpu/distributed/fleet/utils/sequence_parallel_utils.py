@@ -1,0 +1,211 @@
+"""Sequence-parallel utilities (Megatron SP).
+
+Parity: `python/paddle/distributed/fleet/utils/sequence_parallel_utils.py` —
+scatter (`:42`), all_gather (`:58`), reduce_scatter (`:69`), ScatterOp
+(`:85`), GatherOp (`:97`), AllGatherOp (`:111`), ReduceScatterOp (`:127`),
+ColumnSequenceParallelLinear (`:395`), RowSequenceParallelLinear (`:528`),
+mark/is_sequence_parallel_parameter (`:148`).
+
+TPU-native: the reference implements each op as a PyLayer whose forward and
+backward issue explicit NCCL calls.  Here the ops are *sharding moves*: in
+eager they are device_puts to the target NamedSharding; under jit they are
+`with_sharding_constraint`s that GSPMD lowers to the identical all-gather /
+reduce-scatter pairs — and to their transposes in the backward pass
+automatically (the adjoint of all-gather IS reduce-scatter, which is why the
+reference had to hand-write both directions).  The sequence axis rides the
+'mp' mesh axis, exactly like the reference reuses the TP group for SP.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....framework.tensor import Tensor
+from ....nn.layer.layers import Layer
+from ....ops.registry import dispatch as _dispatch, register_op
+from .. import mp_layers as _mp
+from ... import mesh as _mesh
+
+__all__ = ["scatter", "all_gather", "reduce_scatter",
+           "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "is_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _base_entries(value, ndim: int):
+    """Per-dim spec entries preserving the value's existing sharding on all
+    dims we don't touch (so a dp-sharded batch dim stays dp-sharded).
+    Tracers have no readable sharding — leave other dims UNCONSTRAINED for
+    GSPMD to propagate."""
+    if isinstance(value, jax.core.Tracer):
+        unconstrained = getattr(P, "UNCONSTRAINED", None)
+        return [unconstrained] * ndim
+    sh = getattr(value, "sharding", None)
+    if isinstance(sh, NamedSharding) and len(sh.spec) <= ndim:
+        entries = list(sh.spec) + [None] * (ndim - len(sh.spec))
+        return entries
+    return [None] * ndim
+
+
+def _mesh_or_raise():
+    m = _mesh.get_mesh()
+    if m is None:
+        raise RuntimeError("sequence parallel needs fleet.init / a global "
+                           "mesh (distributed.mesh.set_mesh)")
+    return m
+
+
+def _strip_axis(entries, axis_name):
+    """A mesh axis may appear in at most one spec entry."""
+    out = []
+    for e in entries:
+        if e == axis_name:
+            out.append(None)
+        elif isinstance(e, tuple) and axis_name in e:
+            rest = tuple(x for x in e if x != axis_name)
+            out.append(rest if rest else None)
+        else:
+            out.append(e)
+    return out
+
+
+def _seq_sharding(value, seq_axis: int, axis_name: str = "mp"):
+    ndim = value.ndim
+    entries = _strip_axis(_base_entries(value, ndim), axis_name)
+    entries[seq_axis] = axis_name
+    return NamedSharding(_mesh_or_raise(), P(*entries))
+
+
+def _replicated(value, seq_axis: int, axis_name: str = "mp"):
+    ndim = value.ndim
+    entries = _base_entries(value, ndim)
+    entries[seq_axis] = None
+    return NamedSharding(_mesh_or_raise(), P(*entries))
+
+
+def _move(value, sharding=None):
+    if isinstance(value, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(value, sharding)
+    return jax.device_put(value, sharding)
+
+
+# registered so the eager tape differentiates through the move (the adjoint
+# of a sharding move is a sharding move — jax.vjp of device_put handles it)
+register_op("sp_sharding_move", _move)
+
+
+def _apply_move(input, sharding):
+    if isinstance(input, Tensor):
+        return _dispatch("sp_sharding_move", (input,),
+                         {"sharding": sharding})
+    return _move(input, sharding)
+
+
+def scatter(input, axis: int = 0, axis_name: str = "mp"):
+    """Split the sequence dim over the SP group (reference `:42`)."""
+    v = input._value if isinstance(input, Tensor) else input
+    return _apply_move(input, _seq_sharding(v, axis, axis_name))
+
+
+def all_gather(input, axis: int = 0, axis_name: str = "mp"):
+    """Reassemble the full sequence on every rank (reference `:58`)."""
+    v = input._value if isinstance(input, Tensor) else input
+    return _apply_move(input, _replicated(v, axis, axis_name))
+
+
+def reduce_scatter(input, axis: int = 0, axis_name: str = "mp"):
+    """Sum partial activations and shard the sequence dim (reference `:69`).
+
+    In the GSPMD formulation the partial-sum enters as a replicated-but-
+    partial value only inside a manual shard_map; at the user API level the
+    op is the sharding move whose lowering is the reduce-scatter.
+    """
+    return scatter(input, axis, axis_name)
+
+
+# Layer aliases matching the reference's PyLayer names ----------------------
+class _OpModule:
+    """Reference exposes ScatterOp.apply(x); keep that call shape."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def apply(self, x, *a, **k):
+        return self._fn(x, *a, **k)
+
+    def __call__(self, x, *a, **k):
+        return self._fn(x, *a, **k)
+
+
+ScatterOp = _OpModule(scatter)
+GatherOp = _OpModule(all_gather)
+AllGatherOp = _OpModule(all_gather)
+ReduceScatterOp = _OpModule(reduce_scatter)
+
+
+_sp_params = None
+
+
+def _sp_registry():
+    global _sp_params
+    if _sp_params is None:
+        import weakref
+        # id-keyed (Tensor __eq__ is elementwise, so no WeakSet); entries
+        # vanish with the parameter, so a recycled id cannot false-positive
+        _sp_params = weakref.WeakValueDictionary()
+    return _sp_params
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    _sp_registry()[id(parameter)] = parameter
+
+
+def is_sequence_parallel_parameter(parameter):
+    return _sp_registry().get(id(parameter)) is parameter
+
+
+def register_sequence_parallel_allreduce_hooks(layer, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """Reference `:192`: allreduce SP params' grads over the mp group.
+
+    Under GSPMD the gradient of a replicated parameter used by sharded
+    activations is already all-reduced by sharding propagation; this hook
+    exists for API parity and asserts the marked params are replicated.
+    """
+    for p in layer.parameters():
+        if is_sequence_parallel_parameter(p):
+            sh = getattr(p._value, "sharding", None)
+            if sh is not None and not sh.is_fully_replicated:
+                raise ValueError(
+                    f"sequence-parallel parameter {p.name} must be "
+                    "replicated; got sharding "f"{sh}")
+
+
+class ColumnSequenceParallelLinear(_mp.ColumnParallelLinear):
+    """Column-parallel linear whose input arrives sequence-sharded.
+
+    Parity: reference `:395`.  The input is all-gathered along the sequence
+    (sharding move to replicated), then the column-parallel matmul runs —
+    GSPMD fuses the gather into the matmul schedule.
+    """
+
+    def forward(self, x):
+        x = all_gather(x, axis=1 if x.ndim >= 3 else 0)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(_mp.RowParallelLinear):
+    """Row-parallel linear whose output leaves sequence-sharded.
+
+    Parity: reference `:528`.  The row-parallel partial sums are combined
+    and immediately scattered along the sequence: one reduce-scatter
+    instead of the reference's allreduce-then-split.
+    """
+
+    def forward(self, x):
+        out = super().forward(x)
+        return reduce_scatter(out, axis=1 if out.ndim >= 3 else 0)

@@ -168,8 +168,7 @@ class GShardGate(NaiveGate):
     def __init__(self, d_model, num_expert, world_size=1, top_k=2,
                  capacity=(1.2, 2.4), random_routing=True, group=None):
         assert top_k == 2, "GShardGate routes top-2"
-        super().__init__(d_model, num_expert, world_size, 2,
-                         capacity_factor=capacity[0] / 2.0)
+        super().__init__(d_model, num_expert, world_size, 2)
         # reference capacity tuple is (train, eval) multiples of tokens/E
         self._cap_train, self._cap_eval = capacity
         self.random_routing = random_routing
@@ -177,7 +176,9 @@ class GShardGate(NaiveGate):
     def forward(self, x):
         T = x.shape[0]
         factor = self._cap_train if self.training else self._cap_eval
-        cap = max(int(math.ceil(T / self.tot_expert * factor)), 4)
+        # factor is already in tokens/E units (includes the top-2)
+        cap = capacity(T, self.tot_expert, 1, factor,
+                       min_capacity=self.min_capacity)
         gates = F.softmax(self.gate(x), axis=-1)
         second_keep = None
         if self.random_routing and self.training:

@@ -18,9 +18,7 @@ ICI inside a jit program instead of going through host NCCL calls.
 from __future__ import annotations
 
 import math
-from typing import List, Optional, Sequence
-
-import numpy as np
+from typing import Optional
 
 import paddle_tpu as paddle
 from paddle_tpu.nn.layer.layers import Layer
@@ -103,6 +101,13 @@ class MoELayer(Layer):
                                   capacity_factor=capacity_factor,
                                   **gate_kwargs)
             elif gate == "gshard":
+                if top_k != 2:
+                    raise ValueError("gshard gate routes top-2; use "
+                                     "gate='naive' for other top_k")
+                if "capacity" not in gate_kwargs and capacity_factor != 1.25:
+                    # translate tokens/(E*k) factor to GShard's tokens/E tuple
+                    gate_kwargs["capacity"] = (2 * capacity_factor,
+                                               2 * capacity_factor)
                 gate = GShardGate(d_model, E, **gate_kwargs)
             else:
                 raise ValueError(f"unknown gate {gate!r}")

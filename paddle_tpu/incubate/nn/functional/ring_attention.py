@@ -1,0 +1,136 @@
+"""Ring attention: exact long-context attention over a sequence-parallel
+mesh axis.
+
+Parity target: the reference's long-context path is flash-attention +
+sequence/context parallel groups (`fleet/utils/sequence_parallel_utils.py`,
+`phi/kernels/gpu/flash_attn_kernel.cu` with cu_seqlens); this module is the
+TPU-native equivalent SURVEY §5.7 calls out as "where TPU should beat the
+reference": each device holds S/n of the sequence, K/V blocks rotate around
+the ring via `ppermute` over ICI while every hop's partial attention is
+accumulated with the flash-attention online-softmax update — compute and
+communication overlap, no device ever materialises the full K/V.
+
+Layout: (batch, num_heads, seq, head_dim), matching `ops/pallas_flash.py`.
+
+Use inside `shard_map` (axis_name = the sequence/context-parallel mesh
+axis), or call `ring_attention` with a mesh for the wrapped version.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention_local", "ring_attention"]
+
+_NEG = -1e30
+
+
+def _register():
+    from ....ops.registry import register_op
+    register_op("ring_attention", _ring_attention_val)
+
+
+def _block_update(q, k, v, acc, m, l, q_off, k_off, causal, scale):
+    """One flash-attention online-softmax step on a (S_q, S_k) block."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_off + jax.lax.iota(jnp.int32, q.shape[2])[:, None]
+        kpos = k_off + jax.lax.iota(jnp.int32, k.shape[2])[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG)
+    m_new = jnp.maximum(m, s.max(axis=-1))              # (B, H, Sq)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])                   # (B, H, Sq, Sk)
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(p.dtype),
+                    preferred_element_type=jnp.float32)
+    acc_new = acc * alpha[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None):
+    """Exact attention where q/k/v are sequence-sharded over `axis_name`.
+
+    Must run inside shard_map/pjit manual-sharding over `axis_name`.
+    q, k, v: (B, H, S_local, D) — this rank's sequence slice.
+    Returns (B, H, S_local, D) for this rank's queries over the FULL keys.
+    """
+    n = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    acc0 = jnp.zeros((B, H, S, D), jnp.float32)
+    m0 = jnp.full((B, H, S), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    # initial carries are rank-invariant; outputs vary with the rank — mark
+    # them varying over the manual axis so scan's carry types match
+    if hasattr(jax.lax, "pcast"):
+        acc0, m0, l0 = (jax.lax.pcast(x, (axis_name,), to="varying")
+                        for x in (acc0, m0, l0))
+    elif hasattr(jax.lax, "pvary"):
+        acc0, m0, l0 = (jax.lax.pvary(x, (axis_name,))
+                        for x in (acc0, m0, l0))
+
+    def hop(carry, i):
+        acc, m, l, k_cur, v_cur = carry
+        # after i hops this rank holds the block that started on rank-i
+        src = (rank - i) % n
+        acc, m, l = _block_update(q, k_cur, v_cur, acc, m, l,
+                                  q_off=rank * S, k_off=src * S,
+                                  causal=causal, scale=scale)
+        # rotate K/V one step around the ring (skipped after the last hop
+        # would be ideal; keeping it uniform lets XLA pipeline the permute
+        # of hop i+1 under the compute of hop i)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m, l, k_nxt, v_nxt), None
+
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        hop, (acc0, m0, l0, k, v), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)
+
+
+def _ring_attention_val(q, k, v, mesh=None, axis_name="sp", causal=False,
+                        scale=None):
+    spec = P(None, None, axis_name, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec)
+    def run(q, k, v):
+        return ring_attention_local(q, k, v, axis_name, causal, scale)
+
+    return run(q, k, v)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Convenience wrapper: shard q/k/v's sequence dim over `axis_name` of
+    `mesh` and run `ring_attention_local` under shard_map.
+
+    Accepts paddle Tensors or jax arrays of shape (B, H, S, D) with S
+    divisible by the axis size.  Returns the same type as the input.
+    Tensor inputs go through the op registry, so eager `loss.backward()`
+    differentiates through the ring (AD of ppermute is the reverse permute).
+    """
+    from ....framework.tensor import Tensor
+    from ....ops.registry import dispatch as _dispatch
+
+    static = {"mesh": mesh, "axis_name": axis_name, "causal": causal,
+              "scale": scale}
+    if isinstance(q, Tensor):
+        return _dispatch("ring_attention", (q, k, v), static)
+    return _ring_attention_val(q, k, v, **static)
+
+
+_register()
