@@ -18,6 +18,8 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate,  # noqa:
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .store import Store, TCPStore  # noqa: F401
+from . import launch  # noqa: F401
 
 
 def get_mesh():

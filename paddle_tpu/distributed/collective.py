@@ -390,12 +390,71 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return all_gather(gather_list, tensor, group, sync_op)
 
 
+_store_state = {"store": None, "barrier_seq": 0, "p2p_seq": {}}
+
+
+def _generation() -> str:
+    """Elastic restart generation: restarted workers must not collide with
+    keys a previous generation left in the launcher's store."""
+    import os
+    return os.environ.get("PADDLE_RESTART_GENERATION", "0")
+
+
+def _host_store():
+    """Cross-process control-plane store (hosted by the launcher).
+
+    Returns None when not in a multi-process job.  Workers connect to
+    PADDLE_MASTER, the rendezvous server `paddle_tpu.distributed.launch`
+    hosts (reference: the ProcessGroup's TCPStore, `tcp_store.h:121`).
+    """
+    import os
+    if _store_state["store"] is not None:
+        return _store_state["store"]
+    master = os.environ.get("PADDLE_MASTER")
+    if not master or int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) <= 1:
+        return None
+    from .store import TCPStore
+    host, port = master.rsplit(":", 1)
+    _store_state["store"] = TCPStore(
+        host=host, port=int(port),
+        world_size=int(os.environ["PADDLE_TRAINERS_NUM"]))
+    return _store_state["store"]
+
+
+def _host_p2p(tensor, peer, is_send, group):
+    """Eager cross-process p2p through the store (control path only; inside
+    compiled pipeline schedules use ppermute, which rides ICI)."""
+    import os
+    import pickle
+    import numpy as np
+    store = _host_store()
+    if store is None:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    src, dst = (rank, peer) if is_send else (peer, rank)
+    key_id = (src, dst)
+    seq = _store_state["p2p_seq"].get(key_id, 0)
+    _store_state["p2p_seq"][key_id] = seq + 1
+    key = f"__p2p__/{_generation()}/{src}->{dst}/{seq}"
+    if is_send:
+        store.set(key, pickle.dumps(np.asarray(tensor._value)))
+    else:
+        store.wait(key)
+        arr = pickle.loads(store.get(key))
+        store.delete_key(key)  # free the payload in the server
+        tensor._value = jnp.asarray(arr, dtype=tensor._value.dtype)
+    return tensor
+
+
 def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
     """Point-to-point over a pipeline axis = ppermute (see fleet pp_utils)."""
     axis = current_axis_for(group)
     if axis is None:
         if _single_rank(group):
             return tensor
+        out = _host_p2p(tensor, dst, True, group)
+        if out is not None:
+            return out
         raise NotImplementedError("p2p outside axis context")
     group = group or _get_default_group()
     n = group.nranks
@@ -410,6 +469,9 @@ def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     if axis is None:
         if _single_rank(group):
             return tensor
+        out = _host_p2p(tensor, src, False, group)
+        if out is not None:
+            return out
         raise NotImplementedError("p2p outside axis context")
     raise NotImplementedError(
         "use fleet pp_utils.p2p helpers inside pipeline schedules; raw "
@@ -421,6 +483,18 @@ irecv = recv
 
 
 def barrier(group=None):
+    """Block until every process of the job arrived.
+
+    Single-process (incl. single-process-many-devices SPMD): no-op, the
+    compiler orders collectives.  Multi-process: synchronizes through the
+    launcher's TCPStore (reference: ProcessGroup::Barrier).
+    """
+    store = _host_store()
+    if store is None:
+        return None
+    seq = _store_state["barrier_seq"]
+    _store_state["barrier_seq"] = seq + 1
+    store.barrier(f"collective/{_generation()}/{seq}")
     return None
 
 

@@ -1,0 +1,7 @@
+"""Elastic training manager.  Parity: `python/paddle/distributed/fleet/
+elastic/manager.py:124` (ElasticManager), `elastic/__init__.py` (enter/exit
+protocol)."""
+
+from .manager import ElasticManager, ElasticStatus
+
+__all__ = ["ElasticManager", "ElasticStatus"]

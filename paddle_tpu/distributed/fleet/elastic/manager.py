@@ -1,0 +1,91 @@
+"""Elastic manager: heartbeat-based liveness over the TCPStore.
+
+Parity: `python/paddle/distributed/fleet/elastic/manager.py:124`.  The
+reference heartbeats into etcd and signals the launcher to scale/restart;
+here the TCPStore is the rendezvous backend (same store the launcher uses),
+and `paddle_tpu.distributed.launch --max_restart N` is the restart executor.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import List, Optional
+
+from ...store import TCPStore
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"       # waiting for nodes
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Per-node heartbeat + liveness watch.
+
+    Each node publishes `heartbeat/<gen>/<node_id>` every `interval`
+    seconds; `dead_nodes()` reports nodes whose beat is older than
+    `2.5 * interval`.  The launcher polls `should_restart()` to decide on a
+    re-rendezvous.
+    """
+
+    def __init__(self, store: TCPStore, node_id: int, nnodes: int,
+                 generation: int = 0, interval: float = 2.0):
+        self.store = store
+        self.node_id = node_id
+        self.nnodes = nnodes
+        self.generation = generation
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ heartbeat
+    def _key(self, node: int) -> str:
+        return f"heartbeat/{self.generation}/{node}"
+
+    def start(self):
+        def beat():
+            while not self._stop.wait(self.interval):
+                self.store.set(self._key(self.node_id),
+                               repr(time.time()).encode())
+        self.store.set(self._key(self.node_id), repr(time.time()).encode())
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval * 2)
+
+    # -------------------------------------------------------------- watching
+    def last_beat(self, node: int) -> Optional[float]:
+        if not self.store.check(self._key(node)):
+            return None
+        return float(self.store.get(self._key(node)).decode())
+
+    def dead_nodes(self, grace: Optional[float] = None) -> List[int]:
+        grace = grace if grace is not None else 2.5 * self.interval
+        now = time.time()
+        dead = []
+        for n in range(self.nnodes):
+            beat = self.last_beat(n)
+            if beat is None or now - beat > grace:
+                dead.append(n)
+        return dead
+
+    def should_restart(self) -> bool:
+        return len(self.dead_nodes()) > 0
+
+    def status(self) -> ElasticStatus:
+        dead = self.dead_nodes()
+        if not dead:
+            return ElasticStatus.COMPLETED
+        if len(dead) == self.nnodes:
+            return ElasticStatus.EXIT
+        return ElasticStatus.RESTART

@@ -1,0 +1,3 @@
+"""Distributed launcher.  Parity: `python/paddle/distributed/launch/`."""
+
+from .main import CollectiveController, launch, parse_args  # noqa: F401

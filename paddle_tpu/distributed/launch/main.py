@@ -1,0 +1,221 @@
+"""`python -m paddle_tpu.distributed.launch` — the distributed job launcher.
+
+Parity: `python/paddle/distributed/launch/main.py:20` (launch),
+`launch/controllers/collective.py:22` (CollectiveController),
+`fleet/elastic/manager.py:124` (restart policy).
+
+Spawns `nproc_per_node` worker processes per host, wires the coordination
+env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER, which
+`init_parallel_env` maps onto `jax.distributed.initialize`), hosts or joins
+the TCPStore rendezvous at `--master`, writes one log file per rank, and —
+elastic mode — restarts the collective when a worker dies, up to
+`--max_restart` times.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from ..store import TCPStore
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="paddle_tpu distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="rendezvous server host:port (default: local)")
+    p.add_argument("--rank", type=int, default=-1, help="node rank")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="number of nodes (N or MIN:MAX for elastic)")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None,
+                   help="device ids to expose per process (comma list)")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective"])
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="elastic: restarts allowed after worker failure")
+    p.add_argument("--elastic_timeout", type=float, default=30.0)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class Proc:
+    def __init__(self, popen: subprocess.Popen, rank: int, log_path: str,
+                 log_file):
+        self.popen = popen
+        self.rank = rank
+        self.log_path = log_path
+        self.log_file = log_file
+
+
+class CollectiveController:
+    """One node's worker pool.  Parity: `controllers/collective.py:22`."""
+
+    def __init__(self, args):
+        self.args = args
+        self.nnodes = int(str(args.nnodes).split(":")[0])
+        self.node_rank = max(args.rank, 0)
+        self.nproc = args.nproc_per_node
+        self.world_size = self.nnodes * self.nproc
+        self.procs: List[Proc] = []
+        self.store: Optional[TCPStore] = None
+        self.master = args.master
+        self.restarts = 0
+
+    # ------------------------------------------------------------ rendezvous
+    def rendezvous(self):
+        """Host (node 0) or join the TCPStore; allocate trainer ranks.
+
+        Idempotent across elastic generations: the server survives a worker
+        restart, only the generation-scoped keys change.
+        """
+        if self.store is None:
+            if self.master is None:
+                self.store = TCPStore(is_master=True, world_size=self.nnodes)
+                self.master = f"127.0.0.1:{self.store.port}"
+            else:
+                host, port = self.master.rsplit(":", 1)
+                is_master = self.node_rank == 0
+                self.store = TCPStore(host=host, port=int(port),
+                                      is_master=is_master,
+                                      world_size=self.nnodes)
+        store = self.store
+        gen = self.restarts
+        if self.args.rank < 0:
+            self.node_rank = store.add(f"node_rank/{gen}", 1) - 1
+        store.barrier(f"rendezvous/{gen}", self.nnodes,
+                      timeout=self.args.elastic_timeout)
+
+    # --------------------------------------------------------------- workers
+    def _worker_env(self, local_rank: int):
+        env = dict(os.environ)
+        rank = self.node_rank * self.nproc + local_rank
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(self.world_size),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_LOCAL_SIZE": str(self.nproc),
+            "PADDLE_NNODES": str(self.nnodes),
+            "PADDLE_MASTER": self.master,
+            "PADDLE_JOB_ID": self.args.job_id,
+            "PADDLE_RESTART_GENERATION": str(self.restarts),
+        })
+        if self.args.devices:
+            devs = self.args.devices.split(",")
+            env["PADDLE_DEVICES"] = devs[local_rank % len(devs)]
+        return env
+
+    def start_workers(self):
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        self.procs = []
+        for lr in range(self.nproc):
+            rank = self.node_rank * self.nproc + lr
+            log_path = os.path.join(
+                self.args.log_dir,
+                f"{self.args.job_id}.rank{rank}.log")
+            logf = open(log_path, "ab")
+            cmd = [sys.executable, "-u", self.args.training_script,
+                   *self.args.training_script_args]
+            popen = subprocess.Popen(cmd, env=self._worker_env(lr),
+                                     stdout=logf, stderr=subprocess.STDOUT)
+            self.procs.append(Proc(popen, rank, log_path, logf))
+
+    def stop_workers(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.popen.poll() is None:
+                try:
+                    p.popen.send_signal(sig)
+                except OSError:
+                    pass
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.popen.wait(max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.popen.kill()
+            p.log_file.close()
+
+    # ------------------------------------------------------------------ run
+    PEER_RESTART = -1
+
+    def _peer_generation(self) -> int:
+        try:
+            if self.store.check("restart_generation"):
+                return int(self.store.get("restart_generation"))
+        except (OSError, TimeoutError):
+            pass
+        return self.restarts
+
+    def watch(self) -> int:
+        """Block until all workers exit (0), one fails (its rc), or another
+        node bumped the restart generation (PEER_RESTART)."""
+        last_poll = 0.0
+        while True:
+            alive = False
+            for p in self.procs:
+                rc = p.popen.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    return rc
+            if not alive:
+                return 0
+            if self.nnodes > 1 and time.time() - last_poll > 1.0:
+                last_poll = time.time()
+                if self._peer_generation() > self.restarts:
+                    return self.PEER_RESTART
+            time.sleep(0.2)
+
+    def run(self) -> int:
+        self.rendezvous()
+        while True:
+            self.start_workers()
+            rc = self.watch()
+            if rc == 0:
+                self.stop_workers()
+                return 0
+            self.stop_workers()
+            if rc == self.PEER_RESTART:
+                # another node initiated the restart; adopt its generation
+                self.restarts = self._peer_generation()
+                sys.stderr.write(
+                    f"[launch] peer requested restart "
+                    f"(generation {self.restarts})\n")
+            else:
+                sys.stderr.write(
+                    f"[launch] worker failed rc={rc} "
+                    f"(restart {self.restarts}/{self.args.max_restart})\n")
+                if self.restarts >= self.args.max_restart:
+                    return rc
+                self.restarts += 1
+                # publish the new generation so surviving nodes rejoin
+                self.store.set("restart_generation", str(self.restarts))
+            self.rendezvous()
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    controller = CollectiveController(args)
+
+    def handler(sig, frame):
+        controller.stop_workers(signal.SIGTERM)
+        sys.exit(128 + sig)
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    return controller.run()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,293 @@
+"""TCPStore: the rendezvous / coordination key-value store.
+
+Parity: `paddle/phi/core/distributed/store/tcp_store.h:121` (TCPStore with
+ADD/GET/CHECK/SET/WAIT commands; DEL added so p2p payloads can be freed) and
+`python/paddle/distributed/collective.py` barrier semantics.
+
+The server is the C++ poll-loop in `core/native/tcp_store.cc` (built on
+demand; WAIT/GET park the socket instead of burning a thread per client),
+with a pure-Python thread server speaking the identical wire protocol as
+fallback.  Each client thread gets its own socket, so a thread parked in
+wait() never blocks another thread's heartbeat/set.  The store is a
+control-plane component — data only flows through it in the documented
+eager send/recv fallback (collective.py), which deletes its keys after use.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["TCPStore", "Store"]
+
+_ADD, _GET, _CHECK, _SET, _WAIT, _STOP, _DEL = range(7)
+
+
+class Store:
+    """Abstract store interface (reference `store.h`)."""
+
+    def set(self, key: str, value: bytes):  # noqa: A003
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, key: str, timeout: Optional[float] = None):
+        raise NotImplementedError
+
+    def check(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_key(self, key: str) -> None:
+        raise NotImplementedError
+
+
+def _recv_exact(conn, n: int) -> bytes:
+    """Read exactly n bytes or raise ConnectionError (clean EOF included)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError(
+                f"peer closed mid-message ({len(buf)}/{n} bytes)")
+        buf += chunk
+    return buf
+
+
+def _send_value(conn, val: bytes):
+    conn.sendall(struct.pack("<Q", len(val)) + val)
+
+
+class _PyServer(threading.Thread):
+    """Pure-Python fallback server; same wire protocol as tcp_store.cc."""
+
+    def __init__(self, port: int):
+        super().__init__(daemon=True)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._store: Dict[str, bytes] = {}
+        self._cv = threading.Condition()
+        self._running = True
+        self.start()
+
+    def run(self):
+        while self._running:
+            try:
+                self._sock.settimeout(0.2)
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        self._sock.close()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                cmd = _recv_exact(conn, 1)[0]
+                klen = struct.unpack("<I", _recv_exact(conn, 4))[0]
+                key = _recv_exact(conn, klen).decode()
+                vlen = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+                val = _recv_exact(conn, vlen) if vlen else b""
+                if cmd == _ADD:
+                    with self._cv:
+                        cur = int(self._store.get(key, b"0")) + int(val)
+                        self._store[key] = str(cur).encode()
+                        self._cv.notify_all()
+                    _send_value(conn, str(cur).encode())
+                elif cmd == _SET:
+                    with self._cv:
+                        self._store[key] = val
+                        self._cv.notify_all()
+                    conn.sendall(b"\x01")
+                elif cmd == _CHECK:
+                    conn.sendall(b"\x01" if key in self._store else b"\x00")
+                elif cmd == _GET:
+                    with self._cv:
+                        while key not in self._store:
+                            self._cv.wait(0.1)
+                            if not self._running:
+                                return
+                        out = self._store[key]
+                    _send_value(conn, out)
+                elif cmd == _WAIT:
+                    with self._cv:
+                        while key not in self._store:
+                            self._cv.wait(0.1)
+                            if not self._running:
+                                return
+                    conn.sendall(b"\x01")
+                elif cmd == _DEL:
+                    with self._cv:
+                        self._store.pop(key, None)
+                    conn.sendall(b"\x01")
+                elif cmd == _STOP:
+                    conn.sendall(b"\x01")
+                    self._running = False
+                    return
+        except (OSError, ConnectionError, struct.error, ValueError):
+            return
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running = False
+
+
+class _NativeServer:
+    def __init__(self, port: int):
+        import ctypes
+        from ..core import native
+        lib = native.build("tcp_store")
+        if lib is None:
+            raise OSError("native build unavailable")
+        lib.pts_start.restype = ctypes.c_int
+        lib.pts_port.restype = ctypes.c_int
+        self._lib = lib
+        self._handle = lib.pts_start(port)
+        if self._handle < 0:
+            raise OSError(f"pts_start failed: {self._handle}")
+        self.port = lib.pts_port(self._handle)
+
+    def stop(self):
+        if self._handle is not None:
+            self._lib.pts_stop(self._handle)
+            self._handle = None
+
+
+class TCPStore(Store):
+    """Client (+ optionally the hosting server) of the TCP store.
+
+    TCPStore(host, port, is_master=False, world_size=1, timeout=900):
+    the master process starts the server (C++ if the toolchain is present,
+    Python otherwise) and every process — master included — connects client
+    sockets to it (one per calling thread).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 900.0):
+        self.host = host
+        self.world_size = world_size
+        self.timeout = timeout
+        self._server = None
+        if is_master:
+            try:
+                self._server = _NativeServer(port)
+            except OSError:
+                self._server = _PyServer(port)
+            port = self._server.port
+        if port == 0:
+            raise ValueError("non-master TCPStore needs the master's port")
+        self.port = port
+        self._tls = threading.local()
+        self._connect()  # fail fast from the constructing thread
+
+    @property
+    def is_native(self) -> bool:
+        return isinstance(self._server, _NativeServer)
+
+    def _connect(self):
+        deadline = time.time() + min(self.timeout, 60.0)
+        last = None
+        while time.time() < deadline:
+            try:
+                c = socket.create_connection((self.host, self.port),
+                                             timeout=self.timeout)
+                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._tls.conn = c
+                return c
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise TimeoutError(f"cannot reach TCPStore at "
+                           f"{self.host}:{self.port}: {last}")
+
+    def _conn_for_thread(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is None:
+            conn = self._connect()
+        return conn
+
+    def _drop_conn(self):
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            finally:
+                self._tls.conn = None
+
+    def _request(self, cmd: int, key: str, val: bytes = b"",
+                 timeout: Optional[float] = None) -> bytes:
+        kb = key.encode()
+        msg = struct.pack("<BI", cmd, len(kb)) + kb + \
+            struct.pack("<Q", len(val)) + val
+        conn = self._conn_for_thread()
+        conn.settimeout(timeout if timeout is not None else self.timeout)
+        try:
+            conn.sendall(msg)
+            if cmd in (_ADD, _GET):
+                ln = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+                return _recv_exact(conn, ln) if ln else b""
+            return _recv_exact(conn, 1)
+        except socket.timeout:
+            # the server may still answer this request later; the socket is
+            # desynchronized — drop it so the next call starts clean
+            self._drop_conn()
+            raise TimeoutError(
+                f"TCPStore request cmd={cmd} key={key!r} timed out")
+        except (OSError, ConnectionError):
+            self._drop_conn()
+            raise
+
+    # Store interface ------------------------------------------------------
+    def set(self, key: str, value) -> None:  # noqa: A003
+        if isinstance(value, str):
+            value = value.encode()
+        self._request(_SET, key, bytes(value))
+
+    def get(self, key: str) -> bytes:
+        return self._request(_GET, key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return int(self._request(_ADD, key, str(int(amount)).encode()))
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        self._request(_WAIT, key, timeout=timeout)
+
+    def check(self, key: str) -> bool:
+        return self._request(_CHECK, key) == b"\x01"
+
+    def delete_key(self, key: str) -> None:
+        self._request(_DEL, key)
+
+    # helpers --------------------------------------------------------------
+    def barrier(self, name: str, world_size: Optional[int] = None,
+                timeout: Optional[float] = None) -> None:
+        """All `world_size` processes block until every one arrived."""
+        n = world_size or self.world_size
+        arrived = self.add(f"__barrier__/{name}/count", 1)
+        if arrived == n:
+            self.set(f"__barrier__/{name}/go", b"1")
+        self.wait(f"__barrier__/{name}/go", timeout=timeout)
+
+    def __del__(self):
+        try:
+            self._drop_conn()
+            if self._server is not None:
+                self._server.stop()
+        except Exception:
+            pass

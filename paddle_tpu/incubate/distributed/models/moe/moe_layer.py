@@ -82,7 +82,7 @@ class MoELayer(Layer):
     def __init__(self, d_model: int, experts: Optional[ExpertMLP] = None,
                  gate: "BaseGate | str" = "gshard", num_expert: int = None,
                  d_hidden: int = None, top_k: int = 2,
-                 capacity_factor: float = 1.25, moe_group=None,
+                 capacity_factor: Optional[float] = None, moe_group=None,
                  mp_group=None, **gate_kwargs):
         super().__init__()
         if experts is None:
@@ -92,19 +92,19 @@ class MoELayer(Layer):
         self.experts = experts
         E = experts.num_expert
         if isinstance(gate, str):
+            cf = 1.25 if capacity_factor is None else capacity_factor
             if gate == "naive":
                 gate = NaiveGate(d_model, E, top_k=top_k,
-                                 capacity_factor=capacity_factor,
-                                 **gate_kwargs)
+                                 capacity_factor=cf, **gate_kwargs)
             elif gate == "switch":
-                gate = SwitchGate(d_model, E,
-                                  capacity_factor=capacity_factor,
+                gate = SwitchGate(d_model, E, capacity_factor=cf,
                                   **gate_kwargs)
             elif gate == "gshard":
                 if top_k != 2:
                     raise ValueError("gshard gate routes top-2; use "
                                      "gate='naive' for other top_k")
-                if "capacity" not in gate_kwargs and capacity_factor != 1.25:
+                if "capacity" not in gate_kwargs and \
+                        capacity_factor is not None:
                     # translate tokens/(E*k) factor to GShard's tokens/E tuple
                     gate_kwargs["capacity"] = (2 * capacity_factor,
                                                2 * capacity_factor)
