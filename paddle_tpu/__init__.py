@@ -66,6 +66,7 @@ from . import amp  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
+from . import profiler  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402

@@ -1,0 +1,14 @@
+"""Inference deployment API.
+
+Parity: `paddle/fluid/inference/api/analysis_predictor.h:100` +
+`python/paddle/inference/__init__.py` (Config, create_predictor, Tensor
+handles with copy_from_cpu/copy_to_cpu).
+
+TPU-native: the "analysis + optimization passes" of the reference are XLA's
+job; a Predictor wraps the `jit.save` StableHLO artifact, pre-compiles on
+first run, and serves through input/output handles.
+"""
+
+from .predictor import Config, PredictHandle, Predictor, create_predictor
+
+__all__ = ["Config", "Predictor", "PredictHandle", "create_predictor"]

@@ -1,0 +1,112 @@
+"""Predictor: serve a jit.save'd model.
+
+Parity: `analysis_predictor.h:100` (Run/GetInputNames/GetInputTensor/
+GetOutputNames/GetOutputTensor), `python/paddle/inference/wrapper.py`
+(copy_from_cpu/copy_to_cpu handle API).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..jit.save_load import TranslatedLayer
+
+__all__ = ["Config", "Predictor", "PredictHandle", "create_predictor"]
+
+
+class Config:
+    """Inference configuration.  Parity: `paddle_infer.Config`."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # reference takes (model.pdmodel, model.pdiparams); both derive from
+        # the same jit.save prefix here
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._memory_pool_mb = 0
+        self._device = "tpu"
+
+    def set_prog_file(self, path: str):
+        self.model_prefix = path[:-len(".pdmodel")] \
+            if path.endswith(".pdmodel") else path
+
+    def enable_use_gpu(self, memory_pool_mb: int = 0, device_id: int = 0):
+        self._device = "gpu"  # accepted for parity; XLA owns placement
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass  # XLA buffer assignment already does this
+
+
+class PredictHandle:
+    """Input/output tensor handle (copy_from_cpu / copy_to_cpu)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"handle {self.name!r} has no value yet")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return None if self._value is None else list(self._value.shape)
+
+    def reshape(self, shape):
+        pass  # shapes flow from copy_from_cpu
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        if not config.model_prefix:
+            raise ValueError("Config needs the jit.save path prefix")
+        self._layer = TranslatedLayer(config.model_prefix)
+        n_in = len(self._layer.input_specs)
+        self._inputs = {f"input_{i}": PredictHandle(f"input_{i}")
+                        for i in range(n_in)}
+        self._outputs: Dict[str, PredictHandle] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> PredictHandle:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs) or ["output_0"]
+
+    def get_output_handle(self, name: str) -> PredictHandle:
+        # handles may be fetched before the first run (standard paddle
+        # inference pattern); run() fills them in place
+        if name not in self._outputs:
+            self._outputs[name] = PredictHandle(name)
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute; either pass arrays directly (returns arrays, the modern
+        `predictor.run([x])` form) or use the input handles."""
+        if inputs is None:
+            inputs = [h.copy_to_cpu() for h in self._inputs.values()]
+            direct = False
+        else:
+            direct = True
+        outs = self._layer(*inputs)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        arrs = [np.asarray(o._value) for o in outs]
+        for i, a in enumerate(arrs):
+            # fill pre-fetched handles in place so references stay valid
+            self.get_output_handle(f"output_{i}").copy_from_cpu(a)
+        return arrs if direct else None
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

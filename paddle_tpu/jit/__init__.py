@@ -1,1 +1,2 @@
 from .api import StaticFunction, ignore_module, not_to_static, to_static  # noqa: F401
+from .save_load import TranslatedLayer, load, save  # noqa: F401

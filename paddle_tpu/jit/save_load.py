@@ -1,0 +1,171 @@
+"""jit.save / jit.load: serialized, servable compiled programs.
+
+Parity: `python/paddle/jit/api.py` (save `:591`, load `:1035`,
+TranslatedLayer `python/paddle/jit/translated_layer.py:1271`).
+
+TPU-native: the saved program is a `jax.export` StableHLO artifact — the
+portable compiler-level format (the role the reference's `.pdmodel`
+program-desc plays), with parameters in a sibling `.pdiparams` npz and a
+JSON manifest.  `None` dims in InputSpec become symbolic dimensions, so one
+artifact serves any batch size.  Loading needs no Python model code:
+TranslatedLayer calls the deserialized StableHLO function directly.
+
+Layout: {path}.pdmodel (StableHLO bytes), {path}.pdiparams (npz),
+{path}.pdmeta.json (param keys, input specs, output tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..static.input_spec import InputSpec
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _as_specs(input_spec, example_inputs=None) -> List[InputSpec]:
+    if input_spec is None:
+        if example_inputs is None:
+            raise ValueError(
+                "jit.save needs input_spec=[InputSpec(...)] (or example "
+                "Tensors) to know the exported signature")
+        input_spec = example_inputs
+    specs = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            specs.append(s)
+        elif isinstance(s, Tensor):
+            specs.append(InputSpec.from_tensor(s))
+        else:
+            specs.append(InputSpec.from_numpy(np.asarray(s)))
+    return specs
+
+
+def _abstract_args(specs: List[InputSpec]):
+    """ShapeDtypeStructs; None entries become symbolic dims (one symbol per
+    None — shapes are independent unless the user names them equal)."""
+    args = []
+    has_sym = any(d is None for s in specs for d in s.shape)
+    scope = jax.export.SymbolicScope() if has_sym else None
+    for i, s in enumerate(specs):
+        dims = [jax.export.symbolic_shape(f"d{i}_{j}", scope=scope)[0]
+                if d is None else d
+                for j, d in enumerate(s.shape)]
+        args.append(jax.ShapeDtypeStruct(tuple(dims), s.dtype))
+    return args
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None,
+         **configs) -> None:
+    """Export `layer` (or a callable on Tensors) + parameters to `path`.*"""
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+
+    out_info = {"multi": False}
+    was_training = False
+    if isinstance(layer, Layer):
+        was_training = getattr(layer, "training", False)
+        layer.eval()
+        sd = layer.state_dict()
+        keys = sorted(sd.keys())
+
+        def fn(param_vals, *input_vals):
+            for k, v in zip(keys, param_vals):
+                sd[k]._value = v
+            outs = layer(*[Tensor._wrap(x) for x in input_vals])
+            out_info["multi"] = isinstance(outs, (tuple, list))
+            return tuple(o._value for o in outs) if out_info["multi"] \
+                else outs._value
+
+        param_vals = [sd[k]._value for k in keys]
+        originals = list(param_vals)
+    else:
+        sd = {}
+        keys, originals = [], []
+
+        def fn(param_vals, *input_vals):
+            outs = layer(*[Tensor._wrap(x) for x in input_vals])
+            out_info["multi"] = isinstance(outs, (tuple, list))
+            return tuple(o._value for o in outs) if out_info["multi"] \
+                else outs._value
+
+    try:
+        specs = _as_specs(input_spec)
+        abstract = _abstract_args(specs)
+        param_abstract = [jax.ShapeDtypeStruct(p.shape, p.dtype)
+                          for p in originals]
+        exported = jax.export.export(jax.jit(fn))(param_abstract, *abstract)
+    finally:
+        # tracing bound tracer values into the live parameters — restore
+        # real storage even when export fails, and restore train mode
+        for k, v in zip(keys, originals):
+            sd[k]._value = v
+        if isinstance(layer, Layer) and was_training:
+            layer.train()
+
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    np.savez(path + ".pdiparams",
+             **{str(i): np.asarray(v) for i, v in enumerate(originals)})
+    with open(path + ".pdmeta.json", "w") as f:
+        json.dump({
+            "param_keys": keys,
+            "multi_output": out_info["multi"],
+            "input_specs": [{"shape": [d if isinstance(d, int) else None
+                                       for d in s.shape],
+                             "dtype": str(np.dtype(s.dtype))}
+                            for s in specs],
+        }, f)
+
+
+class TranslatedLayer(Layer):
+    """A loaded, code-free servable program.  Parity:
+    `translated_layer.py:1271` — callable, with `parameters()` exposing the
+    checkpoint weights under their saved structured names; retraining
+    requires the original Python model."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        with open(path + ".pdmodel", "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with np.load(path + ".pdiparams.npz") as z:
+            param_vals = [jnp.asarray(z[str(i)])
+                          for i in range(len(z.files))]
+        with open(path + ".pdmeta.json") as f:
+            self._meta = json.load(f)
+        from ..framework.tensor import Parameter
+        for key, v in zip(self._meta["param_keys"], param_vals):
+            p = Parameter(v, name=key, trainable=False)
+            self.add_parameter(key.replace(".", "__"), p)
+
+    @property
+    def _param_vals(self):
+        return [p._value for p in self.parameters()]
+
+    @property
+    def input_specs(self):
+        return self._meta["input_specs"]
+
+    def forward(self, *inputs):
+        vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        out = self._exported.call(self._param_vals, *vals)
+        if isinstance(out, (tuple, list)):
+            outs = tuple(Tensor._wrap(o) for o in out)
+            if self._meta.get("multi_output", len(outs) != 1):
+                return outs
+            return outs[0]
+        return Tensor._wrap(out)
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    return TranslatedLayer(path)

@@ -80,6 +80,20 @@ def set_trace_out_recorder(fn: Optional[Callable]) -> None:
     _trace_out_recorder = fn
 
 
+def set_op_stats_sink(sink: Optional[Dict[str, int]]) -> None:
+    global _op_stats_sink
+    _op_stats_sink = sink
+
+
+# Profiler hook: called with (op_name, host_seconds) per eager dispatch.
+_op_timer: Optional[Callable] = None
+
+
+def set_op_timer(fn: Optional[Callable]) -> None:
+    global _op_timer
+    _op_timer = fn
+
+
 def register_op(name: str, fwd: Callable, custom_vjp: Optional[Callable] = None,
                 tags: Sequence[str] = ()) -> OpDef:
     op = OpDef(name, fwd, custom_vjp, tuple(tags))
@@ -152,6 +166,18 @@ def _autocast_vals(op_name: str, vals: List[Any]):
 def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
              op: Optional[OpDef] = None):
     """Execute one op eagerly with autograd tracking."""
+    if _op_timer is not None:
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            return _dispatch_impl(name, diff_inputs, static, op)
+        finally:
+            _op_timer(name, _time.perf_counter() - t0)
+    return _dispatch_impl(name, diff_inputs, static, op)
+
+
+def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
+                   static: Dict[str, Any], op: Optional[OpDef] = None):
     if op is None:
         op = _OPS[name]
     if _op_stats_sink is not None:

@@ -1,0 +1,7 @@
+"""Profiler.  Parity: `python/paddle/profiler/__init__.py`."""
+
+from .profiler import (Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+                       SummaryView, export_chrome_tracing, make_scheduler)
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "SummaryView", "make_scheduler", "export_chrome_tracing"]

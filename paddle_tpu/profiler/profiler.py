@@ -1,0 +1,317 @@
+"""Profiler: scheduled tracing with host timeline + device (XPlane) capture.
+
+Parity: `python/paddle/profiler/profiler.py` — ProfilerState (`:79`),
+ProfilerTarget (`:99`), make_scheduler (`:117`), export_chrome_tracing
+(`:215`), Profiler (`:346` — start/stop/step, on_trace_ready, summary).
+
+TPU-native split: the reference's host tracer
+(`fluid/platform/profiler/host_tracer.cc`) becomes a Python event recorder
+(RecordEvent spans + per-op dispatch timing via the registry's op-timer
+hook); the device side is `jax.profiler.start_trace` producing the XPlane/
+TensorBoard dump XProf reads — the TPU equivalent of the reference's CUPTI
+chrome tracing.  `export_chrome_tracing` writes the host timeline in
+chrome://tracing JSON next to the device dump.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, List, Optional
+
+__all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "SummaryView"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3  # last record step of a cycle: trace is returned
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SummaryView(Enum):
+    OverView = 0
+    OperatorView = 1
+    UserDefinedView = 2
+
+
+def make_scheduler(*, closed: int, ready: int, record: int,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    """Step->state schedule: [skip_first][closed][ready][record...] cycle.
+
+    Parity: `profiler.py:117`.
+    """
+    if closed < 0 or ready < 0 or record <= 0 or repeat < 0 or skip_first < 0:
+        raise ValueError("make_scheduler: closed/ready>=0, record>0")
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step // cycle >= repeat:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_state_scheduler(step: int) -> ProfilerState:
+    return ProfilerState.RECORD
+
+
+class _HostEvent:
+    __slots__ = ("name", "start", "end", "tid", "category")
+
+    def __init__(self, name, start, end, tid, category):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.tid = tid
+        self.category = category
+
+
+class _HostTracer:
+    """Collects RecordEvent spans and per-op dispatch timings."""
+
+    def __init__(self):
+        self.events: List[_HostEvent] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def add(self, name, start, end, category="user"):
+        with self._lock:
+            self.events.append(_HostEvent(
+                name, start - self._t0, end - self._t0,
+                threading.get_ident(), category))
+
+    def op_timer(self, name, dt):
+        now = time.perf_counter()
+        self.add(name, now - dt, now, category="operator")
+
+
+_active_tracer: Optional[_HostTracer] = None
+
+
+class RecordEvent:
+    """User-labelled span on the host timeline (`profiler/utils.py`
+    RecordEvent).  Usable as context manager or begin()/end()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._start = None
+
+    def begin(self):
+        self._start = time.perf_counter()
+
+    def end(self):
+        if self._start is None:
+            return
+        if _active_tracer is not None:
+            _active_tracer.add(self.name, self._start, time.perf_counter())
+        self._start = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def export_chrome_tracing(dir_name: str,
+                          worker_name: Optional[str] = None) -> Callable:
+    """on_trace_ready handler writing chrome://tracing JSON.
+
+    Parity: `profiler.py:215`.
+    """
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        worker = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name,
+                            f"{worker}_step{prof.step_num}.pd.json")
+        prof.export(path)
+        prof.last_export_path = path
+
+    return handler
+
+
+class Profiler:
+    """Scheduled profiler.  Parity: `profiler.py:346`.
+
+    with Profiler(scheduler=make_scheduler(closed=1, ready=1, record=2),
+                  on_trace_ready=export_chrome_tracing("./prof")) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False,
+                 device_trace_dir: Optional[str] = None):
+        if scheduler is None:
+            self._scheduler = _default_state_scheduler
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(
+                closed=max(lo, 0), ready=0, record=hi - lo, repeat=1)
+        else:
+            self._scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._tracer: Optional[_HostTracer] = None
+        self._device_trace_dir = device_trace_dir
+        self._device_tracing = False
+        self.last_export_path = None
+        self._step_start = None
+        self._step_times: List[float] = []
+        self._reported = False  # on_trace_ready already ran for this tracer
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self.current_state = self._scheduler(self.step_num)
+        self._transition(ProfilerState.CLOSED, self.current_state)
+        self._step_start = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._tracer is not None and self.on_trace_ready is not None \
+                and not self._reported:
+            self.on_trace_ready(self)
+            self._reported = True
+        self._transition(self.current_state, ProfilerState.CLOSED)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        if self._step_start is not None:
+            self._step_times.append(time.perf_counter() - self._step_start)
+        prev = self.current_state
+        self.step_num += 1
+        self.current_state = self._scheduler(self.step_num)
+        self._transition(prev, self.current_state)
+        self._step_start = time.perf_counter()
+
+    def _transition(self, old: ProfilerState, new: ProfilerState):
+        global _active_tracer
+        recording_old = old in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN)
+        recording_new = new in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN)
+        if old is ProfilerState.RECORD_AND_RETURN:
+            if self.on_trace_ready is not None and not self._reported:
+                self.on_trace_ready(self)
+            self._reported = True
+            recording_old = False  # cycle closed: start a fresh tracer next
+            self._teardown_tracer()
+        if not recording_old and recording_new:
+            self._setup_tracer()
+        elif recording_old and not recording_new:
+            self._teardown_tracer()
+
+    def _setup_tracer(self):
+        global _active_tracer
+        if self.timer_only:
+            return
+        self._tracer = _HostTracer()
+        self._reported = False
+        _active_tracer = self._tracer
+        from ..ops import registry
+        registry.set_op_timer(self._tracer.op_timer)
+        if self._device_trace_dir:
+            import jax
+            try:
+                jax.profiler.start_trace(self._device_trace_dir)
+                self._device_tracing = True
+            except Exception:
+                self._device_tracing = False
+
+    def _teardown_tracer(self):
+        global _active_tracer
+        from ..ops import registry
+        registry.set_op_timer(None)
+        if _active_tracer is self._tracer:
+            _active_tracer = None
+        if self._device_tracing:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._device_tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -------------------------------------------------------------- results
+    def events(self) -> List[_HostEvent]:
+        return list(self._tracer.events) if self._tracer else []
+
+    def export(self, path: str, format: str = "json"):  # noqa: A002
+        """Write the host timeline as chrome://tracing JSON."""
+        evs = self.events()
+        trace = {"traceEvents": [
+            {"name": e.name, "cat": e.category, "ph": "X",
+             "ts": round(e.start * 1e6, 3),
+             "dur": round((e.end - e.start) * 1e6, 3),
+             "pid": os.getpid(), "tid": e.tid}
+            for e in evs]}
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms",
+                views=None) -> str:
+        """Aggregate table: per-name count/total/avg/max, printed + returned."""
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        rows = {}
+        for e in self.events():
+            r = rows.setdefault((e.category, e.name), [0, 0.0, 0.0])
+            dt = e.end - e.start
+            r[0] += 1
+            r[1] += dt
+            r[2] = max(r[2], dt)
+        lines = []
+        if self._step_times:
+            avg = sum(self._step_times) / len(self._step_times)
+            lines.append(f"steps: {len(self._step_times)}  "
+                         f"avg step: {avg * unit:.3f}{time_unit}")
+        header = (f"{'category':<10}{'name':<36}{'calls':>8}"
+                  f"{'total':>14}{'avg':>12}{'max':>12}")
+        lines.append(header)
+        lines.append("-" * len(header))
+        for (cat, name), (cnt, tot, mx) in sorted(
+                rows.items(), key=lambda kv: -kv[1][1]):
+            lines.append(
+                f"{cat:<10}{name[:35]:<36}{cnt:>8}"
+                f"{tot * unit:>12.3f}{time_unit:<2}"
+                f"{tot / cnt * unit:>10.3f}{time_unit:<2}"
+                f"{mx * unit:>10.3f}{time_unit:<2}")
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -1,0 +1,9 @@
+"""paddle.static — graph-mode facade.  Parity: `python/paddle/static/`.
+
+The TPU build has no separate static graph engine: `Program` records a
+traced callable via the same capture machinery as `jit.to_static`, and
+`Executor.run` executes the captured XLA program.  InputSpec is shared with
+`jit.save`.
+"""
+
+from .input_spec import InputSpec  # noqa: F401

@@ -1,0 +1,38 @@
+"""InputSpec: symbolic input signature for export/compilation.
+
+Parity: `python/paddle/static/input/__init__.py` (InputSpec).
+None dims become export-time symbolic dimensions (jax.export symbolic
+shapes), so a saved model serves any batch size — the reference gets the
+same effect from ir dynamic dims.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core import dtypes as _dtypes
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(shape)
+        self.dtype = _dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray: np.ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")

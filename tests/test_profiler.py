@@ -1,0 +1,94 @@
+"""Profiler: scheduler states, host timeline, op capture, chrome export.
+
+Mirrors the reference's `test/legacy_test/test_profiler.py` +
+`test_newprofiler.py` strategy.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (Profiler, ProfilerState, RecordEvent,
+                                 export_chrome_tracing, make_scheduler)
+
+
+def test_make_scheduler_cycle():
+    sched = make_scheduler(closed=1, ready=1, record=2, repeat=2,
+                           skip_first=1)
+    want = [ProfilerState.CLOSED,                 # skip_first
+            ProfilerState.CLOSED, ProfilerState.READY,
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED, ProfilerState.READY,
+            ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN,
+            ProfilerState.CLOSED, ProfilerState.CLOSED]  # repeat exhausted
+    got = [sched(i) for i in range(len(want))]
+    assert got == want
+
+
+def test_make_scheduler_validates():
+    with pytest.raises(ValueError):
+        make_scheduler(closed=1, ready=0, record=0)
+
+
+def test_profiler_records_ops_and_user_events():
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    with Profiler() as prof:
+        with RecordEvent("my_block"):
+            y = x @ x
+            z = y + x
+        paddle.sum(z)
+    evs = prof.events()
+    names = {e.name for e in evs}
+    assert "my_block" in names
+    ops = {e.name for e in evs if e.category == "operator"}
+    assert "matmul" in ops or "add" in ops or "sum" in ops, ops
+    # op timer hook must be uninstalled after stop
+    from paddle_tpu.ops import registry
+    assert registry._op_timer is None
+
+
+def test_profiler_scheduled_capture_and_trace_ready(tmp_path):
+    traces = []
+
+    def on_ready(p):
+        traces.append(p.step_num)
+        p.export(str(tmp_path / f"trace{p.step_num}.json"))
+
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with Profiler(scheduler=make_scheduler(closed=1, ready=0, record=2,
+                                           repeat=1),
+                  on_trace_ready=on_ready) as prof:
+        for _ in range(5):
+            (x + x)
+            prof.step()
+    assert traces, "on_trace_ready never fired"
+    f = json.load(open(tmp_path / f"trace{traces[0]}.json"))
+    assert "traceEvents" in f
+
+
+def test_export_chrome_tracing_handler(tmp_path):
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with Profiler(on_trace_ready=export_chrome_tracing(str(tmp_path))) \
+            as prof:
+        x * x
+    assert prof.last_export_path and os.path.exists(prof.last_export_path)
+    data = json.load(open(prof.last_export_path))
+    assert any(ev["name"] == "multiply" for ev in data["traceEvents"])
+
+
+def test_summary_has_op_rows():
+    x = paddle.to_tensor(np.ones((16, 16), np.float32))
+    with Profiler() as prof:
+        for _ in range(3):
+            x = x * 1.0 + 0.0
+    out = prof.summary(time_unit="us")
+    assert "operator" in out
+    assert "calls" in out
+
+
+def test_record_event_outside_profiler_is_noop():
+    with RecordEvent("nothing"):
+        pass  # must not raise when no tracer is active
