@@ -42,9 +42,9 @@ def disable_static(*a, **k):
 
 
 def enable_static(*a, **k):
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static mode; use paddle_tpu.jit.to_static "
-        "for whole-graph capture.")
+    """Accepted for API parity: the static API works through
+    `paddle.static.program_guard` record-and-replay (see paddle_tpu.static);
+    there is no global mode switch to flip."""
 
 
 def in_dynamic_mode() -> bool:
@@ -67,6 +67,8 @@ from .framework.io import load, save  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402

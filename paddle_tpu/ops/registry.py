@@ -94,6 +94,16 @@ def set_op_timer(fn: Optional[Callable]) -> None:
     _op_timer = fn
 
 
+# paddle.static Program recorder: called with (name, diff_inputs, static,
+# outs) after each eager dispatch while a Program is being built.
+_program_recorder: Optional[Callable] = None
+
+
+def set_program_recorder(fn: Optional[Callable]) -> None:
+    global _program_recorder
+    _program_recorder = fn
+
+
 def register_op(name: str, fwd: Callable, custom_vjp: Optional[Callable] = None,
                 tags: Sequence[str] = ()) -> OpDef:
     op = OpDef(name, fwd, custom_vjp, tuple(tags))
@@ -170,10 +180,14 @@ def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
         import time as _time
         t0 = _time.perf_counter()
         try:
-            return _dispatch_impl(name, diff_inputs, static, op)
+            outs = _dispatch_impl(name, diff_inputs, static, op)
         finally:
             _op_timer(name, _time.perf_counter() - t0)
-    return _dispatch_impl(name, diff_inputs, static, op)
+    else:
+        outs = _dispatch_impl(name, diff_inputs, static, op)
+    if _program_recorder is not None:
+        _program_recorder(name, diff_inputs, static, outs)
+    return outs
 
 
 def _dispatch_impl(name: str, diff_inputs: Sequence[Any],

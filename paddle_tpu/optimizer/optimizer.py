@@ -233,6 +233,12 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import default_main_program, in_static_build
+        if in_static_build():
+            # building a paddle.static Program: record the update for
+            # Executor.run instead of mutating params with build-time zeros
+            default_main_program().record_minimize(self, loss)
+            return None, None
         if loss._grad_node is not None or not loss.stop_gradient:
             loss.backward()
         self.step()

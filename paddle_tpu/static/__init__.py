@@ -1,9 +1,16 @@
-"""paddle.static — graph-mode facade.  Parity: `python/paddle/static/`.
+"""paddle.static — graph-mode facade.
 
-The TPU build has no separate static graph engine: `Program` records a
-traced callable via the same capture machinery as `jit.to_static`, and
-`Executor.run` executes the captured XLA program.  InputSpec is shared with
-`jit.save`.
+Parity: `python/paddle/static/__init__.py`.  The TPU build has no separate
+graph IR: a Program records eager op dispatches (registry hook) and
+Executor.run replays them with feeds — see program.py.  CompiledProgram
+wraps the replay in jit.to_static for a single fused XLA executable.
 """
 
+from .executor import CompiledProgram, Executor, global_scope, scope_guard  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
+from .program import (Program, data, default_main_program,  # noqa: F401
+                      default_startup_program, program_guard)
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "Executor", "CompiledProgram",
+           "global_scope", "scope_guard"]

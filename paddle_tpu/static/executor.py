@@ -1,0 +1,118 @@
+"""Executor: run recorded Programs.
+
+Parity: `python/paddle/base/executor.py:1616` (Executor.run with
+feed/fetch_list/return_numpy), `CompiledProgram`.
+
+Replay goes through the op registry, so every run rebuilds the tape (and
+minimize() updates the live parameters).  `CompiledProgram` wraps the replay
+in `jit.to_static`, giving one donated XLA executable per feed signature —
+the PirInterpreter analogue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .program import Program, default_main_program
+
+__all__ = ["Executor", "CompiledProgram", "global_scope", "scope_guard"]
+
+
+class _Scope:
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield scope
+    return guard()
+
+
+class Executor:
+    """Parity: `base/executor.py:1616`; `place` is accepted for API compat
+    (XLA/PJRT owns placement on the TPU build)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, np.ndarray]] = None,
+            fetch_list: Optional[Sequence] = None,
+            return_numpy: bool = True, **kwargs):
+        program = program or default_main_program()
+        if isinstance(program, CompiledProgram):
+            return program._run(feed or {}, fetch_list or [], return_numpy)
+        if not program.steps and not fetch_list:
+            return []  # startup programs are empty by design
+        env = program.replay(feed or {})
+        return _fetch(program, env, fetch_list, return_numpy)
+
+    def close(self):
+        pass
+
+
+def _fetch(program, env, fetch_list, return_numpy):
+    outs = []
+    for f in fetch_list or []:
+        t = None
+        if isinstance(f, Tensor):
+            uid = program.uid_of(f)
+            if uid is not None and uid in env:
+                t = env[uid]
+            elif f.persistable:
+                t = f  # parameters fetched directly read live storage
+        if t is None:
+            raise KeyError(
+                f"fetch target {f!r} was not produced by this program "
+                "(fetch the tensor returned inside its program_guard)")
+        outs.append(np.asarray(t._value) if return_numpy else t)
+    return outs
+
+
+class CompiledProgram:
+    """jit-compiled replay: one XLA executable per feed signature.
+
+    Parity: `base/compiler.py` CompiledProgram.
+    """
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+        self._compiled = {}
+
+    def _run(self, feed, fetch_list, return_numpy):
+        from ..jit import to_static
+        names = tuple(sorted(feed))
+        fetch = tuple(fetch_list)
+        key = (names, tuple(self.program.uid_of(f) if isinstance(f, Tensor)
+                            else id(f) for f in fetch))
+
+        if key not in self._compiled:
+            def fn(*arrays):
+                env = self.program.replay(dict(zip(names, arrays)))
+                return _fetch(self.program, env, fetch, return_numpy=False)
+            self._compiled[key] = to_static(fn)
+        outs = self._compiled[key](
+            *[np.asarray(feed[n]) for n in names])
+        if return_numpy:
+            return [np.asarray(o._value) for o in outs]
+        return outs

@@ -1,0 +1,251 @@
+"""paddle.static Program: record-and-replay graph facade.
+
+Parity: `python/paddle/static/__init__.py` (data, Program, program_guard,
+default_main_program/default_startup_program), `python/paddle/base/
+framework.py` (Program), with the execution model re-designed for the TPU
+build: there is no separate graph IR — while a `program_guard` is active,
+every eager op dispatch on the guard's thread is *recorded* (registry
+program-recorder hook); the recorded op list IS the program, and
+`Executor.run` replays it with feeds substituted for `static.data`
+placeholders.  Replay re-dispatches through the op registry (recorder
+suspended), so the autograd tape, AMP hooks and profiler all work inside a
+replay, and an `optimizer.minimize(loss)` recorded in the program performs
+real parameter updates at run() time (its construction-time execution is
+suppressed).
+
+Tensors are tracked by per-program uid: after the guard exits, only
+parameters and true constants stay pinned — intermediate build-time
+activations are released (replay recomputes them), so building a large
+program does not hold its activations in HBM.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor
+from ..ops import registry as _registry
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "static_mode_guard",
+           "in_static_build"]
+
+
+class _Ref:
+    """Reference to a build-time tensor by program uid."""
+    __slots__ = ("uid",)
+
+    def __init__(self, uid: int):
+        self.uid = uid
+
+
+class _OpStep:
+    __slots__ = ("name", "inputs", "static", "out_uids")
+
+    def __init__(self, name, inputs, static, out_uids):
+        self.name = name      # op name in the registry
+        self.inputs = inputs  # nested structure; Tensors replaced by _Ref
+        self.static = static
+        self.out_uids = out_uids
+
+
+class _MinimizeStep:
+    __slots__ = ("optimizer", "loss_uid")
+
+    def __init__(self, optimizer, loss_uid):
+        self.optimizer = optimizer
+        self.loss_uid = loss_uid
+
+
+class Program:
+    """A recorded op sequence.  Parity: `base/framework.py` Program."""
+
+    def __init__(self):
+        self.steps: List[object] = []
+        self.placeholders: Dict[str, Tensor] = {}
+        self._uid_by_id: Dict[int, tuple] = {}  # id -> (weakref, uid)
+        self._keep: Dict[int, Tensor] = {}      # uid -> pinned tensor
+        self._produced: set = set()             # uids output by some step
+        self._next_uid = 0
+        self._build_tid: Optional[int] = None
+        self._finalized = False
+
+    # ---------------------------------------------------------- uid space
+    def _uid(self, t: Tensor) -> int:
+        ent = self._uid_by_id.get(id(t))
+        if ent is not None and ent[0]() is t:
+            return ent[1]
+        uid = self._next_uid
+        self._next_uid += 1
+        self._uid_by_id[id(t)] = (weakref.ref(t), uid)
+        self._keep[uid] = t  # pinned at least until finalize
+        return uid
+
+    def uid_of(self, t: Tensor) -> Optional[int]:
+        ent = self._uid_by_id.get(id(t))
+        if ent is not None and ent[0]() is t:
+            return ent[1]
+        return None
+
+    def _finalize(self):
+        """Release intermediate activations: anything a step produces is
+        recomputed by replay; only params/constants must stay alive."""
+        self._finalized = True
+        for uid in self._produced:
+            t = self._keep.get(uid)
+            if t is not None and not isinstance(t, Parameter) \
+                    and not t.persistable:
+                del self._keep[uid]
+
+    # ---------------------------------------------------------- recording
+    def _record(self, name, diff_inputs, static, outs):
+        if self._build_tid is not None and \
+                threading.get_ident() != self._build_tid:
+            return  # another thread (e.g. DataLoader worker) — not ours
+        def enc(x):
+            return _Ref(self._uid(x)) if isinstance(x, Tensor) else x
+        inputs = jax.tree_util.tree_map(
+            enc, list(diff_inputs),
+            is_leaf=lambda x: isinstance(x, Tensor))
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        out_uids = tuple(self._uid(o) for o in outs_t)
+        self._produced.update(out_uids)
+        self.steps.append(_OpStep(name, inputs, dict(static), out_uids))
+
+    def record_minimize(self, optimizer, loss: Tensor):
+        self.steps.append(_MinimizeStep(optimizer, self._uid(loss)))
+
+    # ------------------------------------------------------------- replay
+    def replay(self, feed: Dict[str, np.ndarray]) -> Dict[int, Tensor]:
+        """Re-execute with `feed` bound to the named placeholders; returns
+        the environment mapping uid -> live Tensor."""
+        if not self.steps:
+            raise RuntimeError(
+                "this Program recorded no ops — build it inside "
+                "`with paddle.static.program_guard(program): ...`")
+        env: Dict[int, Tensor] = {}
+        for name, ph in self.placeholders.items():
+            if name not in feed:
+                raise KeyError(f"feed missing static.data {name!r}")
+            val = np.asarray(feed[name]).astype(np.dtype(ph.dtype),
+                                                copy=False)
+            env[self.uid_of(ph)] = Tensor(val)
+
+        def resolve(x):
+            if not isinstance(x, _Ref):
+                return x
+            if x.uid in env:
+                return env[x.uid]
+            t = self._keep.get(x.uid)
+            if t is None:
+                raise RuntimeError(
+                    f"program value uid={x.uid} is neither produced by an "
+                    "earlier step nor pinned — corrupted recording")
+            return t  # live param / constant: current storage is read
+
+        # suspend recording: a replay must never append to a program
+        # (including itself, when run inside a program_guard)
+        prev_recorder = _registry._program_recorder
+        _registry.set_program_recorder(None)
+        try:
+            for step in self.steps:
+                if isinstance(step, _MinimizeStep):
+                    loss = env.get(step.loss_uid)
+                    if loss is None:
+                        raise RuntimeError(
+                            "minimize() recorded for a loss the replay did "
+                            "not produce")
+                    step.optimizer.minimize(loss)
+                    step.optimizer.clear_grad()
+                    continue
+                inputs = jax.tree_util.tree_map(
+                    resolve, step.inputs,
+                    is_leaf=lambda x: isinstance(x, _Ref))
+                outs = _registry.dispatch(step.name, inputs, step.static)
+                outs_t = outs if isinstance(outs, tuple) else (outs,)
+                for uid, o in zip(step.out_uids, outs_t):
+                    env[uid] = o
+        finally:
+            _registry.set_program_recorder(prev_recorder)
+        return env
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        ops = [getattr(s, "name", "minimize") for s in self.steps]
+        return f"Program({len(self.steps)} ops: {ops[:12]}...)"
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.main: Optional[Program] = None
+        self.startup: Optional[Program] = None
+        self.default_main = Program()
+        self.default_startup = Program()
+
+
+_state = _State()
+
+
+def in_static_build() -> bool:
+    return _state.main is not None and \
+        _state.main._build_tid == threading.get_ident()
+
+
+def default_main_program() -> Program:
+    return _state.main if _state.main is not None else _state.default_main
+
+
+def default_startup_program() -> Program:
+    return _state.startup if _state.startup is not None \
+        else _state.default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    """Record this thread's op dispatches in `main_program` while active."""
+    prev = (_state.main, _state.startup)
+    _state.main = main_program
+    _state.startup = startup_program or Program()
+    main_program._build_tid = threading.get_ident()
+    _registry.set_program_recorder(main_program._record)
+    try:
+        yield
+    finally:
+        main_program._finalize()
+        _state.main, _state.startup = prev
+        if _state.main is not None:  # nested guard: re-arm outer recorder
+            _registry.set_program_recorder(_state.main._record)
+        else:
+            _registry.set_program_recorder(None)
+
+
+@contextlib.contextmanager
+def static_mode_guard():
+    yield
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level=0) -> Tensor:
+    """Declare a feedable placeholder.  Parity: `paddle.static.data`.
+
+    None/-1 dims build as size 1; the replay re-runs every op on the real
+    feed shapes, so any batch size works at run() time.
+    """
+    prog = default_main_program()
+    build_shape = tuple(1 if (d is None or d == -1) else d for d in shape)
+    from ..core import dtypes as _dtypes
+    ph = Tensor(np.zeros(build_shape, _dtypes.convert_dtype(dtype)))
+    ph.name = name
+    ph.stop_gradient = True
+    prog.placeholders[name] = ph
+    prog._uid(ph)  # placeholders stay pinned (feeds key off them)
+    return ph
