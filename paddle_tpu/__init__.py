@@ -69,6 +69,9 @@ from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402

@@ -1,0 +1,12 @@
+"""Probability distributions.  Parity: `python/paddle/distribution/`."""
+
+from .distribution import Distribution
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Exponential, Gamma, Geometric, Gumbel, Laplace,
+                            LogNormal, Multinomial, Normal, Poisson, Uniform)
+from .kl import kl_divergence, register_kl
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Gamma", "Laplace", "Exponential",
+           "LogNormal", "Gumbel", "Geometric", "Poisson", "Multinomial",
+           "kl_divergence", "register_kl"]

@@ -1,0 +1,72 @@
+"""Distribution base class.
+
+Parity: `python/paddle/distribution/distribution.py` (Distribution:
+sample/rsample/prob/log_prob/entropy/cdf, batch_shape/event_shape).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+def _t(x, dtype="float32") -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+class Distribution:
+    def __init__(self, batch_shape: Sequence[int] = (),
+                 event_shape: Sequence[int] = ()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self) -> Tensor:
+        raise NotImplementedError
+
+    @property
+    def variance(self) -> Tensor:
+        raise NotImplementedError
+
+    def sample(self, shape: Sequence[int] = ()) -> Tensor:
+        """Draw (non-reparameterized) samples of `shape` + batch + event."""
+        with paddle.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape: Sequence[int] = ()) -> Tensor:
+        raise NotImplementedError
+
+    def log_prob(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def prob(self, value) -> Tensor:
+        return paddle.exp(self.log_prob(value))
+
+    def entropy(self) -> Tensor:
+        raise NotImplementedError
+
+    def cdf(self, value) -> Tensor:
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution") -> Tensor:
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape: Sequence[int]) -> Tuple[int, ...]:
+        return tuple(sample_shape) + self._batch_shape + self._event_shape

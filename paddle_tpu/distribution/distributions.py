@@ -1,0 +1,566 @@
+"""The standard distribution zoo.
+
+Parity: `python/paddle/distribution/` — normal.py, uniform.py,
+bernoulli.py, categorical.py, beta.py, dirichlet.py, gamma.py, laplace.py,
+exponential.py, lognormal.py, gumbel.py, geometric.py, poisson.py,
+multinomial.py.  One module instead of one file per class; each class
+documents its reference file.
+
+Sampling: base randomness comes from the framework PRNG (`framework/
+random.next_key`), drawn through registered ops so `rsample` is
+reparameterized on the eager tape (pathwise gradients for normal/uniform/
+gamma-family).  Densities are written with paddle ops, so `log_prob` is
+differentiable everywhere.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework import random as _random
+from ..framework.tensor import Tensor
+from ..ops.registry import dispatch as _d, register_op
+from .distribution import Distribution, _t
+
+__all__ = ["Normal", "Uniform", "Bernoulli", "Categorical", "Beta",
+           "Dirichlet", "Gamma", "Laplace", "Exponential", "LogNormal",
+           "Gumbel", "Geometric", "Poisson", "Multinomial"]
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+# ------------------------------------------------------- sampling primitives
+def _reg(name, fn):
+    register_op(name, fn)
+    return name
+
+
+_GAMMA = _reg("random_gamma",
+              lambda a, key=None, shape=None:
+              jax.random.gamma(key, a, shape=shape, dtype=a.dtype))
+_POISSON = _reg("random_poisson",
+                lambda rate, key=None, shape=None:
+                jax.random.poisson(key, rate, shape=shape).astype(jnp.int32))
+_CATEG = _reg("random_categorical",
+              lambda logits, key=None, shape=None:
+              jax.random.categorical(key, logits, shape=shape))
+
+
+def _gamma_sample(conc: Tensor, shape) -> Tensor:
+    return _d(_GAMMA, (conc,), {"key": _random.next_key(), "shape": shape})
+
+
+# ------------------------------------------------------------ distributions
+class Normal(Distribution):
+    """Parity: `distribution/normal.py`."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc * paddle.ones_like(self.scale)
+
+    @property
+    def variance(self):
+        return (self.scale * paddle.ones_like(self.loc)) ** 2
+
+    @property
+    def stddev(self):
+        return self.scale * paddle.ones_like(self.loc)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        eps = paddle.randn(list(out_shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale ** 2
+        return -((value - self.loc) ** 2) / (2.0 * var) \
+            - paddle.log(self.scale) - _HALF_LOG_2PI
+
+    def entropy(self):
+        return 0.5 + _HALF_LOG_2PI + paddle.log(
+            self.scale * paddle.ones_like(self.loc))
+
+    def cdf(self, value):
+        value = _t(value)
+        return 0.5 * (1.0 + paddle.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2.0))))
+
+
+class Uniform(Distribution):
+    """Parity: `distribution/uniform.py`."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(np.broadcast_shapes(self.low.shape,
+                                                   self.high.shape)))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12.0
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = paddle.logical_and(value >= self.low, value < self.high)
+        lp = -paddle.log(self.high - self.low)
+        return paddle.where(inside, lp * paddle.ones_like(value),
+                            paddle.full_like(value, -float("inf")))
+
+    def entropy(self):
+        return paddle.log(self.high - self.low)
+
+    def cdf(self, value):
+        value = _t(value)
+        return paddle.clip((value - self.low) / (self.high - self.low),
+                           0.0, 1.0)
+
+
+class Bernoulli(Distribution):
+    """Parity: `distribution/bernoulli.py`."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            u = paddle.rand(list(self._extend_shape(shape)))
+            return paddle.cast(u < self.probs, "float32")
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-sigmoid (binary Concrete) relaxation: differentiable
+        w.r.t. probs; hardens toward {0,1} as temperature -> 0."""
+        p = paddle.clip(self.probs, 1e-7, 1.0 - 1e-7)
+        logits = paddle.log(p) - paddle.log1p(-p)
+        u = paddle.clip(paddle.rand(list(self._extend_shape(shape))),
+                        1e-7, 1.0 - 1e-7)
+        logistic = paddle.log(u) - paddle.log1p(-u)
+        import paddle_tpu.nn.functional as F
+        return F.sigmoid((logits + logistic) / float(temperature))
+
+    def log_prob(self, value):
+        value = _t(value)
+        p = paddle.clip(self.probs, 1e-7, 1.0 - 1e-7)
+        return value * paddle.log(p) + (1.0 - value) * paddle.log(1.0 - p)
+
+    def entropy(self):
+        p = paddle.clip(self.probs, 1e-7, 1.0 - 1e-7)
+        return -(p * paddle.log(p) + (1 - p) * paddle.log(1 - p))
+
+
+class Categorical(Distribution):
+    """Parity: `distribution/categorical.py` (logits = unnormalized log
+    probabilities, reference semantics)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self._n = self.logits.shape[-1]
+
+    @property
+    def probs(self):
+        import paddle_tpu.nn.functional as F
+        return F.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            out_shape = tuple(shape) + self._batch_shape
+            return _d(_CATEG, (self.logits,),
+                      {"key": _random.next_key(),
+                       "shape": out_shape if out_shape else None})
+
+    def log_prob(self, value):
+        value = _t(value)
+        logp = self.logits - paddle.logsumexp(self.logits, axis=-1,
+                                              keepdim=True)
+        idx = paddle.cast(value, "int64")
+        oh = paddle.one_hot(idx, self._n)
+        return paddle.sum(oh * logp, axis=-1)
+
+    def probabilities(self, value):
+        return paddle.exp(self.log_prob(value))
+
+    def entropy(self):
+        logp = self.logits - paddle.logsumexp(self.logits, axis=-1,
+                                              keepdim=True)
+        return -paddle.sum(paddle.exp(logp) * logp, axis=-1)
+
+
+class Beta(Distribution):
+    """Parity: `distribution/beta.py` (two-gamma sampling)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(np.broadcast_shapes(self.alpha.shape,
+                                                   self.beta.shape)))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        a = _gamma_sample(self.alpha * paddle.ones(list(out_shape)), None)
+        b = _gamma_sample(self.beta * paddle.ones(list(out_shape)), None)
+        return a / (a + b)
+
+    def _log_norm(self):
+        return paddle.lgamma(self.alpha) + paddle.lgamma(self.beta) \
+            - paddle.lgamma(self.alpha + self.beta)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (self.alpha - 1.0) * paddle.log(value) \
+            + (self.beta - 1.0) * paddle.log(1.0 - value) - self._log_norm()
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return self._log_norm() \
+            - (a - 1.0) * paddle.digamma(a) - (b - 1.0) * paddle.digamma(b) \
+            + (a + b - 2.0) * paddle.digamma(a + b)
+
+
+class Dirichlet(Distribution):
+    """Parity: `distribution/dirichlet.py`."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / paddle.sum(self.concentration, axis=-1,
+                                               keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = paddle.sum(self.concentration, axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def rsample(self, shape=()):
+        out_shape = tuple(shape) + tuple(self.concentration.shape)
+        g = _gamma_sample(self.concentration * paddle.ones(list(out_shape)),
+                          None)
+        return g / paddle.sum(g, axis=-1, keepdim=True)
+
+    def log_prob(self, value):
+        value = _t(value)
+        a = self.concentration
+        log_norm = paddle.sum(paddle.lgamma(a), axis=-1) \
+            - paddle.lgamma(paddle.sum(a, axis=-1))
+        return paddle.sum((a - 1.0) * paddle.log(value), axis=-1) - log_norm
+
+    def entropy(self):
+        a = self.concentration
+        a0 = paddle.sum(a, axis=-1)
+        k = float(a.shape[-1])
+        log_norm = paddle.sum(paddle.lgamma(a), axis=-1) - paddle.lgamma(a0)
+        return log_norm + (a0 - k) * paddle.digamma(a0) \
+            - paddle.sum((a - 1.0) * paddle.digamma(a), axis=-1)
+
+
+class Gamma(Distribution):
+    """Parity: `distribution/gamma.py` (concentration/rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.concentration.shape, self.rate.shape)))
+
+    @property
+    def mean(self):
+        return self.concentration / self.rate
+
+    @property
+    def variance(self):
+        return self.concentration / (self.rate ** 2)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        g = _gamma_sample(self.concentration * paddle.ones(list(out_shape)),
+                          None)
+        return g / self.rate
+
+    def log_prob(self, value):
+        value = _t(value)
+        a, r = self.concentration, self.rate
+        return a * paddle.log(r) - paddle.lgamma(a) \
+            + (a - 1.0) * paddle.log(value) - r * value
+
+    def entropy(self):
+        a, r = self.concentration, self.rate
+        return a - paddle.log(r) + paddle.lgamma(a) \
+            + (1.0 - a) * paddle.digamma(a)
+
+
+class Laplace(Distribution):
+    """Parity: `distribution/laplace.py`."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc * paddle.ones_like(self.scale)
+
+    @property
+    def variance(self):
+        return 2.0 * (self.scale * paddle.ones_like(self.loc)) ** 2
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape))) - 0.5
+        return self.loc - self.scale * paddle.sign(u) * paddle.log1p(
+            -2.0 * paddle.abs(u) + 1e-12)
+
+    def log_prob(self, value):
+        value = _t(value)
+        return -paddle.abs(value - self.loc) / self.scale \
+            - paddle.log(2.0 * self.scale)
+
+    def entropy(self):
+        return 1.0 + paddle.log(2.0 * self.scale *
+                                paddle.ones_like(self.loc))
+
+    def cdf(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * paddle.sign(z) * paddle.expm1(-paddle.abs(z))
+
+
+class Exponential(Distribution):
+    """Parity: `distribution/exponential.py`."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / (self.rate ** 2)
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return -paddle.log1p(-u + 1e-12) / self.rate
+
+    def log_prob(self, value):
+        value = _t(value)
+        return paddle.log(self.rate) - self.rate * value
+
+    def entropy(self):
+        return 1.0 - paddle.log(self.rate)
+
+    def cdf(self, value):
+        return -paddle.expm1(-self.rate * _t(value))
+
+
+class LogNormal(Distribution):
+    """Parity: `distribution/lognormal.py`."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return paddle.exp(self.loc + (self.scale ** 2) / 2.0)
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return paddle.expm1(s2) * paddle.exp(2.0 * self.loc + s2)
+
+    def rsample(self, shape=()):
+        return paddle.exp(self._base.rsample(shape))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return self._base.log_prob(paddle.log(value)) - paddle.log(value)
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class Gumbel(Distribution):
+    """Parity: `distribution/gumbel.py`."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.57721566490153286  # Euler gamma
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale ** 2
+
+    def rsample(self, shape=()):
+        u = paddle.rand(list(self._extend_shape(shape)))
+        return self.loc - self.scale * paddle.log(
+            -paddle.log(u + 1e-12) + 1e-12)
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(z + paddle.exp(-z)) - paddle.log(self.scale)
+
+    def entropy(self):
+        return paddle.log(self.scale * paddle.ones_like(self.loc)) \
+            + 1.0 + 0.57721566490153286
+
+    def cdf(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return paddle.exp(-paddle.exp(-z))
+
+
+class Geometric(Distribution):
+    """Parity: `distribution/geometric.py` (trials before first success,
+    support {0, 1, 2, ...})."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / (self.probs ** 2)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            u = paddle.rand(list(self._extend_shape(shape)))
+            return paddle.floor(paddle.log(u + 1e-12) /
+                                paddle.log1p(-self.probs + 1e-12))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return value * paddle.log1p(-self.probs + 1e-12) \
+            + paddle.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        q = 1.0 - p
+        return -(q * paddle.log(q + 1e-12) + p * paddle.log(p)) / p
+
+
+class Poisson(Distribution):
+    """Parity: `distribution/poisson.py`."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            out_shape = self._extend_shape(shape)
+            return paddle.cast(
+                _d(_POISSON, (self.rate,),
+                   {"key": _random.next_key(),
+                    "shape": out_shape if out_shape else None}), "float32")
+
+    def log_prob(self, value):
+        value = _t(value)
+        return value * paddle.log(self.rate) - self.rate \
+            - paddle.lgamma(value + 1.0)
+
+    def entropy(self):
+        # second-order Stirling approximation (reference uses the same
+        # truncated series)
+        r = self.rate
+        return 0.5 * paddle.log(2.0 * math.pi * math.e * r) \
+            - 1.0 / (12.0 * r) - 1.0 / (24.0 * r * r)
+
+
+class Multinomial(Distribution):
+    """Parity: `distribution/multinomial.py`."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return float(self.total_count) * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        with paddle.no_grad():
+            logits = paddle.log(self.probs + 1e-12)
+            draw_shape = (self.total_count,) + tuple(shape) \
+                + self._batch_shape
+            draws = _d(_CATEG, (logits,),
+                       {"key": _random.next_key(), "shape": draw_shape})
+            k = self.probs.shape[-1]
+            counts = paddle.sum(paddle.one_hot(draws, k), axis=0)
+            return counts
+
+    def log_prob(self, value):
+        value = _t(value)
+        return paddle.lgamma(_t(float(self.total_count)) + 1.0) \
+            - paddle.sum(paddle.lgamma(value + 1.0), axis=-1) \
+            + paddle.sum(value * paddle.log(self.probs + 1e-12), axis=-1)

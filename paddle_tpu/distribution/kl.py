@@ -1,0 +1,113 @@
+"""KL divergence registry.
+
+Parity: `python/paddle/distribution/kl.py` — kl_divergence (`:43`),
+register_kl (`:75`), MRO-based dispatch (`:109`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple, Type
+
+import paddle_tpu as paddle
+from .distribution import Distribution
+from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
+                            Exponential, Gamma, Laplace, Normal, Uniform)
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], Callable] = {}
+
+
+def register_kl(cls_p: Type[Distribution], cls_q: Type[Distribution]):
+    """Decorator registering a KL(p||q) rule for a distribution pair."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def _dispatch(tp: Type, tq: Type) -> Callable:
+    matches = []
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if issubclass(tp, cp) and issubclass(tq, cq):
+            matches.append((tp.__mro__.index(cp) + tq.__mro__.index(cq), fn))
+    if not matches:
+        raise NotImplementedError(
+            f"no KL(p||q) rule registered for ({tp.__name__}, "
+            f"{tq.__name__}); use register_kl")
+    return min(matches, key=lambda m: m[0])[1]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1.0 - paddle.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # infinite when p's support leaves q's; assumes containment (reference
+    # behavior)
+    return paddle.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    a = paddle.clip(p.probs, 1e-7, 1 - 1e-7)
+    b = paddle.clip(q.probs, 1e-7, 1 - 1e-7)
+    return a * paddle.log(a / b) + (1 - a) * paddle.log((1 - a) / (1 - b))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    logp = p.logits - paddle.logsumexp(p.logits, axis=-1, keepdim=True)
+    logq = q.logits - paddle.logsumexp(q.logits, axis=-1, keepdim=True)
+    return paddle.sum(paddle.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    ps, qs = pa + pb, qa + qb
+    return (paddle.lgamma(qa) + paddle.lgamma(qb) - paddle.lgamma(qs)) \
+        - (paddle.lgamma(pa) + paddle.lgamma(pb) - paddle.lgamma(ps)) \
+        + (pa - qa) * paddle.digamma(pa) + (pb - qb) * paddle.digamma(pb) \
+        + (qs - ps) * paddle.digamma(ps)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    pa, qa = p.concentration, q.concentration
+    p0 = paddle.sum(pa, axis=-1)
+    return paddle.lgamma(p0) - paddle.sum(paddle.lgamma(pa), axis=-1) \
+        - paddle.lgamma(paddle.sum(qa, axis=-1)) \
+        + paddle.sum(paddle.lgamma(qa), axis=-1) \
+        + paddle.sum((pa - qa) * (paddle.digamma(pa)
+                                  - paddle.digamma(p0)[..., None]), axis=-1)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    pc, pr, qc, qr = p.concentration, p.rate, q.concentration, q.rate
+    return (pc - qc) * paddle.digamma(pc) - paddle.lgamma(pc) \
+        + paddle.lgamma(qc) + qc * (paddle.log(pr) - paddle.log(qr)) \
+        + pc * (qr / pr - 1.0)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    ratio = p.scale / q.scale
+    diff = paddle.abs(p.loc - q.loc) / q.scale
+    return -paddle.log(ratio) + ratio * paddle.exp(
+        -paddle.abs(p.loc - q.loc) / p.scale) + diff - 1.0
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    ratio = q.rate / p.rate
+    return paddle.log(p.rate) - paddle.log(q.rate) + ratio - 1.0

@@ -313,7 +313,13 @@ class Tensor:
         """Copy value + flags; the autograd graph is never copied (matches
         paddle: deepcopy of a mid-graph tensor detaches)."""
         cls = type(self)
-        t = cls._wrap(self._value, stop_gradient=self.stop_gradient)
+        val = self._value
+        if isinstance(val, jax.Array) and not self._is_traced():
+            # a real buffer copy: the copy must survive the original being
+            # donated by a jitted optimizer step (and vice versa)
+            val = jnp.array(val, copy=True)
+        t = cls._wrap(val, stop_gradient=self.stop_gradient)
+        t.name = self.name  # stable identity: optimizer state keys by name
         t.persistable = self.persistable
         t.trainable = self.trainable
         if isinstance(self, Parameter):

@@ -1,0 +1,18 @@
+"""Model quantization: QAT fake-quant + PTQ observers.
+
+Parity: `python/paddle/quantization/` — QuantConfig (config.py), QAT
+(qat.py), PTQ (ptq.py), FakeQuanterWithAbsMaxObserver (quanters/abs_max.py),
+AbsmaxObserver (observers/abs_max.py), QuantedLinear
+(nn/quant/qat/linear.py).
+"""
+
+from .config import QuantConfig
+from .observers import AbsmaxObserver
+from .ptq import PTQ
+from .qat import QAT, QuantedLinear
+from .quanters import (FakeQuanterWithAbsMaxObserver, fake_quantize_absmax,
+                       quantize_dequantize)
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "QuantedLinear", "AbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver", "fake_quantize_absmax",
+           "quantize_dequantize"]

@@ -1,0 +1,35 @@
+"""PTQ observers.
+
+Parity: `python/paddle/quantization/observers/abs_max.py` (AbsmaxObserver).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .quanters import quantize_dequantize
+
+__all__ = ["AbsmaxObserver"]
+
+
+class AbsmaxObserver(Layer):
+    """Collects the running absmax during calibration; after `convert`, the
+    observed scale drives quantize-dequantize."""
+
+    def __init__(self, quant_bits: int = 8, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.register_buffer("scale", paddle.to_tensor(1e-8),
+                             persistable=True)
+        self._observing = True
+
+    def observe(self, on: bool = True):
+        self._observing = on
+
+    def forward(self, x: Tensor) -> Tensor:
+        if self._observing:
+            cur = paddle.max(paddle.abs(x.detach()))
+            self.scale._value = paddle.maximum(self.scale, cur)._value
+            return x  # calibration passes the signal through untouched
+        return quantize_dequantize(x, self.scale, self.quant_bits)

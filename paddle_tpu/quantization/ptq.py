@@ -1,0 +1,34 @@
+"""Post-training quantization.
+
+Parity: `python/paddle/quantization/ptq.py` (PTQ.quantize inserting
+observers, convert() freezing scales).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .observers import AbsmaxObserver
+from .qat import QAT
+
+__all__ = ["PTQ"]
+
+
+class PTQ(QAT):
+    """Calibrate with observers, then `convert` to frozen fake quant.
+
+    flow:  q = PTQ(QuantConfig(activation=AbsmaxObserver,
+                               weight=AbsmaxObserver))
+           model_q = q.quantize(model)
+           for batch in calib_data: model_q(batch)     # observe
+           final = q.convert(model_q)                  # freeze scales
+    """
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        model = model if inplace else copy.deepcopy(model)
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, AbsmaxObserver):
+                layer.observe(False)
+        return model

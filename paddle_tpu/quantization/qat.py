@@ -1,0 +1,100 @@
+"""Quantization-aware training.
+
+Parity: `python/paddle/quantization/qat.py` (QAT.quantize swapping layers),
+`python/paddle/nn/quant/qat/linear.py` (QuantedLinear), `conv.py`
+(QuantedConv2D).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..nn import Conv2D, Linear
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .quanters import FakeQuanterWithAbsMaxObserver
+
+__all__ = ["QAT", "QuantedLinear", "QuantedConv2D"]
+
+
+def _make(quanter):
+    if quanter is None:
+        return None
+    if isinstance(quanter, type):
+        return quanter()
+    return copy.deepcopy(quanter)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight and (optionally) activation."""
+
+    def __init__(self, linear: Linear, cfg):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.weight_quanter = _make(cfg.weight)
+        self.activation_quanter = _make(cfg.activation)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, conv: Conv2D, cfg):
+        super().__init__()
+        self._conv = conv
+        self.weight = conv.weight
+        self.bias = conv.bias
+        self.weight_quanter = _make(cfg.weight)
+        self.activation_quanter = _make(cfg.activation)
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, self.bias, stride=self._conv._stride,
+                        padding=self._conv._padding,
+                        dilation=self._conv._dilation,
+                        groups=self._conv._groups)
+
+
+_SWAPS = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+class QAT:
+    """model -> fake-quantized model (in place on a copy).
+
+    Parity: `qat.py` QAT(config).quantize(model, inplace=False).
+    """
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        model = model if inplace else copy.deepcopy(model)
+        self._swap(model)
+        return model
+
+    def _swap(self, layer: Layer):
+        for name, child in list(layer._sub_layers.items()):
+            cfg = self._config.config_for(child)
+            swapped = False
+            if cfg is not None:
+                for src, dst in _SWAPS.items():
+                    if type(child) is src:
+                        layer._sub_layers[name] = dst(child, cfg)
+                        object.__setattr__(layer, name,
+                                           layer._sub_layers[name])
+                        swapped = True
+                        break
+            if not swapped:
+                self._swap(child)

@@ -1,0 +1,84 @@
+"""Fake quantization with straight-through gradients.
+
+Parity: `python/paddle/quantization/quanters/abs_max.py`
+(FakeQuanterWithAbsMaxObserver) and the `fake_quantize_dequantize_abs_max`
+kernel family (`paddle/phi/kernels/fake_quantize_kernel.cc`).
+
+The quantize-dequantize round trip is a registered op with a custom
+straight-through vjp (pass-through inside the clip range, zero outside) —
+the same estimator the reference's kernel backward implements.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.registry import dispatch as _d, register_op
+
+__all__ = ["fake_quantize_absmax", "quantize_dequantize",
+           "FakeQuanterWithAbsMaxObserver"]
+
+
+def _qdq(x, scale=None, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _qdq_vjp(treedef, vals, static):
+    import jax
+    x, scale = vals
+    bits = static.get("bits", 8)
+    out = _qdq(x, scale, bits)
+
+    def vjp(gs):
+        g = gs[0] if isinstance(gs, (tuple, list)) else gs
+        mask = (jnp.abs(x) <= jnp.maximum(scale, 1e-8)).astype(g.dtype)
+        return (g * mask, jnp.zeros_like(scale))
+
+    return out, vjp
+
+
+register_op("fake_quantize_dequantize_abs_max", _qdq, custom_vjp=_qdq_vjp)
+
+
+def quantize_dequantize(x: Tensor, scale: Tensor, bits: int = 8) -> Tensor:
+    """STE quantize-dequantize round trip at the given absmax scale."""
+    return _d("fake_quantize_dequantize_abs_max", (x, scale), {"bits": bits})
+
+
+def fake_quantize_absmax(x: Tensor, bits: int = 8) -> Tensor:
+    """One-shot fake quant at the tensor's current absmax."""
+    scale = paddle.max(paddle.abs(x))
+    return quantize_dequantize(x, scale, bits)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT activation/weight quanter with EMA absmax scale.
+
+    Parity: `quanters/abs_max.py` (moving_rate, bit_length).
+    """
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self.register_buffer("scale", paddle.to_tensor(0.0), persistable=True)
+        self._initialized = False
+
+    def forward(self, x: Tensor) -> Tensor:
+        if self.training:
+            cur = paddle.max(paddle.abs(x.detach()))
+            if not self._initialized:
+                new_scale = cur
+                self._initialized = True
+            else:
+                r = self.moving_rate
+                new_scale = self.scale * r + cur * (1.0 - r)
+            self.scale._value = new_scale._value
+        return quantize_dequantize(x, self.scale, self.bit_length)

@@ -1,0 +1,22 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Parity: `python/paddle/sparse/` (creation.py sparse_coo_tensor/
+sparse_csr_tensor, unary/binary ops, matmul, nn.ReLU) and
+`paddle/phi/core/sparse_coo_tensor.h` / `sparse_csr_tensor.h`.
+
+TPU-native: storage is `jax.experimental.sparse` BCOO (the XLA-lowerable
+batched-COO format); CSR creation converts to BCOO internally (XLA has no
+CSR kernels — crow/col views are materialised on demand for API parity).
+Dense results come back as regular paddle Tensors.
+"""
+
+from . import nn  # noqa: F401
+from .binary import add, matmul, multiply, subtract
+from .creation import (SparseCooTensor, SparseCsrTensor, sparse_coo_tensor,
+                       sparse_csr_tensor)
+from .unary import abs, cast, neg, pow, relu, sin, sqrt, square, tanh  # noqa: A004
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "add", "subtract", "multiply", "matmul",
+           "relu", "abs", "neg", "sin", "tanh", "sqrt", "square", "pow",
+           "cast", "nn"]

@@ -1,0 +1,64 @@
+"""Sparse binary ops + spmm.
+
+Parity: `python/paddle/sparse/binary.py` (add/subtract/multiply `:330+`,
+matmul `:38` — sparse x dense -> dense, sparse x sparse elementwise).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from .creation import SparseCooTensor
+
+__all__ = ["add", "subtract", "multiply", "matmul"]
+
+
+def _binary(fn):
+    def op(x, y, name=None):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            out = fn(x._bcoo, y._bcoo)
+            return SparseCooTensor(out.sum_duplicates())
+        raise TypeError("sparse binary ops need two sparse tensors "
+                        "(mixed sparse/dense: use matmul or to_dense)")
+    return op
+
+
+add = _binary(lambda a, b: a + b)
+subtract = _binary(lambda a, b: a + (-b))
+
+
+def multiply(x: SparseCooTensor, y, name=None):
+    """Elementwise product; sparse * scalar and sparse * sparse."""
+    if isinstance(y, (int, float)):
+        return x._replace(x._bcoo.data * y)
+    if isinstance(y, SparseCooTensor):
+        # product is nonzero only where both are: O(nnz log nnz) index
+        # intersection via sorted linear indices — never densify
+        yb = y._bcoo.sum_duplicates()
+        shape = jnp.asarray(x._bcoo.shape)
+        strides = jnp.cumprod(jnp.concatenate(
+            [shape[1:][::-1], jnp.ones(1, shape.dtype)]))[::-1]
+        xl = (x._bcoo.indices * strides).sum(axis=1)
+        yl = (yb.indices * strides).sum(axis=1)
+        order = jnp.argsort(yl)
+        yl_sorted = yl[order]
+        y_data_sorted = yb.data[order]
+        pos = jnp.searchsorted(yl_sorted, xl)
+        pos_c = jnp.clip(pos, 0, max(yl_sorted.shape[0] - 1, 0))
+        hit = (pos < yl_sorted.shape[0]) & (yl_sorted[pos_c] == xl)
+        gathered = jnp.where(hit, y_data_sorted[pos_c], 0)
+        return x._replace(x._bcoo.data * gathered)
+    raise TypeError(f"multiply: unsupported operand {type(y).__name__}")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense Tensor (XLA lowers BCOO matmul to gather/
+    scatter + MXU matmul on the dense side)."""
+    if isinstance(x, SparseCooTensor):
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        return Tensor._wrap(x._bcoo @ yv)
+    if isinstance(y, SparseCooTensor):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor._wrap(xv @ y._bcoo)
+    raise TypeError("paddle.sparse.matmul needs at least one sparse operand")

@@ -1,0 +1,152 @@
+"""Sparse tensor types + creation.
+
+Parity: `python/paddle/sparse/creation.py` (sparse_coo_tensor `:84`,
+sparse_csr_tensor `:183`), `paddle/phi/core/sparse_coo_tensor.h:30`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over a jax BCOO matrix."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -------------------------------------------------------------- views
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        # paddle layout: (sparse_dim, nnz); BCOO stores (nnz, sparse_dim)
+        return Tensor._wrap(self._bcoo.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor._wrap(self._bcoo.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor._wrap(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor._from_bcoo(self._bcoo)
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def _replace(self, data) -> "SparseCooTensor":
+        # preserves the concrete type: relu(csr) stays CSR
+        return type(self)(
+            jsparse.BCOO((data, self._bcoo.indices), shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view: same BCOO storage + materialised crows/cols on demand.
+    Parity: `sparse_csr_tensor.h:30`."""
+
+    @classmethod
+    def _from_bcoo(cls, bcoo):
+        return cls(bcoo.sum_duplicates())
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def crows(self) -> Tensor:
+        idx = np.asarray(self._bcoo.indices)
+        rows = idx[:, 0]
+        n_rows = self.shape[0]
+        crows = np.zeros(n_rows + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        return Tensor._wrap(jnp.asarray(np.cumsum(crows)))
+
+    def cols(self) -> Tensor:
+        return Tensor._wrap(self._bcoo.indices[:, 1])
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) \
+            -> SparseCooTensor:
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _as_jnp(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x))
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True) \
+        -> SparseCooTensor:
+    """Build a COO tensor from (sparse_dim, nnz) indices + (nnz,) values."""
+    idx = _as_jnp(indices).astype(jnp.int32).T  # -> (nnz, sparse_dim)
+    vals = _as_jnp(values)
+    if dtype is not None:
+        from ..core import dtypes as _dtypes
+        vals = vals.astype(_dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values,
+                      shape: Sequence[int], dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    """Build a CSR tensor from compressed rows + cols + values."""
+    crows_np = np.asarray(_as_jnp(crows))
+    cols_np = np.asarray(_as_jnp(cols))
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = jnp.asarray(np.stack([rows, cols_np], axis=1).astype(np.int32))
+    vals = _as_jnp(values)
+    if dtype is not None:
+        from ..core import dtypes as _dtypes
+        vals = vals.astype(_dtypes.convert_dtype(dtype))
+    return SparseCsrTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+# Tensor bridge methods (reference: Tensor.to_sparse_coo / to_dense)
+def _tensor_to_sparse_coo(self, sparse_dim: int) -> SparseCooTensor:
+    return SparseCooTensor(
+        jsparse.BCOO.fromdense(self._value, n_batch=0,
+                               n_dense=self._value.ndim - sparse_dim))
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo

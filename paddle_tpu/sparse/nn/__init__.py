@@ -1,0 +1,30 @@
+"""paddle.sparse.nn — sparse activation layers.
+
+Parity: `python/paddle/sparse/nn/` (layer/activation.py ReLU, LeakyReLU,
+Softmax subset).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer.layers import Layer
+from ..creation import SparseCooTensor
+from .. import unary as _unary
+
+__all__ = ["ReLU", "LeakyReLU"]
+
+
+class ReLU(Layer):
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return _unary.relu(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope: float = 0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return x._replace(jnp.where(x._bcoo.data > 0, x._bcoo.data,
+                                    x._bcoo.data * self.negative_slope))

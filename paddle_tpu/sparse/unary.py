@@ -1,0 +1,46 @@
+"""Sparse unary ops: applied to stored values, preserving sparsity.
+
+Parity: `python/paddle/sparse/unary.py` (relu/abs/sin/tanh/sqrt/square/
+pow/cast/neg — the zero-preserving subset the reference registers sparse
+kernels for).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .creation import SparseCooTensor
+
+__all__ = ["relu", "abs", "neg", "sin", "tanh", "sqrt", "square", "pow",
+           "cast"]
+
+
+def _unary(fn):
+    def op(x: SparseCooTensor, *args, name=None, **kwargs):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError("paddle.sparse unary ops take sparse tensors; "
+                            "use the dense op for dense tensors")
+        return x._replace(fn(x._bcoo.data, *args, **kwargs))
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+abs = _unary(jnp.abs)  # noqa: A001
+neg = _unary(jnp.negative)
+sin = _unary(jnp.sin)
+tanh = _unary(jnp.tanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+pow = _unary(lambda v, factor: jnp.power(v, factor))  # noqa: A001
+
+
+def cast(x: SparseCooTensor, index_dtype=None, value_dtype=None, name=None):
+    from ..core import dtypes as _dtypes
+    bcoo = x._bcoo
+    data, indices = bcoo.data, bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(_dtypes.convert_dtype(value_dtype))
+    if index_dtype is not None:
+        indices = indices.astype(_dtypes.convert_dtype(index_dtype))
+    from jax.experimental import sparse as jsparse
+    return type(x)(jsparse.BCOO((data, indices), shape=bcoo.shape))

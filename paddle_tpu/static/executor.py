@@ -79,6 +79,8 @@ def _fetch(program, env, fetch_list, return_numpy):
             uid = program.uid_of(f)
             if uid is not None and uid in env:
                 t = env[uid]
+            elif uid is not None and uid in program._keep:
+                t = program._keep[uid]  # pinned constant captured in-guard
             elif f.persistable:
                 t = f  # parameters fetched directly read live storage
         if t is None:

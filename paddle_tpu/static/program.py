@@ -45,6 +45,18 @@ class _Ref:
         self.uid = uid
 
 
+class _FreshKey:
+    """Marks a recorded PRNG key: replay draws a fresh one, so dropout &
+    friends re-randomize per run (the reference's static dropout draws a
+    new mask each Executor.run)."""
+    __slots__ = ()
+
+
+def _is_prng_key(v) -> bool:
+    return isinstance(v, jax.Array) and jax.dtypes.issubdtype(
+        v.dtype, jax.dtypes.prng_key)
+
+
 class _OpStep:
     __slots__ = ("name", "inputs", "static", "out_uids")
 
@@ -116,7 +128,9 @@ class Program:
         outs_t = outs if isinstance(outs, tuple) else (outs,)
         out_uids = tuple(self._uid(o) for o in outs_t)
         self._produced.update(out_uids)
-        self.steps.append(_OpStep(name, inputs, dict(static), out_uids))
+        static_rec = {k: (_FreshKey() if _is_prng_key(v) else v)
+                      for k, v in static.items()}
+        self.steps.append(_OpStep(name, inputs, static_rec, out_uids))
 
     def record_minimize(self, optimizer, loss: Tensor):
         self.steps.append(_MinimizeStep(optimizer, self._uid(loss)))
@@ -149,10 +163,10 @@ class Program:
                     "earlier step nor pinned — corrupted recording")
             return t  # live param / constant: current storage is read
 
-        # suspend recording: a replay must never append to a program
-        # (including itself, when run inside a program_guard)
-        prev_recorder = _registry._program_recorder
-        _registry.set_program_recorder(None)
+        # suspend recording on THIS thread: a replay must never append to a
+        # program (including itself when run inside its own program_guard),
+        # and minimize() inside a replay must execute, not re-record
+        _state.replay_depth += 1
         try:
             for step in self.steps:
                 if isinstance(step, _MinimizeStep):
@@ -167,12 +181,18 @@ class Program:
                 inputs = jax.tree_util.tree_map(
                     resolve, step.inputs,
                     is_leaf=lambda x: isinstance(x, _Ref))
-                outs = _registry.dispatch(step.name, inputs, step.static)
+                static = step.static
+                if any(isinstance(v, _FreshKey) for v in static.values()):
+                    from ..framework import random as _random
+                    static = {k: (_random.next_key()
+                                  if isinstance(v, _FreshKey) else v)
+                              for k, v in static.items()}
+                outs = _registry.dispatch(step.name, inputs, static)
                 outs_t = outs if isinstance(outs, tuple) else (outs,)
                 for uid, o in zip(step.out_uids, outs_t):
                     env[uid] = o
         finally:
-            _registry.set_program_recorder(prev_recorder)
+            _state.replay_depth -= 1
         return env
 
     def global_block(self):
@@ -187,45 +207,59 @@ class _State(threading.local):
     def __init__(self):
         self.main: Optional[Program] = None
         self.startup: Optional[Program] = None
-        self.default_main = Program()
-        self.default_startup = Program()
+        self.replay_depth = 0
 
 
 _state = _State()
+_default_main = Program()
+_default_startup = Program()
+_guard_lock = threading.Lock()
+_active_guards = 0
+
+
+def _thread_recorder(name, diff_inputs, static, outs):
+    """Single global recorder: forwards to this thread's active Program (if
+    any), so guards on different threads cannot disable each other."""
+    prog = _state.main
+    if prog is not None and _state.replay_depth == 0:
+        prog._record(name, diff_inputs, static, outs)
 
 
 def in_static_build() -> bool:
-    return _state.main is not None and \
+    return _state.main is not None and _state.replay_depth == 0 and \
         _state.main._build_tid == threading.get_ident()
 
 
 def default_main_program() -> Program:
-    return _state.main if _state.main is not None else _state.default_main
+    return _state.main if _state.main is not None else _default_main
 
 
 def default_startup_program() -> Program:
     return _state.startup if _state.startup is not None \
-        else _state.default_startup
+        else _default_startup
 
 
 @contextlib.contextmanager
 def program_guard(main_program: Program,
                   startup_program: Optional[Program] = None):
     """Record this thread's op dispatches in `main_program` while active."""
+    global _active_guards
     prev = (_state.main, _state.startup)
     _state.main = main_program
     _state.startup = startup_program or Program()
     main_program._build_tid = threading.get_ident()
-    _registry.set_program_recorder(main_program._record)
+    with _guard_lock:
+        _active_guards += 1
+        _registry.set_program_recorder(_thread_recorder)
     try:
         yield
     finally:
         main_program._finalize()
         _state.main, _state.startup = prev
-        if _state.main is not None:  # nested guard: re-arm outer recorder
-            _registry.set_program_recorder(_state.main._record)
-        else:
-            _registry.set_program_recorder(None)
+        with _guard_lock:
+            _active_guards -= 1
+            if _active_guards == 0:
+                _registry.set_program_recorder(None)
 
 
 @contextlib.contextmanager

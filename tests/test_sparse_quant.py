@@ -1,0 +1,172 @@
+"""paddle.sparse + paddle.quantization.
+
+Mirrors the reference's `test/legacy_test/test_sparse_*` and
+`test/quantization/test_quant_aware*` strategies.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse as psp
+from paddle_tpu.quantization import (QAT, PTQ, AbsmaxObserver,
+                                     FakeQuanterWithAbsMaxObserver,
+                                     QuantConfig, fake_quantize_absmax,
+                                     quantize_dequantize)
+
+
+# ------------------------------------------------------------------ sparse
+def dense_example():
+    d = np.zeros((4, 5), np.float32)
+    d[0, 1] = 1.0
+    d[2, 3] = -2.0
+    d[3, 0] = 3.0
+    return d
+
+
+def test_sparse_coo_creation_and_dense_round_trip():
+    d = dense_example()
+    idx = np.array([[0, 2, 3], [1, 3, 0]], np.int64)
+    vals = np.array([1.0, -2.0, 3.0], np.float32)
+    s = psp.sparse_coo_tensor(idx, vals, shape=[4, 5])
+    assert s.nnz == 3
+    assert s.shape == [4, 5]
+    np.testing.assert_array_equal(np.asarray(s.to_dense()._value), d)
+    np.testing.assert_array_equal(np.asarray(s.indices()._value), idx)
+    np.testing.assert_array_equal(np.asarray(s.values()._value), vals)
+
+
+def test_tensor_to_sparse_coo():
+    d = dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo(2)
+    assert s.nnz == 3
+    np.testing.assert_array_equal(np.asarray(s.to_dense()._value), d)
+
+
+def test_sparse_csr_round_trip():
+    d = dense_example()
+    crows = np.array([0, 1, 1, 2, 3], np.int64)
+    cols = np.array([1, 3, 0], np.int64)
+    vals = np.array([1.0, -2.0, 3.0], np.float32)
+    s = psp.sparse_csr_tensor(crows, cols, vals, shape=[4, 5])
+    assert s.is_sparse_csr()
+    np.testing.assert_array_equal(np.asarray(s.to_dense()._value), d)
+    np.testing.assert_array_equal(np.asarray(s.crows()._value), crows)
+    np.testing.assert_array_equal(np.asarray(s.cols()._value), cols)
+    # coo <-> csr
+    coo = s.to_sparse_coo()
+    np.testing.assert_array_equal(np.asarray(coo.to_dense()._value), d)
+    csr2 = coo.to_sparse_csr()
+    np.testing.assert_array_equal(np.asarray(csr2.to_dense()._value), d)
+
+
+def test_sparse_unary_and_binary():
+    d = dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo(2)
+    np.testing.assert_array_equal(
+        np.asarray(psp.relu(s).to_dense()._value), np.maximum(d, 0))
+    np.testing.assert_array_equal(
+        np.asarray(psp.abs(s).to_dense()._value), np.abs(d))
+    two = psp.add(s, s)
+    np.testing.assert_array_equal(np.asarray(two.to_dense()._value), 2 * d)
+    np.testing.assert_array_equal(
+        np.asarray(psp.subtract(two, s).to_dense()._value), d)
+    prod = psp.multiply(s, s)
+    np.testing.assert_array_equal(np.asarray(prod.to_dense()._value), d * d)
+    np.testing.assert_array_equal(
+        np.asarray(psp.multiply(s, 3.0).to_dense()._value), 3 * d)
+
+
+def test_sparse_matmul():
+    d = dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo(2)
+    rhs = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    out = psp.matmul(s, paddle.to_tensor(rhs))
+    np.testing.assert_allclose(np.asarray(out._value), d @ rhs, rtol=1e-6)
+
+
+def test_sparse_nn_relu():
+    d = dense_example()
+    s = paddle.to_tensor(d).to_sparse_coo(2)
+    out = psp.nn.ReLU()(s)
+    np.testing.assert_array_equal(np.asarray(out.to_dense()._value),
+                                  np.maximum(d, 0))
+
+
+# ------------------------------------------------------------ quantization
+def test_fake_quant_round_trip_and_ste_grad():
+    x = paddle.to_tensor(np.array([-1.0, -0.5, 0.0, 0.3, 0.9], np.float32),
+                         stop_gradient=False)
+    y = fake_quantize_absmax(x, bits=8)
+    got = np.asarray(y._value)
+    # 8-bit absmax grid: scale=1.0, 127 steps
+    want = np.round(np.array([-1, -0.5, 0, 0.3, 0.9]) * 127) / 127
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    loss = paddle.sum(y * y)
+    loss.backward()
+    g = np.asarray(x.grad._value)
+    assert np.abs(g).sum() > 0  # STE passes gradients through
+
+
+def test_quantize_dequantize_clips_outliers():
+    x = paddle.to_tensor(np.array([-5.0, 0.5, 5.0], np.float32),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0))
+    y = quantize_dequantize(x, scale)
+    np.testing.assert_allclose(np.asarray(y._value),
+                               [-1.0, 64 / 127, 1.0], rtol=1e-5)
+    paddle.sum(y).backward()
+    # STE masks gradients outside the clip range
+    np.testing.assert_allclose(np.asarray(x.grad._value), [0.0, 1.0, 0.0])
+
+
+def test_qat_swaps_and_trains():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterWithAbsMaxObserver)
+    qnet = QAT(cfg).quantize(net)
+    from paddle_tpu.quantization import QuantedLinear
+    assert isinstance(qnet[0], QuantedLinear)
+    assert isinstance(qnet[2], QuantedLinear)
+    # original untouched
+    assert isinstance(net[0], paddle.nn.Linear)
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype(np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=qnet.parameters())
+    qnet.train()
+    losses = []
+    for _ in range(10):
+        loss = paddle.mean((qnet(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss._value))
+    assert losses[-1] < losses[0], losses
+    # quantized forward stays close to float forward
+    net_out = np.asarray(net(x)._value)
+    q0 = QAT(cfg).quantize(net)
+    q0.train()
+    q_out = np.asarray(q0(x)._value)
+    assert np.abs(net_out - q_out).max() < 0.15
+
+
+def test_ptq_calibrate_then_convert():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 8))
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver))
+    qnet = ptq.quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype(np.float32))
+    calib_out = np.asarray(qnet(x)._value)        # observing: pass-through
+    np.testing.assert_allclose(calib_out, np.asarray(net(x)._value),
+                               rtol=1e-6)
+    final = ptq.convert(qnet)
+    q_out = np.asarray(final(x)._value)
+    assert not np.allclose(q_out, calib_out)      # now actually quantized
+    assert np.abs(q_out - calib_out).max() < 0.2  # but close

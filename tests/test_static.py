@@ -163,6 +163,47 @@ def test_intermediates_released_after_guard():
     assert ref() is None, "build-time intermediate still pinned by Program"
 
 
+def test_minimize_replay_inside_own_guard_terminates():
+    paddle.seed(0)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 2], "float32")
+        lin = paddle.nn.Linear(2, 1)
+        loss = paddle.mean(lin(x) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=lin.parameters()).minimize(loss)
+        n = len(prog.steps)
+        w0 = np.asarray(lin.weight._value).copy()
+        static.Executor().run(prog, feed={"x": np.ones((4, 2), np.float32)},
+                              fetch_list=[loss])
+    assert len(prog.steps) == n          # nothing re-recorded
+    assert not np.allclose(np.asarray(lin.weight._value), w0)  # real update
+
+
+def test_recorded_dropout_rerandomizes_per_run():
+    paddle.seed(0)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [256], "float32")
+        y = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    feed = {"x": np.ones(256, np.float32)}
+    a, = exe.run(prog, feed=feed, fetch_list=[y])
+    b, = exe.run(prog, feed=feed, fetch_list=[y])
+    assert not np.array_equal(a, b), "dropout mask frozen across runs"
+
+
+def test_fetch_in_guard_constant():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 2], "float32")
+        w = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        y = paddle.matmul(x, w)
+    outs = static.Executor().run(prog, feed={"x": np.ones((2, 2), np.float32)},
+                                 fetch_list=[y, w])
+    np.testing.assert_array_equal(outs[1], np.eye(2))
+
+
 def test_default_main_program_records_outside_guard_nothing():
     before = len(static.default_main_program().steps)
     paddle.to_tensor(np.ones(3, np.float32)) + 1.0  # eager, not recorded
