@@ -2,3 +2,11 @@ from .api import (dtensor_from_fn, reshard, shard_layer, shard_optimizer,  # noq
                   shard_tensor, to_static, unshard_dtensor)
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh  # noqa: F401
+from . import spmd_rules  # noqa: F401
+from .spmd_rules import DistAttr, get_spmd_rule, infer_spmd, register_spmd_rule  # noqa: F401
+from . import reshard as reshard_engine  # noqa: F401
+from .reshard import (PartialTensor, get_reshard_fn, make_partial,  # noqa: F401
+                      register_reshard, reshard_partial)
+# importing the reshard submodule set the package attr `reshard` to the
+# module — rebind the user-facing function from api over it
+from .api import reshard  # noqa: F401,E402

@@ -1,0 +1,148 @@
+"""Reshard engine: placement-transition registry with Partial semantics.
+
+Parity: `paddle/phi/core/distributed/auto_parallel/reshard/` —
+s_to_r_reshard_function.cc (all-gather), r_to_s (slice), p_to_r
+(all-reduce), p_to_s (reduce-scatter), s_to_s (all-to-all),
+same_status / cross-mesh (send-recv), and the registry in
+reshard_function_registry.cc.
+
+TPU-native: a pending-sum ("Partial") value is represented explicitly as a
+jax array with a leading unreduced axis of length `mesh_dim_size`, sharded
+over that mesh dim — the canonical unreduced layout.  Transitions out of
+Partial are a `sum` over that axis with the target sharding constrained;
+XLA lowers exactly to the all-reduce (p2r) / reduce-scatter (p2s) the
+reference codes by hand.  Shard<->Shard and Shard<->Replicate transitions
+are sharding moves (device_put / with_sharding_constraint) that GSPMD
+lowers to all-to-all / all-gather / slice.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.tensor import Tensor
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = ["PartialTensor", "reshard_partial", "make_partial",
+           "register_reshard", "get_reshard_fn"]
+
+
+_RESHARD: Dict[Tuple[str, str], Callable] = {}
+
+
+def _kind(p: Placement) -> str:
+    if p.is_partial():
+        return "p"
+    if p.is_shard():
+        return "s"
+    return "r"
+
+
+def register_reshard(src: str, dst: str):
+    def deco(fn):
+        _RESHARD[(src, dst)] = fn
+        return fn
+    return deco
+
+
+def get_reshard_fn(src: Placement, dst: Placement) -> Callable:
+    key = (_kind(src), _kind(dst))
+    if key not in _RESHARD:
+        raise NotImplementedError(f"no reshard rule {key[0]}->{key[1]}")
+    return _RESHARD[key]
+
+
+class PartialTensor:
+    """A pending-sum DistTensor along one mesh dim.
+
+    `unreduced` has shape (mesh_dim_size, *logical_shape) and is sharded on
+    dim 0 over `axis_name` — shard i holds rank i's partial contribution.
+    """
+
+    def __init__(self, unreduced: jax.Array, mesh: Mesh, axis_name: str):
+        self.unreduced = unreduced
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    @property
+    def logical_shape(self):
+        return tuple(self.unreduced.shape[1:])
+
+
+def make_partial(fn_per_rank, mesh: Mesh, axis_name: str, *args,
+                 in_specs=None) -> PartialTensor:
+    """Build a PartialTensor by running `fn_per_rank(local_slices...)`
+    under shard_map.  `in_specs` gives each arg's PartitionSpec (default:
+    sharded on its leading dim) — a row-parallel matmul needs
+    in_specs=(P(None, axis), P(axis, None))."""
+    import functools
+
+    if in_specs is None:
+        in_specs = tuple(P(axis_name) for _ in args)
+    else:
+        in_specs = tuple(in_specs)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=P(axis_name))
+    def run(*local_args):
+        out = fn_per_rank(*local_args)
+        return out[None]  # leading unreduced axis
+
+    return PartialTensor(run(*args), mesh, axis_name)
+
+
+def _move(val, sharding):
+    if isinstance(val, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(val, sharding)
+    return jax.device_put(val, sharding)
+
+
+# ------------------------------------------------------------- transitions
+@register_reshard("p", "r")
+def p_to_r(pt: PartialTensor, dst: Placement, **kw):
+    """Pending sum -> replicated: one all-reduce (`p_to_r_reshard...cc`)."""
+    out = jnp.sum(pt.unreduced, axis=0)
+    repl = NamedSharding(pt.mesh, P(*([None] * out.ndim)))
+    return _move(out, repl)
+
+
+@register_reshard("p", "s")
+def p_to_s(pt: PartialTensor, dst: Shard, **kw):
+    """Pending sum -> sharded: reduce-scatter (`p_to_s_reshard...cc`)."""
+    out = jnp.sum(pt.unreduced, axis=0)
+    entries = [None] * out.ndim
+    entries[dst.get_dim()] = pt.axis_name
+    return _move(out, NamedSharding(pt.mesh, P(*entries)))
+
+
+@register_reshard("s", "r")
+def s_to_r(val, dst: Placement, mesh=None, axis_name=None, **kw):
+    """Sharded -> replicated: all-gather (`s_to_r_reshard...cc`)."""
+    return _move(val, NamedSharding(mesh, P(*([None] * val.ndim))))
+
+
+@register_reshard("r", "s")
+def r_to_s(val, dst: Shard, mesh=None, axis_name=None, **kw):
+    """Replicated -> sharded: local slice (`r_to_s_reshard...cc`)."""
+    entries = [None] * val.ndim
+    entries[dst.get_dim()] = axis_name
+    return _move(val, NamedSharding(mesh, P(*entries)))
+
+
+@register_reshard("s", "s")
+def s_to_s(val, dst: Shard, mesh=None, axis_name=None, src_dim=None, **kw):
+    """Shard(i) -> Shard(j): all-to-all (`s_to_s_reshard...cc`)."""
+    entries = [None] * val.ndim
+    entries[dst.get_dim()] = axis_name
+    return _move(val, NamedSharding(mesh, P(*entries)))
+
+
+def reshard_partial(pt: PartialTensor, dst: Placement) -> Tensor:
+    """Materialize a PartialTensor under the destination placement."""
+    fn = get_reshard_fn(Partial(), dst)
+    return Tensor._wrap(fn(pt, dst))

@@ -1,0 +1,315 @@
+"""Per-op SPMD rules: dims-mapping inference for eager DistTensor ops.
+
+Parity: `paddle/phi/infermeta/spmd_rules/` — matmul.cc, elementwise.cc,
+reduction.cc, reshape.cc, transpose.cc, embedding.cc, softmax.cc,
+layer_norm.cc, cross_entropy_with_softmax.cc, concat.cc, split.cc,
+flash_attention.cc, `rules.h` registry.
+
+Representation matches the reference: a `DistAttr` is a dims_mapping
+(tensor dim -> mesh dim, -1 replicated) plus the set of mesh dims the
+value is partial (pending-sum) over.  A rule takes input attrs (+ op
+attrs), resolves conflicts, and returns (inferred input attrs, output
+attrs).  On TPU these rules serve the eager op-by-op path — inside jit,
+GSPMD performs the same propagation in XLA; the library exists so eager
+DistTensor ops place outputs deterministically (and tests can check the
+reference's published rule semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["DistAttr", "register_spmd_rule", "get_spmd_rule", "infer_spmd"]
+
+
+class DistAttr:
+    """dims_mapping + partial mesh-dim set (reference TensorDistAttr)."""
+
+    def __init__(self, dims_mapping: Sequence[int],
+                 partial_dims: Sequence[int] = ()):
+        self.dims_mapping = list(dims_mapping)
+        self.partial_dims = set(partial_dims)
+
+    def __eq__(self, other):
+        return (isinstance(other, DistAttr)
+                and self.dims_mapping == other.dims_mapping
+                and self.partial_dims == other.partial_dims)
+
+    def __repr__(self):
+        p = f", partial={sorted(self.partial_dims)}" if self.partial_dims \
+            else ""
+        return f"DistAttr({self.dims_mapping}{p})"
+
+    @property
+    def ndim(self):
+        return len(self.dims_mapping)
+
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_spmd_rule(name):
+    def deco(fn):
+        _RULES[name] = fn
+        return fn
+    return deco
+
+
+def get_spmd_rule(name: str) -> Callable:
+    if name not in _RULES:
+        raise KeyError(f"no SPMD rule registered for op {name!r}")
+    return _RULES[name]
+
+
+def infer_spmd(name: str, *attrs, **op_attrs):
+    return get_spmd_rule(name)(*attrs, **op_attrs)
+
+
+# ------------------------------------------------------------------ helpers
+def _merge_dim(a: int, b: int) -> int:
+    """Resolve one tensor-dim mapping across inputs: sharded wins over
+    replicated; conflicting shards fall back to replicated (reference
+    ShardingMergeForTensors semantics)."""
+    if a == -1:
+        return b
+    if b == -1 or a == b:
+        return a
+    return -1
+
+
+def _einsum_like(notations: List[str], attrs: List[DistAttr],
+                 out_notation: str) -> Tuple[List[DistAttr], DistAttr]:
+    """Generalized einsum rule: merge per-letter mesh mappings across
+    inputs, map the output, mark contracted sharded letters partial.
+    This is the reference's axes-notation machinery (matmul.cc builds
+    'mk,kn->mn' and calls the same merge)."""
+    letter_map: Dict[str, int] = {}
+    for notation, attr in zip(notations, attrs):
+        assert len(notation) == attr.ndim, (notation, attr)
+        for ch, dm in zip(notation, attr.dims_mapping):
+            letter_map[ch] = _merge_dim(letter_map.get(ch, -1), dm)
+    # a mesh dim may back at most one letter: later conflicts replicate
+    used: Dict[int, str] = {}
+    for ch in sorted(letter_map):
+        dm = letter_map[ch]
+        if dm == -1:
+            continue
+        if dm in used and used[dm] != ch:
+            letter_map[ch] = -1
+        else:
+            used[dm] = ch
+    inferred_in = [
+        DistAttr([letter_map[ch] for ch in notation])
+        for notation in notations]
+    out_partial = {letter_map[ch] for ch in letter_map
+                   if ch not in out_notation and letter_map[ch] != -1}
+    out = DistAttr([letter_map[ch] for ch in out_notation],
+                   sorted(out_partial))
+    return inferred_in, out
+
+
+# -------------------------------------------------------------------- rules
+@register_spmd_rule("matmul")
+def matmul_rule(x: DistAttr, y: DistAttr, trans_x=False, trans_y=False):
+    """Parity: `spmd_rules/matmul.cc` (batched, broadcast, transposes)."""
+    nx, ny = x.ndim, y.ndim
+    batch = max(nx - 2, ny - 2, 0)
+    letters = "abcdefgh"[:batch]
+    xn = "mk" if not trans_x else "km"
+    yn = "kn" if not trans_y else "nk"
+    if nx == 1:
+        xn = "k"
+    if ny == 1:
+        yn = "k"
+    x_not = letters[batch - (nx - 2):] + xn if nx > 2 else xn
+    y_not = letters[batch - (ny - 2):] + yn if ny > 2 else yn
+    out_not = letters + ("m" if "m" in xn and nx > 1 else "") + \
+        ("n" if "n" in yn and ny > 1 else "")
+    (xi, yi), out = _einsum_like([x_not, y_not], [x, y], out_not)
+    return [xi, yi], out
+
+
+@register_spmd_rule("elementwise")
+def elementwise_rule(*attrs: DistAttr):
+    """Parity: `spmd_rules/elementwise.cc` — right-aligned broadcasting."""
+    ndim = max(a.ndim for a in attrs)
+    merged = [-1] * ndim
+    for a in attrs:
+        off = ndim - a.ndim
+        for i, dm in enumerate(a.dims_mapping):
+            merged[off + i] = _merge_dim(merged[off + i], dm)
+    inferred = []
+    for a in attrs:
+        off = ndim - a.ndim
+        inferred.append(DistAttr(merged[off:]))
+    partial = set()
+    for a in attrs:
+        partial |= a.partial_dims
+    return inferred, DistAttr(merged, sorted(partial))
+
+
+@register_spmd_rule("reduction")
+def reduction_rule(x: DistAttr, axis=None, keep_dim=False, linear=True):
+    """Parity: `spmd_rules/reduction.cc`.  Reducing over a sharded dim
+    leaves the output partial on that mesh dim (for linear reductions)."""
+    ndim = x.ndim
+    if axis is None:
+        axes = list(range(ndim))
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        axes = [a % ndim for a in axes]
+    out_mapping = []
+    new_partial = set(x.partial_dims)
+    for i, dm in enumerate(x.dims_mapping):
+        if i in axes:
+            if dm != -1 and linear:
+                new_partial.add(dm)
+            if keep_dim:
+                out_mapping.append(-1)
+        else:
+            out_mapping.append(dm)
+    return [x], DistAttr(out_mapping, sorted(new_partial))
+
+
+@register_spmd_rule("reshape")
+def reshape_rule(x: DistAttr, src_shape, dst_shape):
+    """Parity: `spmd_rules/reshape.cc` (dim_trans.cc).  Walks matching
+    size-product groups: 1-to-1 dims keep their shard; a split src dim
+    gives its shard to the group's leading dst dim; merged src dims give
+    the leading src dim's shard to the dst dim.  Anything irregular
+    replicates."""
+    out_mapping = [-1] * len(dst_shape)
+    si = di = 0
+    while si < len(src_shape) and di < len(dst_shape):
+        s_prod, d_prod = src_shape[si], dst_shape[di]
+        s_end, d_end = si + 1, di + 1
+        while s_prod != d_prod:
+            if s_prod < d_prod and s_end < len(src_shape):
+                s_prod *= src_shape[s_end]
+                s_end += 1
+            elif d_prod < s_prod and d_end < len(dst_shape):
+                d_prod *= dst_shape[d_end]
+                d_end += 1
+            else:
+                return [x], DistAttr(out_mapping, sorted(x.partial_dims))
+        # group [si:s_end] -> [di:d_end]: leading dim carries the shard
+        out_mapping[di] = x.dims_mapping[si]
+        si, di = s_end, d_end
+    return [x], DistAttr(out_mapping, sorted(x.partial_dims))
+
+
+@register_spmd_rule("transpose")
+def transpose_rule(x: DistAttr, perm):
+    """Parity: `spmd_rules/transpose.cc`."""
+    return [x], DistAttr([x.dims_mapping[p] for p in perm],
+                         sorted(x.partial_dims))
+
+
+@register_spmd_rule("embedding")
+def embedding_rule(ids: DistAttr, w: DistAttr):
+    """Parity: `spmd_rules/embedding.cc` — vocab-sharded weight makes the
+    output partial over that mesh dim (each shard contributes the rows it
+    owns); sharded embedding dim flows through."""
+    row_dm, col_dm = w.dims_mapping
+    out_mapping = list(ids.dims_mapping) + [col_dm]
+    partial = set(ids.partial_dims)
+    if row_dm != -1:
+        partial.add(row_dm)
+    return [ids, w], DistAttr(out_mapping, sorted(partial))
+
+
+@register_spmd_rule("softmax")
+def softmax_rule(x: DistAttr, axis=-1):
+    """Parity: `spmd_rules/softmax.cc` — the normalized axis must be
+    unsharded, and (nonlinear op) any pending partial sum must be resolved
+    BEFORE the op: the inferred input clears partial, demanding a p->r
+    reshard from the caller."""
+    axis = axis % x.ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    inferred = DistAttr(mapping)  # partial must be resolved first
+    return [inferred], DistAttr(list(mapping))
+
+
+@register_spmd_rule("layer_norm")
+def layer_norm_rule(x: DistAttr, scale: DistAttr, bias: DistAttr,
+                    begin_norm_axis=-1):
+    """Parity: `spmd_rules/layer_norm.cc` — normalized trailing dims are
+    unsharded; scale/bias replicated."""
+    axis = begin_norm_axis % x.ndim
+    mapping = list(x.dims_mapping)
+    for i in range(axis, x.ndim):
+        mapping[i] = -1
+    # nonlinear in x: pending partials must resolve before the op
+    xi = DistAttr(mapping)
+    rep = DistAttr([-1] * scale.ndim)
+    return [xi, rep, DistAttr([-1] * bias.ndim)], DistAttr(list(mapping))
+
+
+@register_spmd_rule("cross_entropy_with_softmax")
+def cross_entropy_rule(logits: DistAttr, label: DistAttr, axis=-1):
+    """Parity: `spmd_rules/cross_entropy_with_softmax.cc` — class-dim
+    sharding stays (parallel cross entropy) and makes the loss partial."""
+    axis = axis % logits.ndim
+    cls_dm = logits.dims_mapping[axis]
+    batch_dms = [dm for i, dm in enumerate(logits.dims_mapping)
+                 if i != axis]
+    # merge the batch axes with the label's mapping so both shards align
+    merged = [_merge_dim(b, l) for b, l in
+              zip(batch_dms, list(label.dims_mapping)
+                  + [-1] * (len(batch_dms) - label.ndim))]
+    if cls_dm != -1 and cls_dm in merged:
+        cls_dm = -1  # class mesh dim already used by a batch axis
+    logits_mapping = list(merged)
+    logits_mapping.insert(axis, cls_dm)
+    li = DistAttr(logits_mapping)
+    lab = DistAttr(merged[:label.ndim])
+    partial = {cls_dm} if cls_dm != -1 else set()
+    return [li, lab], DistAttr(merged, sorted(partial))
+
+
+@register_spmd_rule("concat")
+def concat_rule(attrs: List[DistAttr], axis=0):
+    """Parity: `spmd_rules/concat.cc` — concat axis unsharded, others
+    merged."""
+    ndim = attrs[0].ndim
+    axis = axis % ndim
+    merged = [-1] * ndim
+    for a in attrs:
+        for i, dm in enumerate(a.dims_mapping):
+            if i != axis:
+                merged[i] = _merge_dim(merged[i], dm)
+    merged[axis] = -1
+    partial = set()
+    for a in attrs:
+        partial |= a.partial_dims  # concat is linear: partials flow through
+    inferred = [DistAttr(list(merged), sorted(a.partial_dims))
+                for a in attrs]
+    return inferred, DistAttr(merged, sorted(partial))
+
+
+@register_spmd_rule("split")
+def split_rule(x: DistAttr, num, axis=0):
+    """Parity: `spmd_rules/split.cc`."""
+    axis = axis % x.ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    xi = DistAttr(mapping, sorted(x.partial_dims))
+    return [xi], [DistAttr(list(mapping), sorted(x.partial_dims))
+                  for _ in range(num)]
+
+
+@register_spmd_rule("flash_attention")
+def flash_attention_rule(q: DistAttr, k: DistAttr, v: DistAttr,
+                         causal=True):
+    """Parity: `spmd_rules/flash_attention.cc` — batch/head dims merged
+    and kept; sequence + head_dim unsharded (ring attention handles
+    sequence sharding separately)."""
+    b = _merge_dim(_merge_dim(q.dims_mapping[0], k.dims_mapping[0]),
+                   v.dims_mapping[0])
+    h = _merge_dim(_merge_dim(q.dims_mapping[1], k.dims_mapping[1]),
+                   v.dims_mapping[1])
+    if h == b and b != -1:
+        h = -1  # one mesh axis cannot back two tensor dims
+    attr = DistAttr([b, h, -1, -1])
+    return [attr, attr, attr], DistAttr([b, h, -1, -1])

@@ -1,0 +1,263 @@
+"""SPMD rule library + reshard engine with Partial semantics.
+
+Mirrors the reference's `test/auto_parallel/spmd_rules/test_matmul_rule.py`
+etc. (dims_mapping in/out assertions) plus value-level reshard checks on
+the CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.auto_parallel import (DistAttr, PartialTensor,
+                                                  infer_spmd, make_partial,
+                                                  reshard_partial)
+from paddle_tpu.distributed.auto_parallel.placement import (Partial,
+                                                            Replicate, Shard)
+
+
+# ------------------------------------------------------------------- rules
+def test_matmul_rule_row_parallel():
+    # x: [M/mesh0, K], y: [K, N] -> out [M/mesh0, N]
+    ins, out = infer_spmd("matmul", DistAttr([0, -1]), DistAttr([-1, -1]))
+    assert out == DistAttr([0, -1])
+
+
+def test_matmul_rule_contraction_becomes_partial():
+    # x: [M, K/mesh1], y: [K/mesh1, N] -> out [M, N] partial over mesh1
+    ins, out = infer_spmd("matmul", DistAttr([-1, 1]), DistAttr([1, -1]))
+    assert out.dims_mapping == [-1, -1]
+    assert out.partial_dims == {1}
+
+
+def test_matmul_rule_conflicting_shards_replicate():
+    ins, out = infer_spmd("matmul", DistAttr([-1, 0]), DistAttr([1, -1]))
+    # k mapped to both 0 and 1 -> conflict resolved; no crash
+    assert out.ndim == 2
+
+
+def test_matmul_rule_batched_and_transposed():
+    # batched: [B/mesh0, M, K] @ [B/mesh0, K, N]
+    ins, out = infer_spmd("matmul", DistAttr([0, -1, -1]),
+                          DistAttr([0, -1, -1]))
+    assert out == DistAttr([0, -1, -1])
+    # trans_y: y is [N/mesh1, K]
+    ins, out = infer_spmd("matmul", DistAttr([-1, -1]), DistAttr([1, -1]),
+                          trans_y=True)
+    assert out == DistAttr([-1, 1])
+
+
+def test_elementwise_broadcast_merge():
+    ins, out = infer_spmd("elementwise", DistAttr([0, -1]), DistAttr([-1]))
+    assert out == DistAttr([0, -1])
+    assert ins[1] == DistAttr([-1])
+    ins, out = infer_spmd("elementwise", DistAttr([0, -1]), DistAttr([-1, 1]))
+    assert out == DistAttr([0, 1])
+
+
+def test_reduction_rule_partial():
+    ins, out = infer_spmd("reduction", DistAttr([0, 1]), axis=1)
+    assert out.dims_mapping == [0]
+    assert out.partial_dims == {1}
+    ins, out = infer_spmd("reduction", DistAttr([0, 1]), axis=1,
+                          keep_dim=True)
+    assert out.dims_mapping == [0, -1]
+    # non-linear reductions (max) don't produce partials
+    ins, out = infer_spmd("reduction", DistAttr([0, 1]), axis=1,
+                          linear=False)
+    assert out.partial_dims == set()
+
+
+def test_reshape_rule_split_and_merge():
+    # [B/mesh0, S*H] -> [B/mesh0, S, H]: shard follows leading group dim
+    ins, out = infer_spmd("reshape", DistAttr([0, -1]),
+                          src_shape=[8, 12], dst_shape=[8, 3, 4])
+    assert out == DistAttr([0, -1, -1])
+    # merge [B/mesh0, S, H] -> [B/mesh0, S*H]
+    ins, out = infer_spmd("reshape", DistAttr([0, 1, -1]),
+                          src_shape=[8, 3, 4], dst_shape=[8, 12])
+    assert out == DistAttr([0, 1])
+
+
+def test_transpose_embedding_softmax_rules():
+    ins, out = infer_spmd("transpose", DistAttr([0, -1, 1]), perm=[2, 0, 1])
+    assert out == DistAttr([1, 0, -1])
+
+    ins, out = infer_spmd("embedding", DistAttr([0, -1]), DistAttr([1, -1]))
+    assert out.dims_mapping == [0, -1, -1]
+    assert out.partial_dims == {1}  # vocab-parallel partial
+
+    ins, out = infer_spmd("softmax", DistAttr([0, 1]), axis=-1)
+    assert out == DistAttr([0, -1])
+
+
+def test_layer_norm_cross_entropy_concat_split_flash_rules():
+    ins, out = infer_spmd("layer_norm", DistAttr([0, -1, 1]),
+                          DistAttr([-1]), DistAttr([-1]),
+                          begin_norm_axis=2)
+    assert out == DistAttr([0, -1, -1])
+
+    ins, out = infer_spmd("cross_entropy_with_softmax",
+                          DistAttr([0, 1]), DistAttr([0]))
+    assert out.dims_mapping == [0]
+    assert out.partial_dims == {1}
+
+    ins, out = infer_spmd("concat", [DistAttr([0, -1]), DistAttr([0, 1])],
+                          axis=1)
+    assert out == DistAttr([0, -1])
+
+    ins, outs = infer_spmd("split", DistAttr([0, 1]), num=2, axis=1)
+    assert all(o == DistAttr([0, -1]) for o in outs)
+
+    ins, out = infer_spmd("flash_attention", DistAttr([0, 1, -1, -1]),
+                          DistAttr([0, -1, -1, -1]),
+                          DistAttr([0, 1, -1, -1]))
+    assert out == DistAttr([0, 1, -1, -1])
+
+
+def test_nonlinear_rules_force_partial_resolution():
+    """softmax/layer_norm must demand p->r before running: inferred input
+    clears partial (softmax of a partial sum is not a partial softmax)."""
+    ins, out = infer_spmd("softmax", DistAttr([0, -1], partial_dims=[1]))
+    assert ins[0].partial_dims == set()
+    assert out.partial_dims == set()
+    ins, out = infer_spmd("layer_norm", DistAttr([0, -1], partial_dims=[1]),
+                          DistAttr([-1]), DistAttr([-1]))
+    assert ins[0].partial_dims == set()
+
+
+def test_concat_keeps_partials():
+    ins, out = infer_spmd("concat",
+                          [DistAttr([0, -1], partial_dims=[1]),
+                           DistAttr([0, -1], partial_dims=[1])], axis=1)
+    assert out.partial_dims == {1}
+
+
+def test_flash_attention_no_double_mesh_dim():
+    ins, out = infer_spmd("flash_attention", DistAttr([0, -1, -1, -1]),
+                          DistAttr([-1, 0, -1, -1]),
+                          DistAttr([-1, -1, -1, -1]))
+    dms = [d for d in out.dims_mapping if d != -1]
+    assert len(dms) == len(set(dms))  # each mesh dim at most once
+
+
+def test_cross_entropy_merges_label_batch():
+    ins, out = infer_spmd("cross_entropy_with_softmax",
+                          DistAttr([-1, 1]), DistAttr([0]))
+    # label batch shard merges into logits batch dim
+    assert ins[0].dims_mapping[0] == 0
+    assert ins[1].dims_mapping == [0]
+    assert out.dims_mapping == [0]
+    assert out.partial_dims == {1}
+
+
+def test_dist_reshard_api_still_callable():
+    """The reshard submodule must not shadow the reshard() function."""
+    import paddle_tpu.distributed as dist
+    assert callable(dist.reshard)
+    assert callable(dist.auto_parallel.reshard)
+
+
+def test_make_partial_row_parallel_specs():
+    mesh = _mesh(4)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    pt = make_partial(lambda xl, wl: xl @ wl, mesh, "mp", x, w,
+                      in_specs=(P(None, "mp"), P("mp", None)))
+    out = reshard_partial(pt, Replicate())
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(x @ w),
+                               rtol=3e-5, atol=3e-5)
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(KeyError):
+        infer_spmd("no_such_op", DistAttr([-1]))
+
+
+# ---------------------------------------------------------------- reshard
+def _mesh(n=4, name="mp"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def test_partial_to_replicate_matches_full_matmul():
+    """Row-parallel matmul -> PartialTensor -> p2r == serial result."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))   # [M, K]
+    w = jnp.asarray(rng.randn(16, 4).astype(np.float32))   # [K, N]
+    mesh = _mesh(4)
+    # shard K over mp: each rank multiplies its K/4 slice -> partial sums
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "mp")))
+    ws = jax.device_put(w, NamedSharding(mesh, P("mp", None)))
+
+    def local_mm(x_loc, w_loc):
+        return x_loc @ w_loc
+
+    import functools
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(None, "mp"), P("mp", None)),
+                       out_specs=P("mp"))
+    def partial_mm(xl, wl):
+        return (xl @ wl)[None]
+
+    pt = PartialTensor(partial_mm(xs, ws), mesh, "mp")
+    out = reshard_partial(pt, Replicate())
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(x @ w),
+                               rtol=2e-5, atol=1e-5)
+    assert out._value.sharding.is_fully_replicated
+
+
+def test_partial_to_shard_reduce_scatter():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    w = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    mesh = _mesh(4)
+
+    import functools
+
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=(P(None, "mp"), P("mp", None)),
+                       out_specs=P("mp"))
+    def partial_mm(xl, wl):
+        return (xl @ wl)[None]
+
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, "mp")))
+    ws = jax.device_put(w, NamedSharding(mesh, P("mp", None)))
+    pt = PartialTensor(partial_mm(xs, ws), mesh, "mp")
+    out = reshard_partial(pt, Shard(0))
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(x @ w),
+                               rtol=2e-5, atol=1e-5)
+    spec = out._value.sharding.spec
+    assert spec[0] == "mp"
+
+
+def test_make_partial_helper():
+    mesh = _mesh(4)
+    a = jnp.arange(16, dtype=jnp.float32)  # sharded into 4 chunks of 4
+    pt = make_partial(lambda chunk: chunk.sum(keepdims=True), mesh, "mp", a)
+    assert isinstance(pt, PartialTensor)
+    out = reshard_partial(pt, Replicate())
+    assert float(np.asarray(out._value)[0]) == float(a.sum())
+
+
+def test_shard_replicate_moves():
+    from paddle_tpu.distributed.auto_parallel.reshard import get_reshard_fn
+    mesh = _mesh(4)
+    v = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    # r -> s
+    vs = get_reshard_fn(Replicate(), Shard(0))(v, Shard(0), mesh=mesh,
+                                               axis_name="mp")
+    assert vs.sharding.spec[0] == "mp"
+    # s -> s (axis move)
+    vss = get_reshard_fn(Shard(0), Shard(1))(vs, Shard(1), mesh=mesh,
+                                             axis_name="mp")
+    assert vss.sharding.spec[1] == "mp"
+    # s -> r
+    vr = get_reshard_fn(Shard(1), Replicate())(vss, Replicate(), mesh=mesh,
+                                               axis_name="mp")
+    assert vr.sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(vr), np.asarray(v))
