@@ -65,6 +65,10 @@ from .autograd import grad  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+from . import decomposition  # noqa: F401,E402
+from .framework.tensor_array import (TensorArray, array_length,  # noqa: F401,E402
+                                     array_read, array_write, create_array)
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import static  # noqa: F401,E402

@@ -1,0 +1,131 @@
+"""Composite-op decomposition registry.
+
+Parity: `python/paddle/decomposition/decomp.py:177` (decompose) +
+`paddle/fluid/primitive/composite/composite.h` (the rule corpus).
+
+On TPU the compiler fuses primitives back together, so decomposition's
+role here is (a) a reference implementation corpus for testing fused ops
+and (b) an escape hatch when a fused kernel must be lowered to primitives
+(e.g. custom-AD through a composite).  Each rule maps an op name to a
+pure-primitive implementation over paddle Tensors.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict
+
+__all__ = ["register_decomp", "get_decomp", "has_decomp", "decompose",
+           "list_decomps"]
+
+_DECOMPS: Dict[str, Callable] = {}
+
+
+def register_decomp(name: str):
+    def deco(fn):
+        _DECOMPS[name] = fn
+        return fn
+    return deco
+
+
+def has_decomp(name: str) -> bool:
+    return name in _DECOMPS
+
+
+def get_decomp(name: str) -> Callable:
+    if name not in _DECOMPS:
+        raise KeyError(f"no decomposition registered for {name!r}")
+    return _DECOMPS[name]
+
+
+def list_decomps():
+    return sorted(_DECOMPS)
+
+
+def decompose(name: str, *args, **kwargs):
+    return get_decomp(name)(*args, **kwargs)
+
+
+# ------------------------------------------------------------ rule corpus
+@register_decomp("gelu")
+def _gelu(x, approximate=False):
+    import paddle_tpu as paddle
+    if approximate:
+        c = math.sqrt(2.0 / math.pi)
+        return 0.5 * x * (1.0 + paddle.tanh(c * (x + 0.044715 * x * x * x)))
+    return 0.5 * x * (1.0 + paddle.erf(x / math.sqrt(2.0)))
+
+
+@register_decomp("softmax")
+def _softmax(x, axis=-1):
+    import paddle_tpu as paddle
+    m = paddle.max(x, axis=axis, keepdim=True)
+    e = paddle.exp(x - m)
+    return e / paddle.sum(e, axis=axis, keepdim=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax(x, axis=-1):
+    import paddle_tpu as paddle
+    m = paddle.max(x, axis=axis, keepdim=True)
+    shifted = x - m
+    return shifted - paddle.log(
+        paddle.sum(paddle.exp(shifted), axis=axis, keepdim=True))
+
+
+@register_decomp("silu")
+def _silu(x):
+    import paddle_tpu as paddle
+    return x / (1.0 + paddle.exp(-x))
+
+
+@register_decomp("layer_norm")
+def _layer_norm(x, weight=None, bias=None, epsilon=1e-5):
+    import paddle_tpu as paddle
+    mean = paddle.mean(x, axis=-1, keepdim=True)
+    var = paddle.mean((x - mean) ** 2, axis=-1, keepdim=True)
+    out = (x - mean) * paddle.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_decomp("rms_norm")
+def _rms_norm(x, weight=None, epsilon=1e-6):
+    import paddle_tpu as paddle
+    ms = paddle.mean(x * x, axis=-1, keepdim=True)
+    out = x * paddle.rsqrt(ms + epsilon)
+    return out * weight if weight is not None else out
+
+
+@register_decomp("mean")
+def _mean(x, axis=None, keepdim=False):
+    import paddle_tpu as paddle
+    import numpy as np
+    n = float(np.prod(x.shape)) if axis is None else \
+        float(np.prod([x.shape[a] for a in
+                      ([axis] if isinstance(axis, int) else axis)]))
+    return paddle.sum(x, axis=axis, keepdim=keepdim) / n
+
+
+@register_decomp("sigmoid")
+def _sigmoid(x):
+    import paddle_tpu as paddle
+    return 1.0 / (1.0 + paddle.exp(-x))
+
+
+@register_decomp("swiglu")
+def _swiglu(x, y):
+    import paddle_tpu as paddle
+    return (x / (1.0 + paddle.exp(-x))) * y
+
+
+@register_decomp("dropout")
+def _dropout(x, p=0.5, training=True):
+    import paddle_tpu as paddle
+    if not training or p == 0:
+        return x
+    mask = paddle.cast(paddle.rand(x.shape) >= p, x.dtype)
+    return x * mask / (1.0 - p)

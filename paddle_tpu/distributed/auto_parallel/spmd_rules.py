@@ -138,14 +138,20 @@ def elementwise_rule(*attrs: DistAttr):
         off = ndim - a.ndim
         for i, dm in enumerate(a.dims_mapping):
             merged[off + i] = _merge_dim(merged[off + i], dm)
+    # a partial dim survives only when EVERY input is partial over it —
+    # add(A_partial, B_full) resolved later would sum n copies of B;
+    # mixed inputs must resolve first (their inferred attr drops the dim)
+    common = None
+    for a in attrs:
+        common = set(a.partial_dims) if common is None \
+            else common & a.partial_dims
+    common = common or set()
     inferred = []
     for a in attrs:
         off = ndim - a.ndim
-        inferred.append(DistAttr(merged[off:]))
-    partial = set()
-    for a in attrs:
-        partial |= a.partial_dims
-    return inferred, DistAttr(merged, sorted(partial))
+        inferred.append(DistAttr(merged[off:],
+                                 sorted(a.partial_dims & common)))
+    return inferred, DistAttr(merged, sorted(common))
 
 
 @register_spmd_rule("reduction")
@@ -159,7 +165,14 @@ def reduction_rule(x: DistAttr, axis=None, keep_dim=False, linear=True):
         axes = [axis] if isinstance(axis, int) else list(axis)
         axes = [a % ndim for a in axes]
     out_mapping = []
-    new_partial = set(x.partial_dims)
+    if linear:
+        xi = x
+        new_partial = set(x.partial_dims)
+    else:
+        # nonlinear reductions (max/min) over pending sums are wrong:
+        # the inferred input demands p->r first
+        xi = DistAttr(list(x.dims_mapping))
+        new_partial = set()
     for i, dm in enumerate(x.dims_mapping):
         if i in axes:
             if dm != -1 and linear:
@@ -168,7 +181,7 @@ def reduction_rule(x: DistAttr, axis=None, keep_dim=False, linear=True):
                 out_mapping.append(-1)
         else:
             out_mapping.append(dm)
-    return [x], DistAttr(out_mapping, sorted(new_partial))
+    return [xi], DistAttr(out_mapping, sorted(new_partial))
 
 
 @register_spmd_rule("reshape")
@@ -179,6 +192,7 @@ def reshape_rule(x: DistAttr, src_shape, dst_shape):
     the leading src dim's shard to the dst dim.  Anything irregular
     replicates."""
     out_mapping = [-1] * len(dst_shape)
+    in_mapping = list(x.dims_mapping)
     si = di = 0
     while si < len(src_shape) and di < len(dst_shape):
         s_prod, d_prod = src_shape[si], dst_shape[di]
@@ -191,11 +205,18 @@ def reshape_rule(x: DistAttr, src_shape, dst_shape):
                 d_prod *= dst_shape[d_end]
                 d_end += 1
             else:
-                return [x], DistAttr(out_mapping, sorted(x.partial_dims))
-        # group [si:s_end] -> [di:d_end]: leading dim carries the shard
+                # irregular: demand a fully replicated input
+                return [DistAttr([-1] * x.ndim, sorted(x.partial_dims))], \
+                    DistAttr(out_mapping, sorted(x.partial_dims))
+        # group [si:s_end] -> [di:d_end]: leading dim carries the shard;
+        # sharded NON-leading dims of a merged group cannot survive a local
+        # reshape — the inferred input replicates them (forces a reshard)
         out_mapping[di] = x.dims_mapping[si]
+        for j in range(si + 1, s_end):
+            in_mapping[j] = -1
         si, di = s_end, d_end
-    return [x], DistAttr(out_mapping, sorted(x.partial_dims))
+    return [DistAttr(in_mapping, sorted(x.partial_dims))], \
+        DistAttr(out_mapping, sorted(x.partial_dims))
 
 
 @register_spmd_rule("transpose")
@@ -254,16 +275,21 @@ def cross_entropy_rule(logits: DistAttr, label: DistAttr, axis=-1):
     cls_dm = logits.dims_mapping[axis]
     batch_dms = [dm for i, dm in enumerate(logits.dims_mapping)
                  if i != axis]
-    # merge the batch axes with the label's mapping so both shards align
+    # merge the batch axes with the label's leading dims (a hard label may
+    # carry a trailing size-1 dim: [B, 1] vs logits [B, C])
+    n_b = len(batch_dms)
+    lab_dms = list(label.dims_mapping)
     merged = [_merge_dim(b, l) for b, l in
-              zip(batch_dms, list(label.dims_mapping)
-                  + [-1] * (len(batch_dms) - label.ndim))]
+              zip(batch_dms, lab_dms[:n_b] + [-1] * max(n_b - label.ndim,
+                                                        0))]
     if cls_dm != -1 and cls_dm in merged:
         cls_dm = -1  # class mesh dim already used by a batch axis
     logits_mapping = list(merged)
     logits_mapping.insert(axis, cls_dm)
     li = DistAttr(logits_mapping)
-    lab = DistAttr(merged[:label.ndim])
+    lab_mapping = merged[:min(label.ndim, n_b)] + \
+        [-1] * max(label.ndim - n_b, 0)
+    lab = DistAttr(lab_mapping)
     partial = {cls_dm} if cls_dm != -1 else set()
     return [li, lab], DistAttr(merged, sorted(partial))
 
@@ -280,12 +306,16 @@ def concat_rule(attrs: List[DistAttr], axis=0):
             if i != axis:
                 merged[i] = _merge_dim(merged[i], dm)
     merged[axis] = -1
-    partial = set()
+    # concat is linear, but a dim may stay partial only if ALL inputs are
+    # partial over it (else the later reduce corrupts the resolved parts)
+    common = None
     for a in attrs:
-        partial |= a.partial_dims  # concat is linear: partials flow through
-    inferred = [DistAttr(list(merged), sorted(a.partial_dims))
+        common = set(a.partial_dims) if common is None \
+            else common & a.partial_dims
+    common = common or set()
+    inferred = [DistAttr(list(merged), sorted(a.partial_dims & common))
                 for a in attrs]
-    return inferred, DistAttr(merged, sorted(partial))
+    return inferred, DistAttr(merged, sorted(common))
 
 
 @register_spmd_rule("split")
@@ -302,14 +332,15 @@ def split_rule(x: DistAttr, num, axis=0):
 @register_spmd_rule("flash_attention")
 def flash_attention_rule(q: DistAttr, k: DistAttr, v: DistAttr,
                          causal=True):
-    """Parity: `spmd_rules/flash_attention.cc` — batch/head dims merged
-    and kept; sequence + head_dim unsharded (ring attention handles
-    sequence sharding separately)."""
+    """Parity: `spmd_rules/flash_attention.cc`.  Paddle flash-attn layout
+    is [B, S, H, D] (`nn/functional/attention.py`): batch (0) and heads
+    (2) merge and stay sharded; sequence (1) and head_dim (3) must be
+    unsharded (ring attention handles sequence sharding separately)."""
     b = _merge_dim(_merge_dim(q.dims_mapping[0], k.dims_mapping[0]),
                    v.dims_mapping[0])
-    h = _merge_dim(_merge_dim(q.dims_mapping[1], k.dims_mapping[1]),
-                   v.dims_mapping[1])
+    h = _merge_dim(_merge_dim(q.dims_mapping[2], k.dims_mapping[2]),
+                   v.dims_mapping[2])
     if h == b and b != -1:
         h = -1  # one mesh axis cannot back two tensor dims
-    attr = DistAttr([b, h, -1, -1])
-    return [attr, attr, attr], DistAttr([b, h, -1, -1])
+    attr = DistAttr([b, -1, h, -1])
+    return [attr, attr, attr], DistAttr([b, -1, h, -1])

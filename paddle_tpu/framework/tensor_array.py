@@ -1,0 +1,83 @@
+"""TensorArray: dynamically sized array of Tensors.
+
+Parity: `paddle/phi/core/tensor_array.h` + `python/paddle/tensor/array.py`
+(create_array, array_write, array_read, array_length).  Eager-first: a
+Python-level container; `stack()`/`concat()` bridge back into fused device
+ops (inside jit, loops over TensorArrays unroll at trace time — the
+lax.scan path is the idiomatic alternative for long loops).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .tensor import Tensor
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length"]
+
+
+class TensorArray:
+    def __init__(self, values: Optional[List[Tensor]] = None):
+        self._items: List[Optional[Tensor]] = list(values or [])
+
+    def append(self, t: Tensor) -> "TensorArray":
+        self._items.append(t)
+        return self
+
+    def write(self, index: int, t: Tensor):
+        index = int(index)
+        while len(self._items) <= index:
+            self._items.append(None)
+        self._items[index] = t
+
+    def read(self, index: int) -> Tensor:
+        t = self._items[int(index)]
+        if t is None:
+            raise IndexError(f"TensorArray slot {index} was never written")
+        return t
+
+    def pop(self, index: int = -1) -> Tensor:
+        return self._items.pop(index)
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self.read(i)
+
+    def __setitem__(self, i, v):
+        self.write(i, v)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def stack(self, axis: int = 0) -> Tensor:
+        import paddle_tpu as paddle
+        return paddle.stack(list(self._items), axis=axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        import paddle_tpu as paddle
+        return paddle.concat(list(self._items), axis=axis)
+
+
+def create_array(dtype="float32", initialized_list=None) -> TensorArray:
+    return TensorArray(initialized_list)
+
+
+def array_write(x: Tensor, i, array: Optional[TensorArray] = None) \
+        -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    idx = int(i._value) if isinstance(i, Tensor) else int(i)
+    array.write(idx, x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    idx = int(i._value) if isinstance(i, Tensor) else int(i)
+    return array.read(idx)
+
+
+def array_length(array: TensorArray) -> int:
+    return len(array)

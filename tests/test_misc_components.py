@@ -1,0 +1,142 @@
+"""Memory stats, LBFGS, TensorArray, decomposition registry.
+
+Mirrors the reference's `test_lbfgs.py`, `test_tensor_array_to_tensor.py`,
+`test_max_memory_allocated.py`, and prim decomposition tests.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -------------------------------------------------------------- mem stats
+def test_memory_stats_api():
+    x = paddle.to_tensor(np.ones((256, 256), np.float32))
+    cur = paddle.device.memory_allocated()
+    assert cur >= x._value.nbytes
+    peak = paddle.device.max_memory_allocated()
+    assert peak >= cur
+    paddle.device.reset_max_memory_allocated()
+    assert paddle.device.max_memory_allocated() >= 0
+    assert paddle.device.memory_reserved() >= 0
+    paddle.device.cuda.empty_cache()  # shim path
+    paddle.device.synchronize()
+
+
+# ------------------------------------------------------------------ LBFGS
+@pytest.mark.parametrize("line_search", [None, "strong_wolfe"])
+def test_lbfgs_rosenbrock(line_search):
+    from paddle_tpu.framework.tensor import Parameter
+
+    p = Parameter(np.array([-1.2, 1.0], np.float32))
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5 if line_search is None
+                                 else 1.0,
+                                 max_iter=60, history_size=10,
+                                 line_search_fn=line_search,
+                                 parameters=[p])
+
+    def closure():
+        opt.clear_grad()
+        x, y = p[0], p[1]
+        loss = (1.0 - x) ** 2 + 100.0 * (y - x * x) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(8):
+        loss = opt.step(closure)
+    got = np.asarray(p._value)
+    assert loss < 1e-4, (loss, got)
+    np.testing.assert_allclose(got, [1.0, 1.0], atol=0.05)
+
+
+def test_lbfgs_quadratic_exact():
+    from paddle_tpu.framework.tensor import Parameter
+
+    A = np.diag([1.0, 10.0, 100.0]).astype(np.float32)
+    b = np.array([1.0, -2.0, 3.0], np.float32)
+    p = Parameter(np.zeros(3, np.float32))
+    opt = paddle.optimizer.LBFGS(line_search_fn="strong_wolfe",
+                                 max_iter=30, parameters=[p])
+
+    def closure():
+        opt.clear_grad()
+        At = paddle.to_tensor(A)
+        bt = paddle.to_tensor(b)
+        loss = 0.5 * paddle.sum(p * paddle.matmul(At, p)) - paddle.sum(bt * p)
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    want = np.linalg.solve(A, b)
+    np.testing.assert_allclose(np.asarray(p._value), want, atol=1e-3)
+
+
+def test_lbfgs_requires_closure():
+    from paddle_tpu.framework.tensor import Parameter
+    opt = paddle.optimizer.LBFGS(parameters=[Parameter(np.zeros(2,
+                                                       np.float32))])
+    with pytest.raises(RuntimeError):
+        opt.step()
+
+
+# ------------------------------------------------------------ TensorArray
+def test_tensor_array_write_read_stack():
+    arr = paddle.create_array()
+    for i in range(4):
+        paddle.array_write(paddle.to_tensor(np.full(3, float(i),
+                                                    np.float32)), i, arr)
+    assert paddle.array_length(arr) == 4
+    np.testing.assert_array_equal(np.asarray(paddle.array_read(arr, 2)._value),
+                                  2.0)
+    stacked = arr.stack()
+    assert tuple(stacked.shape) == (4, 3)
+    cat = arr.concat()
+    assert tuple(cat.shape) == (12,)
+    # sparse write beyond the end + unwritten-slot error
+    arr2 = paddle.TensorArray()
+    arr2.write(2, paddle.ones([1]))
+    assert len(arr2) == 3
+    with pytest.raises(IndexError):
+        arr2.read(0)
+
+
+def test_tensor_array_grad_flows_through_stack():
+    from paddle_tpu.framework.tensor import Parameter
+    p = Parameter(np.ones(2, np.float32))
+    arr = paddle.TensorArray()
+    for i in range(3):
+        arr.append(p * float(i + 1))
+    loss = paddle.sum(arr.stack())
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(p.grad._value), [6.0, 6.0])
+
+
+# ---------------------------------------------------------- decomposition
+def test_decomp_matches_fused_ops():
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.decomposition import decompose, has_decomp, list_decomps
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    for name, fused in [
+            ("gelu", F.gelu), ("softmax", F.softmax), ("silu", F.silu),
+            ("sigmoid", F.sigmoid), ("log_softmax", F.log_softmax)]:
+        assert has_decomp(name), name
+        np.testing.assert_allclose(
+            np.asarray(decompose(name, x)._value),
+            np.asarray(fused(x)._value), rtol=2e-5, atol=2e-6,
+            err_msg=name)
+    # layer_norm with affine params
+    w = paddle.to_tensor(np.random.RandomState(1).rand(8).astype(np.float32))
+    b = paddle.to_tensor(np.random.RandomState(2).rand(8).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(decompose("layer_norm", x, w, b)._value),
+        np.asarray(F.layer_norm(x, [8], w, b)._value), rtol=2e-5, atol=2e-5)
+    assert "rms_norm" in list_decomps()
+
+
+def test_decomp_unknown_raises():
+    from paddle_tpu.decomposition import decompose
+    with pytest.raises(KeyError):
+        decompose("not_an_op", None)

@@ -112,10 +112,15 @@ def test_layer_norm_cross_entropy_concat_split_flash_rules():
     ins, outs = infer_spmd("split", DistAttr([0, 1]), num=2, axis=1)
     assert all(o == DistAttr([0, -1]) for o in outs)
 
+    # [B, S, H, D] layout: heads (dim 2) stay TP-sharded, seq must clear
+    ins, out = infer_spmd("flash_attention", DistAttr([0, -1, 1, -1]),
+                          DistAttr([0, -1, 1, -1]),
+                          DistAttr([0, -1, 1, -1]))
+    assert out == DistAttr([0, -1, 1, -1])
     ins, out = infer_spmd("flash_attention", DistAttr([0, 1, -1, -1]),
                           DistAttr([0, -1, -1, -1]),
-                          DistAttr([0, 1, -1, -1]))
-    assert out == DistAttr([0, 1, -1, -1])
+                          DistAttr([0, -1, -1, -1]))
+    assert out.dims_mapping[1] == -1  # sequence sharding cleared
 
 
 def test_nonlinear_rules_force_partial_resolution():
@@ -150,6 +155,49 @@ def test_cross_entropy_merges_label_batch():
     # label batch shard merges into logits batch dim
     assert ins[0].dims_mapping[0] == 0
     assert ins[1].dims_mapping == [0]
+    assert out.dims_mapping == [0]
+    assert out.partial_dims == {1}
+
+
+def test_mixed_partial_demands_resolution():
+    """add(A_partial, B_full): the output must NOT be partial — B would be
+    summed n times; the partial input's inferred attr drops the dim."""
+    ins, out = infer_spmd("elementwise",
+                          DistAttr([0, -1], partial_dims=[1]),
+                          DistAttr([0, -1]))
+    assert out.partial_dims == set()
+    assert ins[0].partial_dims == set()
+    # both partial: flows through
+    ins, out = infer_spmd("elementwise",
+                          DistAttr([0, -1], partial_dims=[1]),
+                          DistAttr([0, -1], partial_dims=[1]))
+    assert out.partial_dims == {1}
+    # concat mixed
+    ins, out = infer_spmd("concat",
+                          [DistAttr([0, -1], partial_dims=[1]),
+                           DistAttr([0, -1])], axis=1)
+    assert out.partial_dims == set()
+
+
+def test_nonlinear_reduction_clears_input_partial():
+    ins, out = infer_spmd("reduction", DistAttr([0, -1], partial_dims=[1]),
+                          axis=1, linear=False)
+    assert ins[0].partial_dims == set()
+    assert out.partial_dims == set()
+
+
+def test_reshape_merged_group_forces_reshard_of_inner_shard():
+    ins, out = infer_spmd("reshape", DistAttr([0, -1, 1]),
+                          src_shape=[8, 3, 4], dst_shape=[8, 12])
+    assert ins[0].dims_mapping == [0, -1, -1]  # inner shard must resolve
+    assert out == DistAttr([0, -1])
+
+
+def test_cross_entropy_hard_label_trailing_dim():
+    ins, out = infer_spmd("cross_entropy_with_softmax",
+                          DistAttr([0, 1]), DistAttr([0, -1]))
+    assert ins[1].ndim == 2          # label keeps its rank
+    assert ins[1].dims_mapping == [0, -1]
     assert out.dims_mapping == [0]
     assert out.partial_dims == {1}
 
