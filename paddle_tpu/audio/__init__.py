@@ -1,0 +1,9 @@
+"""paddle.audio — DSP functional ops, feature layers, wav IO.
+
+Parity: `python/paddle/audio/`.
+"""
+
+from . import backends, features, functional
+from .backends import info, load, save
+
+__all__ = ["functional", "features", "backends", "load", "save", "info"]

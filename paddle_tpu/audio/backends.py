@@ -1,0 +1,77 @@
+"""Audio IO: wav load/save via the stdlib (no external codec deps).
+
+Parity: `python/paddle/audio/backends/` (load/save/info with the
+wave_backend).  16/32-bit PCM wav only — matching the reference's builtin
+wave_backend scope.
+"""
+
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+
+__all__ = ["load", "save", "info"]
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels,
+                 bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> AudioInfo:
+    with wave.open(filepath, "rb") as w:
+        return AudioInfo(w.getframerate(), w.getnframes(), w.getnchannels(),
+                         w.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True) \
+        -> Tuple[Tensor, int]:
+    """Returns (waveform (channels, time) float32 in [-1,1], sample_rate)."""
+    with wave.open(filepath, "rb") as w:
+        sr = w.getframerate()
+        n_ch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = w.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = w.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, n_ch)
+    if width == 1:
+        data = data.astype(np.float32) - 128.0
+        scale = 128.0
+    else:
+        data = data.astype(np.float32)
+        scale = float(2 ** (8 * width - 1))
+    if normalize:
+        data = data / scale
+    if channels_first:
+        data = data.T
+    return paddle.to_tensor(np.ascontiguousarray(data)), sr
+
+
+def save(filepath: str, src: Tensor, sample_rate: int,
+         channels_first: bool = True, bits_per_sample: int = 16) -> None:
+    data = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    if bits_per_sample != 16:
+        raise ValueError("wave backend saves 16-bit PCM only")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with wave.open(filepath, "wb") as w:
+        w.setnchannels(data.shape[1])
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())

@@ -1,0 +1,130 @@
+"""Audio feature layers: Spectrogram / MelSpectrogram / LogMelSpectrogram /
+MFCC.
+
+Parity: `python/paddle/audio/features/layers.py`.
+
+TPU-native: the STFT is a strided framing (gather) + window multiply +
+rfft; mel projection and DCT are matmuls — one fused XLA pipeline per
+batch of waveforms.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.registry import dispatch as _d, register_op
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_impl(x, window, n_fft=512, hop_length=None, win_length=None,
+               center=True, pad_mode="reflect", power=2.0):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    n_frames = 1 + (x.shape[-1] - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[..., idx]                       # (..., n_frames, n_fft)
+    frames = frames * window[None, :]
+    spec = jnp.fft.rfft(frames, axis=-1)       # (..., n_frames, 1+n_fft//2)
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)           # (..., freq, time)
+
+
+register_op("stft_power", _stft_impl)
+
+
+class Spectrogram(Layer):
+    """Power spectrogram.  Parity: `features/layers.py` Spectrogram."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = np.pad(w, (lp, n_fft - self.win_length - lp))
+        self.register_buffer("window", paddle.to_tensor(w),
+                             persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        return _d("stft_power", (x, self.window),
+                  {"n_fft": self.n_fft, "hop_length": self.hop_length,
+                   "win_length": self.win_length, "center": self.center,
+                   "pad_mode": self.pad_mode, "power": self.power})
+
+
+class MelSpectrogram(Layer):
+    """Parity: `features/layers.py` MelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode)
+        fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                        htk, norm)
+        self.register_buffer("fbank", paddle.to_tensor(fbank),
+                             persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spec = self.spectrogram(x)              # (..., freq, time)
+        return paddle.matmul(self.fbank, spec)  # (..., n_mels, time)
+
+
+class LogMelSpectrogram(Layer):
+    """Parity: `features/layers.py` LogMelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **mel_kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    """Parity: `features/layers.py` MFCC (log-mel -> DCT)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **logmel_kwargs):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr=sr, n_mels=n_mels,
+                                        **logmel_kwargs)
+        dct = AF.create_dct(n_mfcc, n_mels)
+        self.register_buffer("dct", paddle.to_tensor(dct),
+                             persistable=False)
+
+    def forward(self, x: Tensor) -> Tensor:
+        lm = self.logmel(x)                           # (..., n_mels, time)
+        return paddle.matmul(self.dct, lm, transpose_x=True)

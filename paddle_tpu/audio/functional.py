@@ -1,0 +1,144 @@
+"""Audio DSP functional ops.
+
+Parity: `python/paddle/audio/functional/functional.py` (hz_to_mel,
+mel_to_hz, mel_frequencies, fft_frequencies, compute_fbank_matrix,
+power_to_db, create_dct) and `functional/window.py` (get_window).
+
+Everything is jnp math over paddle Tensors — the STFT/mel pipeline is a
+matmul chain XLA fuses and tiles onto the MXU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz -> mel.  Slaney (default) or HTK scale."""
+    f = _val(freq)
+    scalar = np.isscalar(f)
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else Tensor._wrap(mel) \
+        if isinstance(freq, Tensor) else np.asarray(mel)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _val(mel)
+    scalar = np.isscalar(m)
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else Tensor._wrap(hz) \
+        if isinstance(mel, Tensor) else np.asarray(hz)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    return np.asarray([mel_to_hz(float(m), htk) for m in mels],
+                      np.float32)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2).astype(np.float32)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney"):
+    """Triangular mel filterbank (n_mels, 1 + n_fft//2)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights /= np.maximum(
+            np.linalg.norm(weights, ord=norm, axis=-1, keepdims=True), 1e-10)
+    return weights.astype(np.float32)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """Power spectrogram -> decibels."""
+    s = _val(spect)
+    s = jnp.asarray(s)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor._wrap(log_spec) if isinstance(spect, Tensor) \
+        else np.asarray(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """DCT-II matrix (n_mels, n_mfcc)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return dct.astype(np.float32)
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann / hamming / blackman / rectangular windows."""
+    n = win_length + (0 if fftbins else -1)
+    t = np.arange(win_length, dtype=np.float64)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / max(n, 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / max(n, 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / max(n, 1))
+             + 0.08 * np.cos(4 * math.pi * t / max(n, 1)))
+    elif window in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(win_length)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(np.float32)

@@ -1,0 +1,11 @@
+"""paddle.text — sequence decoding + text datasets.
+
+Parity: `python/paddle/text/__init__.py` (viterbi_decode `:25`,
+ViterbiDecoder `:100`, datasets/).
+"""
+
+from .datasets import Conll05st, Imdb, Imikolov, Movielens, UCIHousing
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
+           "Movielens", "UCIHousing", "Conll05st"]

@@ -1,0 +1,172 @@
+"""Text datasets.
+
+Parity: `python/paddle/text/datasets/` (UCIHousing, Imdb, Imikolov,
+Movielens, Conll05st).  The reference downloads from paddle's CDN; this
+environment has no egress, so every dataset takes `data_file=` pointing at
+a local copy in the reference's format, and raises a clear error when
+asked to download.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st"]
+
+
+def _need_file(data_file, name):
+    if data_file is None or not os.path.exists(data_file):
+        raise FileNotFoundError(
+            f"{name}: automatic download is unavailable in this build "
+            f"(no network egress); pass data_file= with a local copy in "
+            "the reference's published format")
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """506x13 regression table (reference `uci_housing.py`): whitespace-
+    separated floats, 14 columns, feature-normalized like the reference."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = False):
+        data_file = _need_file(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mn, mx, avg = feats.min(0), feats.max(0), feats.mean(0)
+        feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+        n_train = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = np.concatenate([feats[:n_train], target[:n_train]],
+                                       axis=1)
+        else:
+            self.data = np.concatenate([feats[n_train:], target[n_train:]],
+                                       axis=1)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return row[:-1], row[-1:]
+
+
+class Imdb(Dataset):
+    """Sentiment-labelled movie reviews from the aclImdb tar layout
+    (reference `imdb.py`): builds a frequency-cutoff vocab, returns
+    (int64 ids, label)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = False):
+        data_file = _need_file(data_file, "Imdb")
+        import collections
+        import re
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = collections.Counter()
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                text = tf.extractfile(member).read().decode(
+                    "utf-8", "ignore").lower().split()
+                docs.append(text)
+                labels.append(0 if m.group(1) == "pos" else 1)
+                freq.update(text)
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= cutoff}
+        unk = len(vocab)
+        self.word_idx = vocab
+        self.docs = [np.array([vocab.get(w, unk) for w in d], np.int64)
+                     for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (reference `imikolov.py`)."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 50, download: bool = False):
+        data_file = _need_file(data_file, "Imikolov")
+        import collections
+        split = "train" if mode == "train" else "valid"
+        freq = collections.Counter()
+        lines = []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                if member.name.endswith(f"ptb.{split}.txt"):
+                    for line in tf.extractfile(member).read().decode() \
+                            .splitlines():
+                        words = line.strip().split()
+                        lines.append(words)
+                        freq.update(words)
+        vocab = {w: i for i, (w, c) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+            if c >= min_word_freq}
+        unk = len(vocab)
+        self.word_idx = vocab
+        self.data = []
+        for words in lines:
+            ids = [vocab.get(w, unk) for w in words]
+            for j in range(len(ids) - window_size + 1):
+                self.data.append(np.array(ids[j:j + window_size], np.int64))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference `movielens.py`)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0,
+                 download: bool = False):
+        data_file = _need_file(data_file, "Movielens")
+        rows = []
+        import zipfile
+        with zipfile.ZipFile(data_file) as z:
+            name = next(n for n in z.namelist() if n.endswith("ratings.dat"))
+            for line in z.read(name).decode("latin1").splitlines():
+                user, movie, rating, _ = line.strip().split("::")
+                rows.append((int(user), int(movie), float(rating)))
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.rand(len(rows)) < test_ratio
+        keep = mask if mode == "test" else ~mask
+        self.data = [r for r, k in zip(rows, keep) if k]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        u, m, r = self.data[i]
+        return np.int64(u), np.int64(m), np.float32(r)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference `conll05.py`) — local-file only."""
+
+    def __init__(self, data_file: Optional[str] = None, download=False,
+                 **kwargs):
+        _need_file(data_file, "Conll05st")
+        raise NotImplementedError(
+            "Conll05st parsing: the reference's preprocessed pickle is "
+            "proprietary-format; load it with paddle.load and wrap in a "
+            "paddle.io.Dataset")

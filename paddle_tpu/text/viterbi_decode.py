@@ -1,0 +1,103 @@
+"""Viterbi decoding for linear-chain CRFs.
+
+Parity: `python/paddle/text/viterbi_decode.py` (viterbi_decode `:25`,
+ViterbiDecoder `:100`) / `paddle/phi/kernels/cpu/viterbi_decode_kernel.cc`.
+
+TPU-native: the time recursion is a `lax.scan` over (B, T, N) potentials —
+no data-dependent Python control flow; the backtrace is a reverse scan
+over the argmax pointers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..ops.registry import dispatch as _d, register_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_impl(potentials, trans, lengths=None,
+                  include_bos_eos_tag=True):
+    """potentials (B, T, N), trans (N, N) [or (N+2, N+2) with BOS/EOS],
+    lengths (B,) int.  Returns (scores (B,), paths (B, T))."""
+    B, T, N = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((B,), T, jnp.int32)
+    if include_bos_eos_tag:
+        # reference layout: trans is (N+2, N+2); tag N = BOS, N+1 = EOS
+        full = trans
+        trans_nn = full[:N, :N]
+        start = full[N, :N]
+        stop = full[:N, N + 1]
+    else:
+        trans_nn = trans
+        start = jnp.zeros((N,), potentials.dtype)
+        stop = jnp.zeros((N,), potentials.dtype)
+
+    alpha0 = potentials[:, 0] + start[None, :]
+
+    def step(carry, t):
+        alpha, best_last = carry
+        # (B, N_prev, N_cur)
+        scores = alpha[:, :, None] + trans_nn[None, :, :]
+        ptr = jnp.argmax(scores, axis=1)                      # (B, N)
+        alpha_new = jnp.max(scores, axis=1) + potentials[:, t]
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, alpha_new, alpha)
+        ptr = jnp.where(active, ptr, jnp.arange(N)[None, :])
+        return (alpha, best_last), ptr
+
+    (alpha, _), ptrs = jax.lax.scan(step, (alpha0, None),
+                                    jnp.arange(1, T))
+    final = alpha + stop[None, :]
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1)                     # (B,)
+
+    # backtrace: walk pointers from t=T-1 down to 1
+    def back(carry, ptr_t_and_t):
+        tag = carry  # best tag at time t
+        ptr_t, t = ptr_t_and_t
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        # positions beyond a sequence's length keep the final tag
+        prev = jnp.where(t < lengths, prev, tag)
+        return prev, prev  # emit the predecessor (tag at t-1)
+
+    ts = jnp.arange(1, T)[::-1]
+    _, prevs_rev = jax.lax.scan(back, last_tag, (ptrs[::-1], ts))
+    # prevs_rev = [tag_{T-2}, ..., tag_0]; assemble tag_0..tag_{T-1}
+    paths = jnp.concatenate(
+        [prevs_rev[::-1].T, last_tag[:, None]], axis=1)       # (B, T)
+    return scores, paths.astype(jnp.int64)
+
+
+register_op("viterbi_decode", _viterbi_impl)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Best tag sequence + its score for each batch row."""
+    args = [potentials, transition_params]
+    if lengths is not None:
+        args.append(lengths if isinstance(lengths, Tensor)
+                    else Tensor._wrap(jnp.asarray(lengths)))
+    return _d("viterbi_decode", tuple(args),
+              {"include_bos_eos_tag": include_bos_eos_tag})
+
+
+class ViterbiDecoder(Layer):
+    """Parity: `viterbi_decode.py:100`."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,146 @@
+"""paddle.audio + paddle.text.
+
+Mirrors the reference's `test/legacy_test/test_audio_functions.py` (librosa
+parity reduced to closed-form checks), `test_audio_logmel_feature.py`, and
+`test_viterbi_decode_op.py` (dynamic-programming result vs brute force).
+"""
+
+import itertools
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+
+
+# ------------------------------------------------------------------- audio
+def test_mel_scale_round_trip():
+    freqs = np.array([0.0, 440.0, 1000.0, 4000.0, 8000.0], np.float32)
+    for htk in (False, True):
+        mel = audio.functional.hz_to_mel(freqs, htk=htk)
+        back = audio.functional.mel_to_hz(mel, htk=htk)
+        np.testing.assert_allclose(back, freqs, rtol=1e-4, atol=1e-2)
+    assert audio.functional.hz_to_mel(1000.0, htk=True) == \
+        pytest.approx(1000.0, rel=1e-3)
+
+
+def test_fbank_matrix_properties():
+    fb = audio.functional.compute_fbank_matrix(sr=16000, n_fft=512,
+                                               n_mels=40)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every filter has some support; DC bin is (near) empty
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_window_functions():
+    for name in ("hann", "hamming", "blackman", "rect"):
+        w = audio.functional.get_window(name, 64)
+        assert w.shape == (64,)
+        assert w.max() <= 1.0 + 1e-6
+    with pytest.raises(ValueError):
+        audio.functional.get_window("kaiser9000", 64)
+
+
+def test_spectrogram_detects_tone():
+    sr, n_fft = 8000, 256
+    t = np.arange(sr, dtype=np.float32) / sr
+    tone = np.sin(2 * np.pi * 1000.0 * t)  # 1 kHz
+    spec = audio.features.Spectrogram(n_fft=n_fft, hop_length=128)(
+        paddle.to_tensor(tone[None, :]))
+    s = np.asarray(spec._value)[0]          # (freq, time)
+    peak_bin = s.mean(axis=1).argmax()
+    want_bin = round(1000.0 / (sr / n_fft))
+    assert abs(int(peak_bin) - want_bin) <= 1
+
+
+def test_logmel_and_mfcc_shapes():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 8000).astype(np.float32))
+    lm = audio.features.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32,
+                                          f_min=0.0)(x)
+    assert np.asarray(lm._value).shape[0:2] == (2, 32)
+    mfcc = audio.features.MFCC(sr=8000, n_mfcc=13, n_mels=32, n_fft=256,
+                               f_min=0.0)(x)
+    assert np.asarray(mfcc._value).shape[0:2] == (2, 13)
+    assert np.isfinite(np.asarray(mfcc._value)).all()
+
+
+def test_wav_save_load_round_trip(tmp_path):
+    sr = 8000
+    t = np.arange(sr // 2, dtype=np.float32) / sr
+    wav = 0.5 * np.sin(2 * np.pi * 440 * t)[None, :]
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, paddle.to_tensor(wav), sr)
+    meta = audio.info(path)
+    assert meta.sample_rate == sr and meta.num_channels == 1
+    loaded, sr2 = audio.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(loaded._value), wav, atol=1e-3)
+
+
+# -------------------------------------------------------------------- text
+def brute_force_viterbi(pot, trans_nn, start, stop):
+    """Enumerate all tag paths (tiny N, T)."""
+    T, N = pot.shape
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(N), repeat=T):
+        s = start[path[0]] + pot[0, path[0]]
+        for t in range(1, T):
+            s += trans_nn[path[t - 1], path[t]] + pot[t, path[t]]
+        s += stop[path[-1]]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_matches_brute_force():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.randn(B, T, N).astype(np.float32)
+    full = rng.randn(N + 2, N + 2).astype(np.float32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(full))
+    s_np = np.asarray(scores._value)
+    p_np = np.asarray(paths._value)
+    for b in range(B):
+        want_s, want_p = brute_force_viterbi(
+            pot[b], full[:N, :N], full[N, :N], full[:N, N + 1])
+        assert s_np[b] == pytest.approx(want_s, rel=1e-5)
+        assert list(p_np[b]) == want_p
+
+
+def test_viterbi_no_bos_eos_and_layer():
+    rng = np.random.RandomState(1)
+    B, T, N = 2, 4, 3
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans),
+                              include_bos_eos_tag=False)
+    scores, paths = dec(paddle.to_tensor(pot))
+    z = np.zeros(N, np.float32)
+    for b in range(2):
+        want_s, want_p = brute_force_viterbi(pot[b], trans, z, z)
+        assert float(np.asarray(scores._value)[b]) == \
+            pytest.approx(want_s, rel=1e-5)
+        assert list(np.asarray(paths._value)[b]) == want_p
+
+
+def test_text_dataset_requires_local_file():
+    with pytest.raises(FileNotFoundError):
+        text.UCIHousing()
+
+
+def test_uci_housing_from_local_file(tmp_path):
+    rng = np.random.RandomState(0)
+    table = rng.rand(50, 14).astype(np.float32)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, table)
+    train = text.UCIHousing(data_file=str(f), mode="train")
+    test = text.UCIHousing(data_file=str(f), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
