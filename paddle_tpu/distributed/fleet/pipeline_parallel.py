@@ -104,6 +104,18 @@ class PipelineParallel:
         return self
 
 
+# The interleaved / virtual-pipeline schedule is implemented ONLY on the
+# compiled SPMD path (`spmd_pipeline.interleaved_pipeline_forward`) — a
+# host-driven eager interleave would serialize what the TPU overlaps.
+# `PipelineParallelWithInterleave` is kept as an alias so reference-API
+# callers get the real schedule's entry point in the error message.
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Virtual-pipeline (interleaved) schedule: same arithmetic on the host
-    path; the SPMD path interleaves via stage-stacking with vpp chunks."""
+    """Use `spmd_pipeline.interleaved_pipeline_forward` (VPP inside one
+    shard_map program); the host path cannot interleave and refuses."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "interleaved pipelining runs on the compiled SPMD path: "
+            "paddle_tpu.distributed.fleet.spmd_pipeline."
+            "interleaved_pipeline_forward (Megatron VPP schedule over the "
+            "pp mesh axis)")
