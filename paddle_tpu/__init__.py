@@ -77,6 +77,12 @@ from . import distribution  # noqa: F401,E402
 from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
+from . import fft  # noqa: F401,E402
+from .ops import generated_ops as _generated_ops  # noqa: E402
+for _gname, _gns in _generated_ops._NAMESPACES.items():
+    if _gns == "":  # top-level ops from the YAML single source
+        globals()[_gname] = getattr(_generated_ops, _gname)
+del _gname, _gns
 from . import text  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
 from .hapi import Model, summary  # noqa: F401,E402

@@ -1,0 +1,13 @@
+"""Parallel-strategy auto-tuner.
+
+Parity: `python/paddle/distributed/auto_tuner/` (tuner.py AutoTuner,
+prune.py rules, search.py GridSearch) — the reference launches trial jobs
+over candidate (dp, mp, pp, sharding, micro-batch) configs and keeps the
+fastest; here trials are user-supplied callables (typically: jit-compile
+the hybrid step on tiny shapes with `dryrun`-style meshes and time one
+step), and the same divisibility/memory prune rules cut the space first.
+"""
+
+from .tuner import AutoTuner, Trial, default_candidates, prune_by_memory
+
+__all__ = ["AutoTuner", "Trial", "default_candidates", "prune_by_memory"]

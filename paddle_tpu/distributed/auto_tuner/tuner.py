@@ -1,0 +1,148 @@
+"""AutoTuner: candidate generation, pruning, grid search.
+
+Parity: `python/paddle/distributed/auto_tuner/tuner.py` (AutoTuner.search),
+`utils.py` (gen candidates / divisor logic), `prune.py` (_prune_by_mp etc.).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Trial", "default_candidates", "prune_by_memory", "AutoTuner"]
+
+
+@dataclass
+class Trial:
+    dp: int
+    mp: int
+    pp: int
+    sharding: int
+    micro_batch_size: int
+    metric: Optional[float] = None
+    error: Optional[str] = None
+    extra: Dict = field(default_factory=dict)
+
+    @property
+    def degree(self) -> int:
+        return self.dp * self.mp * self.pp * self.sharding
+
+    def as_hybrid_configs(self) -> Dict:
+        return {"dp_degree": self.dp, "mp_degree": self.mp,
+                "pp_degree": self.pp, "sharding_degree": self.sharding,
+                "sep_degree": 1}
+
+    def __repr__(self):
+        m = f", {self.metric:.4g}" if self.metric is not None else ""
+        return (f"Trial(dp{self.dp} mp{self.mp} pp{self.pp} "
+                f"sh{self.sharding} mbs{self.micro_batch_size}{m})")
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(world_size: int, global_batch_size: int,
+                       num_layers: int, num_heads: int,
+                       max_mp: Optional[int] = None,
+                       max_pp: Optional[int] = None) -> List[Trial]:
+    """Enumerate configs respecting the reference's validity rules:
+    dp*mp*pp*sharding == world_size, heads % mp == 0, layers % pp == 0,
+    micro-batch divides the per-dp batch."""
+    out = []
+    for mp, pp in itertools.product(_divisors(world_size), repeat=2):
+        if max_mp and mp > max_mp or max_pp and pp > max_pp:
+            continue
+        if num_heads % mp or num_layers % pp:
+            continue
+        rest = world_size // (mp * pp) if world_size % (mp * pp) == 0 else 0
+        if not rest:
+            continue
+        for sharding in _divisors(rest):
+            dp = rest // sharding
+            if global_batch_size % (dp * sharding):
+                continue
+            local_bs = global_batch_size // (dp * sharding)
+            for mbs in _divisors(local_bs):
+                out.append(Trial(dp, mp, pp, sharding, mbs))
+    return out
+
+
+def prune_by_memory(trials: List[Trial], param_bytes: int,
+                    hbm_bytes: int = 16 * 2 ** 30,
+                    optimizer_multiplier: float = 3.0) -> List[Trial]:
+    """Drop configs whose weight+optimizer state cannot fit: params shard
+    over mp*pp, optimizer state additionally over sharding (ZeRO-1).
+    Parity: `prune.py` _prune_by_memory_estimation."""
+    kept = []
+    for t in trials:
+        weights = param_bytes / (t.mp * t.pp)
+        opt_state = optimizer_multiplier * weights / t.sharding
+        if weights + opt_state <= hbm_bytes:
+            kept.append(t)
+    return kept
+
+
+class AutoTuner:
+    """Grid-search over pruned candidates with a user trial function.
+
+    tuner = AutoTuner(candidates, trial_fn)   # trial_fn(Trial) -> seconds
+    best = tuner.search()                     # lower metric is better
+    """
+
+    def __init__(self, candidates: List[Trial],
+                 trial_fn: Callable[[Trial], float],
+                 max_time_per_trial: Optional[float] = None,
+                 verbose: bool = False):
+        if not candidates:
+            raise ValueError("no candidate configs to tune over")
+        self.candidates = list(candidates)
+        self.trial_fn = trial_fn
+        self.max_time_per_trial = max_time_per_trial
+        self.verbose = verbose
+        self.history: List[Trial] = []
+
+    def _run_trial(self, t: Trial) -> Optional[float]:
+        if self.max_time_per_trial is None:
+            return float(self.trial_fn(t))
+        # bound a hung compile/trial: run in a worker and give up on
+        # timeout (the worker thread is abandoned, not killed — the
+        # search continues; same contract as the reference's subprocess
+        # kill, minus the process isolation)
+        from concurrent.futures import ThreadPoolExecutor, TimeoutError
+        with ThreadPoolExecutor(max_workers=1) as ex:
+            fut = ex.submit(self.trial_fn, t)
+            try:
+                return float(fut.result(timeout=self.max_time_per_trial))
+            except TimeoutError:
+                fut.cancel()
+                raise TimeoutError(
+                    f"trial exceeded {self.max_time_per_trial}s")
+
+    def search(self) -> Trial:
+        import math
+        best = None
+        for t in self.candidates:
+            t0 = time.perf_counter()
+            try:
+                t.metric = self._run_trial(t)
+                if t.metric is not None and not math.isfinite(t.metric):
+                    t.error = f"non-finite metric {t.metric}"
+                    t.metric = None
+            except Exception as e:  # a failing config is pruned, not fatal
+                t.error = f"{type(e).__name__}: {e}"
+                t.metric = None
+            t.extra["trial_seconds"] = time.perf_counter() - t0
+            self.history.append(t)
+            if self.verbose:
+                print(f"[auto-tuner] {t} err={t.error}")
+            if t.metric is not None and \
+                    (best is None or t.metric < best.metric):
+                best = t
+        if best is None:
+            raise RuntimeError(
+                "auto-tuner: every candidate failed; errors: "
+                + "; ".join(f"{t}: {t.error}" for t in self.history[:5]))
+        return best

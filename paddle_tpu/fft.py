@@ -1,0 +1,14 @@
+"""paddle.fft namespace — populated from the YAML single source.
+
+Parity: `python/paddle/fft.py`.  Which ops land here is decided by the
+`namespace: fft` field in `ops/specs/ops.yaml`; adding an op there and
+regenerating is all it takes.
+"""
+
+from .ops import generated_ops as _g
+
+__all__ = sorted(n for n, ns in _g._NAMESPACES.items() if ns == "fft")
+
+for _name in __all__:
+    globals()[_name] = getattr(_g, _name)
+del _name, _g

@@ -1,0 +1,113 @@
+"""Auto-tuner: candidate generation, pruning, search over real trials.
+
+Mirrors `test/auto_parallel/test_auto_tuner.py` (config validity) plus a
+live trial run timing the hybrid step on the CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (AutoTuner, Trial,
+                                               default_candidates,
+                                               prune_by_memory)
+
+
+def test_candidates_respect_constraints():
+    cands = default_candidates(world_size=8, global_batch_size=16,
+                               num_layers=12, num_heads=12)
+    assert cands
+    for t in cands:
+        assert t.degree == 8
+        assert 12 % t.mp == 0 and 12 % t.pp == 0
+        assert 16 % (t.dp * t.sharding) == 0
+        local = 16 // (t.dp * t.sharding)
+        assert local % t.micro_batch_size == 0
+    # mp=5 impossible for 12 heads; pp=8 impossible for 12 layers
+    assert not any(t.mp == 5 for t in cands)
+    assert not any(t.pp == 8 for t in cands)
+
+
+def test_prune_by_memory():
+    trials = [Trial(8, 1, 1, 1, 1), Trial(1, 4, 2, 1, 1),
+              Trial(1, 1, 1, 8, 1)]
+    # 40 GB of params, 16 GB HBM: plain DP (full replica + 3x opt) dies,
+    # mp4xpp2 (5 GB weights + 15 GB opt) dies, ZeRO-8 (40+15) dies too
+    kept = prune_by_memory(trials, param_bytes=40 * 2 ** 30)
+    assert Trial(8, 1, 1, 1, 1) not in kept
+    assert all(t.degree == 8 for t in kept)
+    # small model: everything fits
+    assert len(prune_by_memory(trials, param_bytes=2 ** 20)) == 3
+
+
+def test_search_picks_fastest_and_survives_failures():
+    cands = [Trial(4, 1, 1, 1, 2), Trial(2, 2, 1, 1, 2),
+             Trial(1, 4, 1, 1, 2)]
+
+    def trial_fn(t):
+        if t.mp == 4:
+            raise RuntimeError("OOM")
+        return 1.0 / t.dp  # dp4 is fastest
+
+    tuner = AutoTuner(cands, trial_fn)
+    best = tuner.search()
+    assert (best.dp, best.mp) == (4, 1)
+    failed = [t for t in tuner.history if t.error]
+    assert len(failed) == 1 and "OOM" in failed[0].error
+
+
+def test_search_skips_nan_metrics():
+    cands = [Trial(4, 1, 1, 1, 1), Trial(2, 2, 1, 1, 1)]
+    best = AutoTuner(cands, lambda t: float("nan") if t.dp == 4
+                     else 0.8).search()
+    assert best.dp == 2
+    assert any("non-finite" in (t.error or "") for t in cands)
+
+
+def test_trial_timeout_enforced():
+    import time as _time
+    cands = [Trial(4, 1, 1, 1, 1), Trial(2, 2, 1, 1, 1)]
+
+    def trial_fn(t):
+        if t.dp == 4:
+            _time.sleep(5)
+        return 1.0
+
+    tuner = AutoTuner(cands, trial_fn, max_time_per_trial=0.5)
+    best = tuner.search()
+    assert best.dp == 2
+    assert any("exceeded" in (t.error or "") for t in tuner.history)
+
+
+def test_search_all_fail_raises():
+    with pytest.raises(RuntimeError):
+        AutoTuner([Trial(1, 1, 1, 1, 1)],
+                  lambda t: (_ for _ in ()).throw(ValueError("x"))).search()
+
+
+def test_live_trial_on_cpu_mesh():
+    """Time one real jitted DP-vs-MP matmul step per config and pick one."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cands = [Trial(8, 1, 1, 1, 1), Trial(1, 8, 1, 1, 1)]
+    x = jnp.ones((64, 256), jnp.float32)
+    w = jnp.ones((256, 256), jnp.float32)
+
+    def trial_fn(t):
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(t.dp, t.mp),
+                    ("dp", "mp"))
+        xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+        ws = jax.device_put(w, NamedSharding(mesh, P(None, "mp")))
+        f = jax.jit(lambda a, b: (a @ b).sum())
+        f(xs, ws).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(3):
+            f(xs, ws).block_until_ready()
+        return time.perf_counter() - t0
+
+    best = AutoTuner(cands, trial_fn).search()
+    assert best.metric is not None and best.error is None
+    assert best.as_hybrid_configs()["dp_degree"] == best.dp
