@@ -112,14 +112,18 @@ class AutoTuner:
         # search continues; same contract as the reference's subprocess
         # kill, minus the process isolation)
         from concurrent.futures import ThreadPoolExecutor, TimeoutError
-        with ThreadPoolExecutor(max_workers=1) as ex:
-            fut = ex.submit(self.trial_fn, t)
-            try:
-                return float(fut.result(timeout=self.max_time_per_trial))
-            except TimeoutError:
-                fut.cancel()
-                raise TimeoutError(
-                    f"trial exceeded {self.max_time_per_trial}s")
+        ex = ThreadPoolExecutor(max_workers=1)
+        fut = ex.submit(self.trial_fn, t)
+        try:
+            return float(fut.result(timeout=self.max_time_per_trial))
+        except TimeoutError:
+            fut.cancel()
+            raise TimeoutError(
+                f"trial exceeded {self.max_time_per_trial}s")
+        finally:
+            # never join the (possibly hung) worker — that would defeat
+            # the timeout; the thread is daemonic via interpreter exit
+            ex.shutdown(wait=False)
 
     def search(self) -> Trial:
         import math

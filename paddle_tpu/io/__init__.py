@@ -272,9 +272,14 @@ def default_collate_fn(batch):
 class DataLoader:
     """Iterates batches of Tensors.
 
-    num_workers>0 uses a background thread pool for prefetch (the GIL is
-    released during numpy/jax work; true multiprocess workers are a planned
-    extension — the API matches `io/reader.py:216`).
+    num_workers>0 spawns true worker PROCESSES (reference
+    `io/dataloader/dataloader_iter.py` _DataLoaderIterMultiProcess):
+    batches are collated to numpy in the workers and shipped through
+    shared memory, so CPU-bound transforms use every core while the chip
+    trains.  Set use_shared_memory=False to pickle batches through the
+    queue instead, or PADDLE_TPU_THREAD_LOADER=1 to fall back to the
+    thread-prefetch path (useful when the dataset can't be pickled for
+    spawn).
     """
 
     def __init__(self, dataset, feed_list=None, places=None,
@@ -286,7 +291,16 @@ class DataLoader:
         self.dataset = dataset
         self.num_workers = num_workers
         self.collate_fn = collate_fn or default_collate_fn
+        self._custom_collate = collate_fn
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        if persistent_workers:
+            import warnings
+            warnings.warn(
+                "persistent_workers is not implemented: workers are "
+                "re-spawned per epoch (spawn start method)")
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -317,6 +331,112 @@ class DataLoader:
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
 
+    def _iter_multiprocess(self):
+        import multiprocessing as mp
+        import os
+
+        from .worker import unpack_batch, worker_loop
+
+        if mp.parent_process() is not None:
+            raise RuntimeError(
+                "DataLoader(num_workers>0) was reached inside a spawned "
+                "worker process — the training script's entry code must be "
+                "under `if __name__ == '__main__':` (spawn re-imports the "
+                "main module), or pass num_workers=0")
+        ctx = mp.get_context("spawn")  # forking under live XLA is unsafe
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        n = self.num_workers
+        workers = []
+        try:
+            for wid in range(n):
+                p = ctx.Process(
+                    target=worker_loop,
+                    args=(self.dataset, index_q, result_q,
+                          self._custom_collate, self.use_shared_memory,
+                          self.worker_init_fn, wid),
+                    daemon=True)
+                p.start()
+                workers.append(p)
+
+            batches = list(self.batch_sampler)
+            # backpressure: keep at most n*prefetch_factor batch jobs in
+            # flight so workers can't fill /dev/shm ahead of the consumer
+            window = max(n * self.prefetch_factor, 1)
+            feed_seq = 0
+
+            def feed():
+                nonlocal feed_seq
+                while feed_seq < len(batches) and \
+                        feed_seq - next_seq < window:
+                    index_q.put((feed_seq, list(batches[feed_seq])))
+                    feed_seq += 1
+                if feed_seq == len(batches):
+                    feed_seq += n  # enqueue stop tokens exactly once
+                    for _ in range(n):
+                        index_q.put(None)
+
+            pending = {}
+            next_seq = 0
+            done = 0
+            deadline_t = self.timeout if self.timeout else None
+            feed()
+            while next_seq < len(batches):
+                if next_seq in pending:
+                    yield self._to_tensors(pending.pop(next_seq))
+                    next_seq += 1
+                    feed()
+                    continue
+                try:
+                    kind, a, b = result_q.get(
+                        timeout=min(deadline_t, 1.0) if deadline_t else 1.0)
+                except queue.Empty:
+                    if not any(p.is_alive() for p in workers):
+                        raise RuntimeError(
+                            "all DataLoader workers died without reporting "
+                            "(OOM-killed?); check system logs") from None
+                    continue
+                if kind == "error":
+                    raise RuntimeError(
+                        f"DataLoader worker {a} failed:\n{b}")
+                if kind == "done":
+                    done += 1
+                    if done == n and next_seq < len(batches) \
+                            and not pending and result_q.empty():
+                        raise RuntimeError(
+                            "DataLoader workers exited before producing "
+                            "all batches")
+                    continue
+                pending[a] = unpack_batch(b)
+        finally:
+            # free any queued-but-unconsumed shared-memory payloads (early
+            # break from the epoch, or an error above)
+            try:
+                while True:
+                    kind, _, b = result_q.get_nowait()
+                    if kind == "batch":
+                        unpack_batch(b)  # attach + unlink
+            except queue.Empty:
+                pass
+            for p in workers:
+                if p.is_alive():
+                    p.terminate()
+            for p in workers:
+                p.join(5)
+
+    @staticmethod
+    def _to_tensors(obj):
+        import numpy as _np
+        if isinstance(obj, _np.ndarray):
+            return Tensor(obj)
+        if isinstance(obj, tuple):
+            return tuple(DataLoader._to_tensors(x) for x in obj)
+        if isinstance(obj, list):
+            return [DataLoader._to_tensors(x) for x in obj]
+        if isinstance(obj, dict):
+            return {k: DataLoader._to_tensors(v) for k, v in obj.items()}
+        return obj
+
     def __iter__(self):
         if self._iterable_mode:
             yield from self._iter_iterable()
@@ -324,6 +444,10 @@ class DataLoader:
         if self.num_workers <= 0:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
+            return
+        import os
+        if os.environ.get("PADDLE_TPU_THREAD_LOADER") != "1":
+            yield from self._iter_multiprocess()
             return
         # threaded prefetch pipeline
         q: "queue.Queue" = queue.Queue(self.num_workers * self.prefetch_factor)

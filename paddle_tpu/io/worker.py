@@ -1,0 +1,136 @@
+"""Multiprocess DataLoader workers with shared-memory batch transport.
+
+Parity: `python/paddle/io/dataloader/worker.py` (_worker_loop) +
+`paddle/fluid/memory/allocation/mmap_allocator.cc` (the reference moves
+batches between workers and the trainer through shared memory; here the
+payload rides `multiprocessing.shared_memory` blocks and only metadata
+crosses the queue).
+
+Workers are SPAWNED (never forked): JAX/XLA holds native threads in the
+parent, and a forked child inheriting them can deadlock.  Workers collate
+to numpy; the parent turns arrays into device Tensors — so the host-side
+decode/augment runs on all cores while the chip trains.
+"""
+
+from __future__ import annotations
+
+import traceback
+from multiprocessing import shared_memory
+from typing import Any, List
+
+import numpy as np
+
+__all__ = ["worker_loop", "pack_batch", "unpack_batch", "numpy_collate"]
+
+
+def numpy_collate(batch: List[Any]):
+    """Stack samples into numpy arrays, mirroring default_collate's
+    structure handling (tuple/list/dict of arrays/scalars)."""
+    first = batch[0]
+    if isinstance(first, np.ndarray):
+        return np.stack(batch)
+    # dtype parity with io.default_collate_fn: int -> int64, float -> f32
+    if isinstance(first, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(first, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(first, (tuple, list)):
+        return type(first)(numpy_collate(list(col)) for col in zip(*batch))
+    if isinstance(first, dict):
+        return {k: numpy_collate([d[k] for d in batch]) for k in first}
+    # strings / arbitrary objects pass through as a list
+    return list(batch)
+
+
+def _to_numpy_tree(obj):
+    """Convert any paddle Tensors a custom collate_fn produced to numpy."""
+    tname = type(obj).__name__
+    if tname in ("Tensor", "Parameter") and hasattr(obj, "_value"):
+        return np.asarray(obj._value)
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_to_numpy_tree(x) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def pack_batch(batch, use_shared_memory: bool):
+    """Replace large ndarrays with shared-memory descriptors.
+
+    Returns (payload, shm_blocks): payload is queue-safe metadata; the
+    worker must keep `shm_blocks` alive until the parent confirms receipt
+    (we close immediately after put — the parent re-attaches by name and
+    unlinks)."""
+    blocks = []
+
+    def pack(x):
+        if isinstance(x, np.ndarray) and use_shared_memory and x.nbytes > 0:
+            shm = shared_memory.SharedMemory(create=True, size=x.nbytes)
+            view = np.ndarray(x.shape, x.dtype, buffer=shm.buf)
+            view[...] = x
+            blocks.append(shm)
+            return ("__shm__", shm.name, x.shape, str(x.dtype))
+        if isinstance(x, np.ndarray):
+            return ("__np__", x)
+        if isinstance(x, (tuple, list)):
+            return ("__seq__", type(x).__name__, [pack(v) for v in x])
+        if isinstance(x, dict):
+            return ("__map__", {k: pack(v) for k, v in x.items()})
+        return ("__obj__", x)
+
+    return pack(batch), blocks
+
+
+def unpack_batch(payload):
+    """Inverse of pack_batch (parent side); unlinks consumed shm blocks."""
+    tag = payload[0]
+    if tag == "__shm__":
+        _, name, shape, dtype = payload
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            try:
+                shm.unlink()
+            except FileNotFoundError:
+                pass
+        return arr
+    if tag == "__np__":
+        return payload[1]
+    if tag == "__seq__":
+        seq = [unpack_batch(v) for v in payload[2]]
+        return tuple(seq) if payload[1] == "tuple" else seq
+    if tag == "__map__":
+        return {k: unpack_batch(v) for k, v in payload[1].items()}
+    return payload[1]
+
+
+def worker_loop(dataset, index_queue, result_queue, collate_fn,
+                use_shared_memory: bool, worker_init_fn, worker_id: int):
+    """Worker main: pull index lists, collate, ship via shared memory."""
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+    except BaseException:
+        result_queue.put(("error", worker_id, traceback.format_exc()))
+        return
+    while True:
+        job = index_queue.get()
+        if job is None:
+            result_queue.put(("done", worker_id, None))
+            return
+        seq, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            if collate_fn is not None:
+                batch = _to_numpy_tree(collate_fn(samples))
+            else:
+                batch = numpy_collate([_to_numpy_tree(s) for s in samples])
+            payload, blocks = pack_batch(batch, use_shared_memory)
+            result_queue.put(("batch", seq, payload))
+            for b in blocks:
+                b.close()  # parent re-attaches by name and unlinks
+        except BaseException:
+            result_queue.put(("error", worker_id, traceback.format_exc()))
+            return
