@@ -99,9 +99,14 @@ def bench_gpt124m():
         for _ in range(n):
             loss = step(ids, labels)
 
-    dt = marginal_step_s(run_steps,
-                         lambda: model.gpt.ln_f.bias._value,
-                         *((3, 13) if on_tpu else (1, 3)))
+    # the tunneled device adds +-15% queueing noise to any single timing;
+    # take the best of several marginal measurements over longer windows
+    # (noise is strictly additive, so min is the honest sustained rate)
+    sync = lambda: model.gpt.ln_f.bias._value  # noqa: E731
+    if on_tpu:
+        dt = min(marginal_step_s(run_steps, sync, 5, 30) for _ in range(3))
+    else:
+        dt = marginal_step_s(run_steps, sync, 1, 3)
     tokens_per_sec = B * S / dt
     fpt = model.flops_per_token(S)
     mfu = tokens_per_sec * fpt / peak_flops(dev)

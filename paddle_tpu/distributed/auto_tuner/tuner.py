@@ -111,19 +111,29 @@ class AutoTuner:
         # timeout (the worker thread is abandoned, not killed — the
         # search continues; same contract as the reference's subprocess
         # kill, minus the process isolation)
-        from concurrent.futures import ThreadPoolExecutor, TimeoutError
-        ex = ThreadPoolExecutor(max_workers=1)
-        fut = ex.submit(self.trial_fn, t)
-        try:
-            return float(fut.result(timeout=self.max_time_per_trial))
-        except TimeoutError:
-            fut.cancel()
+        # plain daemon thread: unlike ThreadPoolExecutor workers it cannot
+        # block interpreter exit if the trial truly hangs.  We can't kill
+        # the thread, so a hung trial may still contend with later trials
+        # — the reference isolates trials in subprocesses for the same
+        # reason; use process-level trial_fns for hard isolation.
+        import threading
+        box = {}
+
+        def run():
+            try:
+                box["value"] = float(self.trial_fn(t))
+            except BaseException as e:  # surfaced below
+                box["error"] = e
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        th.join(self.max_time_per_trial)
+        if th.is_alive():
             raise TimeoutError(
                 f"trial exceeded {self.max_time_per_trial}s")
-        finally:
-            # never join the (possibly hung) worker — that would defeat
-            # the timeout; the thread is daemonic via interpreter exit
-            ex.shutdown(wait=False)
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
 
     def search(self) -> Trial:
         import math

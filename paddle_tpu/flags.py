@@ -105,8 +105,18 @@ class flag_guard:
 # ---------------------------------------------------------------------------
 # Core flags (mirroring the commonly used subset of paddle/common/flags.cc)
 # ---------------------------------------------------------------------------
-define_flag("check_nan_inf", False, "Scan every op output for NaN/Inf (debugging).")
+def _nan_flag_changed(enabled):
+    from .ops import registry as _reg
+    _reg._on_nan_flag_change(enabled)
+
+
+define_flag("check_nan_inf", False,
+            "Scan every op output for NaN/Inf (debugging).",
+            on_change=_nan_flag_changed)
 define_flag("check_nan_inf_level", 0, "0: fail on nan/inf; >0: warn only.")
+define_flag("check_nan_inf_stride", 1,
+            "ops between host syncs of the nan/inf flags (1 = immediate, "
+            "precise; larger = on-device accumulation, one sync per window).")
 define_flag("use_stride_kernel", False, "Unused on TPU; kept for API parity.")
 define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA owns memory.")
 define_flag("benchmark", False, "Block on every op for accurate per-op timing.")

@@ -333,7 +333,6 @@ class DataLoader:
 
     def _iter_multiprocess(self):
         import multiprocessing as mp
-        import os
 
         from .worker import unpack_batch, worker_loop
 
@@ -354,7 +353,7 @@ class DataLoader:
                     target=worker_loop,
                     args=(self.dataset, index_q, result_q,
                           self._custom_collate, self.use_shared_memory,
-                          self.worker_init_fn, wid),
+                          self.worker_init_fn, wid, n),
                     daemon=True)
                 p.start()
                 workers.append(p)
@@ -381,21 +380,28 @@ class DataLoader:
             done = 0
             deadline_t = self.timeout if self.timeout else None
             feed()
+            waited = 0.0
             while next_seq < len(batches):
                 if next_seq in pending:
                     yield self._to_tensors(pending.pop(next_seq))
                     next_seq += 1
+                    waited = 0.0
                     feed()
                     continue
                 try:
-                    kind, a, b = result_q.get(
-                        timeout=min(deadline_t, 1.0) if deadline_t else 1.0)
+                    kind, a, b = result_q.get(timeout=1.0)
                 except queue.Empty:
+                    waited += 1.0
                     if not any(p.is_alive() for p in workers):
                         raise RuntimeError(
                             "all DataLoader workers died without reporting "
                             "(OOM-killed?); check system logs") from None
+                    if deadline_t and waited >= deadline_t:
+                        raise RuntimeError(
+                            f"DataLoader timed out after {deadline_t}s "
+                            f"waiting for batch {next_seq}") from None
                     continue
+                waited = 0.0
                 if kind == "error":
                     raise RuntimeError(
                         f"DataLoader worker {a} failed:\n{b}")

@@ -107,9 +107,15 @@ def unpack_batch(payload):
 
 
 def worker_loop(dataset, index_queue, result_queue, collate_fn,
-                use_shared_memory: bool, worker_init_fn, worker_id: int):
+                use_shared_memory: bool, worker_init_fn, worker_id: int,
+                num_workers: int = 0):
     """Worker main: pull index lists, collate, ship via shared memory."""
     try:
+        # publish worker identity so get_worker_info()-sharded datasets and
+        # worker_init_fns see who they are (reference worker.py does the
+        # same before init_fn)
+        from .. import io as _io
+        _io._worker_info = _io._WorkerInfo(worker_id, num_workers, dataset)
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
     except BaseException:

@@ -143,17 +143,62 @@ def _flatten_inputs(diff_inputs):
     return vals, leaves, treedef
 
 
+_nan_check_ring: List = []  # [(op_name, device_flag_scalar)]
+_nan_atexit_registered = False
+
+
+def _on_nan_flag_change(enabled):
+    """Turning the checker off is a sync point: pending deferred flags are
+    reported now, and cannot leak into a later re-enabled phase."""
+    if not enabled and _nan_check_ring:
+        flush_nan_checks()
+
+
+def flush_nan_checks():
+    """Sync the deferred on-device NaN/Inf flags and report offenders.
+
+    With check_nan_inf_stride > 1, per-op checks stay on device (one
+    fused any(~isfinite) reduction per output, no host round trip — the
+    reference's on-device reduction design, `nan_inf_utils_detail.cu`);
+    this is the single blocking read for the whole window.
+    """
+    global _nan_check_ring
+    ring, _nan_check_ring = _nan_check_ring, []
+    if not ring:
+        return
+    flags_host = jax.device_get(jnp.stack([f for _, f in ring]))
+    bad = [name for (name, _), b in zip(ring, flags_host) if b]
+    if bad:
+        level = _flags.get_flag("check_nan_inf_level")
+        msg = f"Ops {sorted(set(bad))} produced NaN/Inf outputs"
+        if level == 0:
+            raise FloatingPointError(msg)
+        import warnings
+        warnings.warn(msg)
+
+
 def _check_nan_inf(name: str, outs):
     level = _flags.get_flag("check_nan_inf_level")
+    stride = int(_flags.get_flag("check_nan_inf_stride") or 1)
     for o in outs:
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
-            bad = bool(jnp.any(~jnp.isfinite(o)))
-            if bad:
-                msg = f"Op '{name}' produced NaN/Inf output"
-                if level == 0:
-                    raise FloatingPointError(msg)
-                import warnings
-                warnings.warn(msg)
+            flag = jnp.any(~jnp.isfinite(o))  # device-side, non-blocking
+            if stride <= 1:
+                if bool(flag):
+                    msg = f"Op '{name}' produced NaN/Inf output"
+                    if level == 0:
+                        raise FloatingPointError(msg)
+                    import warnings
+                    warnings.warn(msg)
+            else:
+                global _nan_atexit_registered
+                if not _nan_atexit_registered:
+                    import atexit
+                    atexit.register(flush_nan_checks)
+                    _nan_atexit_registered = True
+                _nan_check_ring.append((name, flag))
+    if stride > 1 and len(_nan_check_ring) >= stride:
+        flush_nan_checks()
 
 
 def _autocast_vals(op_name: str, vals: List[Any]):

@@ -326,3 +326,32 @@ def test_round_half_away_from_zero():
 def test_expand_invalid_minus_one():
     with pytest.raises(ValueError):
         paddle.expand(paddle.ones([3]), [-1, 3])
+
+
+def test_nan_inf_deferred_stride():
+    """stride>1: flags accumulate on device, one sync per window."""
+    from paddle_tpu.ops import registry
+    paddle.set_flags({"check_nan_inf": True, "check_nan_inf_stride": 4})
+    try:
+        paddle.log(paddle.to_tensor([-1.0]))  # bad, but deferred
+        paddle.exp(paddle.to_tensor([1.0]))   # fine
+        assert len(registry._nan_check_ring) >= 1
+        with pytest.raises(FloatingPointError, match="log"):
+            # filling the window (or flushing) surfaces the offender
+            registry.flush_nan_checks()
+        assert registry._nan_check_ring == []
+    finally:
+        paddle.set_flags({"check_nan_inf": False,
+                          "check_nan_inf_stride": 1})
+
+
+def test_nan_inf_flush_on_disable():
+    """Disabling the checker is a sync point for deferred flags."""
+    paddle.set_flags({"check_nan_inf": True, "check_nan_inf_stride": 8})
+    try:
+        paddle.sqrt(paddle.to_tensor([-4.0]))  # deferred NaN
+        with pytest.raises(FloatingPointError, match="sqrt"):
+            paddle.set_flags({"check_nan_inf": False})
+    finally:
+        paddle.set_flags({"check_nan_inf": False,
+                          "check_nan_inf_stride": 1})
