@@ -78,11 +78,21 @@ def set_flags(flags: Dict[str, Any]) -> None:
         for name, v in flags.items():
             if name not in _registry:
                 raise ValueError(f"Unknown flag: {name!r}")
+        deferred_exc = None
         for name, v in flags.items():
             f = _registry[name]
             f.value = _coerce(f.type, v)
             if f.on_change is not None:
-                f.on_change(f.value)
+                try:
+                    f.on_change(f.value)
+                except BaseException as e:
+                    # every flag in the dict must still be assigned (a
+                    # flag_guard restore can't be left half-applied); the
+                    # first hook failure is re-raised after
+                    if deferred_exc is None:
+                        deferred_exc = e
+        if deferred_exc is not None:
+            raise deferred_exc
 
 
 class flag_guard:

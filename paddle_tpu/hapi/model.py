@@ -75,31 +75,71 @@ class Model:
                                 f"got {type(m).__name__}")
         self._jit_compile = jit_compile
         self._compiled = {}
+        self._amp_kwargs = None
+        self._scaler = None
         if amp_configs is not None:
-            raise NotImplementedError(
-                "amp_configs: wrap the optimizer/loss with paddle_tpu.amp "
-                "auto_cast/GradScaler instead (Model-level AMP planned)")
+            # reference hapi accepts "O1"/"O2" or a dict mixing auto_cast
+            # and GradScaler settings (`hapi/model.py` _check_amp_configs)
+            if isinstance(amp_configs, str):
+                amp_configs = {"level": amp_configs}
+            cfg = dict(amp_configs)
+            level = cfg.pop("level", "O1")
+            if level not in ("O0", "O1", "O2"):
+                raise ValueError(f"amp level must be O0/O1/O2, got {level}")
+            if level != "O0":
+                from .. import amp as _amp
+                scaler_keys = {k: cfg.pop(k) for k in list(cfg)
+                               if k in ("init_loss_scaling", "incr_ratio",
+                                        "decr_ratio", "incr_every_n_steps",
+                                        "decr_every_n_nan_or_inf",
+                                        "use_dynamic_loss_scaling")}
+                self._amp_kwargs = {"level": level, **cfg}
+                # bf16 on TPU needs no loss scaling; fp16 (any alias) does
+                import jax.numpy as _jnp
+
+                from ..core import dtypes as _dtypes
+                is_fp16 = "dtype" in cfg and _dtypes.convert_dtype(
+                    cfg["dtype"]) == _jnp.dtype(_jnp.float16)
+                if scaler_keys or is_fp16:
+                    self._scaler = _amp.GradScaler(**scaler_keys)
 
     # ----------------------------------------------------------------- steps
     def _mode_fn(self, mode):
         """The raw (uncompiled) step function for `mode`."""
+        import contextlib
+
+        def _amp_ctx():
+            if self._amp_kwargs is None:
+                return contextlib.nullcontext()
+            from .. import amp as _amp
+            return _amp.auto_cast(True, **self._amp_kwargs)
+
         if mode == "train":
             def step(*args):
                 n_in = self._n_inputs
                 ins, labs = args[:n_in], args[n_in:]
-                outputs = to_list(self.network(*ins))
-                loss = self._loss(*(outputs + list(labs)))
-                loss.backward()
-                self._optimizer.step()
+                with _amp_ctx():
+                    outputs = to_list(self.network(*ins))
+                    loss = self._loss(*(outputs + list(labs)))
+                if self._scaler is not None:
+                    self._scaler.scale(loss).backward()
+                    self._scaler.step(self._optimizer)
+                else:
+                    loss.backward()
+                    self._optimizer.step()
                 self._optimizer.clear_grad()
                 return [loss] + outputs
         elif mode == "accumulate":  # train_batch(update=False)
             def step(*args):
                 n_in = self._n_inputs
                 ins, labs = args[:n_in], args[n_in:]
-                outputs = to_list(self.network(*ins))
-                loss = self._loss(*(outputs + list(labs)))
-                loss.backward()
+                with _amp_ctx():
+                    outputs = to_list(self.network(*ins))
+                    loss = self._loss(*(outputs + list(labs)))
+                if self._scaler is not None:
+                    self._scaler.scale(loss).backward()
+                else:
+                    loss.backward()
                 return [loss] + outputs
         elif mode == "eval":
             def step(*args):
@@ -128,7 +168,10 @@ class Model:
         # the captured program state — run it (and the step consuming it)
         # eagerly; steady-state update=True training stays compiled
         eager_needed = mode == "accumulate" or \
-            (mode == "train" and self._pending_accum)
+            (mode == "train" and self._pending_accum) or \
+            (mode in ("train", "accumulate") and self._scaler is not None)
+        # (dynamic loss scaling branches on found_inf on the host, which a
+        # captured program can't; bf16 AMP without a scaler stays compiled)
         if self._jit_compile and not eager_needed:
             if key not in self._compiled:
                 from ..jit import to_static

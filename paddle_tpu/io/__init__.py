@@ -380,28 +380,31 @@ class DataLoader:
             done = 0
             deadline_t = self.timeout if self.timeout else None
             feed()
-            waited = 0.0
+            import time as _time
+            wait_start = _time.monotonic()  # since we needed `next_seq`
             while next_seq < len(batches):
                 if next_seq in pending:
                     yield self._to_tensors(pending.pop(next_seq))
                     next_seq += 1
-                    waited = 0.0
+                    wait_start = _time.monotonic()
                     feed()
                     continue
+                remaining = None
+                if deadline_t:
+                    remaining = deadline_t - (_time.monotonic() - wait_start)
+                    if remaining <= 0:
+                        raise RuntimeError(
+                            f"DataLoader timed out after {deadline_t}s "
+                            f"waiting for batch {next_seq}")
                 try:
-                    kind, a, b = result_q.get(timeout=1.0)
+                    kind, a, b = result_q.get(
+                        timeout=min(remaining, 1.0) if remaining else 1.0)
                 except queue.Empty:
-                    waited += 1.0
                     if not any(p.is_alive() for p in workers):
                         raise RuntimeError(
                             "all DataLoader workers died without reporting "
                             "(OOM-killed?); check system logs") from None
-                    if deadline_t and waited >= deadline_t:
-                        raise RuntimeError(
-                            f"DataLoader timed out after {deadline_t}s "
-                            f"waiting for batch {next_seq}") from None
                     continue
-                waited = 0.0
                 if kind == "error":
                     raise RuntimeError(
                         f"DataLoader worker {a} failed:\n{b}")

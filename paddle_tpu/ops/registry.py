@@ -180,7 +180,14 @@ def flush_nan_checks():
 def _check_nan_inf(name: str, outs):
     level = _flags.get_flag("check_nan_inf_level")
     stride = int(_flags.get_flag("check_nan_inf_stride") or 1)
+    if stride <= 1 and _nan_check_ring:
+        flush_nan_checks()  # stride was lowered: report strandees now
     for o in outs:
+        if isinstance(o, jax.core.Tracer):
+            # inside a jit/to_static trace there is no concrete value to
+            # test (and a deferred tracer would escape the trace); the
+            # captured program is validated by its eager warmup run
+            continue
         if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.floating):
             flag = jnp.any(~jnp.isfinite(o))  # device-side, non-blocking
             if stride <= 1:

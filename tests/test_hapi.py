@@ -232,6 +232,30 @@ def test_fit_zero_epochs_is_noop():
     assert logs == {}
 
 
+@pytest.mark.parametrize("amp_configs", ["O1", {"level": "O2"},
+                                         {"level": "O1",
+                                          "init_loss_scaling": 1024.0}])
+def test_model_amp_configs(amp_configs):
+    paddle.seed(0)
+    net = paddle.vision.models.LeNet()
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.Adam(
+        learning_rate=2e-3, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(), metrics=Accuracy(),
+        amp_configs=amp_configs)
+    if isinstance(amp_configs, dict) and "init_loss_scaling" in amp_configs:
+        assert model._scaler is not None
+    logs = model.fit(TinyDataset(48), batch_size=16, epochs=3, verbose=0)
+    assert logs["acc"] > 0.4, logs  # learns under autocast
+    assert np.isfinite(logs["loss"])
+
+
+def test_model_amp_invalid_level():
+    model = paddle.Model(paddle.nn.Linear(2, 2))
+    with pytest.raises(ValueError):
+        model.prepare(amp_configs="O7")
+
+
 def test_summary_counts_params():
     net = paddle.vision.models.LeNet()
     info = paddle.summary(net)
