@@ -62,9 +62,27 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     """paddle.incubate.nn.functional.fused_rotary_position_embedding parity;
     layout [batch, seq, heads, head_dim]."""
     if cos is None or sin is None:
-        cos_v, sin_v = _default_cos_sin(q.shape[1], q.shape[-1],
-                                        q._value.dtype, use_neox_rotary_style,
-                                        rotary_emb_base)
+        if position_ids is not None:
+            # decode-time offsets: rotate by the tokens' absolute positions;
+            # accepts (S,) or the reference's (B, S) per-row id matrix
+            # (eager-only: the table length needs the concrete max id)
+            pids = position_ids._value if isinstance(position_ids, Tensor) \
+                else jnp.asarray(position_ids)
+            length = int(pids.max()) + 1
+            cos_v, sin_v = _default_cos_sin(
+                length, q.shape[-1], q._value.dtype,
+                use_neox_rotary_style, rotary_emb_base)
+            table_c, table_s = cos_v[0, :, 0, :], sin_v[0, :, 0, :]  # (L, D)
+            if pids.ndim == 1:
+                cos_v = table_c[pids][None, :, None, :]
+                sin_v = table_s[pids][None, :, None, :]
+            else:  # (B, S): per-row positions
+                cos_v = table_c[pids][:, :, None, :]
+                sin_v = table_s[pids][:, :, None, :]
+        else:
+            cos_v, sin_v = _default_cos_sin(
+                q.shape[1], q.shape[-1], q._value.dtype,
+                use_neox_rotary_style, rotary_emb_base)
         cos = Tensor._wrap(cos_v)
         sin = Tensor._wrap(sin_v)
     outs = _d("fused_rope", (q, k, v, cos, sin),

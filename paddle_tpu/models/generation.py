@@ -1,0 +1,91 @@
+"""Autoregressive generation with KV caches.
+
+Parity: the reference's `paddlenlp`-style `model.generate` surface
+(greedy / temperature / top-k / top-p sampling, eos early stop) reduced to
+the decoding core.  Eager host loop over single-token steps: the prefill
+runs the full prompt once, then each step feeds one token against the
+per-layer KV caches (attention is O(1) new work per step).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..framework.tensor import Tensor
+
+__all__ = ["GenerationMixin"]
+
+
+def _process_logits(logits, temperature, top_k, top_p):
+    """logits: jnp (B, V) -> filtered logits ready for sampling."""
+    if temperature != 1.0:
+        logits = logits / max(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jnp.exp(sorted_l - jnp.max(sorted_l, axis=-1, keepdims=True))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1)
+        kth = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return logits
+
+
+class GenerationMixin:
+    """Requires the model to implement
+    `forward_with_cache(input_ids, caches, pos_offset) -> (logits, caches)`
+    and `init_caches(batch_size) -> caches`."""
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None) -> Tensor:
+        """Returns (B, prompt_len + <=max_new_tokens) int ids; after a
+        sequence hits eos it is padded with eos."""
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids._value if isinstance(input_ids, Tensor) \
+                else jnp.asarray(input_ids)
+            if ids.ndim == 1:
+                ids = ids[None, :]
+            B, prompt_len = ids.shape
+            caches = self.init_caches(B)
+            logits_t, caches = self.forward_with_cache(
+                Tensor._wrap(ids), caches, pos_offset=0)
+            logits = logits_t._value[:, -1, :]
+
+            out = [ids]
+            finished = jnp.zeros((B,), bool)
+            for step in range(max_new_tokens):
+                if do_sample:
+                    filtered = _process_logits(
+                        logits.astype(jnp.float32), temperature, top_k,
+                        top_p)
+                    import jax
+                    nxt = jax.random.categorical(_random.next_key(),
+                                                 filtered, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                nxt = nxt.astype(ids.dtype)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished, eos_token_id, nxt)
+                    finished = finished | (nxt == eos_token_id)
+                out.append(nxt[:, None])
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+                logits_t, caches = self.forward_with_cache(
+                    Tensor._wrap(nxt[:, None]), caches,
+                    pos_offset=prompt_len + step)
+                logits = logits_t._value[:, -1, :]
+            return Tensor._wrap(jnp.concatenate(out, axis=1))
+        finally:
+            if was_training:
+                self.train()

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from .. import nn
 from ..framework.tensor import Tensor
 from ..nn import functional as F
+from .generation import GenerationMixin
 from ..ops import creation, manipulation as _m
 from ..incubate.nn.functional import fused_rotary_position_embedding
 
@@ -80,9 +81,22 @@ class GPTAttention(nn.Layer):
             new_cache = (k, v)
         else:
             new_cache = None
+        k_len = k.shape[1]
+        if k_len == s:
+            mask, causal = None, True
+        elif s == 1:
+            mask, causal = None, False  # decode token sees all cache
+        else:
+            # chunked prefill: offset-aware causal mask (query i at absolute
+            # position k_len - s + i may see keys 0..k_len-s+i)
+            import jax.numpy as _jnp
+            qpos = _jnp.arange(k_len - s, k_len)[:, None]
+            kpos = _jnp.arange(k_len)[None, :]
+            from ..framework.tensor import Tensor as _T
+            mask, causal = _T._wrap(qpos >= kpos), False
         out = F.scaled_dot_product_attention(
-            q, k, v, dropout_p=self.dropout, is_causal=True,
-            training=self.training)
+            q, k, v, attn_mask=mask, dropout_p=self.dropout,
+            is_causal=causal, training=self.training)
         out = _m.reshape(out, [b, s, self.num_heads * self.head_dim])
         out = self.proj(out)
         if new_cache is not None:
@@ -119,10 +133,14 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln1(x)))
+    def forward(self, x, kv_cache=None):
+        if kv_cache is None:
+            x = x + self.dropout(self.attn(self.ln1(x)))
+        else:
+            a, new_cache = self.attn(self.ln1(x), kv_cache)
+            x = x + self.dropout(a)
         x = x + self.dropout(self.mlp(self.ln2(x)))
-        return x
+        return x if kv_cache is None else (x, new_cache)
 
 
 class GPTModel(nn.Layer):
@@ -150,11 +168,17 @@ class GPTModel(nn.Layer):
                                     for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, pos_offset=0):
         b, s = input_ids.shape[0], input_ids.shape[1]
-        pos = creation.arange(s, dtype="int32")
+        pos = creation.arange(pos_offset, pos_offset + s, dtype="int32")
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
+        if kv_caches is not None:
+            new_caches = []
+            for block, cache in zip(self.blocks, kv_caches):
+                x, nc = block(x, cache)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         if self.cfg.use_recompute and self.training:
             from ..distributed.fleet import recompute
             for block in self.blocks:
@@ -165,7 +189,7 @@ class GPTModel(nn.Layer):
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
         self.cfg = cfg
@@ -177,6 +201,22 @@ class GPTForCausalLM(nn.Layer):
         h = self.gpt(input_ids)
         from ..ops.linalg import matmul
         return matmul(h, self.gpt.wte.weight, transpose_y=True)
+
+    def init_caches(self, batch_size):
+        import jax.numpy as jnp
+        from ..framework.tensor import Tensor as _T
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        dtype = self.gpt.wte.weight._value.dtype
+        empty = lambda: _T._wrap(jnp.zeros(
+            (batch_size, 0, cfg.num_heads, hd), dtype))
+        return [(empty(), empty()) for _ in range(cfg.num_layers)]
+
+    def forward_with_cache(self, input_ids, caches, pos_offset=0):
+        h, new_caches = self.gpt(input_ids, kv_caches=caches,
+                                 pos_offset=pos_offset)
+        from ..ops.linalg import matmul
+        return matmul(h, self.gpt.wte.weight, transpose_y=True), new_caches
 
     def compute_loss(self, input_ids, labels):
         logits = self(input_ids)

@@ -12,6 +12,7 @@ from dataclasses import dataclass
 from .. import nn
 from ..incubate.nn.functional import fused_rotary_position_embedding, swiglu
 from ..nn import functional as F
+from .generation import GenerationMixin
 from ..ops import creation, manipulation as _m
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama_tiny",
@@ -64,27 +65,49 @@ class LlamaAttention(nn.Layer):
             self.o_proj = nn.Linear(h * self.head_dim, cfg.hidden_size,
                                     bias_attr=False)
 
-    def forward(self, x, kv_cache=None):
+    def forward(self, x, kv_cache=None, pos_offset=None):
         cfg = self.cfg
         b, s = x.shape[0], x.shape[1]
         q = _m.reshape(self.q_proj(x), [b, s, cfg.num_heads, self.head_dim])
         k = _m.reshape(self.k_proj(x), [b, s, cfg.num_kv_heads, self.head_dim])
         v = _m.reshape(self.v_proj(x), [b, s, cfg.num_kv_heads, self.head_dim])
+        if pos_offset is not None:
+            offset = pos_offset
+        else:
+            offset = kv_cache[0].shape[1] if kv_cache is not None else 0
+        import numpy as _np
+        pos = _np.arange(offset, offset + s) if offset else None
         q, k, _ = fused_rotary_position_embedding(
-            q, k, None, use_neox_rotary_style=True,
+            q, k, None, position_ids=pos, use_neox_rotary_style=True,
             rotary_emb_base=cfg.rope_base)
+        new_cache = None
         if kv_cache is not None:
             pk, pv = kv_cache
             k = _m.concat([pk, k], axis=1)
             v = _m.concat([pv, v], axis=1)
+            new_cache = (k, v)
         if cfg.num_kv_heads != cfg.num_heads:  # GQA: repeat kv heads
             rep = cfg.num_heads // cfg.num_kv_heads
             k = _m.repeat_interleave(k, rep, axis=2)
             v = _m.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+        k_len = k.shape[1]
+        if k_len == s:
+            mask, causal = None, True
+        elif s == 1:
+            mask, causal = None, False  # decode token sees all cache
+        else:
+            # chunked prefill: offset-aware causal mask
+            import jax.numpy as _jnp
+            qpos = _jnp.arange(k_len - s, k_len)[:, None]
+            kpos = _jnp.arange(k_len)[None, :]
+            from ..framework.tensor import Tensor as _T
+            mask, causal = _T._wrap(qpos >= kpos), False
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                             is_causal=causal,
                                              training=self.training)
         out = _m.reshape(out, [b, s, cfg.num_heads * self.head_dim])
-        return self.o_proj(out)
+        out = self.o_proj(out)
+        return out if new_cache is None else (out, new_cache)
 
 
 class LlamaMLP(nn.Layer):
@@ -126,10 +149,15 @@ class LlamaBlock(nn.Layer):
                                                    cfg.rms_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
+    def forward(self, x, kv_cache=None, pos_offset=None):
+        if kv_cache is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+        else:
+            a, new_cache = self.self_attn(self.input_layernorm(x), kv_cache,
+                                          pos_offset)
+            x = x + a
         x = x + self.mlp(self.post_attention_layernorm(x))
-        return x
+        return x if kv_cache is None else (x, new_cache)
 
 
 class LlamaModel(nn.Layer):
@@ -146,8 +174,14 @@ class LlamaModel(nn.Layer):
                                     for _ in range(cfg.num_layers)])
         self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, kv_caches=None, pos_offset=None):
         x = self.embed_tokens(input_ids)
+        if kv_caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, kv_caches):
+                x, nc = layer(x, cache, pos_offset)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         if self.cfg.use_recompute and self.training:
             from ..distributed.fleet import recompute
             for layer in self.layers:
@@ -158,7 +192,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
         self.cfg = cfg
@@ -168,6 +202,21 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         return self.lm_head(self.model(input_ids))
+
+    def init_caches(self, batch_size):
+        import jax.numpy as jnp
+        from ..framework.tensor import Tensor as _T
+        cfg = self.cfg
+        hd = cfg.hidden_size // cfg.num_heads
+        dtype = self.model.embed_tokens.weight._value.dtype
+        empty = lambda: _T._wrap(jnp.zeros(
+            (batch_size, 0, cfg.num_kv_heads, hd), dtype))
+        return [(empty(), empty()) for _ in range(cfg.num_layers)]
+
+    def forward_with_cache(self, input_ids, caches, pos_offset=0):
+        h, new_caches = self.model(input_ids, kv_caches=caches,
+                                   pos_offset=pos_offset)
+        return self.lm_head(h), new_caches
 
     def compute_loss(self, input_ids, labels):
         logits = self(input_ids)

@@ -1,0 +1,111 @@
+"""Autoregressive generation: KV-cache decode parity with full forward.
+
+Mirrors the reference's generate() contract: cached incremental decode
+must produce exactly the tokens a full no-cache forward would pick.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def tiny_llama(**kw):
+    return LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=4, num_kv_heads=2, intermediate_size=128,
+                       max_seq_len=128, **kw)
+
+
+def greedy_no_cache(model, ids, n_new):
+    """Reference decoding: full forward each step, no cache."""
+    cur = np.asarray(ids._value)
+    for _ in range(n_new):
+        logits = model(paddle.to_tensor(cur))
+        nxt = np.asarray(logits._value)[:, -1, :].argmax(-1)
+        cur = np.concatenate([cur, nxt[:, None].astype(cur.dtype)], axis=1)
+    return cur
+
+
+@pytest.mark.parametrize("build", [
+    lambda: GPTForCausalLM(gpt3_tiny()),
+    lambda: LlamaForCausalLM(tiny_llama()),
+], ids=["gpt", "llama"])
+def test_cached_greedy_matches_full_forward(build):
+    paddle.seed(0)
+    model = build()
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 100, (2, 7)).astype(np.int32))
+    want = greedy_no_cache(model, ids, 6)
+    got = np.asarray(model.generate(ids, max_new_tokens=6)._value)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_generate_sampling_and_eos():
+    paddle.seed(1)
+    model = GPTForCausalLM(gpt3_tiny())
+    ids = paddle.to_tensor(np.ones((2, 4), np.int32))
+    out = np.asarray(model.generate(ids, max_new_tokens=8, do_sample=True,
+                                    temperature=0.8, top_k=20,
+                                    top_p=0.95)._value)
+    assert out.shape[1] <= 12 and out.shape[1] > 4
+    assert (out[:, :4] == 1).all()
+    # different seeds -> (almost surely) different samples
+    paddle.seed(2)
+    out2 = np.asarray(model.generate(ids, max_new_tokens=8, do_sample=True,
+                                     temperature=0.8)._value)
+    assert out.shape != out2.shape or not np.array_equal(out, out2)
+
+    # eos early stop: force eos as the argmax by a degenerate vocab trick —
+    # use eos = whatever greedy picks first, then expect padding with it
+    paddle.seed(1)
+    first = np.asarray(model.generate(ids, max_new_tokens=1)._value)[0, -1]
+    gen = np.asarray(model.generate(ids, max_new_tokens=6,
+                                    eos_token_id=int(first))._value)
+    assert gen.shape[1] <= 10
+
+
+@pytest.mark.parametrize("build", [
+    lambda: GPTForCausalLM(gpt3_tiny()),
+    lambda: LlamaForCausalLM(tiny_llama()),
+], ids=["gpt", "llama"])
+def test_chunked_prefill_matches_full(build):
+    """Feeding the prompt in two chunks through the cache must give the
+    same final logits as one full forward (offset-aware causal mask)."""
+    paddle.seed(0)
+    model = build()
+    model.eval()
+    ids = np.random.RandomState(3).randint(0, 100, (2, 8)).astype(np.int32)
+    full = np.asarray(model(paddle.to_tensor(ids))._value)[:, -1, :]
+
+    caches = model.init_caches(2)
+    _, caches = model.forward_with_cache(
+        paddle.to_tensor(ids[:, :5]), caches, pos_offset=0)
+    logits, _ = model.forward_with_cache(
+        paddle.to_tensor(ids[:, 5:]), caches, pos_offset=5)
+    chunked = np.asarray(logits._value)[:, -1, :]
+    np.testing.assert_allclose(chunked, full, rtol=2e-4, atol=2e-5)
+
+
+def test_generate_restores_training_mode():
+    model = GPTForCausalLM(gpt3_tiny())
+    model.train()
+    model.generate(paddle.to_tensor(np.ones((1, 3), np.int32)),
+                   max_new_tokens=2)
+    assert model.training
+
+
+def test_full_forward_unchanged_by_cache_plumbing():
+    """The no-cache training path must be byte-identical to before."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(tiny_llama())
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 100, (2, 8)).astype(np.int32))
+    labels = paddle.to_tensor(
+        np.random.RandomState(2).randint(0, 100, (2, 8)).astype(np.int32))
+    loss = model.compute_loss(ids, labels)
+    loss.backward()
+    assert np.isfinite(float(loss._value))
+    assert model.model.layers[0].self_attn.q_proj.weight.grad is not None
