@@ -1,0 +1,25 @@
+"""Shared model-building helpers."""
+
+from __future__ import annotations
+
+from .. import nn
+
+__all__ = ["tp_linear_pair"]
+
+
+def tp_linear_pair(tensor_parallel: bool, col_in: int, col_out: int,
+                   row_in: int = None, row_out: int = None):
+    """(column, row) linear pair: Megatron column-parallel into
+    row-parallel when `tensor_parallel`, plain Linears otherwise.
+
+    MLP shape (the default): col d->4d, row 4d->d.
+    Attention shape: col d->3d (qkv) but row d->d (out-proj consumes the
+    mixed heads, not the 3d qkv) — pass row_in/row_out explicitly."""
+    row_in = col_out if row_in is None else row_in
+    row_out = col_in if row_out is None else row_out
+    if tensor_parallel:
+        from ..distributed.fleet import (ColumnParallelLinear,
+                                         RowParallelLinear)
+        return (ColumnParallelLinear(col_in, col_out, gather_output=False),
+                RowParallelLinear(row_in, row_out, input_is_parallel=True))
+    return nn.Linear(col_in, col_out), nn.Linear(row_in, row_out)

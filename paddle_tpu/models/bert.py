@@ -1,0 +1,210 @@
+"""BERT model family.
+
+Parity: `PaddleNLP`-style BERT as exercised by the reference's
+`fused_multi_transformer` / flash-attn PHI path (BASELINE rung 3:
+BERT-base MLM); architecture per the original BERT (post-LN encoder).
+
+TPU-native: bidirectional attention goes through the same
+scaled_dot_product_attention entry as GPT (Pallas flash path when shapes
+allow, is_causal=False), the whole MLM step captures under jit.to_static,
+and the encoder works with the TP layers when cfg.tensor_parallel is on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import ParamAttr
+from ..nn.initializer import Normal
+from .. import nn
+from ..nn import functional as F
+from ..ops import creation
+from ..ops import manipulation as _m
+from ..ops import linalg as _lin
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "bert_base", "bert_tiny"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    tensor_parallel: bool = False
+    use_recompute: bool = False
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    defaults = dict(vocab_size=1024, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128,
+                    max_position_embeddings=128)
+    defaults.update(kw)
+    return BertConfig(**defaults)
+
+
+def _init_attr(cfg):
+    return ParamAttr(initializer=Normal(0.0, cfg.initializer_range))
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=_init_attr(cfg))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            weight_attr=_init_attr(cfg))
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size,
+            weight_attr=_init_attr(cfg))
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = creation.arange(s, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            # reference BERT always adds the segment embedding: default to
+            # segment 0 so None vs explicit zeros give identical outputs
+            token_type_ids = creation.zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        from ._common import tp_linear_pair
+        self.qkv, self.out = tp_linear_pair(
+            cfg.tensor_parallel, cfg.hidden_size, 3 * cfg.hidden_size,
+            row_in=cfg.hidden_size, row_out=cfg.hidden_size)
+        self.dropout = cfg.dropout
+
+    def forward(self, x, attention_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = _m.reshape(self.qkv(x), [b, s, 3, self.num_heads,
+                                       self.head_dim])
+        q, k, v = _m.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask, dropout_p=self.dropout,
+            is_causal=False, training=self.training)
+        out = _m.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.out(out)
+
+
+class BertLayer(nn.Layer):
+    """Post-LN transformer block (original BERT ordering)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = nn.LayerNorm(cfg.hidden_size,
+                                      epsilon=cfg.layer_norm_eps)
+        from ._common import tp_linear_pair
+        self.intermediate, self.output = tp_linear_pair(
+            cfg.tensor_parallel, cfg.hidden_size, cfg.intermediate_size)
+        self.out_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attention_mask=None):
+        x = self.attn_norm(x + self.dropout(
+            self.attention(x, attention_mask)))
+        h = self.output(F.gelu(self.intermediate(x)))
+        return self.out_norm(x + self.dropout(h))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = nn.LayerList([BertLayer(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        """Returns (sequence_output (B,S,H), pooled_output (B,H))."""
+        if input_ids.shape[1] > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds "
+                f"max_position_embeddings="
+                f"{self.cfg.max_position_embeddings}")
+        if attention_mask is not None:
+            # (B, S) 1/0 -> boolean keep-mask (B, 1, 1, S) broadcasting
+            # over heads and query positions
+            attention_mask = _m.unsqueeze(attention_mask > 0, [1, 2])
+        x = self.embeddings(input_ids, token_type_ids)
+        if self.cfg.use_recompute and self.training:
+            from ..distributed.fleet import recompute
+            for layer in self.layers:
+                x = recompute(layer, x, attention_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(nn.Layer):
+    """MLM head: dense + gelu + LN + tied decoder (BASELINE rung 3)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = nn.LayerNorm(cfg.hidden_size,
+                                           epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        logits = _lin.matmul(h, self.bert.embeddings.word_embeddings.weight,
+                             transpose_y=True) + self.decoder_bias
+        return logits
+
+    def compute_loss(self, input_ids, labels, ignore_index: int = -100,
+                     token_type_ids=None, attention_mask=None):
+        logits = self(input_ids, token_type_ids, attention_mask)
+        return F.cross_entropy(
+            _m.reshape(logits, [-1, self.cfg.vocab_size]),
+            _m.reshape(labels, [-1]), ignore_index=ignore_index)
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len) -> float:
+        n = self.num_params()
+        attn = 12 * self.cfg.num_layers * self.cfg.hidden_size * seq_len
+        return 6.0 * n + attn
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))

@@ -56,17 +56,10 @@ class GPTAttention(nn.Layer):
         self.num_heads = cfg.num_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.hidden = cfg.hidden_size
-        if cfg.tensor_parallel:
-            from ..distributed.fleet import (ColumnParallelLinear,
-                                             RowParallelLinear)
-            self.qkv = ColumnParallelLinear(cfg.hidden_size,
-                                            3 * cfg.hidden_size,
-                                            gather_output=False)
-            self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
-                                          input_is_parallel=True)
-        else:
-            self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
-            self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        from ._common import tp_linear_pair
+        self.qkv, self.proj = tp_linear_pair(
+            cfg.tensor_parallel, cfg.hidden_size, 3 * cfg.hidden_size,
+            row_in=cfg.hidden_size, row_out=cfg.hidden_size)
         self.dropout = cfg.dropout
 
     def forward(self, x, kv_cache=None):
@@ -107,18 +100,9 @@ class GPTAttention(nn.Layer):
 class GPTMLP(nn.Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
-        if cfg.tensor_parallel:
-            from ..distributed.fleet import (ColumnParallelLinear,
-                                             RowParallelLinear)
-            self.fc1 = ColumnParallelLinear(cfg.hidden_size,
-                                            cfg.intermediate_size,
-                                            gather_output=False)
-            self.fc2 = RowParallelLinear(cfg.intermediate_size,
-                                         cfg.hidden_size,
-                                         input_is_parallel=True)
-        else:
-            self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
-            self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        from ._common import tp_linear_pair
+        self.fc1, self.fc2 = tp_linear_pair(
+            cfg.tensor_parallel, cfg.hidden_size, cfg.intermediate_size)
 
     def forward(self, x):
         return self.fc2(F.gelu(self.fc1(x), approximate=True))
