@@ -286,7 +286,7 @@ def test_group_sharded_parallel_api(hybrid_env):
     net = nn.Linear(8, 8)
     opt = optimizer.Adam(learning_rate=0.01, parameters=net.parameters())
     model, opt2, _ = dist.sharding.group_sharded_parallel(net, opt, "p_g_os")
-    assert net.weight._value.sharding.spec == P("sharding")
+    assert net.weight._value.sharding.spec[0] == "sharding"
 
 
 def test_distributed_batch_sampler_epoch_shuffle(hybrid_env):
@@ -301,3 +301,58 @@ def test_distributed_batch_sampler_epoch_shuffle(hybrid_env):
     s.set_epoch(5)
     e1 = [i for b in s for i in b]
     assert e0 != e1
+
+
+def test_zero_sharding_uses_any_divisible_dim(hybrid_mesh):
+    """A (3, 8) param (dim0 not divisible by sharding=2) must still shard
+    on dim 1 instead of silently replicating."""
+    import warnings as _w
+    from paddle_tpu.distributed.fleet import sharding as shmod
+
+    sh = shmod._shard_spec_for((3, 8))
+    assert sh is not None and sh.spec == P(None, "sharding")
+    # dim0 divisible: prefers dim0
+    sh0 = shmod._shard_spec_for((4, 6))
+    assert sh0.spec[0] == "sharding"
+    # nothing divisible: warns once, returns None
+    shmod._warned_shapes.clear()
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        assert shmod._shard_spec_for((3, 5)) is None
+        assert shmod._shard_spec_for((3, 5)) is None
+    assert len([r for r in rec if "sharding" in str(r.message)]) == 1
+
+
+def test_stage2_validates_params(hybrid_mesh):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.sharding import (
+        GroupShardedOptimizerStage2)
+
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    GroupShardedOptimizerStage2(lin.parameters(), opt)  # ok
+    other = paddle.nn.Linear(2, 2)
+    with pytest.raises(ValueError):
+        GroupShardedOptimizerStage2(other.parameters(), opt)
+    with pytest.raises(NotImplementedError):
+        GroupShardedOptimizerStage2(lin.parameters(), opt, offload=True)
+
+
+def test_zero_sharding_preserves_tp_layout(hybrid_mesh):
+    """A param already mp-sharded on some dim must keep that dim; ZeRO
+    goes on a FREE divisible dim (and never double-applies)."""
+    from paddle_tpu.distributed.fleet import sharding as shmod
+    from paddle_tpu.distributed import mesh as meshmod
+
+    m = meshmod.get_mesh()
+    # vocab-parallel style: dim0 mp-sharded, dim1 free and divisible
+    existing = NamedSharding(m, P("mp", None))
+    sh = shmod._shard_spec_for((30522, 8), existing)
+    assert sh is not None
+    assert sh.spec[0] == "mp" and sh.spec[1] == "sharding"
+    # already ZeRO-sharded: no double application
+    assert shmod._shard_spec_for((8, 8), sh) is None
+    # every dim taken or indivisible: keeps layout, returns None
+    shmod._warned_shapes.clear()
+    assert shmod._shard_spec_for((30521,), NamedSharding(m, P("mp"))) is None
