@@ -85,6 +85,6 @@ for _gname, _gns in _generated_ops._NAMESPACES.items():
 del _gname, _gns
 from . import text  # noqa: F401,E402
 from . import hapi  # noqa: F401,E402
-from .hapi import Model, summary  # noqa: F401,E402
+from .hapi import Model, flops, summary  # noqa: F401,E402
 from .hapi import callbacks  # noqa: F401,E402
 

@@ -2,6 +2,7 @@
 
 from . import callbacks  # noqa: F401
 from .model import Model  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
 from .model_summary import summary  # noqa: F401
 
-__all__ = ["Model", "callbacks", "summary"]
+__all__ = ["Model", "callbacks", "summary", "flops"]

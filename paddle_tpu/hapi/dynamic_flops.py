@@ -1,0 +1,128 @@
+"""paddle.flops: per-layer FLOPs/params profile via forward hooks.
+
+Parity: `python/paddle/hapi/dynamic_flops.py` (flops `:24`,
+dynamic_flops `:159`, the per-layer-type count_* handlers).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    return int(np.prod(shape)) if shape else 1
+
+
+def _count_linear(layer, x: Tensor, y: Tensor) -> int:
+    # matmul MACs: out_elems * in_features
+    return _numel(y.shape) * layer.weight.shape[0]
+
+
+def _count_conv(layer, x: Tensor, y: Tensor) -> int:
+    w = layer.weight.shape  # (out_c, in_c/groups, *k)
+    kernel_ops = _numel(w[1:])
+    return _numel(y.shape) * kernel_ops
+
+
+def _count_norm(layer, x: Tensor, y: Tensor) -> int:
+    return 2 * _numel(x.shape)
+
+
+def _count_activation(layer, x: Tensor, y: Tensor) -> int:
+    return _numel(x.shape)
+
+
+def _count_pool(layer, x: Tensor, y: Tensor) -> int:
+    return _numel(y.shape)
+
+
+def _count_embedding(layer, x: Tensor, y: Tensor) -> int:
+    return 0  # gather, no MACs
+
+
+_HANDLERS = []
+
+
+def _register_handlers():
+    from .. import nn
+    _HANDLERS.extend([
+        (nn.Linear, _count_linear),
+        (nn.Conv2D, _count_conv),
+        (getattr(nn, "Conv1D", nn.Conv2D), _count_conv),
+        (nn.BatchNorm2D, _count_norm),
+        (nn.LayerNorm, _count_norm),
+        (getattr(nn, "RMSNorm", nn.LayerNorm), _count_norm),
+        (nn.ReLU, _count_activation),
+        (nn.GELU, _count_activation),
+        (nn.Sigmoid, _count_activation),
+        (nn.Tanh, _count_activation),
+        (nn.MaxPool2D, _count_pool),
+        (nn.AvgPool2D, _count_pool),
+        (getattr(nn, "AdaptiveAvgPool2D", nn.AvgPool2D), _count_pool),
+        (nn.Embedding, _count_embedding),
+    ])
+
+
+def flops(net: Layer, input_size: Sequence[int], custom_ops: Optional[Dict] = None,
+          print_detail: bool = False) -> int:
+    """Total multiply-accumulate count for one forward at `input_size`.
+
+    input_size includes the batch dim, e.g. [1, 3, 224, 224].
+    custom_ops: {LayerType: fn(layer, input, output) -> int} overrides.
+    """
+    if not _HANDLERS:
+        _register_handlers()
+    handlers = list(_HANDLERS)
+    if custom_ops:
+        handlers = [(t, f) for t, f in custom_ops.items()] + handlers
+
+    counts: Dict[int, int] = {}
+    rows = []
+    hooks = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, outputs):
+            x = inputs[0] if isinstance(inputs, tuple) else inputs
+            y = outputs[0] if isinstance(outputs, tuple) else outputs
+            if not isinstance(x, Tensor) or not isinstance(y, Tensor):
+                return
+            for t, fn in handlers:
+                if isinstance(lyr, t):
+                    n = int(fn(lyr, x, y))
+                    counts[id(lyr)] = counts.get(id(lyr), 0) + n
+                    rows.append((type(lyr).__name__, tuple(y.shape), n))
+                    return
+        return hook
+
+    for layer in net.sublayers(include_self=True):
+        if not layer._sub_layers:  # leaves only
+            hooks.append(layer.register_forward_post_hook(make_hook(layer)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        with paddle.no_grad():
+            net(paddle.zeros(list(input_size)))
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(counts.values())
+    if print_detail:
+        print(f"{'Layer':<24}{'Output shape':<24}{'FLOPs':>14}")
+        print("-" * 62)
+        for name, shape, n in rows:
+            print(f"{name:<24}{str(list(shape)):<24}{n:>14,}")
+        print("-" * 62)
+        print(f"Total FLOPs (MACs): {total:,}")
+    return total

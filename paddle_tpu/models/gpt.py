@@ -44,10 +44,21 @@ class GPTConfig:
     dropout: float = 0.0
     use_recompute: bool = False
     tensor_parallel: bool = False
+    # GPT-MoE: replace the MLP of every `moe_every_n_layers`-th block with
+    # a mixture of experts (0 experts = dense); shard ExpertMLP weights
+    # over an 'ep' mesh axis for expert parallelism
+    moe_num_experts: int = 0
+    moe_every_n_layers: int = 2
+    moe_top_k: int = 2
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
+        if self.moe_num_experts > 0 and self.moe_every_n_layers < 1:
+            raise ValueError(
+                "moe_every_n_layers must be >= 1 when moe_num_experts > 0 "
+                "(1 = every block is MoE)")
 
 
 class GPTAttention(nn.Layer):
@@ -109,12 +120,21 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, use_moe: bool = False):
         super().__init__()
         self.ln1 = nn.LayerNorm(cfg.hidden_size)
         self.attn = GPTAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.hidden_size)
-        self.mlp = GPTMLP(cfg)
+        if use_moe:
+            from ..incubate.distributed.models.moe import MoELayer
+            self.mlp = MoELayer(
+                d_model=cfg.hidden_size, num_expert=cfg.moe_num_experts,
+                d_hidden=cfg.intermediate_size,
+                gate=("gshard" if cfg.moe_top_k == 2 else
+                      "switch" if cfg.moe_top_k == 1 else "naive"),
+                top_k=cfg.moe_top_k)
+        else:
+            self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
 
     def forward(self, x, kv_cache=None):
@@ -148,8 +168,11 @@ class GPTModel(nn.Layer):
         self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
                                 weight_attr=emb_attr())
         self.drop = nn.Dropout(cfg.dropout)
-        self.blocks = nn.LayerList([GPTBlock(cfg)
-                                    for _ in range(cfg.num_layers)])
+        def _is_moe(i):
+            return cfg.moe_num_experts > 0 and \
+                (i + 1) % cfg.moe_every_n_layers == 0
+        self.blocks = nn.LayerList([GPTBlock(cfg, use_moe=_is_moe(i))
+                                    for i in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
 
     def forward(self, input_ids, kv_caches=None, pos_offset=0):
@@ -165,8 +188,15 @@ class GPTModel(nn.Layer):
             return self.ln_f(x), new_caches
         if self.cfg.use_recompute and self.training:
             from ..distributed.fleet import recompute
+            from ..incubate.distributed.models.moe import MoELayer
             for block in self.blocks:
-                x = recompute(block, x)
+                if isinstance(block.mlp, MoELayer):
+                    # the gate's aux loss leaves the block as an attribute,
+                    # which cannot cross a jax.checkpoint boundary — MoE
+                    # blocks run un-checkpointed (dense blocks still remat)
+                    x = block(x)
+                else:
+                    x = recompute(block, x)
         else:
             for block in self.blocks:
                 x = block(x)
@@ -204,9 +234,15 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
 
     def compute_loss(self, input_ids, labels):
         logits = self(input_ids)
-        return F.cross_entropy(
+        loss = F.cross_entropy(
             _m.reshape(logits, [-1, self.cfg.vocab_size]),
             _m.reshape(labels, [-1]))
+        if self.cfg.moe_num_experts > 0:
+            for block in self.gpt.blocks:
+                aux = getattr(block.mlp, "l_aux", None)
+                if aux is not None:
+                    loss = loss + self.cfg.moe_aux_weight * aux
+        return loss
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())

@@ -262,3 +262,29 @@ def test_summary_counts_params():
     want = sum(int(np.prod(p.shape)) for p in net.parameters())
     assert info["total_params"] == want
     assert info["trainable_params"] == want
+
+
+def test_flops_counts_lenet():
+    net = paddle.vision.models.LeNet()
+    net.train()
+    n = paddle.flops(net, [1, 1, 28, 28])
+    assert net.training  # flops() must restore the mode it found
+    # exact conv+fc MAC lower bound; activations/pools add a little more
+    want_min = 6 * 25 * 24 * 24 + 16 * 150 * 8 * 8 + 400 * 120 \
+        + 120 * 84 + 84 * 10
+    assert want_min <= n <= int(want_min * 1.25)
+    # batch scales linearly for the conv/fc terms
+    n4 = paddle.flops(net, [4, 1, 28, 28])
+    assert 3.5 * n < n4 < 4.5 * n
+
+
+def test_flops_custom_ops_and_detail(capsys):
+    lin = paddle.nn.Linear(8, 4)
+    n = paddle.flops(lin, [2, 8])
+    assert n == 2 * 4 * 8
+    n2 = paddle.flops(lin, [2, 8],
+                      custom_ops={paddle.nn.Linear: lambda l, x, y: 7})
+    assert n2 == 7
+    paddle.flops(lin, [2, 8], print_detail=True)
+    out = capsys.readouterr().out
+    assert "Total FLOPs" in out
