@@ -12,9 +12,11 @@ from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
                          wait)
 from .parallel import DataParallel, init_parallel_env, shard_batch  # noqa: F401
 from . import fleet  # noqa: F401
-from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate,  # noqa: F401
-                            Shard, dtensor_from_fn, reshard, shard_layer,
-                            shard_optimizer, shard_tensor, unshard_dtensor)
+from .auto_parallel import (DistModel, Engine, Partial, Placement,  # noqa: F401
+                            ProcessMesh, Replicate, Shard, Strategy,
+                            dtensor_from_fn, reshard, shard_layer,
+                            shard_optimizer, shard_tensor, to_static,
+                            unshard_dtensor)
 from . import sharding  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401

@@ -1,5 +1,7 @@
 from .api import (dtensor_from_fn, reshard, shard_layer, shard_optimizer,  # noqa: F401
                   shard_tensor, to_static, unshard_dtensor)
+from .engine import DistModel, Engine  # noqa: F401
+from .strategy import Strategy  # noqa: F401
 from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
 from .process_mesh import ProcessMesh  # noqa: F401
 from . import spmd_rules  # noqa: F401

@@ -175,9 +175,31 @@ def shard_optimizer(optimizer: Optimizer, shard_fn=None) -> _ShardOptimizer:
 
 
 def to_static(layer_or_fn, loader=None, loss=None, optimizer=None,
-              strategy=None):
-    """Semi-auto static path: captures the step with jit (GSPMD propagates
-    the DistTensor shardings through the whole graph) — the Engine
-    equivalent (`auto_parallel/static/engine.py`)."""
-    from ...jit.api import to_static as _jit_to_static
-    return _jit_to_static(layer_or_fn)
+              strategy=None, input_spec=None):
+    """Semi-auto static path (`auto_parallel/api.py:2097`).
+
+    With (loss, optimizer) builds an Engine-backed `DistModel` whose call
+    runs the compiled distributed train step (forward + loss + backward +
+    optimizer update as ONE XLA program, GSPMD propagating the DistTensor
+    shardings).  A bare function/layer falls back to plain jit capture.
+    """
+    if loss is None and optimizer is None and strategy is None:
+        from ...jit.api import to_static as _jit_to_static
+        return _jit_to_static(layer_or_fn, input_spec=input_spec)
+    from .engine import DistModel, Engine
+    n_inputs = 1
+    if loader is not None and not (hasattr(loader, "__next__")
+                                   or hasattr(loader, "gi_frame")):
+        # peek a RE-ITERABLE loader's structure to learn the input/label
+        # split (reference DistModel takes (inputs, labels) per the
+        # loader's batch); one-shot iterators are never consumed here
+        try:
+            first = next(iter(loader))
+            if isinstance(first, (list, tuple)) and len(first) > 1:
+                n_inputs = max(len(first) - 1, 1)
+        except Exception:
+            pass
+    engine = Engine(model=layer_or_fn, loss=loss, optimizer=optimizer,
+                    strategy=strategy)
+    engine.prepare()
+    return DistModel(engine, n_inputs=n_inputs)

@@ -1,0 +1,70 @@
+"""Auto-parallel Strategy: typed config tree for the static Engine.
+
+Parity: `python/paddle/distributed/auto_parallel/strategy.py` (Strategy with
+amp / recompute / gradient_merge / sharding / pipeline sub-configs, each a
+config object with an ``enable`` switch) and `api.py:1351`.
+
+TPU-native: plain attribute dataclasses — no proto round trip.  Each field
+maps to a capture-time decision of the Engine (AMP context, jax.checkpoint
+wrapping, in-step microbatch accumulation, ZeRO state sharding) rather than
+to a program-rewrite pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["Strategy"]
+
+
+@dataclass
+class _Config:
+    enable: bool = False
+
+
+@dataclass
+class AmpConfig(_Config):
+    dtype: str = "float16"
+    level: str = "o1"
+    init_loss_scaling: float = 32768.0
+    use_master_grad: bool = False
+    custom_white_list: tuple = ()
+    custom_black_list: tuple = ()
+
+
+@dataclass
+class RecomputeConfig(_Config):
+    # reference exposes per-op checkpoint lists; the TPU engine applies
+    # jax.checkpoint around the model forward (XLA rematerializes inside)
+    refined_ops_patterns: tuple = ()
+
+
+@dataclass
+class GradientMergeConfig(_Config):
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclass
+class ShardingConfig(_Config):
+    stage: int = 1
+    degree: int = -1  # -1: the mesh's full "dp" axis
+
+
+@dataclass
+class PipelineConfig(_Config):
+    schedule_mode: str = "1F1B"
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+
+
+@dataclass
+class Strategy:
+    """`auto.Strategy()` — attribute-compatible subset of the reference."""
+
+    amp: AmpConfig = field(default_factory=AmpConfig)
+    recompute: RecomputeConfig = field(default_factory=RecomputeConfig)
+    gradient_merge: GradientMergeConfig = field(
+        default_factory=GradientMergeConfig)
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)

@@ -215,34 +215,8 @@ class Model:
 
     # ------------------------------------------------------------- save/load
     def _remap_opt_state(self, sd, to_structured: bool):
-        """Translate optimizer accumulator keys between this process's
-        auto-generated parameter names ("param_37_moment1") and the
-        network's stable structured names ("fc.0.weight@moment1"), so a
-        .pdopt saved by one process restores into a freshly built model."""
-        state = self.network.state_dict()
-        by_pname = {p.name: k for k, p in state.items()}
-        by_struct = state
-        accs = self._optimizer._known_state_names() | {"master_weight"}
-        out = {}
-        for key, v in sd.items():
-            if key in ("LR_Scheduler", "global_step"):
-                out[key] = v
-                continue
-            mapped = None
-            if to_structured:
-                for acc in accs:
-                    if key.endswith("_" + acc):
-                        sname = by_pname.get(key[:-len(acc) - 1])
-                        if sname is not None:
-                            mapped = f"{sname}@{acc}"
-                        break
-            elif "@" in key:
-                sname, acc = key.rsplit("@", 1)
-                p = by_struct.get(sname)
-                if p is not None:
-                    mapped = f"{p.name}_{acc}"
-            out[mapped or key] = v
-        return out
+        return self._optimizer.remap_state_keys(self.network, sd,
+                                                to_structured)
 
     def save(self, path: str, training: bool = True):
         dirname = os.path.dirname(path)

@@ -276,6 +276,38 @@ class Optimizer:
         names.add("master_weight")
         return names
 
+    def remap_state_keys(self, network, sd, to_structured: bool):
+        """Translate accumulator keys between this process's auto-generated
+        parameter names ("param_37_moment1") and the network's stable
+        structured names ("fc.0.weight@moment1"), so a .pdopt saved by one
+        process restores into a freshly built model (the reference keys by
+        parameter name, which its framework keeps stable across processes;
+        our names are a process-global counter, so checkpoints store the
+        structured form)."""
+        state = network.state_dict()
+        by_pname = {p.name: k for k, p in state.items()}
+        accs = self._known_state_names()
+        out = {}
+        for key, v in sd.items():
+            if key in ("LR_Scheduler", "global_step"):
+                out[key] = v
+                continue
+            mapped = None
+            if to_structured:
+                for acc in accs:
+                    if key.endswith("_" + acc):
+                        sname = by_pname.get(key[:-len(acc) - 1])
+                        if sname is not None:
+                            mapped = f"{sname}@{acc}"
+                        break
+            elif "@" in key:
+                sname, acc = key.rsplit("@", 1)
+                p = state.get(sname)
+                if p is not None:
+                    mapped = f"{p.name}_{acc}"
+            out[mapped or key] = v
+        return out
+
     def set_state_dict(self, state):
         import warnings
         import numpy as np

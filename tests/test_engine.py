@@ -1,0 +1,214 @@
+"""Auto-parallel static Engine (reference `auto_parallel/static/engine.py`).
+
+Covers: Engine.fit/evaluate/predict with sharded params over a dp x mp
+mesh, loss parity vs a serial run, Strategy options (gradient_merge,
+recompute, amp, ZeRO sharding), dist.to_static returning a working
+DistModel, and save/load round trip.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+
+
+class MLP(nn.Layer):
+    def __init__(self, din=16, dh=32, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, dh)
+        self.fc2 = nn.Linear(dh, dout)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _mesh():
+    return dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                            dim_names=["dp", "mp"])
+
+
+def _shard_mlp(model, mesh):
+    # Megatron column/row parallel over the mp axis
+    for p, pl in ((model.fc1.weight, [dist.Replicate(), dist.Shard(1)]),
+                  (model.fc1.bias, [dist.Replicate(), dist.Shard(0)]),
+                  (model.fc2.weight, [dist.Replicate(), dist.Shard(0)]),
+                  (model.fc2.bias, [dist.Replicate(), dist.Replicate()])):
+        sharded = dist.shard_tensor(p, mesh, pl)
+        p._value = sharded._value
+        p._dist_attr = sharded._dist_attr
+
+
+def _data(n=32, din=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, din).astype(np.float32)
+    y = rng.randint(0, classes, (n, 1)).astype(np.int64)
+    return x, y
+
+
+def _fresh(seed=7):
+    paddle.seed(seed)
+    return MLP()
+
+
+def test_engine_fit_matches_serial():
+    x, y = _data()
+    # serial reference
+    model_s = _fresh()
+    opt_s = optimizer.SGD(learning_rate=0.1,
+                          parameters=model_s.parameters())
+    lossf = nn.CrossEntropyLoss()
+    serial_losses = []
+    for i in range(4):
+        xb = paddle.to_tensor(x[i * 8:(i + 1) * 8])
+        yb = paddle.to_tensor(y[i * 8:(i + 1) * 8])
+        loss = lossf(model_s(xb), yb)
+        loss.backward()
+        opt_s.step()
+        opt_s.clear_grad()
+        serial_losses.append(float(loss.item()))
+
+    # Engine over dp4 x mp2
+    mesh = _mesh()
+    model = _fresh()
+    _shard_mlp(model, mesh)
+    eng = Engine(model=model,
+                 loss=nn.CrossEntropyLoss(),
+                 optimizer=optimizer.SGD(learning_rate=0.1,
+                                         parameters=model.parameters()))
+    logs = eng.fit(train_data=(x, y), batch_size=8, epochs=1,
+                   shuffle=False, verbose=0)
+    np.testing.assert_allclose(logs["loss"], serial_losses,
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_engine_evaluate_and_predict():
+    x, y = _data()
+    mesh = _mesh()
+    model = _fresh()
+    _shard_mlp(model, mesh)
+    eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                 optimizer=optimizer.SGD(
+                     learning_rate=0.1, parameters=model.parameters()))
+    res = eng.evaluate((x, y), batch_size=8, verbose=0)
+    assert "loss" in res and np.isfinite(res["loss"])
+    outs = eng.predict([x], batch_size=8)
+    assert len(outs) == 4 and outs[0][0].shape == (8, 4)
+
+
+def test_engine_gradient_merge_parity():
+    """k_steps microbatch accumulation == one big-batch step (linear model +
+    SGD make the equivalence exact)."""
+    x, y = _data(n=16)
+    results = []
+    for k in (1, 2):
+        model = _fresh()
+        strat = Strategy()
+        strat.gradient_merge.enable = k > 1
+        strat.gradient_merge.k_steps = k
+        eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                     optimizer=optimizer.SGD(
+                         learning_rate=0.1, parameters=model.parameters()),
+                     strategy=strat)
+        eng.fit(train_data=(x, y), batch_size=16, epochs=1, shuffle=False,
+                verbose=0)
+        results.append(np.asarray(model.fc1.weight._value))
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5, atol=1e-6)
+
+
+def test_engine_recompute_and_amp():
+    x, y = _data()
+    mesh = _mesh()
+    model = _fresh()
+    _shard_mlp(model, mesh)
+    strat = Strategy()
+    strat.recompute.enable = True
+    strat.amp.enable = True
+    strat.amp.dtype = "bfloat16"
+    strat.amp.level = "o1"
+    eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                 optimizer=optimizer.SGD(
+                     learning_rate=0.1, parameters=model.parameters()),
+                 strategy=strat)
+    logs = eng.fit(train_data=(x, y), batch_size=8, epochs=1, shuffle=False,
+                   verbose=0)
+    assert np.all(np.isfinite(logs["loss"]))
+
+
+def test_engine_zero_shards_opt_state():
+    x, y = _data()
+    mesh = _mesh()
+    model = _fresh()
+    _shard_mlp(model, mesh)
+    strat = Strategy()
+    strat.sharding.enable = True
+    eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                 optimizer=optimizer.Adam(
+                     learning_rate=0.01, parameters=model.parameters()),
+                 strategy=strat)
+    eng.fit(train_data=(x, y), batch_size=8, epochs=1, shuffle=False,
+            verbose=0)
+    # fc2.bias is fully replicated [4]; too small to shard — just check the
+    # moment state of the replicated-on-mp fc1.weight got a dp shard
+    opt = eng._optimizer._inner
+    store = opt._accumulators.get("moment1") or {}
+    assert store, "Adam moments missing"
+    w = model.fc1.weight
+    m = store[id(w)]
+    spec = m.sharding.spec
+    assert "dp" in [e for e in spec if e is not None] or \
+        any(isinstance(e, tuple) and "dp" in e for e in spec)
+
+
+def test_dist_to_static_returns_working_distmodel():
+    x, y = _data()
+    mesh = _mesh()
+    model = _fresh()
+    _shard_mlp(model, mesh)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    loader = [(paddle.to_tensor(x[i * 8:(i + 1) * 8]),
+               paddle.to_tensor(y[i * 8:(i + 1) * 8])) for i in range(4)]
+    dm = dist.to_static(model, loader, nn.CrossEntropyLoss(), opt)
+    dm.train()
+    losses = [float(np.asarray(dm(xb, yb)._value)) for xb, yb in loader]
+    assert all(np.isfinite(losses))
+    # training should make progress on replays of the same data
+    losses2 = [float(np.asarray(dm(xb, yb)._value)) for xb, yb in loader]
+    assert np.mean(losses2) < np.mean(losses)
+
+
+def test_engine_save_load(tmp_path):
+    x, y = _data()
+    model = _fresh()
+    eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+                 optimizer=optimizer.Adam(
+                     learning_rate=0.01, parameters=model.parameters()))
+    eng.fit(train_data=(x, y), batch_size=8, epochs=1, shuffle=False,
+            verbose=0)
+    path = str(tmp_path / "ckpt")
+    eng.save(path)
+    model2 = _fresh(seed=99)
+    eng2 = Engine(model=model2, loss=nn.CrossEntropyLoss(),
+                  optimizer=optimizer.Adam(
+                      learning_rate=0.01, parameters=model2.parameters()))
+    eng2.load(path)
+    np.testing.assert_allclose(np.asarray(model2.fc1.weight._value),
+                               np.asarray(model.fc1.weight._value))
+    # optimizer accumulators must survive the cross-process rename
+    # (param_N counters differ between the two engines)
+    src = eng._optimizer._accumulators["moment1"]
+    dst = eng2._optimizer._accumulators["moment1"]
+    np.testing.assert_allclose(
+        np.asarray(dst[id(model2.fc1.weight)]),
+        np.asarray(src[id(model.fc1.weight)]), rtol=1e-6)
+
+
+def test_engine_predict_keeps_ragged_tail():
+    x, _ = _data(n=20)
+    model = _fresh()
+    eng = Engine(model=model)
+    outs = eng.predict([x], batch_size=8)
+    total = sum(o[0].shape[0] for o in outs)
+    assert total == 20  # 8 + 8 + 4: trailing partial batch not dropped
