@@ -236,6 +236,19 @@ class Engine:
         cost of one extra compile for a ragged final batch."""
         from ... import io
         if isinstance(data, io.DataLoader):
+            sampler = getattr(data, "batch_sampler", None)
+            already_drops = getattr(sampler, "drop_last",
+                                    getattr(data, "drop_last", False))
+            if drop_last and not already_drops and \
+                    getattr(data, "dataset", None) is not None and \
+                    not getattr(data, "_iterable_mode", False):
+                # a ragged final batch would violate the compiled step's
+                # fixed shape; rebuild the loader over the same dataset
+                bs = getattr(sampler, "batch_size", None) or batch_size
+                return io.DataLoader(
+                    data.dataset, batch_size=bs, shuffle=shuffle,
+                    collate_fn=data._custom_collate,
+                    num_workers=data.num_workers, drop_last=True)
             return data
         if isinstance(data, (list, tuple)) and data and \
                 isinstance(data[0], (np.ndarray, Tensor)):
@@ -381,7 +394,10 @@ class DistModel:
 
     def __init__(self, engine: Engine, n_inputs: int = 1):
         self._engine = engine
-        self._mode = "train" if engine._optimizer is not None else "predict"
+        # train mode needs BOTH pieces; an optimizer without a loss cannot
+        # form a train step
+        self._mode = "train" if (engine._optimizer is not None
+                                 and engine._loss is not None) else "predict"
         self._n_inputs = n_inputs
 
     def train(self):
@@ -401,8 +417,14 @@ class DistModel:
     def __call__(self, *args):
         eng = self._engine
         eng._ensure_prepared()
+        if self._mode == "train" and eng._loss is None:
+            raise RuntimeError("DistModel in train mode needs a loss; "
+                               "pass loss= to dist.to_static or call "
+                               ".predict()/.eval()")
         if self._mode == "predict":
-            return eng._run_batch("predict", list(args), [])
+            res = eng._run_batch("predict", list(args), [])
+            # mirror the model's own forward: single output unwrapped
+            return res[0] if isinstance(res, list) and len(res) == 1 else res
         n = self._n_inputs
         res = eng._run_batch(self._mode, list(args[:n]), list(args[n:]))
         return res if not isinstance(res, list) else res[0]

@@ -208,6 +208,66 @@ def _check_nan_inf(name: str, outs):
         flush_nan_checks()
 
 
+# --------------------------------------------------------------------------
+# Fast path: cached per-(op, tree, attrs) jitted forward/backward programs.
+#
+# The reference keeps the eager hot loop in C++ (`multiply_fwd_func.cc:39`);
+# here the Python cost is hidden by compiling each op ONCE per (input
+# structure, static attrs) into two cached XLA executables:
+#   fwd(vals)          — the op's lowering, jitted
+#   bwd(primals, cot)  — jax.vjp of the op *inside* jit: the forward is
+#                        recomputed at op granularity and XLA dead-code-
+#                        eliminates whatever the grad doesn't need (matmul's
+#                        bwd keeps exactly its two matmuls), so no residual
+#                        closure has to cross the jit boundary.
+# Ops with unhashable attrs (e.g. dropout's traced RNG key) or that cannot
+# trace (dynamic output shapes: nonzero/unique/masked_select) fall back to
+# the direct eager path; a failing op is remembered in _fast_disabled.
+# --------------------------------------------------------------------------
+
+_fast_fwd: Dict[Any, Any] = {}
+_fast_bwd: Dict[Any, Any] = {}
+_fast_disabled: set = set()
+
+
+def _freeze_val(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze_val(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze_val(x)) for k, x in v.items()))
+    hash(v)  # raises TypeError for arrays and other unhashables
+    # carry the type: 0 / 0.0 / False compare equal but close over
+    # different-dtype programs (e.g. clip bounds decide output dtype)
+    return (type(v).__name__, v)
+
+
+def _static_key(static: Dict[str, Any]):
+    # AMP changes what nested dispatches trace to (composite ops like
+    # recompute re-enter the registry during THEIR trace); the backward
+    # program traces later, possibly outside the auto_cast context, so the
+    # fast path is simply skipped while autocasting — the legacy jax.vjp
+    # linearizes at dispatch time, inside the context, which is correct.
+    if _autocast_hook is not None:
+        return None
+    try:
+        return tuple(sorted((k, _freeze_val(v)) for k, v in static.items()))
+    except TypeError:
+        return None
+
+
+def _fast_programs(name: str, treedef, skey, fn_flat):
+    key = (name, treedef, skey)
+    fwd = _fast_fwd.get(key)
+    if fwd is None:
+        fwd = jax.jit(fn_flat)
+        _fast_fwd[key] = fwd
+
+        def bwd(primals, cot):
+            return jax.vjp(fn_flat, *primals)[1](cot)
+        _fast_bwd[key] = jax.jit(bwd)
+    return fwd, _fast_bwd[key]
+
+
 def _autocast_vals(op_name: str, vals: List[Any]):
     """Apply AMP casting to float inputs; returns (vals, cast_back_dtype)."""
     if _autocast_hook is None:
@@ -262,8 +322,21 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
         args = jax.tree_util.tree_unflatten(treedef, vs)
         return fn(*args, **static)
 
+    skey = None if name in _fast_disabled else _static_key(static)
+
     if not requires_grad:
-        outs = fn_flat(*vals)
+        outs = None
+        if skey is not None:
+            fwd_j, _ = _fast_programs(name, treedef, skey, fn_flat)
+            try:
+                outs = fwd_j(*vals)
+            except Exception:
+                outs = fn_flat(*vals)  # user error re-raises right here
+                # the eager run succeeded, so the op itself is untraceable
+                # (dynamic output shape / value-dependent branch): disable
+                _fast_disabled.add(name)
+        if outs is None:
+            outs = fn_flat(*vals)
         multi = isinstance(outs, tuple)
         outs_t = tuple(outs) if multi else (outs,)
         if _flags.get_flag("check_nan_inf"):
@@ -277,7 +350,30 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
         outs, vjp_fn = op.custom_vjp(treedef, vals, static)
         make_vjp = lambda v: op.custom_vjp(treedef, v, static)  # noqa: E731
     else:
-        outs, vjp_fn = jax.vjp(fn_flat, *vals)
+        outs = None
+        if skey is not None:
+            fwd_j, bwd_j = _fast_programs(name, treedef, skey, fn_flat)
+            try:
+                outs = fwd_j(*vals)
+            except Exception:
+                # eager linearization below re-raises genuine user errors
+                # (bad shapes); if it succeeds the op itself is untraceable
+                # under jit (dynamic output shape / value-dependent branch)
+                outs, vjp_fn = jax.vjp(fn_flat, *vals)
+                _fast_disabled.add(name)
+            else:
+                primals = tuple(vals)
+
+                def vjp_fn(cot, _p=primals, _bwd=bwd_j, _f=fn_flat):
+                    try:
+                        return _bwd(_p, cot)
+                    except Exception:
+                        # degrade to the eager linearization rather than
+                        # poisoning every later step
+                        _fast_disabled.add(name)
+                        return jax.vjp(_f, *_p)[1](cot)
+        if outs is None:
+            outs, vjp_fn = jax.vjp(fn_flat, *vals)
         make_vjp = lambda v: jax.vjp(fn_flat, *v)  # noqa: E731
 
     multi = isinstance(outs, tuple)

