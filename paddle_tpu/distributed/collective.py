@@ -226,9 +226,32 @@ def _single_rank(group: Optional[Group]) -> bool:
 
 
 # ------------------------------------------------------------ functional API
+def _maybe_static_check(op_name: str, tensor) -> None:
+    """FLAGS_comm_static_check: cross-process meta verification before the
+    collective (reference `CommStaticCheck`, static_check.h:24).  Active in
+    multi-process jobs; in-process SPMD shapes are uniform by construction."""
+    from .. import flags as _fl
+    if not _fl.get_flag("comm_static_check"):
+        return
+    store = _host_store()
+    if store is None:
+        return
+    import os
+    from .watchdog import static_check_meta
+    seqs = _store_state.setdefault("check_seq", {})
+    seq = seqs.get(op_name, 0)
+    seqs[op_name] = seq + 1
+    static_check_meta(
+        store, int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1")), op_name, seq,
+        shape=tuple(tensor.shape), dtype=tensor.dtype,
+        generation=_generation())
+
+
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
     """In-place all-reduce (paddle semantics: mutates `tensor`)."""
+    _maybe_static_check("all_reduce", tensor)
     axis = current_axis_for(group)
     if axis is not None:
         out = _d("c_allreduce", (tensor,), {"op": op, "axis": axis})
@@ -254,6 +277,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op: bool = True):
+    _maybe_static_check("all_gather", tensor)
     axis = current_axis_for(group)
     group = group or _get_default_group()
     if axis is not None:
@@ -436,13 +460,31 @@ def _host_p2p(tensor, peer, is_send, group):
     seq = _store_state["p2p_seq"].get(key_id, 0)
     _store_state["p2p_seq"][key_id] = seq + 1
     key = f"__p2p__/{_generation()}/{src}->{dst}/{seq}"
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if is_send:
-        store.set(key, pickle.dumps(np.asarray(tensor._value)))
+        arr = np.asarray(tensor._value)
+        # meta travels with the payload: the NCCLDynamicCheck equivalent
+        store.set(key, pickle.dumps(
+            {"shape": arr.shape, "dtype": str(arr.dtype), "data": arr}))
     else:
-        store.wait(key)
-        arr = pickle.loads(store.get(key))
+        from .watchdog import comm_task
+        with comm_task(f"recv({src}->{dst})", key=key, rank=rank,
+                       world_size=world, store=store,
+                       generation=_generation()):
+            store.wait(key)
+        msg = pickle.loads(store.get(key))
         store.delete_key(key)  # free the payload in the server
-        tensor._value = jnp.asarray(arr, dtype=tensor._value.dtype)
+        if tuple(msg["shape"]) != tuple(tensor.shape):
+            raise RuntimeError(
+                f"p2p dynamic check: sender {src} shipped shape "
+                f"{tuple(msg['shape'])} but receiver expects "
+                f"{tuple(tensor.shape)}")
+        if msg["dtype"] != str(np.dtype(tensor._value.dtype)):
+            raise RuntimeError(
+                f"p2p dynamic check: sender {src} shipped dtype "
+                f"{msg['dtype']} but receiver tensor is "
+                f"{np.dtype(tensor._value.dtype)}")
+        tensor._value = jnp.asarray(msg["data"], dtype=tensor._value.dtype)
     return tensor
 
 
@@ -492,9 +534,15 @@ def barrier(group=None):
     store = _host_store()
     if store is None:
         return None
+    import os
     seq = _store_state["barrier_seq"]
     _store_state["barrier_seq"] = seq + 1
-    store.barrier(f"collective/{_generation()}/{seq}")
+    from .watchdog import comm_task
+    with comm_task(f"barrier#{seq}",
+                   rank=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                   world_size=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+                   store=store, generation=_generation()):
+        store.barrier(f"collective/{_generation()}/{seq}")
     return None
 
 

@@ -23,6 +23,8 @@ from ..nn.layer.layers import Layer
 from . import env as _env
 from . import mesh as _mesh
 
+_heartbeat = None  # rank-liveness publisher; started once per process
+
 __all__ = ["init_parallel_env", "DataParallel", "shard_batch", "ParallelEnv"]
 
 from .env import ParallelEnv  # noqa: F401  (re-export)
@@ -44,6 +46,18 @@ def init_parallel_env(backend: Optional[str] = None):
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     if _mesh.get_mesh() is None:
         _mesh.set_mesh(_mesh.build_mesh({"dp": -1}))
+    # liveness heartbeat through the launcher's store so watchdog hang
+    # reports can name the missing rank (reference Watcher polling);
+    # idempotent across repeated init calls, stoppable via its handle
+    global _heartbeat
+    if _heartbeat is None:
+        from .collective import _generation, _host_store
+        store = _host_store()
+        if store is not None:
+            from .watchdog import Heartbeat
+            _heartbeat = Heartbeat(
+                store, int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+                generation=_generation()).start()
     _env._mark_initialized()
     return _env.ParallelEnv()
 

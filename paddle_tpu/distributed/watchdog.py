@@ -1,0 +1,317 @@
+"""Comm watchdog + cross-rank sanity checks.
+
+Parity targets:
+- `paddle/phi/core/distributed/comm_task_manager.h:37` CommTaskManager — a
+  background thread that tracks every collective task's start/end, flags
+  hangs past a timeout, and keeps error traces for post-mortems.
+- `paddle/phi/core/distributed/check/static_check.h:24` CommStaticCheck —
+  same shape/dtype/place across ranks before a collective runs.
+- `check/nccl_dynamic_check.h` NCCLDynamicCheck — runtime meta broadcast.
+
+TPU-native redesign: compiled SPMD collectives cannot hang rank-subsets the
+way NCCL rings can (XLA schedules them; a lost chip surfaces as a PJRT
+execute error), so the watchdog guards the HOST control plane instead — the
+TCPStore barriers, eager p2p waits and rendezvous where multi-host jobs
+actually wedge.  Tasks are registered around every store wait; a daemon
+thread scans for overdue tasks, reports which peer is missing (via store
+heartbeats), and records traces.  Meta checks ride the p2p payload
+(sender packs shape/dtype; receiver verifies) and a store round for
+collectives when FLAGS_comm_static_check is on.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .. import flags as _flags
+
+__all__ = ["CommTaskManager", "comm_task", "static_check_meta",
+           "Heartbeat", "dead_peers"]
+
+_flags.define_flag("enable_comm_watchdog", True,
+                   "watch host-side comm tasks for hangs")
+_flags.define_flag("comm_watchdog_timeout_s", 300.0,
+                   "seconds before a host comm task is reported as hung")
+_flags.define_flag("comm_static_check", False,
+                   "verify shape/dtype across ranks before collectives")
+
+
+@dataclass
+class CommTask:
+    task_id: int
+    name: str
+    meta: Dict[str, Any]
+    started: float = field(default_factory=time.monotonic)
+    stack: str = ""
+    done: bool = False
+    error: Optional[str] = None
+
+
+class CommTaskManager:
+    """Tracks host comm tasks; a daemon scan thread reports hangs.
+
+    Singleton like the reference's (`comm_task_manager.cc`); cheap enough
+    to always be on — registration is two dict ops, the scan thread wakes
+    once a second only while tasks are live.
+    """
+
+    _instance: Optional["CommTaskManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._tasks: Dict[int, CommTask] = {}
+        self._history: List[CommTask] = []
+        self._next_id = 0
+        self._tlock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._hang_hooks: List[Any] = []
+        self._reported: set = set()
+
+    @classmethod
+    def instance(cls) -> "CommTaskManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    # ------------------------------------------------------------- tasks
+    def start_task(self, name: str, **meta) -> int:
+        if not _flags.get_flag("enable_comm_watchdog"):
+            return -1
+        with self._tlock:
+            tid = self._next_id
+            self._next_id += 1
+            task = CommTask(tid, name, meta,
+                            stack="".join(traceback.format_stack(limit=8)))
+            self._tasks[tid] = task
+            self._ensure_thread_locked()
+        return tid
+
+    def end_task(self, tid: int, error: Optional[str] = None):
+        if tid < 0:
+            return
+        with self._tlock:
+            task = self._tasks.pop(tid, None)
+            if task is not None:
+                task.done = True
+                task.error = error
+                self._history.append(task)
+                del self._history[:-64]  # bounded post-mortem buffer
+
+    def live_tasks(self) -> List[CommTask]:
+        with self._tlock:
+            return list(self._tasks.values())
+
+    def history(self) -> List[CommTask]:
+        with self._tlock:
+            return list(self._history)
+
+    def add_hang_hook(self, fn):
+        """fn(task) called once per task when it exceeds the timeout."""
+        self._hang_hooks.append(fn)
+
+    # -------------------------------------------------------------- scan
+    def _ensure_thread_locked(self):
+        """Caller holds _tlock.  The scan loop hands its slot back (sets
+        _thread=None) under the same lock before exiting, so either the
+        loop saw this task, or we see a dead/None thread and start one —
+        a task can never be left unmonitored."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._scan_loop,
+                                            name="comm-watchdog",
+                                            daemon=True)
+            self._thread.start()
+
+    def _scan_loop(self):
+        while not self._stop.wait(1.0):
+            timeout = float(_flags.get_flag("comm_watchdog_timeout_s"))
+            now = time.monotonic()
+            with self._tlock:
+                if not self._tasks:
+                    self._thread = None  # idle: restartable by start_task
+                    break
+                overdue = [t for t in self._tasks.values()
+                           if now - t.started > timeout
+                           and t.task_id not in self._reported]
+                for t in overdue:
+                    self._reported.add(t.task_id)
+            for t in overdue:
+                self._report_hang(t)
+
+    def _report_hang(self, task: CommTask):
+        import logging
+        missing = ""
+        store = task.meta.get("store")
+        if store is not None:
+            dead = dead_peers(store, task.meta.get("world_size", 0),
+                              task.meta.get("generation", "0"))
+            if dead:
+                missing = f"; ranks without heartbeat: {dead}"
+        msg = (f"[comm watchdog] task '{task.name}' "
+               f"(meta={ {k: v for k, v in task.meta.items() if k != 'store'} }) "
+               f"has been blocked for "
+               f"{time.monotonic() - task.started:.0f}s{missing}\n"
+               f"started at:\n{task.stack}")
+        logging.getLogger("paddle_tpu.distributed").error(msg)
+        for fn in self._hang_hooks:
+            try:
+                fn(task)
+            except Exception:
+                pass
+
+    def shutdown(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class comm_task:
+    """Context manager registering a host comm task with the watchdog."""
+
+    def __init__(self, name: str, **meta):
+        self._name = name
+        self._meta = meta
+        self._tid = -1
+
+    def __enter__(self):
+        self._tid = CommTaskManager.instance().start_task(
+            self._name, **self._meta)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        CommTaskManager.instance().end_task(
+            self._tid, error=repr(exc) if exc is not None else None)
+        return False
+
+
+# --------------------------------------------------------------------------
+# Heartbeats: liveness through the launcher's store so a hang report can say
+# WHICH rank is missing (reference: TCPStore barrier keys + Watcher polling).
+# --------------------------------------------------------------------------
+
+class Heartbeat:
+    """Publishes this rank's liveness to the store every `interval` s.
+
+    The published value is a monotonically increasing sequence number, NOT
+    a wall-clock timestamp — liveness is judged by whether the counter
+    advances, so cross-host clock skew can't produce false dead reports.
+    """
+
+    def __init__(self, store, rank: int, generation: str = "0",
+                 interval: float = 5.0):
+        self._store = store
+        self._rank = rank
+        self._generation = generation
+        self._interval = interval
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"heartbeat-r{rank}")
+
+    def key(self) -> str:
+        return f"__hb__/{self._generation}/{self._rank}"
+
+    def start(self):
+        self.beat()
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._seq += 1
+        self._store.set(self.key(), str(self._seq).encode())
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.beat()
+            except Exception:
+                return  # store gone: launcher is tearing down
+
+    def stop(self):
+        self._stop.set()
+
+
+def _read_heartbeats(store, world_size: int, generation: str):
+    seqs = {}
+    for r in range(world_size):
+        key = f"__hb__/{generation}/{r}"
+        try:
+            if store.check(key):
+                seqs[r] = int(store.get(key).decode())
+        except Exception:
+            pass
+    return seqs
+
+
+def dead_peers(store, world_size: int, generation: str = "0",
+               probe: float = 12.0) -> List[int]:
+    """Ranks with no heartbeat key, or whose counter does not advance
+    within `probe` seconds (> 2x the default beat interval).  Blocking is
+    fine: this runs from hang reports, after minutes of stall."""
+    before = _read_heartbeats(store, world_size, generation)
+    missing = [r for r in range(world_size) if r not in before]
+    if len(missing) == world_size:
+        return missing  # nobody ever beat: don't stall the report
+    time.sleep(probe)
+    after = _read_heartbeats(store, world_size, generation)
+    return [r for r in range(world_size)
+            if r not in after or after[r] <= before.get(r, -1)]
+
+
+# --------------------------------------------------------------------------
+# Cross-rank meta checks (CommStaticCheck / NCCLDynamicCheck equivalents)
+# --------------------------------------------------------------------------
+
+def static_check_meta(store, rank: int, world_size: int, op: str, seq: int,
+                      shape, dtype, generation: str = "0",
+                      timeout: float = 60.0) -> None:
+    """Verify every rank brings the same (shape, dtype) to collective `op`.
+
+    Reference `CommStaticCheck::CheckShape` (static_check.h:24) runs on the
+    root's meta; here every rank publishes its meta under the op's sequence
+    key and rank 0 cross-checks all of them, so the error names the
+    offending rank instead of crashing inside the collective.
+    """
+    me = json.dumps({"shape": list(shape), "dtype": str(dtype)})
+    base = f"__meta__/{generation}/{op}/{seq}"
+    # Deferred GC, no extra barrier (the store would otherwise grow one key
+    # per collective).  Own meta of seq-1 is safe to free: verdict seq-1
+    # existed only after rank 0 read every meta.  The verdict must age one
+    # more round (free seq-2): a slow rank may still be waiting on verdict
+    # seq-1 while rank 0 enters seq.
+    try:
+        if seq > 0:
+            store.delete_key(f"__meta__/{generation}/{op}/{seq - 1}/{rank}")
+        if rank == 0 and seq > 1:
+            store.delete_key(f"__meta__/{generation}/{op}/{seq - 2}/verdict")
+    except Exception:
+        pass
+    store.set(f"{base}/{rank}", me.encode())
+    if rank == 0:
+        metas = {}
+        for r in range(world_size):
+            store.wait(f"{base}/{r}", timeout=timeout)
+            metas[r] = json.loads(store.get(f"{base}/{r}").decode())
+        ref = metas[0]
+        for r, m in metas.items():
+            if m != ref:
+                store.set(f"{base}/verdict",
+                          f"rank {r} meta {m} != rank 0 meta {ref}".encode())
+                raise RuntimeError(
+                    f"comm_static_check failed for '{op}' seq {seq}: "
+                    f"rank {r} brings {m}, rank 0 brings {ref}")
+        store.set(f"{base}/verdict", b"ok")
+    else:
+        store.wait(f"{base}/verdict", timeout=timeout)
+        verdict = store.get(f"{base}/verdict")
+        if verdict != b"ok":
+            raise RuntimeError(
+                f"comm_static_check failed for '{op}' seq {seq}: "
+                f"{verdict.decode()}")

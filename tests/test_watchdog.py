@@ -1,0 +1,115 @@
+"""Comm watchdog, heartbeats and cross-rank meta checks.
+
+Reference behaviors: `comm_task_manager.h:37` (hang detection),
+`check/static_check.h:24` (same meta across ranks), heartbeat liveness.
+Ranks are simulated with threads over one local TCPStore.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.watchdog import (CommTaskManager, Heartbeat,
+                                             comm_task, dead_peers,
+                                             static_check_meta)
+
+
+def test_task_lifecycle_and_history():
+    mgr = CommTaskManager.instance()
+    tid = mgr.start_task("barrier#test", rank=0, world_size=2)
+    assert any(t.task_id == tid for t in mgr.live_tasks())
+    mgr.end_task(tid)
+    assert all(t.task_id != tid for t in mgr.live_tasks())
+    assert any(t.task_id == tid and t.done for t in mgr.history())
+
+
+def test_hang_detection_fires_hook():
+    mgr = CommTaskManager.instance()
+    fired = []
+    mgr.add_hang_hook(lambda task: fired.append(task.name))
+    paddle.set_flags({"comm_watchdog_timeout_s": 0.5})
+    try:
+        with comm_task("recv(0->1)#hang", rank=1, world_size=2):
+            deadline = time.monotonic() + 6
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.1)
+    finally:
+        paddle.set_flags({"comm_watchdog_timeout_s": 300.0})
+        mgr._hang_hooks.clear()
+    assert "recv(0->1)#hang" in fired
+
+
+def test_comm_task_records_error():
+    mgr = CommTaskManager.instance()
+    with pytest.raises(ValueError):
+        with comm_task("failing-op", rank=0, world_size=1):
+            raise ValueError("boom")
+    last = mgr.history()[-1]
+    assert last.name == "failing-op" and "boom" in last.error
+
+
+def test_heartbeat_and_dead_peers():
+    store = TCPStore(is_master=True, world_size=1)
+    hb0 = Heartbeat(store, 0, interval=0.2).start()
+    try:
+        time.sleep(0.3)
+        # rank 1 never started: reported dead; rank 0's counter advances
+        assert dead_peers(store, 2, probe=0.6) == [1]
+    finally:
+        hb0.stop()
+        # stopped rank stops advancing: now reported dead too
+        assert 0 in dead_peers(store, 2, probe=0.6)
+
+
+def test_static_check_meta_matching():
+    store = TCPStore(is_master=True, world_size=1)
+    errs = []
+
+    def rank_fn(r):
+        try:
+            static_check_meta(store, r, 2, "all_reduce", 0,
+                              shape=(4, 8), dtype="float32")
+        except Exception as e:  # noqa: BLE001
+            errs.append((r, e))
+
+    ts = [threading.Thread(target=rank_fn, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=30) for t in ts]
+    assert not errs
+
+
+def test_static_check_meta_mismatch_names_rank():
+    store = TCPStore(is_master=True, world_size=1)
+    errs = {}
+
+    def rank_fn(r):
+        try:
+            static_check_meta(store, r, 2, "all_gather", 1,
+                              shape=(4, 8) if r == 0 else (4, 9),
+                              dtype="float32")
+        except Exception as e:  # noqa: BLE001
+            errs[r] = str(e)
+
+    ts = [threading.Thread(target=rank_fn, args=(r,)) for r in range(2)]
+    [t.start() for t in ts]
+    [t.join(timeout=30) for t in ts]
+    assert 0 in errs and "rank 1" in errs[0]
+
+
+def test_static_check_gc_frees_old_keys():
+    store = TCPStore(is_master=True, world_size=1)
+    for seq in range(3):
+        def rank_fn(r, s=seq):
+            static_check_meta(store, r, 2, "all_reduce", s,
+                              shape=(2,), dtype="float32")
+        ts = [threading.Thread(target=rank_fn, args=(r,)) for r in range(2)]
+        [t.start() for t in ts]
+        [t.join(timeout=30) for t in ts]
+    # seq 0 and 1 metas freed when ranks reached seq 1 / 2; verdict 0 freed
+    assert not store.check("__meta__/0/all_reduce/0/0")
+    assert not store.check("__meta__/0/all_reduce/0/verdict")
+    assert not store.check("__meta__/0/all_reduce/1/1")
