@@ -28,8 +28,11 @@ def build(name: str, extra_flags=()) -> Optional[ctypes.CDLL]:
     if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
         return None
     src = os.path.join(os.path.dirname(__file__), f"{name}.cc")
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    try:
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:  # source not shipped: pure-Python fallback
+        return None
     out = os.path.join(_CACHE, f"{name}-{digest}.so")
     if not os.path.exists(out):
         os.makedirs(_CACHE, exist_ok=True)

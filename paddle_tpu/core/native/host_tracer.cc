@@ -1,0 +1,135 @@
+// Host event tracer: native span recorder behind paddle_tpu.profiler.
+//
+// Role of the reference's HostEventRecorder/HostTracer
+// (`paddle/fluid/platform/profiler/host_tracer.cc`, ring buffers of
+// RecordEvent spans, merged into the chrome trace): each thread owns an
+// event buffer + string arena guarded by its own (uncontended in steady
+// state) mutex, registered once in a global list.  Dumps are INCREMENTAL:
+// ht_dump emits only events recorded since the previous dump, so draining
+// the trace mid-run neither resets epochs nor retires buffers.  Python
+// (ctypes) drives it through the C ABI below.
+//
+// Build: paddle_tpu.core.native.build("host_tracer") -> cached .so.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Event {
+  uint32_t name_idx;
+  uint32_t cat_idx;
+  double start;  // seconds, caller's clock base
+  double end;
+};
+
+struct ThreadBuf {
+  std::mutex mu;  // owner thread vs dumping thread; uncontended otherwise
+  std::vector<Event> events;
+  std::deque<std::string> names;  // deque: stable addresses across growth
+  size_t dumped = 0;              // events[0:dumped] already emitted
+  uint64_t tid;
+};
+
+std::mutex g_mu;
+std::vector<ThreadBuf*> g_bufs;
+std::vector<ThreadBuf*> g_stale;  // retired by ht_start; kept allocated —
+                                  // a racing thread may still hold a pointer
+std::atomic<bool> g_enabled{false};
+std::atomic<uint64_t> g_epoch{1};
+
+thread_local ThreadBuf* t_buf = nullptr;
+thread_local uint64_t t_epoch = 0;
+
+ThreadBuf* buf_for_thread() {
+  uint64_t epoch = g_epoch.load(std::memory_order_acquire);
+  if (t_buf == nullptr || t_epoch != epoch) {
+    auto* b = new ThreadBuf();
+    static std::atomic<uint64_t> next_tid{1};
+    b->tid = next_tid.fetch_add(1);
+    b->events.reserve(1 << 12);
+    std::lock_guard<std::mutex> lk(g_mu);
+    g_bufs.push_back(b);
+    t_buf = b;
+    t_epoch = epoch;
+  }
+  return t_buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a fresh recording epoch.  Old buffers are retired, not freed: a
+// thread racing an ht_record may still write into its stale buffer — the
+// write lands in memory that stays valid and is simply never dumped.
+// Epochs are per profiler *session* (not per dump), so g_stale growth is
+// bounded by sessions x threads.
+void ht_start() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto* b : g_bufs) g_stale.push_back(b);
+  g_bufs.clear();
+  g_epoch.fetch_add(1, std::memory_order_release);
+  g_enabled.store(true, std::memory_order_release);
+}
+
+void ht_stop() { g_enabled.store(false, std::memory_order_release); }
+
+int ht_enabled() { return g_enabled.load(std::memory_order_acquire); }
+
+// Record a completed span (timestamps in the caller's clock domain).
+void ht_record(const char* name, const char* cat, double start, double end) {
+  if (!g_enabled.load(std::memory_order_acquire)) return;
+  ThreadBuf* b = buf_for_thread();
+  std::lock_guard<std::mutex> lk(b->mu);
+  b->names.emplace_back(name);
+  uint32_t name_idx = static_cast<uint32_t>(b->names.size() - 1);
+  b->names.emplace_back(cat);
+  uint32_t cat_idx = static_cast<uint32_t>(b->names.size() - 1);
+  b->events.push_back(Event{name_idx, cat_idx, start, end});
+}
+
+// Append events recorded since the previous dump as TSV
+// (tid \t category \t start \t end \t name) and return how many were
+// written (-1: cannot open path).  Safe against concurrent recorders:
+// each buffer is visited under its own mutex.
+long ht_dump(const char* path) {
+  std::FILE* f = std::fopen(path, "w");
+  if (f == nullptr) return -1;
+  long n = 0;
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> bl(b->mu);
+    for (size_t i = b->dumped; i < b->events.size(); i++) {
+      const Event& e = b->events[i];
+      std::fprintf(f, "%llu\t%s\t%.9f\t%.9f\t%s\n",
+                   (unsigned long long)b->tid, b->names[e.cat_idx].c_str(),
+                   e.start, e.end, b->names[e.name_idx].c_str());
+      n++;
+    }
+    b->dumped = b->events.size();
+  }
+  std::fclose(f);
+  return n;
+}
+
+long ht_event_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  long n = 0;
+  for (auto* b : g_bufs) {
+    std::lock_guard<std::mutex> bl(b->mu);
+    n += (long)b->events.size();
+  }
+  return n;
+}
+
+}  // extern "C"

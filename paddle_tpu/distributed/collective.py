@@ -226,10 +226,13 @@ def _single_rank(group: Optional[Group]) -> bool:
 
 
 # ------------------------------------------------------------ functional API
-def _maybe_static_check(op_name: str, tensor) -> None:
+def _maybe_static_check(op_name: str, tensor, group=None) -> None:
     """FLAGS_comm_static_check: cross-process meta verification before the
     collective (reference `CommStaticCheck`, static_check.h:24).  Active in
-    multi-process jobs; in-process SPMD shapes are uniform by construction."""
+    multi-process jobs for WORLD-spanning collectives; in-process SPMD
+    shapes are uniform by construction, and sub-group collectives are
+    skipped (their rank sets don't include the rank-0 verifier; checking
+    them needs per-group stores, which the reference scopes the same way)."""
     from .. import flags as _fl
     if not _fl.get_flag("comm_static_check"):
         return
@@ -237,6 +240,10 @@ def _maybe_static_check(op_name: str, tensor) -> None:
     if store is None:
         return
     import os
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if group is not None and (group._ranks is not None
+                              and len(group._ranks) != world):
+        return
     from .watchdog import static_check_meta
     seqs = _store_state.setdefault("check_seq", {})
     seq = seqs.get(op_name, 0)
@@ -251,7 +258,7 @@ def _maybe_static_check(op_name: str, tensor) -> None:
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
     """In-place all-reduce (paddle semantics: mutates `tensor`)."""
-    _maybe_static_check("all_reduce", tensor)
+    _maybe_static_check("all_reduce", tensor, group)
     axis = current_axis_for(group)
     if axis is not None:
         out = _d("c_allreduce", (tensor,), {"op": op, "axis": axis})
@@ -277,7 +284,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op: bool = True):
-    _maybe_static_check("all_gather", tensor)
+    _maybe_static_check("all_gather", tensor, group)
     axis = current_axis_for(group)
     group = group or _get_default_group()
     if axis is not None:

@@ -228,11 +228,18 @@ class Heartbeat:
         self._store.set(self.key(), str(self._seq).encode())
 
     def _loop(self):
+        failures = 0
         while not self._stop.wait(self._interval):
             try:
                 self.beat()
+                failures = 0
             except Exception:
-                return  # store gone: launcher is tearing down
+                # one transient store error must not silently kill a live
+                # rank's heartbeat (later hang reports would name THIS rank
+                # dead); give up only after sustained failure = store gone
+                failures += 1
+                if failures >= 5:
+                    return
 
     def stop(self):
         self._stop.set()

@@ -90,15 +90,66 @@ class _HostEvent:
         self.category = category
 
 
+_native_tracer_lib = None
+_native_tracer_tried = False
+
+
+def _native_lib():
+    """The C++ host tracer (`core/native/host_tracer.cc`) — per-thread
+    event buffers + string arenas, lock-free steady state, the role of the
+    reference's HostEventRecorder ring buffers (`host_tracer.cc`)."""
+    global _native_tracer_lib, _native_tracer_tried
+    if not _native_tracer_tried:
+        _native_tracer_tried = True
+        import ctypes
+        from ..core import native
+        lib = native.build("host_tracer")
+        if lib is not None:
+            lib.ht_record.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_double, ctypes.c_double]
+            lib.ht_dump.argtypes = [ctypes.c_char_p]
+            lib.ht_dump.restype = ctypes.c_long
+            lib.ht_event_count.restype = ctypes.c_long
+        _native_tracer_lib = lib
+    return _native_tracer_lib
+
+
+# The native recorder is process-global; this token says which _HostTracer
+# currently owns its epoch (two overlapping Profilers must not steal each
+# other's events or reset each other's buffers).
+_native_owner: Optional["_HostTracer"] = None
+
+
 class _HostTracer:
-    """Collects RecordEvent spans and per-op dispatch timings."""
+    """Collects RecordEvent spans and per-op dispatch timings.
+
+    Recording goes to the native per-thread buffers when the C++ tracer
+    built; `flush()` drains them INCREMENTALLY into `.events` for
+    export/summary (no epoch reset, so mid-run summaries are cheap).
+    Pure-Python locked list is the fallback."""
 
     def __init__(self):
+        global _native_owner
         self.events: List[_HostEvent] = []
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        self._lib = _native_lib()
+        if self._lib is not None:
+            self._lib.ht_start()
+            _native_owner = self
+
+    @staticmethod
+    def _clean(s: str) -> str:
+        # the dump format is tab-separated, newline-terminated
+        return s.replace("\t", " ").replace("\n", " ")
 
     def add(self, name, start, end, category="user"):
+        if self._lib is not None:
+            if _native_owner is self:
+                self._lib.ht_record(
+                    self._clean(name).encode(), self._clean(category).encode(),
+                    start - self._t0, end - self._t0)
+            return
         with self._lock:
             self.events.append(_HostEvent(
                 name, start - self._t0, end - self._t0,
@@ -107,6 +158,33 @@ class _HostTracer:
     def op_timer(self, name, dt):
         now = time.perf_counter()
         self.add(name, now - dt, now, category="operator")
+
+    def close(self):
+        """Final drain at profiler teardown; recording stops."""
+        if self._lib is not None and _native_owner is self:
+            self.flush()
+            self._lib.ht_stop()
+
+    def flush(self):
+        """Drain new native events into `.events` (incremental append)."""
+        if self._lib is None or _native_owner is not self:
+            return  # a newer profiler owns the global recorder now
+        import os
+        import tempfile
+        fd, path = tempfile.mkstemp(suffix=".httrace")
+        os.close(fd)
+        try:
+            n = self._lib.ht_dump(path.encode())
+            if n <= 0:
+                return
+            with open(path) as f:
+                for line in f:
+                    tid, cat, start, end, name = line.rstrip("\n").split(
+                        "\t", 4)
+                    self.events.append(_HostEvent(
+                        name, float(start), float(end), int(tid), cat))
+        finally:
+            os.unlink(path)
 
 
 _active_tracer: Optional[_HostTracer] = None
@@ -254,6 +332,8 @@ class Profiler:
         registry.set_op_timer(None)
         if _active_tracer is self._tracer:
             _active_tracer = None
+        if self._tracer is not None:
+            self._tracer.close()  # drain native buffers while still owner
         if self._device_tracing:
             import jax
             try:
@@ -269,7 +349,10 @@ class Profiler:
 
     # -------------------------------------------------------------- results
     def events(self) -> List[_HostEvent]:
-        return list(self._tracer.events) if self._tracer else []
+        if self._tracer is None:
+            return []
+        self._tracer.flush()
+        return list(self._tracer.events)
 
     def export(self, path: str, format: str = "json"):  # noqa: A002
         """Write the host timeline as chrome://tracing JSON."""

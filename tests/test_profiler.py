@@ -92,3 +92,29 @@ def test_summary_has_op_rows():
 def test_record_event_outside_profiler_is_noop():
     with RecordEvent("nothing"):
         pass  # must not raise when no tracer is active
+
+
+def test_native_host_tracer_multithreaded():
+    """C++ host tracer (`core/native/host_tracer.cc`): per-thread buffers
+    collect spans from many threads; falls back silently when g++ absent."""
+    import threading
+
+    from paddle_tpu import profiler
+    from paddle_tpu.profiler.profiler import _native_lib
+
+    p = profiler.Profiler()
+    p.start()
+
+    def worker(i):
+        for j in range(10):
+            with profiler.RecordEvent(f"w{i}-span"):
+                pass
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    p.stop()
+    evs = [e for e in p.events() if e.name.endswith("-span")]
+    assert len(evs) == 40
+    if _native_lib() is not None:
+        assert len({e.tid for e in evs}) == 4  # one native tid per thread
