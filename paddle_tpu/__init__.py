@@ -78,6 +78,9 @@ from . import sparse  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import audio  # noqa: F401,E402
 from . import fft  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
+from . import geometric  # noqa: F401,E402
+from . import incubate  # noqa: F401,E402
 from .ops import generated_ops as _generated_ops  # noqa: E402
 for _gname, _gns in _generated_ops._NAMESPACES.items():
     if _gns == "":  # top-level ops from the YAML single source

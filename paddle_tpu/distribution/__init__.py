@@ -4,9 +4,18 @@ from .distribution import Distribution
 from .distributions import (Bernoulli, Beta, Categorical, Dirichlet,
                             Exponential, Gamma, Geometric, Gumbel, Laplace,
                             LogNormal, Multinomial, Normal, Poisson, Uniform)
+from .extras import (AbsTransform, AffineTransform, Binomial, Cauchy,
+                     ChainTransform, ContinuousBernoulli, ExpTransform,
+                     Independent, MultivariateNormal, PowerTransform,
+                     SigmoidTransform, TanhTransform, Transform,
+                     TransformedDistribution)
 from .kl import kl_divergence, register_kl
 
 __all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
            "Beta", "Dirichlet", "Gamma", "Laplace", "Exponential",
            "LogNormal", "Gumbel", "Geometric", "Poisson", "Multinomial",
-           "kl_divergence", "register_kl"]
+           "Binomial", "Cauchy", "ContinuousBernoulli",
+           "MultivariateNormal", "Independent", "TransformedDistribution",
+           "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "AbsTransform",
+           "ChainTransform", "kl_divergence", "register_kl"]

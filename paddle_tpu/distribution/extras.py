@@ -1,0 +1,479 @@
+"""Distribution zoo extensions: Binomial, Cauchy, ContinuousBernoulli,
+MultivariateNormal, Independent, Transform zoo + TransformedDistribution.
+
+Parity: `python/paddle/distribution/binomial.py`, `cauchy.py`,
+`continuous_bernoulli.py`, `multivariate_normal.py`, `independent.py`,
+`transform.py`, `transformed_distribution.py`.
+
+Same conventions as `distributions.py`: sampling draws through the
+framework PRNG; densities are paddle-op expressions so `log_prob`
+differentiates; everything traces under jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from ..framework import random as _random
+from ..framework.tensor import Tensor
+from ..ops.registry import dispatch as _d, register_op
+from .distribution import Distribution, _t
+
+__all__ = ["Binomial", "Cauchy", "ContinuousBernoulli",
+           "MultivariateNormal", "Independent", "TransformedDistribution",
+           "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "AbsTransform",
+           "ChainTransform"]
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+register_op("random_binomial",
+            lambda n, probs, *, key, shape:
+            jax.random.binomial(key, n, probs, shape=shape).astype(
+                jnp.float32))
+
+
+def _mvn_sample(loc, scale_tril, *, key, shape):
+    batch = jnp.broadcast_shapes(loc.shape[:-1], scale_tril.shape[:-2])
+    eps = jax.random.normal(key, tuple(shape) + batch + loc.shape[-1:],
+                            loc.dtype)
+    return loc + jnp.einsum("...ij,...j->...i", scale_tril, eps)
+
+
+register_op("random_mvn", _mvn_sample)
+
+
+class Binomial(Distribution):
+    """Parity: `distribution/binomial.py` (total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(np.broadcast_shapes(
+            self.total_count.shape, self.probs.shape)))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape: Sequence[int] = ()):
+        out_shape = self._extend_shape(shape)
+        with paddle.no_grad():
+            return _d("random_binomial", (self.total_count, self.probs),
+                      {"key": _random.next_key(),
+                       "shape": tuple(out_shape)})
+
+    def log_prob(self, value):
+        value = _t(value)
+        n, p = self.total_count, self.probs
+        logc = (paddle.lgamma(n + 1.0) - paddle.lgamma(value + 1.0)
+                - paddle.lgamma(n - value + 1.0))
+        return logc + value * paddle.log(p) + (n - value) * paddle.log1p(-p)
+
+    def entropy(self):
+        # second-order Stirling approximation (reference uses the same
+        # closed form for large n; exact sum for small n is data-dependent)
+        n, p = self.total_count, self.probs
+        return 0.5 * paddle.log(
+            2.0 * math.pi * math.e * n * p * (1.0 - p) + 1e-8)
+
+
+class Cauchy(Distribution):
+    """Parity: `distribution/cauchy.py` (loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape,
+                                                   self.scale.shape)))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def rsample(self, shape: Sequence[int] = ()):
+        out_shape = self._extend_shape(shape)
+        u = paddle.rand(list(out_shape))
+        return self.loc + self.scale * paddle.tan(
+            math.pi * (u - 0.5))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        z = (value - self.loc) / self.scale
+        return -math.log(math.pi) - paddle.log(self.scale) \
+            - paddle.log1p(z * z)
+
+    def cdf(self, value):
+        value = _t(value)
+        return paddle.atan((value - self.loc) / self.scale) / math.pi + 0.5
+
+    def entropy(self):
+        return paddle.log(4.0 * math.pi * self.scale
+                          * paddle.ones_like(self.loc))
+
+    def kl_divergence(self, other: "Cauchy"):
+        # closed form (Chyzak & Nielsen 2019), as the reference cites
+        a = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+        return paddle.log(a / (4.0 * self.scale * other.scale))
+
+
+class ContinuousBernoulli(Distribution):
+    """Parity: `distribution/continuous_bernoulli.py` (probs in (0,1))."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm(self):
+        """log C(p); Taylor expansion near p=0.5 (the reference's trick —
+        the exact form 0/0s there)."""
+        p = self.probs
+        safe = paddle.where(self._outside(), p,
+                            paddle.full_like(p, self._lims[0] - 0.1))
+        exact = paddle.log(
+            paddle.abs(2.0 * paddle.atanh(1.0 - 2.0 * safe))
+            / (paddle.abs(1.0 - 2.0 * safe) + 1e-30))
+        x = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return paddle.where(self._outside(), exact, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        safe = paddle.where(self._outside(), p,
+                            paddle.full_like(p, self._lims[0] - 0.1))
+        exact = safe / (2.0 * safe - 1.0) + \
+            1.0 / (2.0 * paddle.atanh(1.0 - 2.0 * safe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return paddle.where(self._outside(), exact, taylor)
+
+    @property
+    def variance(self):
+        p = self.probs
+        safe = paddle.where(self._outside(), p,
+                            paddle.full_like(p, self._lims[0] - 0.1))
+        t = paddle.atanh(1.0 - 2.0 * safe)
+        exact = safe * (safe - 1.0) / (1.0 - 2.0 * safe) ** 2 \
+            + 1.0 / (2.0 * t) ** 2
+        x = (p - 0.5) ** 2
+        taylor = 1.0 / 12.0 - (1.0 / 15.0 - 128.0 / 945.0 * x) * x
+        return paddle.where(self._outside(), exact, taylor)
+
+    def rsample(self, shape: Sequence[int] = ()):
+        out_shape = self._extend_shape(shape)
+        u = paddle.rand(list(out_shape))
+        p = self.probs
+        safe = paddle.where(self._outside(), p,
+                            paddle.full_like(p, self._lims[0] - 0.1))
+        # inverse CDF for p != 1/2; u itself at p == 1/2
+        icdf = (paddle.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (paddle.log(safe) - paddle.log1p(-safe)))
+        return paddle.where(self._outside(), icdf, u)
+
+    sample = rsample
+
+    def log_prob(self, value):
+        value = _t(value)
+        p = self.probs
+        return value * paddle.log(p) + (1.0 - value) * paddle.log1p(-p) \
+            + self._log_norm()
+
+    def entropy(self):
+        # E[-log p(X)] = -(C' terms); use mean identity
+        m = self.mean
+        p = self.probs
+        return -(m * paddle.log(p) + (1.0 - m) * paddle.log1p(-p)
+                 + self._log_norm())
+
+
+class MultivariateNormal(Distribution):
+    """Parity: `distribution/multivariate_normal.py` (loc + one of
+    covariance_matrix / precision_matrix / scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError("give exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = paddle.linalg.cholesky(_t(covariance_matrix))
+        else:
+            prec = _t(precision_matrix)
+            self.scale_tril = paddle.linalg.inv(
+                paddle.linalg.cholesky(prec)).transpose(
+                    perm=list(range(prec.ndim - 2)) + [prec.ndim - 1,
+                                                       prec.ndim - 2])
+        d = self.loc.shape[-1]
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape[:-1]), tuple(self.scale_tril.shape[:-2]))),
+            (d,))
+
+    @property
+    def covariance_matrix(self):
+        lt = self.scale_tril
+        perm = list(range(lt.ndim - 2)) + [lt.ndim - 1, lt.ndim - 2]
+        return paddle.matmul(lt, lt.transpose(perm=perm))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return (self.scale_tril ** 2).sum(axis=-1)
+
+    def rsample(self, shape: Sequence[int] = ()):
+        return _d("random_mvn", (self.loc, self.scale_tril),
+                  {"key": _random.next_key(), "shape": tuple(shape)})
+
+    sample = rsample
+
+    def _maha_and_logdet(self, value):
+        diff = value - self.loc
+        sol = paddle.linalg.triangular_solve(
+            self.scale_tril, diff.unsqueeze(-1), upper=False).squeeze(-1)
+        maha = (sol * sol).sum(axis=-1)
+        logdet = paddle.log(paddle.abs(
+            self.scale_tril.diagonal(axis1=-2, axis2=-1))).sum(axis=-1)
+        return maha, logdet
+
+    def log_prob(self, value):
+        value = _t(value)
+        d = self.loc.shape[-1]
+        maha, logdet = self._maha_and_logdet(value)
+        return -0.5 * maha - logdet - d * _HALF_LOG_2PI
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        lt = self.scale_tril
+        logdet = paddle.log(paddle.abs(
+            lt.diagonal(axis1=-2, axis2=-1))).sum(axis=-1)
+        return logdet + 0.5 * d * (1.0 + math.log(2.0 * math.pi))
+
+    def kl_divergence(self, other: "MultivariateNormal"):
+        d = self.loc.shape[-1]
+        # tr(S2^-1 S1) + maha - d + logdet2 - logdet1
+        sol = paddle.linalg.triangular_solve(
+            other.scale_tril,
+            self.scale_tril, upper=False)
+        tr = (sol * sol).sum(axis=[-2, -1])
+        maha, logdet2 = other._maha_and_logdet(self.loc)
+        logdet1 = paddle.log(paddle.abs(
+            self.scale_tril.diagonal(axis1=-2, axis2=-1))).sum(axis=-1)
+        return 0.5 * (tr + maha - float(d)) + logdet2 - logdet1
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims as event dims (`independent.py`)."""
+
+    def __init__(self, base, reinterpreted_batch_rank: int, name=None):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        if self._rank > len(bshape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        super().__init__(bshape[:len(bshape) - self._rank],
+                         bshape[len(bshape) - self._rank:]
+                         + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape: Sequence[int] = ()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape: Sequence[int] = ()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        if self._rank == 0:
+            return lp
+        return lp.sum(axis=list(range(lp.ndim - self._rank, lp.ndim)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        if self._rank == 0:
+            return ent
+        return ent.sum(axis=list(range(ent.ndim - self._rank, ent.ndim)))
+
+
+# ----------------------------------------------------------------- transforms
+class Transform:
+    """Bijector base (`distribution/transform.py` Transform)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return paddle.log(paddle.abs(self.scale)) * paddle.ones_like(x)
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return paddle.exp(x)
+
+    def inverse(self, y):
+        return paddle.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return paddle.log(paddle.abs(self.power * x ** (self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return paddle.nn.functional.sigmoid(x)
+
+    def inverse(self, y):
+        return paddle.log(y) - paddle.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -paddle.nn.functional.softplus(-x) \
+            - paddle.nn.functional.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return paddle.tanh(x)
+
+    def inverse(self, y):
+        return paddle.atanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x
+                      - paddle.nn.functional.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return paddle.abs(x)
+
+    def inverse(self, y):
+        return y  # principal branch
+
+    def forward_log_det_jacobian(self, x):
+        return paddle.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Pushforward of `base` through `transforms`
+    (`transformed_distribution.py`)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(tuple(base.batch_shape), tuple(base.event_shape))
+
+    def _chain(self):
+        return ChainTransform(self.transforms)
+
+    def sample(self, shape: Sequence[int] = ()):
+        return self._chain().forward(self.base.sample(shape))
+
+    def rsample(self, shape: Sequence[int] = ()):
+        return self._chain().forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        value = _t(value)
+        chain = self._chain()
+        x = chain.inverse(value)
+        return self.base.log_prob(x) - chain.forward_log_det_jacobian(x)

@@ -1,0 +1,173 @@
+"""paddle.geometric: segment reductions + graph message passing.
+
+Parity: `python/paddle/geometric/math.py` (segment_sum/mean/min/max) and
+`geometric/message_passing/send_recv.py` (send_u_recv, send_ue_recv,
+send_uv), `geometric/reindex.py` (reindex_graph).
+
+TPU-native: every reduction lowers to ONE XLA scatter(-add/-min/-max) via
+jax segment ops — no sorting, no host loop.  Paddle's semantics infer the
+segment count from max(ids)+1, a data-dependent shape: eager mode computes
+it from the concrete ids (these ops are graph-break points under jit, same
+as the reference's dynamic-shape ops); pass `out_size`/num_segments to the
+message-passing ops to stay jittable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.registry import dispatch as _d, register_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph"]
+
+
+def _num_segments(segment_ids) -> int:
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) else segment_ids
+    if ids.shape[0] == 0:
+        return 0
+    return int(jax.device_get(ids.max())) + 1
+
+
+register_op("segment_sum", lambda data, ids, *, n:
+            jax.ops.segment_sum(data, ids, num_segments=n))
+register_op("segment_min", lambda data, ids, *, n:
+            jax.ops.segment_min(data, ids, num_segments=n))
+register_op("segment_max", lambda data, ids, *, n:
+            jax.ops.segment_max(data, ids, num_segments=n))
+
+
+def _segment_mean_impl(data, ids, *, n):
+    tot = jax.ops.segment_sum(data, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype), ids,
+                              num_segments=n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return tot / jnp.maximum(cnt, 1).reshape(shape)
+
+
+register_op("segment_mean", _segment_mean_impl)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _d("segment_sum", (data, segment_ids),
+              {"n": _num_segments(segment_ids)})
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _d("segment_mean", (data, segment_ids),
+              {"n": _num_segments(segment_ids)})
+
+
+def segment_min(data, segment_ids, name=None):
+    return _d("segment_min", (data, segment_ids),
+              {"n": _num_segments(segment_ids)})
+
+
+def segment_max(data, segment_ids, name=None):
+    return _d("segment_max", (data, segment_ids),
+              {"n": _num_segments(segment_ids)})
+
+
+# ------------------------------------------------------------ message passing
+_SEG_REDUCE = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "mean": None,  # handled via sum/count
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _gather_reduce(msg, dst, n, pool_type):
+    if pool_type in ("mean",):
+        tot = jax.ops.segment_sum(msg, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1).reshape((n,) + (1,) * (msg.ndim - 1))
+    fn = _SEG_REDUCE[pool_type]
+    out = fn(msg, dst, num_segments=n)
+    if pool_type in ("min", "max"):
+        # paddle zero-fills untouched rows (segment_min/max give +-inf)
+        touched = jax.ops.segment_sum(
+            jnp.ones((msg.shape[0],), jnp.float32), dst, num_segments=n)
+        mask = (touched > 0).reshape((n,) + (1,) * (msg.ndim - 1))
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return out
+
+
+register_op("send_u_recv", lambda x, src, dst, *, n, pool:
+            _gather_reduce(jnp.take(x, src, axis=0), dst, n, pool))
+
+
+def _apply_message(xs, e, op):
+    if op == "add":
+        return xs + e
+    if op == "sub":
+        return xs - e
+    if op == "mul":
+        return xs * e
+    if op == "div":
+        return xs / e
+    raise ValueError(f"unknown message_op {op}")
+
+
+register_op("send_ue_recv", lambda x, e, src, dst, *, n, mop, pool:
+            _gather_reduce(_apply_message(jnp.take(x, src, axis=0), e, mop),
+                           dst, n, pool))
+register_op("send_uv", lambda x, y, src, dst, *, mop:
+            _apply_message(jnp.take(x, src, axis=0),
+                           jnp.take(y, dst, axis=0), mop))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather x[src], reduce into dst (`send_recv.py` send_u_recv)."""
+    n = int(out_size) if out_size is not None else max(
+        _num_segments(dst_index), x.shape[0])
+    return _d("send_u_recv", (x, src_index, dst_index),
+              {"n": n, "pool": reduce_op})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Message = combine(x[src], edge feature y), reduced into dst."""
+    n = int(out_size) if out_size is not None else max(
+        _num_segments(dst_index), x.shape[0])
+    return _d("send_ue_recv", (x, y, src_index, dst_index),
+              {"n": n, "mop": message_op, "pool": reduce_op})
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message combine(x[src], y[dst]) (`send_recv.py` send_uv)."""
+    return _d("send_uv", (x, y, src_index, dst_index), {"mop": message_op})
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (`geometric/reindex.py`).
+
+    Eager-only (output size is data-dependent), like the reference's
+    dynamic-shape graph ops.
+    """
+    import numpy as np
+    xs = np.asarray(jax.device_get(
+        x._value if isinstance(x, Tensor) else x))
+    nb = np.asarray(jax.device_get(
+        neighbors._value if isinstance(neighbors, Tensor) else neighbors))
+    cnt = np.asarray(jax.device_get(
+        count._value if isinstance(count, Tensor) else count))
+    # paddle orders: the input nodes keep their position; new neighbor ids
+    # follow in first-seen order
+    order = {}
+    for v in np.concatenate([xs, nb]):
+        if v not in order:
+            order[v] = len(order)
+    remap = np.vectorize(order.__getitem__)
+    reindex_src = remap(nb)
+    reindex_dst = np.repeat(np.arange(len(xs)), cnt)
+    out_nodes = np.array(sorted(order, key=order.__getitem__))
+    mk = lambda a, dt: Tensor._wrap(jnp.asarray(a, dt))  # noqa: E731
+    return (mk(reindex_src, jnp.int64), mk(reindex_dst, jnp.int64),
+            mk(out_nodes, jnp.int64))

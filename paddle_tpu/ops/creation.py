@@ -16,6 +16,7 @@ __all__ = [
     "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
     "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
     "create_parameter", "tril_indices", "triu_indices", "complex_",
+    "real", "imag",
 ]
 
 
@@ -167,6 +168,20 @@ def clone(x, name=None) -> Tensor:
 
 def complex_(real, imag, name=None) -> Tensor:
     return _d("complex", (real, imag), {})
+
+
+register_op("real", lambda x: jnp.real(x))
+register_op("imag", lambda x: jnp.imag(x))
+
+
+def real(x, name=None) -> Tensor:
+    """paddle.real (`tensor/attribute.py` real)."""
+    return _d("real", (x,), {})
+
+
+def imag(x, name=None) -> Tensor:
+    """paddle.imag (`tensor/attribute.py` imag)."""
+    return _d("imag", (x,), {})
 
 
 def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,

@@ -1,0 +1,139 @@
+"""DenseNet. Parity: `python/paddle/vision/models/densenet.py`.
+
+Dense blocks concatenate every preceding feature map — on TPU the concats
+are pure layout ops XLA fuses into the following conv's input, so the
+architecture maps cleanly onto the MXU without the memory-copy cost it has
+in eager CUDA frameworks.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, drop_rate):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(num_input_features)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(num_input_features, bn_size * growth_rate, 1,
+                               bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.drop_rate = drop_rate
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.drop_rate > 0:
+            out = nn.functional.dropout(out, p=self.drop_rate,
+                                        training=self.training)
+        return out
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, num_input_features, bn_size, growth_rate,
+                 drop_rate):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(num_input_features + i * growth_rate, growth_rate,
+                        bn_size, drop_rate)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        from ...ops import manipulation as _m
+        features = [x]
+        for layer in self.layers:
+            new = layer(_m.concat(features, axis=1)
+                        if len(features) > 1 else features[0])
+            features.append(new)
+        return _m.concat(features, axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__(
+            nn.BatchNorm2D(num_input_features),
+            nn.ReLU(),
+            nn.Conv2D(num_input_features, num_output_features, 1,
+                      bias_attr=False),
+            nn.AvgPool2D(kernel_size=2, stride=2))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        if layers not in _CFG:
+            raise ValueError(f"supported layers: {sorted(_CFG)}")
+        num_init_features, growth_rate, block_config = _CFG[layers]
+        self.features_stem = nn.Sequential(
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features),
+            nn.ReLU(),
+            nn.MaxPool2D(kernel_size=3, stride=2, padding=1))
+        blocks = []
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            blocks.append(_DenseBlock(num_layers, num_features, bn_size,
+                                      growth_rate, dropout))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        self.blocks = nn.Sequential(*blocks)
+        self.norm_final = nn.BatchNorm2D(num_features)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.features_stem(x)
+        x = self.relu(self.norm_final(self.blocks(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            from ...ops import manipulation as _m
+            x = self.classifier(_m.flatten(x, start_axis=1))
+        return x
+
+
+def _densenet(layers, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, **kwargs)

@@ -1,0 +1,59 @@
+"""MobileNetV1. Parity: `python/paddle/vision/models/mobilenetv1.py`."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as _m
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, inp, oup, k, stride, padding=0, groups=1):
+        super().__init__(
+            nn.Conv2D(inp, oup, k, stride, padding, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(oup),
+            nn.ReLU())
+
+
+class _DepthwiseSeparable(nn.Sequential):
+    def __init__(self, inp, oup, stride):
+        super().__init__(
+            _ConvBNReLU(inp, inp, 3, stride, 1, groups=inp),
+            _ConvBNReLU(inp, oup, 1, 1))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: max(int(c * scale), 8)  # noqa: E731
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        layers = [_ConvBNReLU(3, s(32), 3, 2, 1)]
+        inp = s(32)
+        for c, stride in cfg:
+            layers.append(_DepthwiseSeparable(inp, s(c), stride))
+            inp = s(c)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(inp, num_classes)
+        self._out = inp
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(_m.flatten(x, start_axis=1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,138 @@
+"""ShuffleNetV2. Parity: `python/paddle/vision/models/shufflenetv2.py`.
+
+Channel shuffle is a reshape-transpose-reshape — free layout work for XLA.
+"""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as _m
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _channel_shuffle(x, groups: int):
+    n, c, h, w = x.shape
+    x = _m.reshape(x, [n, groups, c // groups, h, w])
+    x = _m.transpose(x, perm=[0, 2, 1, 3, 4])
+    return _m.reshape(x, [n, c, h, w])
+
+
+def _conv_bn(inp, oup, k, stride, groups=1, act="relu"):
+    layers = [nn.Conv2D(inp, oup, k, stride, (k - 1) // 2, groups=groups,
+                        bias_attr=False),
+              nn.BatchNorm2D(oup)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "swish":
+        layers.append(nn.Swish())
+    return nn.Sequential(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_features = oup // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _conv_bn(inp // 2, branch_features, 1, 1, act=act),
+                _conv_bn(branch_features, branch_features, 3, 1,
+                         groups=branch_features, act="none"),
+                _conv_bn(branch_features, branch_features, 1, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                _conv_bn(inp, inp, 3, stride, groups=inp, act="none"),
+                _conv_bn(inp, branch_features, 1, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _conv_bn(inp, branch_features, 1, 1, act=act),
+                _conv_bn(branch_features, branch_features, 3, stride,
+                         groups=branch_features, act="none"),
+                _conv_bn(branch_features, branch_features, 1, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = _m.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = _m.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"supported scales: {sorted(_STAGE_OUT)}")
+        outs = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn(3, outs[0], 3, 2, act=act)
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        inp = outs[0]
+        for idx, repeat in enumerate(_REPEATS):
+            oup = outs[idx + 1]
+            blocks = [_InvertedResidual(inp, oup, 2, act)]
+            for _ in range(repeat - 1):
+                blocks.append(_InvertedResidual(oup, oup, 1, act))
+            stages.append(nn.Sequential(*blocks))
+            inp = oup
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn(inp, outs[4], 1, 1, act=act)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[4], num_classes)
+
+    def forward(self, x):
+        x = self.max_pool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(_m.flatten(x, start_axis=1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return ShuffleNetV2(1.0, act="swish", **kw)

@@ -1,0 +1,272 @@
+"""signal / geometric / distribution-extras / incubate-optimizer /
+new vision families.
+
+Parity targets: `python/paddle/signal.py`, `python/paddle/geometric/`,
+`python/paddle/distribution/{binomial,cauchy,continuous_bernoulli,
+multivariate_normal,independent,transform}.py`,
+`python/paddle/incubate/optimizer/{lookahead,modelaverage}.py`,
+`python/paddle/vision/models/{densenet,squeezenet,shufflenetv2,
+mobilenetv1,googlenet}.py`.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+# ------------------------------------------------------------------- signal
+def test_frame_matches_reference_docs():
+    x = paddle.to_tensor(np.arange(8))
+    y0 = paddle.signal.frame(x, 4, 2, axis=-1)
+    np.testing.assert_array_equal(
+        np.asarray(y0._value),
+        [[0, 2, 4], [1, 3, 5], [2, 4, 6], [3, 5, 7]])
+    y1 = paddle.signal.frame(x, 4, 2, axis=0)
+    np.testing.assert_array_equal(
+        np.asarray(y1._value), [[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]])
+    x2 = paddle.to_tensor(np.arange(16).reshape(2, 8))
+    assert paddle.signal.frame(x2, 4, 2, axis=-1).shape == [2, 4, 3]
+
+
+def test_overlap_add_matches_reference_docs():
+    ola = paddle.signal.overlap_add(
+        paddle.to_tensor(np.arange(16).reshape(8, 2)), 2, axis=-1)
+    np.testing.assert_array_equal(
+        np.asarray(ola._value), [0, 2, 5, 9, 13, 17, 21, 25, 13, 15])
+
+
+def test_stft_istft_roundtrip_and_numpy_parity():
+    sig = np.random.RandomState(0).rand(2, 512).astype(np.float32)
+    t = paddle.to_tensor(sig)
+    w = paddle.to_tensor(np.hanning(128).astype(np.float32))
+    S = paddle.signal.stft(t, n_fft=128, hop_length=32, window=w)
+    assert S.shape == [2, 65, 17]
+    # vs numpy stft
+    frames = np.lib.stride_tricks.sliding_window_view(
+        np.pad(sig[0], 64, mode="reflect"), 128)[::32]
+    ref = np.fft.rfft(frames * np.hanning(128), axis=-1).T
+    np.testing.assert_allclose(np.asarray(S._value)[0], ref,
+                               rtol=1e-4, atol=1e-4)
+    rec = paddle.signal.istft(S, n_fft=128, hop_length=32, window=w,
+                              length=512)
+    np.testing.assert_allclose(np.asarray(rec._value), sig, atol=1e-5)
+
+
+def test_stft_differentiable():
+    sig = np.random.RandomState(1).rand(1, 256).astype(np.float32)
+    t = paddle.to_tensor(sig)
+    t.stop_gradient = False
+    paddle.signal.stft(t, 64, 16).abs().sum().backward()
+    assert np.all(np.isfinite(np.asarray(t.grad._value)))
+
+
+# ---------------------------------------------------------------- geometric
+def test_segment_reductions():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                     np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.geometric.segment_sum(data, ids)._value),
+        [[4., 6.], [5., 6.]])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.geometric.segment_mean(data, ids)._value),
+        [[2., 3.], [5., 6.]])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.geometric.segment_min(data, ids)._value),
+        [[1., 2.], [5., 6.]])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.geometric.segment_max(data, ids)._value),
+        [[3., 4.], [5., 6.]])
+
+
+def test_send_u_recv_and_variants():
+    x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    np.testing.assert_array_equal(np.asarray(out._value).ravel(),
+                                  [1., 4., 2.])
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="max")
+    np.testing.assert_array_equal(np.asarray(out._value).ravel(),
+                                  [1., 3., 2.])
+    e = paddle.to_tensor(np.array([[10.], [20.], [30.], [40.]], np.float32))
+    out = paddle.geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+    np.testing.assert_array_equal(np.asarray(out._value).ravel(),
+                                  [41., 44., 22.])
+    uv = paddle.geometric.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_array_equal(np.asarray(uv._value).ravel(),
+                                  [2., 6., 6., 1.])
+
+
+def test_segment_grads_flow():
+    data = paddle.to_tensor(np.ones((4, 2), np.float32))
+    data.stop_gradient = False
+    ids = paddle.to_tensor(np.array([0, 1, 1, 0], np.int32))
+    paddle.geometric.segment_sum(data, ids).sum().backward()
+    np.testing.assert_array_equal(np.asarray(data.grad._value),
+                                  np.ones((4, 2)))
+
+
+# ------------------------------------------------------------ distributions
+def test_binomial_cauchy():
+    b = paddle.distribution.Binomial(10., 0.3)
+    # log C(10,3) + 3 log .3 + 7 log .7
+    ref = (math.lgamma(11) - math.lgamma(4) - math.lgamma(8)
+           + 3 * math.log(0.3) + 7 * math.log(0.7))
+    assert abs(float(b.log_prob(paddle.to_tensor(3.0)).item()) - ref) < 1e-5
+    assert abs(float(b.mean.item()) - 3.0) < 1e-6
+
+    c = paddle.distribution.Cauchy(1.0, 2.0)
+    z = (0.5 - 1.0) / 2.0
+    ref = -math.log(math.pi) - math.log(2.0) - math.log1p(z * z)
+    assert abs(float(c.log_prob(paddle.to_tensor(0.5)).item()) - ref) < 1e-6
+    with pytest.raises(ValueError):
+        _ = c.mean
+    c2 = paddle.distribution.Cauchy(0.0, 1.0)
+    assert float(c.kl_divergence(c2).item()) > 0
+    assert abs(float(c.kl_divergence(c).item())) < 1e-7
+
+
+def test_multivariate_normal():
+    L = np.array([[1.0, 0.0], [0.5, 1.2]], np.float32)
+    cov = L @ L.T
+    m = paddle.distribution.MultivariateNormal(
+        paddle.to_tensor(np.zeros(2, np.float32)),
+        covariance_matrix=paddle.to_tensor(cov))
+    v = np.array([0.3, -0.7], np.float32)
+    # scipy-free reference
+    inv = np.linalg.inv(cov)
+    ref = float(-0.5 * v @ inv @ v - 0.5 * np.log(np.linalg.det(cov))
+                - np.log(2 * np.pi))
+    assert abs(float(m.log_prob(paddle.to_tensor(v)).item()) - ref) < 1e-5
+    paddle.seed(0)
+    samp = np.asarray(m.rsample((4000,))._value)
+    assert np.abs(np.cov(samp.T) - cov).max() < 0.15
+    m2 = paddle.distribution.MultivariateNormal(
+        paddle.to_tensor(np.zeros(2, np.float32)),
+        covariance_matrix=paddle.to_tensor(cov))
+    assert abs(float(m.kl_divergence(m2).item())) < 1e-6
+
+
+def test_independent_and_transformed():
+    base = paddle.distribution.Normal(
+        paddle.to_tensor(np.zeros((3, 4), np.float32)),
+        paddle.to_tensor(np.ones((3, 4), np.float32)))
+    ind = paddle.distribution.Independent(base, 1)
+    lp = ind.log_prob(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+    assert lp.shape == [3]
+    # exp(Normal) == LogNormal
+    td = paddle.distribution.TransformedDistribution(
+        paddle.distribution.Normal(0.0, 1.0),
+        paddle.distribution.ExpTransform())
+    x = 1.7
+    ref = -math.log(x) - 0.5 * math.log(2 * math.pi) \
+        - 0.5 * math.log(x) ** 2
+    assert abs(float(td.log_prob(paddle.to_tensor(x)).item()) - ref) < 1e-5
+
+
+def test_transforms_invert():
+    for t in (paddle.distribution.AffineTransform(2.0, 3.0),
+              paddle.distribution.ExpTransform(),
+              paddle.distribution.SigmoidTransform(),
+              paddle.distribution.TanhTransform()):
+        x = paddle.to_tensor(np.array([0.1, 0.5, -0.3], np.float32))
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(np.asarray(back._value),
+                                   np.asarray(x._value), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_continuous_bernoulli_moments():
+    cb = paddle.distribution.ContinuousBernoulli(0.3)
+    # numerical reference
+    C = 2 * np.arctanh(1 - 2 * 0.3) / (1 - 2 * 0.3)
+    xs = np.linspace(0, 1, 20001)
+    pdf = C * (0.3 ** xs) * (0.7 ** (1 - xs))
+    mean_ref = np.trapz(xs * pdf, xs)
+    assert abs(float(cb.mean.item()) - mean_ref) < 1e-4
+    paddle.seed(0)
+    s = np.asarray(cb.sample((20000,))._value)
+    assert abs(s.mean() - mean_ref) < 5e-3
+
+
+# ------------------------------------------------------- incubate optimizers
+def _tiny_problem(seed=5):
+    paddle.seed(seed)
+    net = nn.Linear(4, 1)
+    X = paddle.to_tensor(np.random.RandomState(0).rand(16, 4)
+                         .astype(np.float32))
+    Y = X.sum(axis=1, keepdim=True)
+    return net, X, Y
+
+
+def test_lookahead_converges_and_syncs():
+    net, X, Y = _tiny_problem()
+    inner = optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+    opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=5)
+    first = None
+    for i in range(40):
+        loss = nn.MSELoss()(net(X), Y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.item())
+    assert float(loss.item()) < first * 0.2
+    sd = opt.state_dict()
+    assert any(k.endswith("_slow") for k in sd)
+    opt.set_state_dict(sd)
+
+
+def test_model_average_apply_restore():
+    net, X, Y = _tiny_problem()
+    inner = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    ma = paddle.incubate.ModelAverage(0.15, parameters=net.parameters())
+    for _ in range(10):
+        loss = nn.MSELoss()(net(X), Y)
+        loss.backward()
+        inner.step()
+        inner.clear_grad()
+        ma.step()
+    current = np.asarray(net.weight._value).copy()
+    with ma:
+        averaged = np.asarray(net.weight._value).copy()
+    restored = np.asarray(net.weight._value)
+    np.testing.assert_allclose(restored, current)
+    assert not np.allclose(averaged, current)  # average differs mid-training
+
+
+# ---------------------------------------------------------- vision families
+@pytest.mark.parametrize("ctor", ["densenet121", "squeezenet1_1",
+                                  "shufflenet_v2_x0_25", "mobilenet_v1"])
+def test_new_vision_families_forward_backward(ctor):
+    from paddle_tpu.vision import models as M
+    paddle.seed(0)
+    m = getattr(M, ctor)(num_classes=7)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 32, 32)
+                         .astype(np.float32))
+    m.train()
+    out = m(x)
+    assert out.shape == [2, 7]
+    out.mean().backward()
+    assert m.parameters()[0].grad is not None
+
+
+def test_googlenet_aux_heads():
+    from paddle_tpu.vision import models as M
+    paddle.seed(0)
+    m = M.googlenet(num_classes=5)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 64, 64)
+                         .astype(np.float32))
+    m.train()
+    main, aux1, aux2 = m(x)
+    assert main.shape == [2, 5] and aux1.shape == [2, 5] \
+        and aux2.shape == [2, 5]
+    m.eval()
+    out = m(x)
+    assert out.shape == [2, 5]
