@@ -22,6 +22,7 @@ from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .store import Store, TCPStore  # noqa: F401
 from . import launch  # noqa: F401
+from . import rpc  # noqa: F401
 
 
 def get_mesh():

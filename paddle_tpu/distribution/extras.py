@@ -115,8 +115,6 @@ class Cauchy(Distribution):
         return self.loc + self.scale * paddle.tan(
             math.pi * (u - 0.5))
 
-    sample = rsample
-
     def log_prob(self, value):
         value = _t(value)
         z = (value - self.loc) / self.scale
@@ -195,8 +193,6 @@ class ContinuousBernoulli(Distribution):
                 / (paddle.log(safe) - paddle.log1p(-safe)))
         return paddle.where(self._outside(), icdf, u)
 
-    sample = rsample
-
     def log_prob(self, value):
         value = _t(value)
         p = self.probs
@@ -255,8 +251,6 @@ class MultivariateNormal(Distribution):
     def rsample(self, shape: Sequence[int] = ()):
         return _d("random_mvn", (self.loc, self.scale_tril),
                   {"key": _random.next_key(), "shape": tuple(shape)})
-
-    sample = rsample
 
     def _maha_and_logdet(self, value):
         diff = value - self.loc

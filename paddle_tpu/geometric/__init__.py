@@ -81,11 +81,8 @@ _SEG_REDUCE = {
 
 
 def _gather_reduce(msg, dst, n, pool_type):
-    if pool_type in ("mean",):
-        tot = jax.ops.segment_sum(msg, dst, num_segments=n)
-        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
-                                  num_segments=n)
-        return tot / jnp.maximum(cnt, 1).reshape((n,) + (1,) * (msg.ndim - 1))
+    if pool_type == "mean":
+        return _segment_mean_impl(msg, dst, n=n)
     fn = _SEG_REDUCE[pool_type]
     out = fn(msg, dst, num_segments=n)
     if pool_type in ("min", "max"):

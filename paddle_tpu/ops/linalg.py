@@ -15,7 +15,7 @@ _ITYPE = _ityfn()
 __all__ = [
     "matmul", "mm", "bmm", "dot", "mv", "norm", "dist", "einsum", "cross",
     "histogram", "cholesky", "qr", "svd", "eig", "eigh", "eigvals", "eigvalsh",
-    "matrix_power", "inverse", "pinv", "solve", "triangular_solve", "lstsq",
+    "matrix_power", "inverse", "inv", "pinv", "solve", "triangular_solve", "lstsq",
     "det", "slogdet", "matrix_rank", "cond", "lu", "householder_product",
     "corrcoef", "cov", "multi_dot", "vecdot", "vector_norm", "matrix_norm",
 ]
@@ -212,6 +212,7 @@ def matrix_power(x, n, name=None):
 
 
 inverse = _simple("inverse", lambda x: jnp.linalg.inv(x))
+inv = inverse  # paddle.linalg.inv alias (`tensor/linalg.py` inv)
 
 
 register_op("pinv", lambda x, *, rcond: jnp.linalg.pinv(x, rtol=rcond))

@@ -139,6 +139,10 @@ def stft(x, n_fft: int, hop_length: Optional[int] = None,
     if center:
         x = _d("signal_pad_center", (x,),
                {"pad": n_fft // 2, "mode": pad_mode})
+    if x.shape[-1] < n_fft:
+        raise ValueError(
+            f"stft: input length {x.shape[-1]} (after centering) is "
+            f"shorter than n_fft={n_fft}")
     frames = _d("signal_frames_flast", (x,),
                 {"frame_length": n_fft,
                  "hop_length": int(hop_length)})  # [..., F, n_fft]
@@ -172,6 +176,11 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     (`signal.py:423`)."""
     from . import fft as _fft
     from .ops import manipulation as _m
+    if onesided and return_complex:
+        # a onesided spectrum cannot reconstruct a complex signal (the
+        # reference asserts the same combination away)
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False")
     if hop_length is None:
         hop_length = n_fft // 4
     if win_length is None:

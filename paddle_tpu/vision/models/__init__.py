@@ -14,3 +14,4 @@ from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,  # noqa: F401
                            shufflenet_v2_x1_0, shufflenet_v2_x1_5,
                            shufflenet_v2_x2_0, shufflenet_v2_swish)
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401

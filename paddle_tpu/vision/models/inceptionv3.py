@@ -1,0 +1,154 @@
+"""InceptionV3. Parity: `python/paddle/vision/models/inceptionv3.py`
+(stem + InceptionA/B/C/D/E stacks, 299x299 canonical input)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as _m
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, inp, oup, k, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(inp, oup, k, stride, padding, bias_attr=False),
+            nn.BatchNorm2D(oup),
+            nn.ReLU())
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, inp, pool_features):
+        super().__init__()
+        self.b1 = _ConvBNAct(inp, 64, 1)
+        self.b5 = nn.Sequential(_ConvBNAct(inp, 48, 1),
+                                _ConvBNAct(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBNAct(inp, 64, 1),
+                                _ConvBNAct(64, 96, 3, padding=1),
+                                _ConvBNAct(96, 96, 3, padding=1))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                  _ConvBNAct(inp, pool_features, 1))
+
+    def forward(self, x):
+        return _m.concat([self.b1(x), self.b5(x), self.b3(x),
+                          self.pool(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _ConvBNAct(inp, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_ConvBNAct(inp, 64, 1),
+                                 _ConvBNAct(64, 96, 3, padding=1),
+                                 _ConvBNAct(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _m.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    """Factorized 7x7 branches at 17x17."""
+
+    def __init__(self, inp, c7):
+        super().__init__()
+        self.b1 = _ConvBNAct(inp, 192, 1)
+        self.b7 = nn.Sequential(
+            _ConvBNAct(inp, c7, 1),
+            _ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNAct(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _ConvBNAct(inp, c7, 1),
+            _ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBNAct(c7, 192, (1, 7), padding=(0, 3)))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                  _ConvBNAct(inp, 192, 1))
+
+    def forward(self, x):
+        return _m.concat([self.b1(x), self.b7(x), self.b7d(x),
+                          self.pool(x)], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = nn.Sequential(_ConvBNAct(inp, 192, 1),
+                                _ConvBNAct(192, 320, 3, stride=2))
+        self.b7x3 = nn.Sequential(
+            _ConvBNAct(inp, 192, 1),
+            _ConvBNAct(192, 192, (1, 7), padding=(0, 3)),
+            _ConvBNAct(192, 192, (7, 1), padding=(3, 0)),
+            _ConvBNAct(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _m.concat([self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _ConvBNAct(inp, 320, 1)
+        self.b3_stem = _ConvBNAct(inp, 384, 1)
+        self.b3_a = _ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_ConvBNAct(inp, 448, 1),
+                                      _ConvBNAct(448, 384, 3, padding=1))
+        self.b3d_a = _ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                  _ConvBNAct(inp, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return _m.concat([
+            self.b1(x),
+            _m.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+            _m.concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+            self.pool(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 32, 3, stride=2),
+            _ConvBNAct(32, 32, 3),
+            _ConvBNAct(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBNAct(64, 80, 1),
+            _ConvBNAct(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(_m.flatten(x, start_axis=1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

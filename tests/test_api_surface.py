@@ -270,3 +270,16 @@ def test_googlenet_aux_heads():
     m.eval()
     out = m(x)
     assert out.shape == [2, 5]
+
+
+def test_inception_v3_forward_backward():
+    from paddle_tpu.vision import models as M
+    paddle.seed(0)
+    m = M.inception_v3(num_classes=6)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 3, 128, 128)
+                         .astype(np.float32))
+    m.train()
+    out = m(x)
+    assert out.shape == [1, 6]
+    out.mean().backward()
+    assert m.parameters()[0].grad is not None

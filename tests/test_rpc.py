@@ -1,0 +1,61 @@
+"""paddle.distributed.rpc over the TCPStore control plane.
+
+Parity: `python/paddle/distributed/rpc/rpc.py` — named workers,
+rpc_sync/rpc_async, exception propagation, worker info registry.
+Workers are simulated as two in-process agents over one store.
+"""
+
+import numpy as np
+
+from paddle_tpu.distributed.rpc import _RpcAgent, WorkerInfo
+from paddle_tpu.distributed.store import TCPStore
+
+
+def _pair():
+    import threading
+    store = TCPStore(is_master=True, world_size=1)
+    a = _RpcAgent("alice", 0, 2, store)
+    b = _RpcAgent("bob", 1, 2, store)
+    # register() blocks until every rank has published its info — run both
+    # concurrently, as the two real worker processes would
+    t = threading.Thread(target=a.register)
+    t.start()
+    b.register()
+    t.join(timeout=30)
+    return a, b
+
+
+def _add(x, y):
+    return x + y
+
+
+def _boom():
+    raise ValueError("remote boom")
+
+
+def test_rpc_sync_roundtrip_and_registry():
+    a, b = _pair()
+    try:
+        assert a.workers["bob"] == WorkerInfo("bob", 1)
+        fut = a.invoke("bob", _add, (2, 3), {}, timeout=30)
+        assert fut.result(30) == 5
+        # reverse direction
+        fut = b.invoke("alice", _add, (np.arange(3), 10), {}, timeout=30)
+        np.testing.assert_array_equal(fut.result(30), [10, 11, 12])
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_rpc_async_many_and_exception():
+    a, b = _pair()
+    try:
+        futs = [a.invoke("bob", _add, (i, i), {}, timeout=30)
+                for i in range(8)]
+        assert [f.result(30) for f in futs] == [0, 2, 4, 6, 8, 10, 12, 14]
+        err = a.invoke("bob", _boom, (), {}, timeout=30)
+        exc = err.exception(30)
+        assert isinstance(exc, ValueError) and "remote boom" in str(exc)
+    finally:
+        a.shutdown()
+        b.shutdown()
