@@ -20,7 +20,8 @@ from .ring_attention import ring_attention, ring_attention_local  # noqa: F401,E
 __all__ = ["ring_attention", "ring_attention_local",
            "fused_rotary_position_embedding", "rope", "swiglu",
            "fused_rms_norm", "fused_layer_norm", "fused_bias_act",
-           "fused_linear", "fused_multi_head_attention"]
+           "fused_linear", "fused_multi_head_attention",
+           "block_multihead_attention", "BlockKVCache"]
 
 
 def _rope_impl(q, k, v, cos, sin, *, use_neox):
@@ -134,3 +135,24 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
     raise NotImplementedError(
         "fused_multi_head_attention: use nn.MultiHeadAttention (SDPA/Pallas "
         "path) — kept for API discovery")
+
+
+def block_multihead_attention(q, k_cache, v_cache, block_tables, seq_lens,
+                              name=None):
+    """Paged-KV decode attention (reference
+    `incubate/nn/functional/block_multihead_attention.py` /
+    `block_multi_head_attention_kernel.cu`): q [B, nh, hd] against a
+    block-paged cache [nh, num_blocks, bs, hd] — a Pallas kernel whose
+    block-table gather rides the DMA index_map (`ops/pallas_paged.py`).
+
+    Accepts/returns framework Tensors; raw jax arrays pass through.
+    """
+    raw = [x._value if isinstance(x, _Tensor) else x
+           for x in (q, k_cache, v_cache, block_tables, seq_lens)]
+    out = _paged_attention(*raw)
+    return _Tensor._wrap(out) if isinstance(q, _Tensor) else out
+
+
+from ....framework.tensor import Tensor as _Tensor  # noqa: E402
+from ....ops.pallas_paged import (  # noqa: E402,F401
+    BlockKVCache, paged_attention as _paged_attention)

@@ -1,0 +1,232 @@
+"""Paged-KV decode attention as a Pallas TPU kernel.
+
+Role of the reference's `block_multihead_attention` decode path
+(`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu` +
+`fluid/operators/fused/fused_multi_transformer_op.cu.h` cache-KV branch):
+the KV cache lives in fixed-size physical blocks; each sequence owns a
+block table mapping its logical positions to physical blocks, so cache
+memory is allocated in pages instead of max-length rectangles.
+
+TPU design: one decode step attends a single query token per sequence over
+that sequence's block list.  The kernel runs on a (B*nh, max_blocks) grid
+whose LAST dimension is sequential on TPU, carrying the online-softmax
+state (m, l, acc) in VMEM scratch across block steps.  The physical block
+to stream is chosen by the BlockSpec index_map reading the SCALAR-PREFETCHED
+block table — the gather happens in the DMA engine's addressing, not as a
+data-plane gather op.  Blocks past ceil(seq_len/bs) are skipped entirely
+(`pl.when`), so compute is proportional to the true context length, not
+the padded table width.
+
+Non-TPU backends run the same math as one jnp gather + masked softmax
+(`paged_attention_reference`), which is also the CI oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["paged_attention", "paged_attention_reference", "BlockKVCache"]
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, bs, max_blocks, nh):
+    bh = pl.program_id(0)
+    blk = pl.program_id(1)
+    b = bh // nh
+
+    @pl.when(blk == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    seq_len = lens_ref[b]
+    n_blocks = (seq_len + bs - 1) // bs
+
+    @pl.when(blk < n_blocks)
+    def _():
+        q = q_ref[:, :]                                   # [1, hd]
+        k = k_ref[:, :]                                   # [bs, hd]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [1, bs]
+        pos = blk * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        s = jnp.where(pos < seq_len, s, _NEG_INF)
+        m_prev = m_scr[0, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s))
+        p = jnp.exp(s - m_new)                            # [1, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        v = v_ref[:, :]                                   # [bs, hd]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [1, hd]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p)
+        m_scr[:] = jnp.full_like(m_scr, m_new)
+
+    @pl.when(blk == max_blocks - 1)
+    def _():
+        l = l_scr[0, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[:, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, seq_lens,
+                    interpret=None):
+    """Decode attention over a paged KV cache.
+
+    q:            [B, nh, hd]        one query token per sequence
+    k_cache/v_cache: [nh, num_blocks, bs, hd] physical block pool — heads
+        lead so each streamed block is a clean [bs, hd] tile (Mosaic needs
+        the trailing two dims tileable; a squeezed head dim between them
+        would break that)
+    block_tables: [B, max_blocks] int32 physical block ids (pad with 0)
+    seq_lens:     [B] int32 current context length per sequence
+    Returns [B, nh, hd].
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if pltpu is None:  # no pallas TPU lowering available at all
+        return paged_attention_reference(q, k_cache, v_cache, block_tables,
+                                         seq_lens)
+    B, nh, hd = q.shape
+    _, _, bs, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    scale = 1.0 / math.sqrt(hd)
+
+    kern = functools.partial(_decode_kernel, scale=scale, bs=bs,
+                             max_blocks=max_blocks, nh=nh)
+
+    def qmap(bh, blk, tables, lens):
+        return (bh // nh, bh % nh, 0, 0)
+
+    def kvmap(bh, blk, tables, lens):
+        return (bh % nh, tables[bh // nh, blk], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * nh, max_blocks),
+        in_specs=[
+            pl.BlockSpec((None, None, 1, hd), qmap),
+            pl.BlockSpec((None, None, bs, hd), kvmap),
+            pl.BlockSpec((None, None, bs, hd), kvmap),
+        ],
+        out_specs=pl.BlockSpec((None, None, 1, hd), qmap),
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),
+            pltpu.VMEM((1, 128), jnp.float32),
+            pltpu.VMEM((1, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, 1, hd), q.dtype),
+        interpret=interpret,
+    )(block_tables, seq_lens, q[:, :, None, :], k_cache, v_cache)
+    return out[:, :, 0, :]
+
+
+def paged_attention_reference(q, k_cache, v_cache, block_tables, seq_lens):
+    """Pure-XLA oracle: gather each sequence's blocks, masked softmax."""
+    B, nh, hd = q.shape
+    _, _, bs, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    # [nh, B, max_blocks, bs, hd] -> [B, S_max, nh, hd]
+    k = jnp.moveaxis(k_cache[:, block_tables], 0, 3).reshape(
+        B, max_blocks * bs, nh, hd)
+    v = jnp.moveaxis(v_cache[:, block_tables], 0, 3).reshape(
+        B, max_blocks * bs, nh, hd)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(hd)
+    pos = jnp.arange(max_blocks * bs)[None, None, :]
+    live = pos < seq_lens[:, None, None]
+    s = jnp.where(live, s, _NEG_INF)
+    p = jnp.where(live, jax.nn.softmax(s, axis=-1), 0.0)
+    # seq_len == 0: every position masked -> zeros (matching the kernel's
+    # l == 0 guard), not a uniform average over pad blocks
+    return jnp.einsum("bhs,bshd->bhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+class BlockKVCache:
+    """Host-side block allocator + device block pool (the role of the
+    reference's block-table manager around `block_multihead_attention`).
+
+    append() writes one decode step's k/v into each sequence's current
+    block (allocating a fresh physical block when the previous fills) with
+    a single scatter; attend() runs the paged kernel.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, num_heads: int,
+                 head_dim: int, batch: int, max_blocks_per_seq: int,
+                 dtype=jnp.float32):
+        self.bs = block_size
+        self.k = jnp.zeros((num_heads, num_blocks, block_size, head_dim),
+                           dtype)
+        self.v = jnp.zeros_like(self.k)
+        self.tables = jnp.zeros((batch, max_blocks_per_seq), jnp.int32)
+        self.seq_lens = jnp.zeros((batch,), jnp.int32)
+        self._free = list(range(num_blocks - 1, 0, -1))  # block 0 = pad
+        self._owned = [[] for _ in range(batch)]
+        self._lens = [0] * batch  # host mirror: no device sync per token
+
+    def _alloc(self, b: int) -> int:
+        if not self._free:
+            raise RuntimeError("BlockKVCache: out of physical blocks")
+        slot = len(self._owned[b])
+        if slot >= self.tables.shape[1]:
+            # out-of-bounds scatter would be silently DROPPED by XLA and
+            # attention would lose the overflow tokens — fail loudly
+            raise RuntimeError(
+                f"BlockKVCache: sequence {b} exceeds max_blocks_per_seq="
+                f"{self.tables.shape[1]}")
+        blk = self._free.pop()
+        self._owned[b].append(blk)
+        self.tables = self.tables.at[b, slot].set(blk)
+        return blk
+
+    def append(self, k_step, v_step):
+        """k_step/v_step: [B, nh, hd] — one token per sequence."""
+        B = k_step.shape[0]
+        rows, cols = [], []
+        for b in range(B):
+            pos = self._lens[b]  # host mirror: no device sync per token
+            if pos % self.bs == 0:
+                self._alloc(b)
+            blk = self._owned[b][pos // self.bs]
+            rows.append(blk)
+            cols.append(pos % self.bs)
+            self._lens[b] = pos + 1
+        rows = jnp.asarray(rows)
+        cols = jnp.asarray(cols)
+        # target [nh, B, hd] slots at [:, rows, cols]
+        self.k = self.k.at[:, rows, cols].set(
+            jnp.moveaxis(k_step, 0, 1))
+        self.v = self.v.at[:, rows, cols].set(
+            jnp.moveaxis(v_step, 0, 1))
+        self.seq_lens = self.seq_lens + 1
+
+    def attend(self, q, interpret=None):
+        return paged_attention(q, self.k, self.v, self.tables,
+                               self.seq_lens, interpret=interpret)
+
+    def free(self, b: int):
+        """Return sequence b's blocks to the pool."""
+        self._free.extend(reversed(self._owned[b]))
+        self._owned[b] = []
+        self._lens[b] = 0
+        self.tables = self.tables.at[b].set(0)
+        self.seq_lens = self.seq_lens.at[b].set(0)

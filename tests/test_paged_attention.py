@@ -1,0 +1,109 @@
+"""Paged-KV decode attention (Pallas kernel, `ops/pallas_paged.py`).
+
+Reference behavior: `block_multihead_attention` decode path — block-paged
+cache, per-sequence block tables, context-length masking.  CPU runs the
+kernel under the Pallas interpreter against the XLA gather oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas_paged import (BlockKVCache, paged_attention,
+                                         paged_attention_reference)
+
+
+def _rand_setup(B=3, nh=4, hd=64, bs=8, nblocks=16, maxb=4, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.rand(B, nh, hd).astype(np.float32))
+    kc = jnp.asarray(rng.rand(nh, nblocks, bs, hd).astype(np.float32))
+    vc = jnp.asarray(rng.rand(nh, nblocks, bs, hd).astype(np.float32))
+    tables = jnp.asarray(rng.randint(1, nblocks, (B, maxb)).astype(np.int32))
+    return q, kc, vc, tables
+
+
+def test_kernel_matches_oracle_varied_lengths():
+    q, kc, vc, tables = _rand_setup()
+    lens = jnp.asarray(np.array([5, 17, 32], np.int32))
+    ref = paged_attention_reference(q, kc, vc, tables, lens)
+    out = paged_attention(q, kc, vc, tables, lens)
+    # exact under the interpreter; MXU bf16-pass rounding on real TPU
+    atol = 1e-5 if jax.default_backend() != "tpu" else 5e-3
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=atol)
+
+
+def test_kernel_block_boundary_lengths():
+    q, kc, vc, tables = _rand_setup()
+    for L in (1, 8, 9, 16, 24):
+        lens = jnp.asarray(np.array([L, L, L], np.int32))
+        ref = paged_attention_reference(q, kc, vc, tables, lens)
+        out = paged_attention(q, kc, vc, tables, lens)
+        atol = 1e-5 if jax.default_backend() != "tpu" else 5e-3
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=atol, err_msg=f"L={L}")
+
+
+def test_block_cache_matches_dense_attention():
+    rng = np.random.RandomState(1)
+    cache = BlockKVCache(num_blocks=32, block_size=4, num_heads=2,
+                         head_dim=64, batch=2, max_blocks_per_seq=8)
+    ks, vs = [], []
+    for _ in range(10):
+        k = jnp.asarray(rng.rand(2, 2, 64).astype(np.float32))
+        v = jnp.asarray(rng.rand(2, 2, 64).astype(np.float32))
+        cache.append(k, v)
+        ks.append(k)
+        vs.append(v)
+    qd = jnp.asarray(rng.rand(2, 2, 64).astype(np.float32))
+    out = cache.attend(qd)
+    K, V = jnp.stack(ks, 1), jnp.stack(vs, 1)
+    p = jax.nn.softmax(
+        jnp.einsum("bhd,bshd->bhs", qd, K) / np.sqrt(64), -1)
+    dense = jnp.einsum("bhs,bshd->bhd", p, V)
+    atol = 1e-5 if jax.default_backend() != "tpu" else 5e-3
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), atol=atol)
+
+
+def test_block_cache_alloc_free_reuse():
+    cache = BlockKVCache(num_blocks=8, block_size=2, num_heads=1,
+                         head_dim=64, batch=2, max_blocks_per_seq=4)
+    free0 = len(cache._free)
+    for _ in range(4):
+        cache.append(jnp.ones((2, 1, 64)), jnp.ones((2, 1, 64)))
+    assert len(cache._free) == free0 - 4  # 2 blocks per sequence
+    cache.free(0)
+    assert len(cache._free) == free0 - 2
+    assert int(cache.seq_lens[0]) == 0 and int(cache.seq_lens[1]) == 4
+
+
+def test_incubate_api_with_tensors():
+    q, kc, vc, tables = _rand_setup()
+    lens = jnp.asarray(np.array([9, 9, 9], np.int32))
+    out = paddle.incubate.nn.functional.block_multihead_attention(
+        paddle.Tensor._wrap(q), paddle.Tensor._wrap(kc),
+        paddle.Tensor._wrap(vc), paddle.Tensor._wrap(tables),
+        paddle.Tensor._wrap(lens))
+    ref = paged_attention_reference(q, kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               atol=1e-5)
+
+
+def test_cache_overflow_raises():
+    import pytest
+    cache = BlockKVCache(num_blocks=16, block_size=2, num_heads=1,
+                         head_dim=64, batch=1, max_blocks_per_seq=2)
+    for _ in range(4):
+        cache.append(jnp.ones((1, 1, 64)), jnp.ones((1, 1, 64)))
+    with pytest.raises(RuntimeError, match="max_blocks_per_seq"):
+        cache.append(jnp.ones((1, 1, 64)), jnp.ones((1, 1, 64)))
+
+
+def test_zero_length_sequence_zeros():
+    q, kc, vc, tables = _rand_setup(B=2)
+    lens = jnp.asarray(np.array([0, 9], np.int32))
+    ref = paged_attention_reference(q, kc, vc, tables, lens)
+    out = paged_attention(q, kc, vc, tables, lens)
+    np.testing.assert_allclose(np.asarray(ref)[0], 0.0)
+    atol = 1e-5 if jax.default_backend() != "tpu" else 5e-3
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=atol)
