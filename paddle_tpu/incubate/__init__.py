@@ -3,4 +3,5 @@ Parity: `python/paddle/incubate/` (fused_rope, fused_rms_norm, MoE ...)."""
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,57 @@
+"""Automatic SParsity (2:4). Parity: `incubate/asp/asp.py` semantics —
+prune to n:m windows by magnitude, masks persist through training."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+
+
+def test_mask_keeps_largest_per_window():
+    w = paddle.to_tensor(np.array([[1., 5., 2., 6., 0.1, 0.2, 9., 8.]],
+                                  np.float32))
+    mask = asp.create_mask(w, 2, 4)
+    np.testing.assert_array_equal(
+        mask, [[False, True, False, True, False, False, True, True]])
+
+
+def test_prune_model_and_density():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    masks = asp.prune_model(net, n=2, m=4)
+    assert masks  # linear weights pruned
+    for _, p in net.state_dict().items():
+        if p.ndim == 2:
+            assert asp.check_sparsity(p, 2, 4)
+            assert abs(asp.calculate_density(p) - 0.5) < 0.05
+
+
+def test_decorated_optimizer_keeps_sparsity():
+    paddle.seed(1)
+    net = nn.Linear(16, 8)
+    asp.prune_model(net)
+    opt = asp.decorate(optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(0).rand(4, 16)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).rand(4, 8)
+                         .astype(np.float32))
+    for _ in range(3):
+        loss = nn.MSELoss()(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_sparsity(net.weight, 2, 4)  # zeros stayed zero
+
+
+def test_excluded_layers():
+    paddle.seed(2)
+    net = nn.Linear(8, 8)
+    asp.set_excluded_layers([net.weight.name])
+    try:
+        masks = asp.prune_model(net)
+        assert not masks
+        assert asp.calculate_density(net.weight) == 1.0
+    finally:
+        asp.reset_excluded_layers()
