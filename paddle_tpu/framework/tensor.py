@@ -359,7 +359,8 @@ class Parameter(Tensor):
 
     Reference: `python/paddle/base/framework.py` EagerParamBase.
     """
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "need_clip")
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed",
+                 "need_clip", "_asp_mask")
 
     _name_counter = 0
 

@@ -27,7 +27,6 @@ __all__ = ["prune_model", "decorate", "set_excluded_layers",
            "create_mask"]
 
 _excluded_param_names: set = set()
-_masks: Dict[int, jnp.ndarray] = {}
 
 
 def set_excluded_layers(param_names: List[str], main_program=None):
@@ -78,10 +77,10 @@ def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
     return bool((windows <= n).all())
 
 
-def _prunable(name: str, p) -> bool:
+def _prunable(name: str, p, m: int) -> bool:
     if p.ndim < 2:  # biases, norms
         return False
-    if p.shape[-1] % 4 != 0:
+    if p.shape[-1] % m != 0:
         return False
     return p.name not in _excluded_param_names and \
         name not in _excluded_param_names
@@ -93,7 +92,7 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
     (`asp.py:302`).  Returns {param_name: mask}."""
     out = {}
     for name, p in model.state_dict().items():
-        if not isinstance(p, Tensor) or not _prunable(name, p):
+        if not isinstance(p, Tensor) or not _prunable(name, p, m):
             continue
         mask = create_mask(p, n, m, mask_algo)
         if mask is None:
@@ -101,7 +100,9 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
         dmask = jnp.asarray(mask, p._value.dtype)
         p._value = p._value * dmask
         if with_mask:
-            _masks[id(p)] = dmask
+            # the mask rides the Parameter itself: no global registry to
+            # leak or collide on id() reuse across models
+            p._asp_mask = dmask
         out[name] = mask
     return out
 
@@ -113,18 +114,22 @@ class _ASPOptimizer:
     def __init__(self, inner):
         self._inner = inner
 
-    def step(self):
-        self._inner.step()
+    def _apply_masks(self):
         for p in self._inner._parameter_list:
-            mask = _masks.get(id(p))
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._value = p._value * mask
 
+    def step(self):
+        self._inner.step()
+        self._apply_masks()
+
     def minimize(self, loss, *a, **k):
-        loss.backward()
-        self.step()
-        self._inner.clear_grad()
-        return None, None
+        # delegate: keeps the base optimizer's static-program recording and
+        # stop_gradient handling intact
+        res = self._inner.minimize(loss, *a, **k)
+        self._apply_masks()
+        return res
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

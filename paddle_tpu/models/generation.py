@@ -46,9 +46,14 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 eos_token_id: Optional[int] = None) -> Tensor:
+                 eos_token_id: Optional[int] = None,
+                 cache_impl: str = "dense") -> Tensor:
         """Returns (B, prompt_len + <=max_new_tokens) int ids; after a
-        sequence hits eos it is padded with eos."""
+        sequence hits eos it is padded with eos.
+
+        cache_impl="paged" (models supporting it) decodes against
+        block-paged KV caches via the Pallas paged-attention kernel
+        instead of concat-and-grow dense caches."""
         was_training = self.training
         self.eval()
         try:
@@ -57,7 +62,15 @@ class GenerationMixin:
             if ids.ndim == 1:
                 ids = ids[None, :]
             B, prompt_len = ids.shape
-            caches = self.init_caches(B)
+            import inspect
+            sig = inspect.signature(self.init_caches)
+            if "cache_impl" in sig.parameters:
+                caches = self.init_caches(B, cache_impl=cache_impl)
+            elif cache_impl != "dense":
+                raise ValueError(
+                    f"{type(self).__name__} supports only dense caches")
+            else:
+                caches = self.init_caches(B)
             logits_t, caches = self.forward_with_cache(
                 Tensor._wrap(ids), caches, pos_offset=0)
             logits = logits_t._value[:, -1, :]

@@ -78,6 +78,11 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv(x)
         qkv = _m.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = _m.unbind(qkv, axis=2)
+        if kv_cache is not None and not isinstance(kv_cache, tuple):
+            # non-tuple cache = BlockKVCache (dense caches are (k, v)
+            # tuples); checked structurally so the pallas import chain is
+            # only paid when paged decoding is actually used
+            return self._paged_forward(q, k, v, kv_cache, b, s)
         if kv_cache is not None:
             pk, pv = kv_cache
             k = _m.concat([pk, k], axis=1)
@@ -106,6 +111,29 @@ class GPTAttention(nn.Layer):
         if new_cache is not None:
             return out, new_cache
         return out
+
+    def _paged_forward(self, q, k, v, cache, b, s):
+        """Decode/prefill against a paged block cache: the Pallas
+        `paged_attention` kernel replaces concat-and-grow dense caches
+        (the reference's block_multihead_attention serving path)."""
+        from ..framework.tensor import Tensor as _T
+        if s == 1:
+            cache.append(k._value[:, 0], v._value[:, 0])
+            out = cache.attend(q._value[:, 0])  # [B, nh, hd]
+            out_t = _T._wrap(out[:, None].reshape(
+                b, 1, self.num_heads * self.head_dim))
+        else:  # prefill: dense causal attention + bulk cache insert
+            if cache._lens and cache._lens[0] != 0:
+                raise NotImplementedError(
+                    "chunked prefill against a paged cache: the chunk "
+                    "would need the offset-aware mask over cached tokens; "
+                    "prefill in one chunk or use cache_impl='dense'")
+            cache.append_prefill(k._value, v._value)
+            dense = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=False)
+            out_t = _m.reshape(dense, [b, s,
+                                       self.num_heads * self.head_dim])
+        return self.proj(out_t), cache
 
 
 class GPTMLP(nn.Layer):
@@ -216,12 +244,22 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         from ..ops.linalg import matmul
         return matmul(h, self.gpt.wte.weight, transpose_y=True)
 
-    def init_caches(self, batch_size):
+    def init_caches(self, batch_size, cache_impl: str = "dense",
+                    block_size: int = 16):
         import jax.numpy as jnp
         from ..framework.tensor import Tensor as _T
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         dtype = self.gpt.wte.weight._value.dtype
+        if cache_impl == "paged":
+            from ..ops.pallas_paged import BlockKVCache
+            max_blocks = (cfg.max_seq_len + block_size - 1) // block_size
+            return [BlockKVCache(
+                num_blocks=batch_size * max_blocks + 1,
+                block_size=block_size, num_heads=cfg.num_heads,
+                head_dim=hd, batch=batch_size,
+                max_blocks_per_seq=max_blocks, dtype=dtype)
+                for _ in range(cfg.num_layers)]
         empty = lambda: _T._wrap(jnp.zeros(
             (batch_size, 0, cfg.num_heads, hd), dtype))
         return [(empty(), empty()) for _ in range(cfg.num_layers)]

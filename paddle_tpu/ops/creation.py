@@ -16,7 +16,7 @@ __all__ = [
     "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
     "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
     "create_parameter", "tril_indices", "triu_indices", "complex_",
-    "real", "imag",
+    "real", "imag", "conj", "angle",
 ]
 
 
@@ -172,6 +172,8 @@ def complex_(real, imag, name=None) -> Tensor:
 
 register_op("real", lambda x: jnp.real(x))
 register_op("imag", lambda x: jnp.imag(x))
+register_op("conj", lambda x: jnp.conj(x))
+register_op("angle", lambda x: jnp.angle(x))
 
 
 def real(x, name=None) -> Tensor:
@@ -182,6 +184,16 @@ def real(x, name=None) -> Tensor:
 def imag(x, name=None) -> Tensor:
     """paddle.imag (`tensor/attribute.py` imag)."""
     return _d("imag", (x,), {})
+
+
+def conj(x, name=None) -> Tensor:
+    """paddle.conj (`tensor/math.py` conj)."""
+    return _d("conj", (x,), {})
+
+
+def angle(x, name=None) -> Tensor:
+    """paddle.angle (`tensor/math.py` angle)."""
+    return _d("angle", (x,), {})
 
 
 def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,

@@ -219,6 +219,39 @@ class BlockKVCache:
             jnp.moveaxis(v_step, 0, 1))
         self.seq_lens = self.seq_lens + 1
 
+    def append_prefill(self, k, v):
+        """Bulk-insert a whole prompt: k/v [B, S, nh, hd].  All sequences
+        must be at the same (typically zero) length — the prefill case.
+        One scatter per block column, not per token."""
+        B, S = k.shape[0], k.shape[1]
+        if len(set(self._lens)) != 1:
+            raise RuntimeError("append_prefill needs equal sequence lengths")
+        start = self._lens[0]
+        if start % self.bs != 0:
+            # fall back to per-token appends for a ragged tail
+            for t in range(S):
+                self.append(k[:, t], v[:, t])
+            return
+        nb = (S + self.bs - 1) // self.bs
+        pad = nb * self.bs - S
+        if pad:
+            zeros = jnp.zeros((B, pad) + k.shape[2:], k.dtype)
+            k = jnp.concatenate([k, zeros], axis=1)
+            v = jnp.concatenate([v, zeros], axis=1)
+        # [B, nb, bs, nh, hd] -> per block column [nh, B, bs, hd]
+        kb = jnp.moveaxis(k.reshape(B, nb, self.bs, *k.shape[2:]), 3, 0)
+        vb = jnp.moveaxis(v.reshape(B, nb, self.bs, *v.shape[2:]), 3, 0)
+        for blk in range(nb):
+            rows = []
+            for b in range(B):
+                rows.append(self._alloc(b))
+            rows = jnp.asarray(rows)
+            self.k = self.k.at[:, rows].set(kb[:, :, blk])
+            self.v = self.v.at[:, rows].set(vb[:, :, blk])
+        for b in range(B):
+            self._lens[b] = start + S
+        self.seq_lens = jnp.full_like(self.seq_lens, start + S)
+
     def attend(self, q, interpret=None):
         return paged_attention(q, self.k, self.v, self.tables,
                                self.seq_lens, interpret=interpret)

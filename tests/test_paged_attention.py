@@ -107,3 +107,15 @@ def test_zero_length_sequence_zeros():
     np.testing.assert_allclose(np.asarray(ref)[0], 0.0)
     atol = 1e-5 if jax.default_backend() != "tpu" else 5e-3
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=atol)
+
+
+def test_gpt_generate_with_paged_cache_matches_dense():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt3_tiny())
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, 1024, (2, 13)).astype(np.int32))
+    dense = model.generate(ids, max_new_tokens=6)
+    paged = model.generate(ids, max_new_tokens=6, cache_impl="paged")
+    np.testing.assert_array_equal(np.asarray(dense._value),
+                                  np.asarray(paged._value))
