@@ -3,7 +3,7 @@
 Parity: `python/paddle/audio/`.
 """
 
-from . import backends, features, functional
+from . import backends, datasets, features, functional
 from .backends import info, load, save
 
 __all__ = ["functional", "features", "backends", "load", "save", "info"]

@@ -1,0 +1,133 @@
+"""paddle.audio.datasets: TESS and ESC50.
+
+Parity: `python/paddle/audio/datasets/{tess,esc50}.py` — waveform
+classification datasets returning (waveform, label) or computed features.
+
+Zero-egress convention (same as `vision/datasets`): load from a local
+`archive_path` when given, else fall back to a deterministic synthetic set
+of the reference's shapes/sample rates so tests and examples run offline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _AudioClassifyDataset(Dataset):
+    sample_rate: int = 16000
+    duration: float = 1.0
+    n_classes: int = 2
+    label_list: List[str] = []
+
+    def __init__(self, mode: str = "train", feat_type: str = "raw",
+                 archive_path: Optional[str] = None, synthetic_size=None,
+                 **feat_kwargs):
+        if mode not in ("train", "dev", "test"):
+            raise ValueError("mode must be train/dev/test")
+        self.mode = mode
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        if archive_path is not None and os.path.isdir(archive_path):
+            self._files = self._scan(archive_path)
+            self._synthetic = None
+        else:
+            n = synthetic_size or (64 if mode == "train" else 16)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            t = int(self.sample_rate * self.duration)
+            freqs = rng.uniform(80, 2000, size=n)
+            labels = rng.randint(0, self.n_classes, size=n)
+            # deterministic tones: label-correlated frequency bands so a
+            # classifier can actually learn from the synthetic set
+            xs = np.sin(2 * np.pi
+                        * (freqs[:, None] + 200 * labels[:, None])
+                        * np.arange(t)[None, :] / self.sample_rate)
+            self._synthetic = (xs.astype(np.float32), labels.astype(np.int64))
+            self._files = None
+
+    def _scan(self, root) -> List[Tuple[str, int]]:
+        out = []
+        for dirpath, _, files in os.walk(root):
+            for f in sorted(files):
+                if f.lower().endswith(".wav"):
+                    out.append((os.path.join(dirpath, f),
+                                self._label_of(f)))
+        return out
+
+    def _label_of(self, filename: str) -> int:
+        raise NotImplementedError
+
+    def _featurize(self, wav: np.ndarray):
+        if self.feat_type == "raw":
+            return wav
+        from . import features as F
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(wav[None, :])
+        if self.feat_type == "melspectrogram":
+            ex = F.MelSpectrogram(sr=self.sample_rate, **self.feat_kwargs)
+        elif self.feat_type == "spectrogram":
+            ex = F.Spectrogram(**self.feat_kwargs)
+        elif self.feat_type == "logmelspectrogram":
+            ex = F.LogMelSpectrogram(sr=self.sample_rate, **self.feat_kwargs)
+        elif self.feat_type == "mfcc":
+            ex = F.MFCC(sr=self.sample_rate, **self.feat_kwargs)
+        else:
+            raise ValueError(f"unknown feat_type {self.feat_type!r}")
+        return np.asarray(ex(x)._value)[0]
+
+    def __len__(self):
+        if self._synthetic is not None:
+            return len(self._synthetic[1])
+        return len(self._files)
+
+    def __getitem__(self, idx):
+        if self._synthetic is not None:
+            wav, label = self._synthetic[0][idx], self._synthetic[1][idx]
+        else:
+            from .backends import load as _load
+            path, label = self._files[idx]
+            wav, _ = _load(path)
+            wav = np.asarray(wav)
+            if wav.ndim > 1:
+                wav = wav[0]
+        return self._featurize(wav), np.int64(label)
+
+
+class TESS(_AudioClassifyDataset):
+    """Toronto Emotional Speech Set (`audio/datasets/tess.py`): 7 emotion
+    classes from the filename's `..._emotion.wav` suffix."""
+
+    sample_rate = 24414
+    duration = 2.0
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    n_classes = 7
+
+    def _label_of(self, filename: str) -> int:
+        stem = os.path.splitext(filename)[0]
+        emotion = stem.rsplit("_", 1)[-1].lower()
+        return self.label_list.index(emotion) \
+            if emotion in self.label_list else 0
+
+
+class ESC50(_AudioClassifyDataset):
+    """ESC-50 environmental sounds (`audio/datasets/esc50.py`): 50 classes
+    encoded in the filename `fold-srcfile-take-target.wav`."""
+
+    sample_rate = 44100
+    duration = 5.0
+    n_classes = 50
+    label_list = [str(i) for i in range(50)]
+
+    def _label_of(self, filename: str) -> int:
+        stem = os.path.splitext(filename)[0]
+        try:
+            return int(stem.split("-")[-1]) % self.n_classes
+        except ValueError:
+            return 0

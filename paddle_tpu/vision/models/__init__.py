@@ -5,6 +5,8 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
+from .mobilenetv3 import (MobileNetV3Large, MobileNetV3Small,  # noqa: F401
+                          mobilenet_v3_large, mobilenet_v3_small)
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .densenet import (DenseNet, densenet121, densenet161,  # noqa: F401
                        densenet169, densenet201, densenet264)

@@ -1,0 +1,144 @@
+"""MobileNetV3 (small + large).
+Parity: `python/paddle/vision/models/mobilenetv3.py` — inverted residuals
+with optional squeeze-excitation and hardswish activations."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...ops import manipulation as _m
+from .mobilenetv2 import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+# kernel, expanded, out, use_se, activation, stride
+_LARGE = [
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2),
+    (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1),
+    (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2),
+    (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2),
+    (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1),
+    (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1),
+    (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2),
+    (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+def _act(name):
+    return nn.Hardswish() if name == "hardswish" else nn.ReLU()
+
+
+class _SqueezeExcite(nn.Layer):
+    def __init__(self, channels, squeeze_factor=4):
+        super().__init__()
+        sq = _make_divisible(channels // squeeze_factor)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, sq, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(sq, channels, 1)
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, inp, oup, k, stride=1, groups=1, act="hardswish"):
+        layers = [nn.Conv2D(inp, oup, k, stride, (k - 1) // 2, groups=groups,
+                            bias_attr=False),
+                  nn.BatchNorm2D(oup)]
+        if act:
+            layers.append(_act(act))
+        super().__init__(*layers)
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, expanded, oup, k, use_se, act, stride):
+        super().__init__()
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expanded != inp:
+            layers.append(_ConvBNAct(inp, expanded, 1, act=act))
+        layers.append(_ConvBNAct(expanded, expanded, k, stride,
+                                 groups=expanded, act=act))
+        if use_se:
+            layers.append(_SqueezeExcite(expanded))
+        layers.append(_ConvBNAct(expanded, oup, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: _make_divisible(c * scale)  # noqa: E731
+        inp = s(16)
+        layers = [_ConvBNAct(3, inp, 3, stride=2, act="hardswish")]
+        for k, exp, out, se, act, stride in config:
+            layers.append(_InvertedResidual(inp, s(exp), s(out), k, se, act,
+                                            stride))
+            inp = s(out)
+        last_conv = s(6 * inp)
+        layers.append(_ConvBNAct(inp, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel),
+                nn.Hardswish(),
+                nn.Dropout(0.2),
+                nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(_m.flatten(x, start_axis=1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

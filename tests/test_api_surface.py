@@ -283,3 +283,32 @@ def test_inception_v3_forward_backward():
     assert out.shape == [1, 6]
     out.mean().backward()
     assert m.parameters()[0].grad is not None
+
+
+def test_mobilenet_v3_forward_backward():
+    from paddle_tpu.vision import models as M
+    paddle.seed(0)
+    m = M.mobilenet_v3_small(num_classes=5)
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 3, 32, 32)
+                         .astype(np.float32))
+    m.train()
+    out = m(x)
+    assert out.shape == [2, 5]
+    out.mean().backward()
+    assert m.parameters()[0].grad is not None
+
+
+def test_audio_datasets():
+    from paddle_tpu.audio.datasets import ESC50, TESS
+    ds = TESS(mode="train")
+    wav, label = ds[0]
+    assert wav.shape == (48828,) and 0 <= int(label) < 7
+    ds2 = TESS(mode="dev", feat_type="mfcc", n_mfcc=13)
+    feat, _ = ds2[0]
+    assert feat.shape[0] == 13
+    esc = ESC50(mode="test", synthetic_size=4)
+    wav, label = esc[0]
+    assert 0 <= int(label) < 50
+    # determinism across constructions
+    wav2, _ = ESC50(mode="test", synthetic_size=4)[0]
+    np.testing.assert_array_equal(wav, wav2)
