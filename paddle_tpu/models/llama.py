@@ -80,6 +80,14 @@ class LlamaAttention(nn.Layer):
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=pos, use_neox_rotary_style=True,
             rotary_emb_base=cfg.rope_base)
+        if kv_cache is not None and not isinstance(kv_cache, tuple):
+            # paged block cache (non-tuple): kernel attends one q head per
+            # cached kv head, so GQA caches the repeated heads
+            if cfg.num_kv_heads != cfg.num_heads:
+                rep = cfg.num_heads // cfg.num_kv_heads
+                k = _m.repeat_interleave(k, rep, axis=2)
+                v = _m.repeat_interleave(v, rep, axis=2)
+            return self._paged_forward(q, k, v, kv_cache, b, s)
         new_cache = None
         if kv_cache is not None:
             pk, pv = kv_cache
@@ -108,6 +116,28 @@ class LlamaAttention(nn.Layer):
         out = _m.reshape(out, [b, s, cfg.num_heads * self.head_dim])
         out = self.o_proj(out)
         return out if new_cache is None else (out, new_cache)
+
+    def _paged_forward(self, q, k, v, cache, b, s):
+        """Decode/prefill against a paged block cache (see
+        `models/gpt.py:_paged_forward`; same Pallas kernel)."""
+        from ..framework.tensor import Tensor as _T
+        cfg = self.cfg
+        if s == 1:
+            cache.append(k._value[:, 0], v._value[:, 0])
+            out = cache.attend(q._value[:, 0])
+            out_t = _T._wrap(out[:, None].reshape(
+                b, 1, cfg.num_heads * self.head_dim))
+        else:
+            if cache._lens and cache._lens[0] != 0:
+                raise NotImplementedError(
+                    "chunked prefill against a paged cache; prefill in one "
+                    "chunk or use cache_impl='dense'")
+            cache.append_prefill(k._value, v._value)
+            dense = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=False)
+            out_t = _m.reshape(dense,
+                               [b, s, cfg.num_heads * self.head_dim])
+        return self.o_proj(out_t), cache
 
 
 class LlamaMLP(nn.Layer):
@@ -203,12 +233,22 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def forward(self, input_ids):
         return self.lm_head(self.model(input_ids))
 
-    def init_caches(self, batch_size):
+    def init_caches(self, batch_size, cache_impl: str = "dense",
+                    block_size: int = 16):
         import jax.numpy as jnp
         from ..framework.tensor import Tensor as _T
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         dtype = self.model.embed_tokens.weight._value.dtype
+        if cache_impl == "paged":
+            from ..ops.pallas_paged import BlockKVCache
+            max_blocks = (cfg.max_seq_len + block_size - 1) // block_size
+            return [BlockKVCache(
+                num_blocks=batch_size * max_blocks + 1,
+                block_size=block_size, num_heads=cfg.num_heads,
+                head_dim=hd, batch=batch_size,
+                max_blocks_per_seq=max_blocks, dtype=dtype)
+                for _ in range(cfg.num_layers)]
         empty = lambda: _T._wrap(jnp.zeros(
             (batch_size, 0, cfg.num_kv_heads, hd), dtype))
         return [(empty(), empty()) for _ in range(cfg.num_layers)]

@@ -119,3 +119,16 @@ def test_gpt_generate_with_paged_cache_matches_dense():
     paged = model.generate(ids, max_new_tokens=6, cache_impl="paged")
     np.testing.assert_array_equal(np.asarray(dense._value),
                                   np.asarray(paged._value))
+
+
+def test_llama_generate_with_paged_cache_matches_dense():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    # GQA config: paged path caches the repeated kv heads
+    model = LlamaForCausalLM(llama_tiny(num_kv_heads=2))
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, 256, (2, 11)).astype(np.int32))
+    dense = model.generate(ids, max_new_tokens=5)
+    paged = model.generate(ids, max_new_tokens=5, cache_impl="paged")
+    np.testing.assert_array_equal(np.asarray(dense._value),
+                                  np.asarray(paged._value))
