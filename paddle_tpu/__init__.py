@@ -81,6 +81,8 @@ from . import fft  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from . import geometric  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
+from . import hub  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
 from .ops import generated_ops as _generated_ops  # noqa: E402
 for _gname, _gns in _generated_ops._NAMESPACES.items():
     if _gns == "":  # top-level ops from the YAML single source

@@ -1,0 +1,74 @@
+"""paddle.hub: load models/entry points from a hubconf.py.
+
+Parity: `python/paddle/hapi/hub.py` (hub.list `:123`, hub.help `:158`,
+hub.load `:197`, sources github/gitee/local).
+
+Zero-egress build: the `local` source is fully supported (a directory
+containing `hubconf.py` whose public callables are the entry points);
+remote github/gitee sources raise — this image has no network egress, and
+a checkout on disk serves the same purpose through source="local".
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access; this build is "
+            "offline — clone the repo and use source='local'")
+
+
+def list(repo_dir: str, source: str = "local",  # noqa: A001
+         force_reload: bool = False) -> List[str]:
+    """Entry-point names exported by the repo's hubconf (`hub.py:123`)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",  # noqa: A001
+         force_reload: bool = False) -> Optional[str]:
+    """Entry point's docstring (`hub.py:158`)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entry point {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir: str, model: str, *args, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate an entry point (`hub.py:197`)."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"no entry point {model!r} in {repo_dir}")
+    return fn(*args, **kwargs)

@@ -1,0 +1,36 @@
+"""paddle.onnx: ONNX export facade.
+
+Parity: `python/paddle/onnx/export.py` — the reference delegates entirely
+to the external `paddle2onnx` package.  This build's serving format is
+StableHLO (`paddle.jit.save` -> `paddle.inference.Predictor`); ONNX
+protobuf emission requires the `onnx` package, which is not part of this
+image, so `export` gates on its availability rather than shipping a
+half-working converter.
+"""
+
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export `layer` to ONNX (`onnx/export.py` export).
+
+    Raises ImportError when the `onnx` runtime is unavailable, pointing at
+    the TPU-native path: `paddle.jit.save` exports a StableHLO artifact
+    that `paddle.inference.Predictor` serves, and StableHLO->ONNX
+    conversion can run offline wherever `onnx` is installed.
+    """
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "paddle.onnx.export needs the 'onnx' package, which this "
+            "offline TPU image does not ship. Use paddle.jit.save(layer, "
+            "path) to export a StableHLO artifact servable by "
+            "paddle.inference.Predictor, or run the conversion on a "
+            "machine with onnx installed") from e
+    raise NotImplementedError(
+        "direct ONNX emission is not implemented in this build; "
+        "jit.save's StableHLO artifact is the supported export")

@@ -140,3 +140,31 @@ def test_decomp_unknown_raises():
     from paddle_tpu.decomposition import decompose
     with pytest.raises(KeyError):
         decompose("not_an_op", None)
+
+
+def test_hub_local_roundtrip(tmp_path):
+    """paddle.hub list/help/load from a local hubconf.py
+    (`hapi/hub.py:123,:158,:197`)."""
+    import paddle_tpu as paddle
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_mlp(hidden=8):\n"
+        "    '''A tiny MLP entry point.'''\n"
+        "    from paddle_tpu import nn\n"
+        "    return nn.Linear(4, hidden)\n")
+    names = paddle.hub.list(str(tmp_path))
+    assert "tiny_mlp" in names
+    assert "tiny MLP" in paddle.hub.help(str(tmp_path), "tiny_mlp")
+    m = paddle.hub.load(str(tmp_path), "tiny_mlp", hidden=16)
+    assert m.weight.shape == [4, 16]
+    import pytest
+    with pytest.raises(RuntimeError, match="offline"):
+        paddle.hub.list("user/repo", source="github")
+
+
+def test_onnx_export_gated():
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    with pytest.raises((ImportError, NotImplementedError),
+                       match="StableHLO"):
+        paddle.onnx.export(nn.Linear(2, 2), "/tmp/x.onnx")
