@@ -19,35 +19,50 @@ from typing import List, Optional
 __all__ = ["list", "help", "load"]
 
 _HUBCONF = "hubconf.py"
+_hubconf_cache = {}
 
 
-def _load_hubconf(repo_dir: str):
+def _load_hubconf(repo_dir: str, force_reload: bool = False):
+    repo_dir = os.path.abspath(repo_dir)
+    if not force_reload and repo_dir in _hubconf_cache:
+        return _hubconf_cache[repo_dir]
     path = os.path.join(repo_dir, _HUBCONF)
     if not os.path.isfile(path):
         raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
-    spec = importlib.util.spec_from_file_location(
-        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    name = f"paddle_tpu_hubconf_{abs(hash(repo_dir))}"
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
+    # registered so classes defined in hubconf.py pickle/deepcopy correctly
+    # (pickle resolves them through sys.modules[cls.__module__])
+    sys.modules[name] = mod
     sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)
+        raise
     finally:
         sys.path.remove(repo_dir)
+    _hubconf_cache[repo_dir] = mod
     return mod
 
 
 def _check_source(source: str):
-    if source != "local":
+    if source in ("github", "gitee"):
         raise RuntimeError(
             f"hub source {source!r} needs network access; this build is "
             "offline — clone the repo and use source='local'")
+    if source != "local":
+        raise ValueError(
+            f"unknown hub source {source!r}; expected 'github', 'gitee' "
+            "or 'local'")
 
 
 def list(repo_dir: str, source: str = "local",  # noqa: A001
          force_reload: bool = False) -> List[str]:
     """Entry-point names exported by the repo's hubconf (`hub.py:123`)."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     return [n for n in dir(mod)
             if callable(getattr(mod, n)) and not n.startswith("_")]
 
@@ -56,7 +71,7 @@ def help(repo_dir: str, model: str, source: str = "local",  # noqa: A001
          force_reload: bool = False) -> Optional[str]:
     """Entry point's docstring (`hub.py:158`)."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise ValueError(f"no entry point {model!r} in {repo_dir}")
@@ -67,7 +82,7 @@ def load(repo_dir: str, model: str, *args, source: str = "local",
          force_reload: bool = False, **kwargs):
     """Instantiate an entry point (`hub.py:197`)."""
     _check_source(source)
-    mod = _load_hubconf(repo_dir)
+    mod = _load_hubconf(repo_dir, force_reload)
     fn = getattr(mod, model, None)
     if fn is None or not callable(fn):
         raise ValueError(f"no entry point {model!r} in {repo_dir}")

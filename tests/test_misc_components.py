@@ -168,3 +168,22 @@ def test_onnx_export_gated():
     with pytest.raises((ImportError, NotImplementedError),
                        match="StableHLO"):
         paddle.onnx.export(nn.Linear(2, 2), "/tmp/x.onnx")
+
+
+def test_hub_pickle_and_cache(tmp_path):
+    import sys
+    import paddle_tpu as paddle
+    (tmp_path / "hubconf.py").write_text(
+        "class Thing:\n"
+        "    pass\n"
+        "def make():\n"
+        "    return Thing()\n")
+    a = paddle.hub.load(str(tmp_path), "make")
+    b = paddle.hub.load(str(tmp_path), "make")
+    assert type(a) is type(b)  # cached module: one class object
+    import pickle
+    rt = pickle.loads(pickle.dumps(a))  # registered in sys.modules
+    assert type(rt).__name__ == "Thing"
+    import pytest
+    with pytest.raises(ValueError, match="unknown hub source"):
+        paddle.hub.list(str(tmp_path), source="locl")
