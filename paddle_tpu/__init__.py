@@ -69,6 +69,7 @@ from . import device  # noqa: F401,E402
 from . import decomposition  # noqa: F401,E402
 from .framework.tensor_array import (TensorArray, array_length,  # noqa: F401,E402
                                      array_read, array_write, create_array)
+from .framework.tensor_variants import SelectedRows, StringTensor  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import static  # noqa: F401,E402

@@ -1,0 +1,147 @@
+"""Tensor variants: SelectedRows and StringTensor.
+
+Parity: `paddle/phi/core/selected_rows.h` (row-sparse value holder used by
+sparse embedding gradients and distributed lookup tables) and
+`paddle/phi/core/string_tensor.h` (pstring array for text preprocessing
+ops).
+
+TPU-native notes: XLA has no sparse buffers — a SelectedRows here is the
+COO-by-rows pair (int rows, dense [n, ...] values) living as two jax
+arrays; `to_dense`/`apply_to` lower to one scatter(-add), which is exactly
+what the reference's SelectedRows ends up doing inside its optimizers.
+Embedding gradients stay dense by default (gather transpose = scatter is
+already fused by XLA); SelectedRows is provided for API/semantic parity
+and as the merge container for PS-style row updates.  StringTensor holds a
+numpy object array host-side: strings never ship to the chip; tokenizer
+ops consume them on host, which mirrors the reference (string kernels are
+CPU-only there too).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["SelectedRows", "StringTensor"]
+
+
+class SelectedRows:
+    """Row-sparse tensor: `height` logical rows, of which `rows[i]` holds
+    `value[i]` (`selected_rows.h`)."""
+
+    def __init__(self, rows: Sequence[int], value, height: int):
+        self._rows = jnp.asarray(np.asarray(rows, np.int32))
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        if v.shape[0] != self._rows.shape[0]:
+            raise ValueError(
+                f"SelectedRows: {self._rows.shape[0]} rows vs value dim0 "
+                f"{v.shape[0]}")
+        self._value = v
+        self._height = int(height)
+
+    @property
+    def rows(self):
+        return Tensor._wrap(self._rows)
+
+    @property
+    def value(self):
+        return Tensor._wrap(self._value)
+
+    @property
+    def height(self) -> int:
+        return self._height
+
+    @property
+    def shape(self):
+        return [self._height] + list(self._value.shape[1:])
+
+    def has_merged_rows(self) -> bool:
+        import numpy as _np
+        r = _np.asarray(jax.device_get(self._rows))
+        return len(_np.unique(r)) == len(r)
+
+    def merge(self) -> "SelectedRows":
+        """Sum duplicate rows (the reference's scatter-merge,
+        `phi/kernels/funcs/selected_rows_functor.cc` MergeAdd)."""
+        import numpy as _np
+        r = _np.asarray(jax.device_get(self._rows))
+        uniq, inv = _np.unique(r, return_inverse=True)
+        merged = jax.ops.segment_sum(self._value, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return SelectedRows(uniq, merged, self._height)
+
+    def to_dense(self) -> Tensor:
+        """One scatter-add into a zero [height, ...] tensor."""
+        out = jnp.zeros((self._height,) + self._value.shape[1:],
+                        self._value.dtype)
+        return Tensor._wrap(out.at[self._rows].add(self._value))
+
+    def apply_to(self, dense: Tensor, scale: float = 1.0) -> Tensor:
+        """dense[rows] += scale * value — the optimizer-update form the
+        reference's sparse SGD kernel implements."""
+        v = dense._value.at[self._rows].add(
+            (self._value * scale).astype(dense._value.dtype))
+        return Tensor._wrap(v)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self._height}, "
+                f"n={int(self._rows.shape[0])}, "
+                f"row_shape={tuple(self._value.shape[1:])})")
+
+
+class StringTensor:
+    """Host-side string array (`string_tensor.h` pstring tensor).
+
+    Strings never move to the device; ops over them (lowercasing,
+    tokenization) run on host and produce numeric Tensors for the chip.
+    """
+
+    def __init__(self, data, name=None):
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return "pstring"
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return len(self._data)
+
+    def lower(self) -> "StringTensor":
+        return StringTensor(np.vectorize(str.lower, otypes=[object])(
+            self._data))
+
+    def upper(self) -> "StringTensor":
+        return StringTensor(np.vectorize(str.upper, otypes=[object])(
+            self._data))
+
+    def encode_ids(self, vocab: dict, unk_id: int = 0) -> Tensor:
+        """Map each string through `vocab` to an int32 id Tensor."""
+        ids = np.vectorize(lambda s: vocab.get(s, unk_id),
+                           otypes=[np.int32])(self._data)
+        return Tensor._wrap(jnp.asarray(ids))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape})"

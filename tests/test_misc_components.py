@@ -187,3 +187,38 @@ def test_hub_pickle_and_cache(tmp_path):
     import pytest
     with pytest.raises(ValueError, match="unknown hub source"):
         paddle.hub.list(str(tmp_path), source="locl")
+
+
+def test_selected_rows_merge_dense_apply():
+    """SelectedRows semantics (`phi/core/selected_rows.h` + MergeAdd)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    sr = paddle.SelectedRows(rows=[1, 3, 1], value=np.array(
+        [[1., 1.], [2., 2.], [10., 10.]], np.float32), height=5)
+    assert sr.shape == [5, 2]
+    assert not sr.has_merged_rows()
+    m = sr.merge()
+    assert m.has_merged_rows()
+    np.testing.assert_array_equal(np.asarray(m.rows._value), [1, 3])
+    np.testing.assert_array_equal(np.asarray(m.value._value),
+                                  [[11., 11.], [2., 2.]])
+    dense = sr.to_dense()
+    np.testing.assert_array_equal(
+        np.asarray(dense._value),
+        [[0, 0], [11, 11], [0, 0], [2, 2], [0, 0]])
+    base = paddle.ones([5, 2])
+    out = sr.apply_to(base, scale=-1.0)
+    np.testing.assert_array_equal(
+        np.asarray(out._value),
+        [[1, 1], [-10, -10], [1, 1], [-1, -1], [1, 1]])
+
+
+def test_string_tensor_ops():
+    import numpy as np
+    import paddle_tpu as paddle
+    st = paddle.StringTensor([["Hello", "World"], ["Foo", "Bar"]])
+    assert st.shape == [2, 2] and st.dtype == "pstring"
+    low = st.lower()
+    assert low[0][1] == "world"
+    ids = low.encode_ids({"hello": 1, "world": 2, "foo": 3}, unk_id=9)
+    np.testing.assert_array_equal(np.asarray(ids._value), [[1, 2], [3, 9]])
