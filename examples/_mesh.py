@@ -1,0 +1,14 @@
+"""Shared helper: repo-root import path + virtual CPU mesh when no TPU."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def ensure_devices(n=8):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count={n}")
+    import jax
+    if jax.default_backend() != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+    return jax

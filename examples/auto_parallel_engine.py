@@ -1,0 +1,31 @@
+"""Semi-auto parallel Engine: mark placements, Engine compiles the whole
+distributed step (GSPMD inserts the collectives)."""
+from _mesh import ensure_devices
+
+ensure_devices(8)
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy  # noqa: E402
+
+paddle.seed(0)
+mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), dim_names=["dp", "mp"])
+model = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 8))
+for p, pl in ((model[0].weight, [dist.Replicate(), dist.Shard(1)]),
+              (model[2].weight, [dist.Replicate(), dist.Shard(0)])):
+    sharded = dist.shard_tensor(p, mesh, pl)
+    p._value, p._dist_attr = sharded._value, sharded._dist_attr
+
+strat = Strategy()
+strat.amp.enable, strat.amp.dtype = True, "bfloat16"
+eng = Engine(model=model, loss=nn.CrossEntropyLoss(),
+             optimizer=optimizer.AdamW(learning_rate=1e-2,
+                                       parameters=model.parameters()),
+             strategy=strat)
+rng = np.random.RandomState(0)
+x = rng.rand(256, 32).astype(np.float32)
+y = rng.randint(0, 8, (256, 1)).astype(np.int64)
+logs = eng.fit(train_data=(x, y), batch_size=32, epochs=3, verbose=0)
+print("loss first/last:", logs["loss"][0], logs["loss"][-1])

@@ -1,0 +1,18 @@
+"""Autoregressive decoding: dense KV cache vs the Pallas paged-attention
+block cache (identical outputs, paged memory)."""
+from _mesh import ensure_devices
+
+ensure_devices(1)
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny  # noqa: E402
+
+paddle.seed(0)
+model = GPTForCausalLM(gpt3_tiny())
+prompt = paddle.to_tensor(
+    np.random.RandomState(0).randint(0, 1024, (2, 12)).astype(np.int32))
+dense = model.generate(prompt, max_new_tokens=8)
+paged = model.generate(prompt, max_new_tokens=8, cache_impl="paged")
+assert (np.asarray(dense._value) == np.asarray(paged._value)).all()
+print("dense == paged:", np.asarray(paged._value)[:, -8:])

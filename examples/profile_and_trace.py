@@ -1,0 +1,21 @@
+"""Profiler: host timeline (C++ tracer) + summary + chrome trace export."""
+from _mesh import ensure_devices
+
+ensure_devices(1)
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, profiler  # noqa: E402
+
+paddle.seed(0)
+net = nn.Sequential(nn.Linear(64, 256), nn.GELU(), nn.Linear(256, 64))
+x = paddle.to_tensor(np.random.RandomState(0).rand(32, 64)
+                     .astype(np.float32))
+with profiler.Profiler() as prof:
+    for _ in range(4):
+        with profiler.RecordEvent("fwd+bwd"):
+            y = net(x).mean()
+            y.backward()
+path = prof.export("/tmp/paddle_tpu_trace.json")
+print(prof.summary(time_unit="us")[:600])
+print("chrome trace:", path)

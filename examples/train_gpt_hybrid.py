@@ -1,0 +1,28 @@
+"""BASELINE rung 4 (shape): GPT trained with dp2 x mp2 x pp2 hybrid
+parallelism — pipeline ppermute + Megatron TP/SP + ZeRO-1 sharded Adam,
+compiled as ONE SPMD program over the mesh."""
+from _mesh import ensure_devices
+
+jax = ensure_devices(8)
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from paddle_tpu.distributed.fleet.hybrid_step import (  # noqa: E402
+    HybridConfig, hybrid_param_specs, init_gpt_params, init_zero_state,
+    make_hybrid_train_step, stack_for_pipeline)
+
+cfg = HybridConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                   num_heads=4, seq_len=32, pp=2, mp=2, dp=2,
+                   n_microbatches=2, sequence_parallel=True, remat=True)
+devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+mesh = Mesh(devs, ("pp", "dp", "mp"))
+params = stack_for_pipeline(init_gpt_params(jax.random.key(0), cfg), cfg)
+m, v, _ = init_zero_state(params, hybrid_param_specs(cfg), mesh)
+step = make_hybrid_train_step(mesh, cfg)
+
+rng = np.random.RandomState(0)
+for i in range(5):
+    ids = rng.randint(0, cfg.vocab_size,
+                      (cfg.n_microbatches, 4, cfg.seq_len)).astype("int32")
+    loss, params, m, v = step(params, m, v, float(i + 1), ids)
+    print(f"step {i}: loss {float(loss):.4f}")

@@ -1,0 +1,36 @@
+"""BASELINE rung 1: LeNet on synthetic MNIST — eager, then one compiled
+train step via paddle.jit.to_static."""
+from _mesh import ensure_devices
+
+ensure_devices(1)
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.io import DataLoader  # noqa: E402
+from paddle_tpu.jit import to_static  # noqa: E402
+from paddle_tpu.vision.datasets import MNIST  # noqa: E402
+from paddle_tpu.vision.models import LeNet  # noqa: E402
+
+paddle.seed(0)
+model = LeNet()
+opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                         parameters=model.parameters())
+lossf = nn.CrossEntropyLoss()
+loader = DataLoader(MNIST(mode="train", synthetic_size=512),
+                    batch_size=64, shuffle=True, drop_last=True)
+
+
+def train_step(x, y):
+    loss = lossf(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+step = to_static(train_step)  # forward+backward+update as ONE XLA program
+for epoch in range(2):
+    for i, (x, y) in enumerate(loader):
+        loss = step(x, y)
+    print(f"epoch {epoch}: loss {float(loss.item()):.4f}")

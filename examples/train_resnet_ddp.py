@@ -1,0 +1,36 @@
+"""BASELINE rung 2 (shape): ResNet-18 data-parallel over the mesh — the
+batch is sharded over dp; GSPMD inserts the gradient all-reduce."""
+from _mesh import ensure_devices
+
+ensure_devices(8)
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.jit import to_static  # noqa: E402
+from paddle_tpu.vision.models import resnet18  # noqa: E402
+
+dist.init_parallel_env()
+paddle.seed(0)
+model = resnet18(num_classes=10)
+opt = optimizer.Momentum(learning_rate=0.1, parameters=model.parameters())
+lossf = nn.CrossEntropyLoss()
+
+
+def train_step(x, y):
+    loss = lossf(model(x), y)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return loss
+
+
+step = to_static(train_step)
+rng = np.random.RandomState(0)
+for i in range(3):
+    x = paddle.to_tensor(rng.rand(16, 3, 32, 32).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 10, (16,)).astype(np.int32))
+    x = dist.shard_batch(x)  # lay the global batch over the dp axis
+    loss = step(x, y)
+    print(f"step {i}: loss {float(loss.item()):.4f}")

@@ -1,0 +1,21 @@
+"""Hand-written lowerings referenced from specs/ops.yaml (the reference's
+equivalent is the manual kernels its YAML entries name)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
+    """Batched diagonal embedding (`tensor/creation.py` diag_embed):
+    the last dim of x becomes the (offset) diagonal of a matrix whose two
+    new axes land at output positions (dim1, dim2)."""
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = base.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    return jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))

@@ -88,3 +88,46 @@ def test_codegen_cli_regenerates(tmp_path):
     out = tmp_path / "gen.py"
     codegen.write(str(out))
     assert out.read_text() == codegen.generate_source()
+
+
+def test_new_generated_math_ops():
+    """The YAML batch beyond fft: values vs numpy."""
+    x = paddle.to_tensor(np.array([0.5, -1.5, 2.0], np.float32))
+    y = paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.nextafter(x, y)._value),
+        np.nextafter([0.5, -1.5, 2.0], 1.0).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.signbit(x)._value), [False, True, False])
+    inf = paddle.to_tensor(np.array([np.inf, -np.inf, 0.0], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.isposinf(inf)._value), [True, False, False])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.isneginf(inf)._value), [False, True, False])
+    z = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+    np.testing.assert_allclose(
+        np.asarray(paddle.logcumsumexp(z)._value),
+        np.log(np.cumsum(np.exp([1., 2., 3.]))), rtol=1e-5)
+
+
+def test_diag_embed_matches_torch_semantics():
+    x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+    out = paddle.diag_embed(paddle.to_tensor(x), offset=1)
+    assert out.shape == [2, 4, 4]
+    dense = np.asarray(out._value)
+    np.testing.assert_allclose(dense[0, 0, 1], x[0, 0])
+    assert dense[0].sum() == x[0].sum()
+    # grads flow
+    t = paddle.to_tensor(x)
+    t.stop_gradient = False
+    paddle.diag_embed(t).sum().backward()
+    np.testing.assert_array_equal(np.asarray(t.grad._value), np.ones((2, 3)))
+
+
+def test_column_row_stack():
+    a = paddle.to_tensor(np.array([1., 2.], np.float32))
+    b = paddle.to_tensor(np.array([3., 4.], np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(paddle.column_stack([a, b])._value), [[1, 3], [2, 4]])
+    np.testing.assert_array_equal(
+        np.asarray(paddle.row_stack([a, b])._value), [[1, 2], [3, 4]])
