@@ -19,3 +19,10 @@ def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
     nd = out.ndim
     d1, d2 = dim1 % nd, dim2 % nd
     return jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+
+
+def logcumsumexp(x, *, axis=-1):
+    """lax.cumlogsumexp with python-style axis normalization (lax rejects
+    negative axes)."""
+    import jax
+    return jax.lax.cumlogsumexp(x, axis=axis % x.ndim)

@@ -94,9 +94,10 @@ def test_new_generated_math_ops():
     """The YAML batch beyond fft: values vs numpy."""
     x = paddle.to_tensor(np.array([0.5, -1.5, 2.0], np.float32))
     y = paddle.to_tensor(np.array([1.0, 1.0, 1.0], np.float32))
-    np.testing.assert_allclose(
+    np.testing.assert_array_equal(
         np.asarray(paddle.nextafter(x, y)._value),
-        np.nextafter([0.5, -1.5, 2.0], 1.0).astype(np.float32))
+        np.nextafter(np.array([0.5, -1.5, 2.0], np.float32),
+                     np.float32(1.0)))
     np.testing.assert_array_equal(
         np.asarray(paddle.signbit(x)._value), [False, True, False])
     inf = paddle.to_tensor(np.array([np.inf, -np.inf, 0.0], np.float32))
