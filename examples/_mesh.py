@@ -1,4 +1,10 @@
-"""Shared helper: repo-root import path + virtual CPU mesh when no TPU."""
+"""Shared helper: repo-root import path + device selection.
+
+n > 1: force the n-device virtual CPU mesh — these examples demonstrate
+multi-chip SPMD and the build box has one tunneled TPU chip; on a real
+pod slice delete the override and the same code runs over ICI.
+n == 1: keep the default backend (the real chip when present).
+"""
 import os
 import sys
 
@@ -6,9 +12,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def ensure_devices(n=8):
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + f" --xla_force_host_platform_device_count={n}")
-    import jax
-    if jax.default_backend() != "tpu":
+    if n > 1:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}")
+        import jax
         jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
     return jax
