@@ -385,16 +385,16 @@ class Profiler:
             lines.append(f"steps: {len(self._step_times)}  "
                          f"avg step: {avg * unit:.3f}{time_unit}")
         header = (f"{'category':<10}{'name':<36}{'calls':>8}"
-                  f"{'total':>14}{'avg':>12}{'max':>12}")
+                  f"{'total':>16}{'avg':>16}{'max':>16}")
         lines.append(header)
         lines.append("-" * len(header))
         for (cat, name), (cnt, tot, mx) in sorted(
                 rows.items(), key=lambda kv: -kv[1][1]):
             lines.append(
                 f"{cat:<10}{name[:35]:<36}{cnt:>8}"
-                f"{tot * unit:>12.3f}{time_unit:<2}"
-                f"{tot / cnt * unit:>10.3f}{time_unit:<2}"
-                f"{mx * unit:>10.3f}{time_unit:<2}")
+                f"{tot * unit:>14.3f}{time_unit:<2}"
+                f"{tot / cnt * unit:>14.3f}{time_unit:<2}"
+                f"{mx * unit:>14.3f}{time_unit:<2}")
         out = "\n".join(lines)
         print(out)
         return out

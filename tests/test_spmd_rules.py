@@ -309,3 +309,25 @@ def test_shard_replicate_moves():
                                                axis_name="mp")
     assert vr.sharding.is_fully_replicated
     np.testing.assert_array_equal(np.asarray(vr), np.asarray(v))
+
+
+def test_cross_mesh_reshard():
+    """Reshard between DIFFERENT meshes (reference `reshard/nd_mesh_...` +
+    cross-mesh functions): device_put re-lays the array out on the target
+    mesh; values survive any (mesh, placement) -> (mesh, placement) hop."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    mesh_a = dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                              dim_names=["dp", "mp"])
+    mesh_b = dist.ProcessMesh(np.arange(8).reshape(2, 4),
+                              dim_names=["x", "y"])
+    t = dist.shard_tensor(paddle.to_tensor(x), mesh_a,
+                          [dist.Shard(0), dist.Shard(1)])
+    out = dist.reshard(t, mesh_b, [dist.Replicate(), dist.Shard(0)])
+    np.testing.assert_array_equal(np.asarray(out._value), x)
+    assert out._dist_attr["mesh"] is mesh_b
+    # and back again with a different placement
+    back = dist.reshard(out, mesh_a, [dist.Shard(1), dist.Replicate()])
+    np.testing.assert_array_equal(np.asarray(back._value), x)
