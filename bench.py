@@ -302,6 +302,42 @@ def bench_dispatch():
          "overhead_ratio": round(raw_ops / eager_ops, 2)})
 
 
+def bench_decode():
+    """Autoregressive decode throughput: GPT-124M greedy generation with
+    the dense KV cache vs the paged block cache (Pallas kernel)."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    paddle.seed(0)
+    cfg = gpt3_124m() if on_tpu else None
+    if cfg is None:
+        from paddle_tpu.models.gpt import gpt3_tiny
+        cfg = gpt3_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    B, prompt, new = (8, 128, 64) if on_tpu else (2, 16, 8)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, prompt)).astype(np.int32))
+    results = {}
+    for impl in ("dense", "paged"):
+        # full-length warmup: dense cache shapes change per step, so every
+        # decode length needs its compile cached before timing
+        model.generate(ids, max_new_tokens=new, cache_impl=impl)
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new, cache_impl=impl)
+        np.asarray(out._value)
+        dt = time.perf_counter() - t0
+        results[impl] = B * new / dt
+    log({"bench": "gpt124m_decode", "batch": B, "prompt": prompt,
+         "new_tokens": new,
+         "dense_tokens_per_sec": round(results["dense"], 1),
+         "paged_tokens_per_sec": round(results["paged"], 1)})
+
+
 def _release_device_memory():
     """Free the previous rung's executables/buffers: each rung must start
     from a clean HBM (compiled programs pin their constants in jax's
@@ -342,6 +378,11 @@ def main():
         bench_bert_base()
     except Exception as e:  # noqa: BLE001
         log({"bench": "bert_base_mlm_train", "error": repr(e)})
+    _release_device_memory()
+    try:
+        bench_decode()
+    except Exception as e:  # noqa: BLE001
+        log({"bench": "gpt124m_decode", "error": repr(e)})
 
 
 if __name__ == "__main__":
