@@ -111,3 +111,39 @@ def _kl_laplace_laplace(p, q):
 def _kl_exponential_exponential(p, q):
     ratio = q.rate / p.rate
     return paddle.log(p.rate) - paddle.log(q.rate) + ratio - 1.0
+
+
+# ------------------------------------------------------- extras (extras.py)
+from .extras import (Binomial, Cauchy, Independent,  # noqa: E402
+                     MultivariateNormal)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_binomial(p, q):
+    # same total_count assumed (the reference's registry does too):
+    # n * KL(Bernoulli(p) || Bernoulli(q))
+    return p.total_count * (
+        p.probs * (paddle.log(p.probs) - paddle.log(q.probs))
+        + (1.0 - p.probs) * (paddle.log1p(-p.probs)
+                             - paddle.log1p(-q.probs)))
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p._rank != q._rank:
+        raise NotImplementedError(
+            "KL between Independents of different reinterpreted ranks")
+    inner = kl_divergence(p.base, q.base)
+    if p._rank == 0:
+        return inner
+    return inner.sum(axis=list(range(inner.ndim - p._rank, inner.ndim)))

@@ -312,3 +312,27 @@ def test_audio_datasets():
     # determinism across constructions
     wav2, _ = ESC50(mode="test", synthetic_size=4)[0]
     np.testing.assert_array_equal(wav, wav2)
+
+
+def test_kl_registry_covers_extras():
+    from paddle_tpu.distribution import (Binomial, Cauchy, Independent,
+                                         MultivariateNormal, Normal,
+                                         kl_divergence)
+    c = kl_divergence(Cauchy(0., 1.), Cauchy(1., 2.))
+    assert float(c.item()) > 0
+    assert abs(float(kl_divergence(Cauchy(0., 1.), Cauchy(0., 1.)).item())) \
+        < 1e-7
+    L = np.eye(2, dtype=np.float32)
+    m1 = MultivariateNormal(paddle.to_tensor(np.zeros(2, np.float32)),
+                            scale_tril=paddle.to_tensor(L))
+    m2 = MultivariateNormal(paddle.to_tensor(np.ones(2, np.float32)),
+                            scale_tril=paddle.to_tensor(L))
+    assert abs(float(kl_divergence(m1, m2).item()) - 1.0) < 1e-5
+    b = kl_divergence(Binomial(10., 0.3), Binomial(10., 0.5))
+    assert float(b.item()) > 0
+    base_p = Normal(paddle.to_tensor(np.zeros((3,), np.float32)),
+                    paddle.to_tensor(np.ones((3,), np.float32)))
+    base_q = Normal(paddle.to_tensor(np.ones((3,), np.float32)),
+                    paddle.to_tensor(np.ones((3,), np.float32)))
+    ind = kl_divergence(Independent(base_p, 1), Independent(base_q, 1))
+    assert abs(float(ind.item()) - 1.5) < 1e-5  # 3 * 0.5
