@@ -200,6 +200,29 @@ class Dirac(Initializer):
         return param
 
 
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel init for transposed-conv upsampling
+    (`nn/initializer/Bilinear.py`): each [kh, kw] plane is the separable
+    triangle filter; channels on the diagonal."""
+
+    def __call__(self, param, block=None):
+        shape = tuple(param.shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv weight")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            return (1 - np.abs(np.arange(k) / f - c))
+        plane = np.outer(tri(kh), tri(kw)).astype(np.float32)
+        v = np.zeros(shape, np.float32)
+        for i in range(min(shape[0], shape[1])):
+            v[i, i] = plane
+        param._value = jnp.asarray(v, param._value.dtype)
+        return param
+
+
 _global_weight_init = None
 _global_bias_init = None
 
