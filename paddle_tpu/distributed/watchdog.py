@@ -33,12 +33,8 @@ from .. import flags as _flags
 __all__ = ["CommTaskManager", "comm_task", "static_check_meta",
            "Heartbeat", "dead_peers"]
 
-_flags.define_flag("enable_comm_watchdog", True,
-                   "watch host-side comm tasks for hangs")
-_flags.define_flag("comm_watchdog_timeout_s", 300.0,
-                   "seconds before a host comm task is reported as hung")
-_flags.define_flag("comm_static_check", False,
-                   "verify shape/dtype across ranks before collectives")
+# The watchdog flags are registered in flags.py (single source of truth) so
+# collective.py's readers never depend on this module's import having run.
 
 
 @dataclass

@@ -132,3 +132,13 @@ define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity; XLA owns memory
 define_flag("benchmark", False, "Block on every op for accurate per-op timing.")
 define_flag("tpu_deterministic", False, "Force deterministic XLA reductions.")
 define_flag("log_level", 0, "VLOG-style verbosity for paddle_tpu internals.")
+
+# Comm-watchdog flags (used by distributed/collective.py and watchdog.py).
+# Registered here — the single source of truth — so readers never depend on
+# watchdog's import having run first.
+define_flag("enable_comm_watchdog", True,
+            "watch host-side comm tasks for hangs")
+define_flag("comm_watchdog_timeout_s", 300.0,
+            "seconds before a host comm task is reported as hung")
+define_flag("comm_static_check", False,
+            "verify shape/dtype across ranks before collectives")
