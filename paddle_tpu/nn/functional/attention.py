@@ -22,6 +22,10 @@ __all__ = ["scaled_dot_product_attention", "flash_attention", "sdpa_xla"]
 
 def _sdpa_xla_impl(q, k, v, mask, *, causal, dropout_p, scale, key):
     # inputs [B, S, H, D] (paddle flash_attn layout); compute in [B,H,S,D]
+    if k.shape[2] != q.shape[2]:  # GQA fallback: repeat kv heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -64,13 +68,25 @@ def sdpa_xla(query, key, value, attn_mask=None, dropout_p=0.0,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    """Layout [batch, seq, heads, head_dim] like paddle's flash-attn API."""
+    """Layout [batch, seq, heads, head_dim] like paddle's flash-attn API.
+
+    Key-padding masks ([B, Sk] / [B, 1, 1, Sk] boolean keep-masks) and
+    attention dropout ride the Pallas flash kernel on TPU; additive or
+    full [Sq, Sk] masks take the XLA path."""
     from ...ops import pallas_kernels
-    if pallas_kernels.flash_attention_available(query, key, value, attn_mask):
-        return pallas_kernels.flash_attention(query, key, value,
-                                              causal=is_causal,
-                                              dropout_p=dropout_p if training
-                                              else 0.0)
+    B, Sk = query.shape[0], key.shape[1]
+    kv_mask = pallas_kernels.as_kv_padding_mask(attn_mask, B, Sk)
+    residual_mask = attn_mask if kv_mask is None else None
+    if pallas_kernels.flash_attention_available(query, key, value,
+                                                residual_mask):
+        return pallas_kernels.flash_attention(
+            query, key, value, causal=is_causal,
+            dropout_p=dropout_p if training else 0.0, kv_mask=kv_mask)
+    if kv_mask is not None:
+        # a recognized integer 0/1 padding mask must KEEP its keep-mask
+        # semantics on the XLA path too (the non-bool sdpa branch would
+        # ADD it to the logits — a silent no-op)
+        attn_mask = (kv_mask != 0).reshape(B, 1, 1, Sk)
     return sdpa_xla(query, key, value, attn_mask, dropout_p, is_causal,
                     None, training)
 

@@ -4,7 +4,13 @@ Role of the reference's CUDA flash attention
 (`paddle/phi/kernels/gpu/flash_attn_kernel.cu` + vendored
 `third_party/flashattn`, and the fused path of
 `fused_multi_transformer_op.cu`): attention computed blockwise in VMEM so
-the [S, S] score matrix never materializes in HBM.
+the [S, S] score matrix never materializes in HBM.  This version carries
+the reference kernel's full feature set: key-padding masks (the varlen
+API's effective semantics), cross/cached attention (Sq != Sk with
+end-aligned causal), GQA (fewer kv heads than q heads, resolved by index
+maps — repeated K/V never touch HBM), and in-kernel dropout (the CUDA
+kernel's philox dropout; here the TPU PRNG reseeded per block so the
+backward kernels regenerate identical bits instead of storing the mask).
 
 Layout follows paddle's flash-attn API: q, k, v are [B, S, nh, hd].
 
@@ -13,16 +19,23 @@ sequential on TPU, so the online-softmax state lives in VMEM scratch across
 k-block steps):
 
 * forward: grid (B*nh, Sq/BQ, Sk/BK); scratch (m, l, acc); causal blocks
-  above the diagonal are skipped (`pl.when`), the diagonal block is masked
-  with `broadcasted_iota`.  Outputs out and the logsumexp rows (for bwd).
+  above the (end-aligned) diagonal are skipped (`pl.when`), the diagonal
+  block is masked with `broadcasted_iota`.  Outputs out and the logsumexp
+  rows (for bwd).
 * backward dq: grid (B*nh, Sq/BQ, Sk/BK), accumulates dq over k blocks.
 * backward dkv: grid (B*nh, Sk/BK, Sq/BQ), accumulates dk/dv over q blocks.
   Uses the FlashAttention-2 identity ds = p * (dp - D), D = rowsum(dO * O),
-  so no second softmax pass is needed.
+  so no second softmax pass is needed.  With GQA the kernels emit per-
+  q-head dk/dv ([B, nh, Sk, hd]) which XLA reduces over the head group.
 
 All matmuls run on the MXU with f32 accumulation (`preferred_element_type`);
 bf16 inputs stay bf16 in HBM.  On non-TPU backends the same kernels run
 under the Pallas interpreter (CPU CI), selected automatically.
+
+Dropout applies to the normalized probabilities (standard attention
+semantics): l accumulates undropped p, acc accumulates dropped p @ v.
+Each (batch*head, q-block, k-block) seeds the PRNG as
+(seed, bh, qi, ki) so all three kernels see the same keep mask.
 """
 
 from __future__ import annotations
@@ -50,21 +63,64 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def supported(q_shape, dtype=None) -> bool:
-    """Kernel applicability: seq a multiple of the block, MXU-friendly hd."""
+def _resolve_interpret(interpret, rate):
+    """The generic pallas interpreter has no lowering for the TPU PRNG
+    primitives; dropout kernels in interpret mode (CPU CI) run under the
+    TPU-semantics interpreter instead."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if interpret is True and rate > 0.0 and _HAS_PLTPU:
+        return pltpu.InterpretParams()
+    return interpret
+
+
+def supported(q_shape, k_shape=None, dtype=None) -> bool:
+    """Kernel applicability: seqs multiples of their blocks, MXU-friendly
+    hd, q heads an integer multiple of kv heads."""
     if len(q_shape) != 4:
         return False
-    _, S, _, hd = q_shape
-    bq = min(128, S)
-    return S % bq == 0 and S % 8 == 0 and S >= 8 and hd in (64, 128, 256)
+    _, Sq, nh, hd = q_shape
+    if k_shape is not None:
+        _, Sk, nkv, hd_k = k_shape
+        if hd_k != hd or nkv == 0 or nh % nkv:
+            return False
+        bk = min(128, Sk)
+        if Sk % bk or Sk % 8 or Sk < 8:
+            return False
+    bq = min(128, Sq)
+    return Sq % bq == 0 and Sq % 8 == 0 and Sq >= 8 and hd in (64, 128, 256)
+
+
+def _block_seed(seed, bh, qi, ki):
+    """Mix block coordinates into ONE extra seed word (Mosaic's
+    tpu.prng_set_seed_32 accepts at most two values).  Bit-packed so
+    distinct blocks get distinct words for all practical grids
+    (bh < 2^11, qi/ki < 2^10); int32 wraparound beyond that is a
+    harmless (deterministic) collision."""
+    return jnp.int32(seed) ^ (bh * jnp.int32(1 << 20)
+                              + qi * jnp.int32(1 << 10) + ki)
+
+
+def _keep_mask(shape, rate):
+    """Regenerate the dropout keep-mask for the current block; the caller
+    must have seeded the PRNG with this block's coordinates."""
+    bits = pltpu.prng_random_bits(shape)
+    # keep with probability (1 - rate): compare against a threshold on the
+    # uint32 line; bitcast keeps the comparison unsigned
+    if bits.dtype != jnp.uint32:
+        bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    thresh = jnp.uint32((1.0 - rate) * 4294967295.0)
+    return bits < thresh
 
 
 # --------------------------------------------------------------------------
 # forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, bq, bk, nk):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
+                o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, bq, bk, nk, offset, rate, has_mask):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -77,8 +133,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     q_start = qi * bq
     k_start = ki * bk
 
-    # causal: skip blocks strictly above the diagonal
-    run = True if not causal else (k_start <= q_start + bq - 1)
+    # causal (end-aligned: query i attends keys <= i + offset, offset =
+    # Sk - Sq): skip blocks strictly above the shifted diagonal
+    run = True if not causal else (k_start <= q_start + offset + bq - 1)
 
     @pl.when(run)
     def _():
@@ -87,18 +144,35 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        valid2d = None
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            valid2d = rows + offset >= cols
+        if has_mask:
+            valid = mask_ref[0, :] != 0                   # [bk]
+            vk = jnp.broadcast_to(valid[None, :], (bq, bk))
+            valid2d = vk if valid2d is None else (valid2d & vk)
+        if valid2d is not None:
+            s = jnp.where(valid2d, s, _NEG_INF)
         m_prev = m_scr[:, 0]                         # [bq]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])              # [bq, bk]
+        if valid2d is not None:
+            # a fully-masked row in this block has m_new == s == _NEG_INF,
+            # making exp(s - m_new) = 1 on masked entries — zero explicitly
+            p = jnp.where(valid2d, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)              # [bq]
         l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
         v = v_ref[:, :]                        # [bk, hd]
+        if rate > 0.0:
+            pltpu.prng_seed(_block_seed(seed_ref[0], bh, qi, ki))
+            keep = _keep_mask((bq, bk), rate)
+            p_v = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            p_v = p
         pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_v.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bq, hd]
         acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
         m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
@@ -128,59 +202,89 @@ def _pick_block(S, target):
     return b
 
 
+def _seed_arr(seed):
+    if seed is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(seed, jnp.int32).reshape((1,))
+
+
+def _mask_arr(kv_mask, B, Sk):
+    """[B, Sk] (or broadcastable) 0/1 key-validity -> [B, 1, Sk] int32."""
+    if kv_mask is None:
+        return jnp.ones((B, 1, Sk), jnp.int32)
+    m = jnp.asarray(kv_mask)
+    m = jnp.broadcast_to(m.reshape(m.shape[0], 1, m.shape[-1]), (B, 1, Sk))
+    return m.astype(jnp.int32)
+
+
 def flash_attention_fwd(q, k, v, causal=False, interpret=None,
+                        kv_mask=None, dropout_rate=0.0, seed=None,
                         block_q=512, block_k=1024):
-    """Returns (out, lse); out [B, S, nh, hd], lse [B, nh, S, 128]
+    """Returns (out, lse); out [B, Sq, nh, hd], lse [B, nh, Sq, 128]
     (float32, rows broadcast across the 128-lane dim).
+
+    k, v may carry fewer heads than q (GQA): nh % nkv == 0; the kernel
+    resolves the head group through the k/v index maps, so the repeated
+    heads never materialize.  kv_mask is a [B, Sk] 0/1 key-validity mask
+    (padding); dropout_rate with `seed` (int32) applies in-kernel dropout
+    to the normalized probabilities.
 
     Kernels run in BNSH layout so blocks are rank-2 [block, hd] after
     squeezing the (batch, head) dims — Mosaic's lane/sublane alignment
     applies to the (seq, hd) dims, which are tile-friendly."""
-    if interpret is None:
-        interpret = _interpret_default()
-    B, S, nh, hd = q.shape
-    Sk = k.shape[1]
-    bq = _pick_block(S, block_q)
+    interpret = _resolve_interpret(interpret, float(dropout_rate))
+    B, Sq, nh, hd = q.shape
+    Sk, nkv = k.shape[1], k.shape[2]
+    group = nh // nkv
+    bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
-    nq, nk = S // bq, Sk // bk
+    nq, nk = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
+    rate = float(dropout_rate)
+    has_mask = kv_mask is not None
 
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             bq=bq, bk=bk, nk=nk)
+                             bq=bq, bk=bk, nk=nk, offset=Sk - Sq,
+                             rate=rate, has_mask=has_mask)
     grid = (B * nh, nq, nk)
 
-    def qmap(bh, qi, ki):
+    def qmap(bh, qi, ki, *_):
         return (bh // nh, bh % nh, qi, 0)
 
-    def kmap(bh, qi, ki):
-        return (bh // nh, bh % nh, ki, 0)
+    def kmap(bh, qi, ki, *_):
+        return (bh // nh, (bh % nh) // group, ki, 0)
 
-    def lsemap4(bh, qi, ki):
-        return (bh // nh, bh % nh, qi, 0)
+    def mmap(bh, qi, ki, *_):
+        return (bh // nh, 0, ki)
 
-    out, lse = pl.pallas_call(
-        kern,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, bq, hd), qmap),
             pl.BlockSpec((None, None, bk, hd), kmap),
             pl.BlockSpec((None, None, bk, hd), kmap),
+            pl.BlockSpec((None, 1, bk), mmap),
         ],
         out_specs=[
             pl.BlockSpec((None, None, bq, hd), qmap),
-            pl.BlockSpec((None, None, bq, 128), lsemap4),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, nh, S, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, nh, S, 128), jnp.float32),
+            pl.BlockSpec((None, None, bq, 128), qmap),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, hd), jnp.float32),
         ],
+    )
+    out, lse = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nh, Sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, nh, Sq, 128), jnp.float32),
+        ],
         interpret=interpret,
-    )(_bnsh(q), _bnsh(k), _bnsh(v))
+    )(_seed_arr(seed), _bnsh(q), _bnsh(k), _bnsh(v), _mask_arr(kv_mask, B, Sk))
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
@@ -188,8 +292,10 @@ def flash_attention_fwd(q, k, v, causal=False, interpret=None,
 # backward
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                   dq_scr, *, scale, causal, bq, bk, nk):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                   mask_ref, dq_ref, dq_scr,
+                   *, scale, causal, bq, bk, nk, offset, rate, has_mask):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -199,7 +305,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 
     q_start = qi * bq
     k_start = ki * bk
-    run = True if not causal else (k_start <= q_start + bq - 1)
+    run = True if not causal else (k_start <= q_start + offset + bq - 1)
 
     @pl.when(run)
     def _():
@@ -214,14 +320,28 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        valid2d = None
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            valid2d = rows + offset >= cols
+        if has_mask:
+            valid = mask_ref[0, :] != 0
+            vk = jnp.broadcast_to(valid[None, :], (bq, bk))
+            valid2d = vk if valid2d is None else (valid2d & vk)
+        if valid2d is not None:
+            s = jnp.where(valid2d, s, _NEG_INF)
         p = jnp.exp(s - lse)                         # [bq, bk]
+        if valid2d is not None:
+            # fully-masked rows carry lse = _NEG_INF; zero explicitly
+            p = jnp.where(valid2d, p, 0.0)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bq, bk]
+        if rate > 0.0:
+            pltpu.prng_seed(_block_seed(seed_ref[0], bh, qi, ki))
+            keep = _keep_mask((bq, bk), rate)
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -232,9 +352,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[:, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, bq, bk, nq):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    mask_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bk, nq, offset, rate, has_mask):
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -245,7 +366,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     q_start = qi * bq
     k_start = ki * bk
-    run = True if not causal else (k_start <= q_start + bq - 1)
+    run = True if not causal else (k_start <= q_start + offset + bq - 1)
 
     @pl.when(run)
     def _():
@@ -259,18 +380,40 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        valid2d = None
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_start
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k_start
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            valid2d = rows + offset >= cols
+        if has_mask:
+            valid = mask_ref[0, :] != 0
+            vk = jnp.broadcast_to(valid[None, :], (bq, bk))
+            valid2d = vk if valid2d is None else (valid2d & vk)
+        if valid2d is not None:
+            s = jnp.where(valid2d, s, _NEG_INF)
         p = jnp.exp(s - lse)                         # [bq, bk]
-        # dv += p^T @ do
+        if valid2d is not None:
+            # fully-masked rows carry lse = _NEG_INF; zero explicitly
+            p = jnp.where(valid2d, p, 0.0)
+        if rate > 0.0:
+            # seeded by LOGICAL block coords (bh, qi, ki) — this kernel's
+            # grid iterates (bh, ki, qi) but must regenerate the exact
+            # bits the forward drew for the (qi, ki) tile
+            pltpu.prng_seed(_block_seed(seed_ref[0], bh, qi, ki))
+            keep = _keep_mask((bq, bk), rate)
+            p_v = jnp.where(keep, p / (1.0 - rate), 0.0)
+        else:
+            keep = None
+            p_v = p
+        # dv += (dropped p)^T @ do
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_v, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bk, hd]
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bq, bk]
+        if rate > 0.0:
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
         ds = p * (dp - delta) * scale                # [bq, bk]
         # dk += ds^T @ q
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
@@ -283,32 +426,37 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[:, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, interpret, res, g, block_q=512, block_k=512):
-    q, k, v, out, lse = res
-    if interpret is None:
-        interpret = _interpret_default()
-    B, S, nh, hd = q.shape
-    Sk = k.shape[1]
-    bq = _pick_block(S, block_q)
+def _flash_bwd(causal, interpret, kv_mask_shape, rate, res, g,
+               block_q=512, block_k=512):
+    q, k, v, out, lse, mask_arr, seed_arr = res
+    interpret = _resolve_interpret(interpret, rate)
+    B, Sq, nh, hd = q.shape
+    Sk, nkv = k.shape[1], k.shape[2]
+    group = nh // nkv
+    bq = _pick_block(Sq, block_q)
     bk = _pick_block(Sk, block_k)
-    nq, nk = S // bq, Sk // bk
+    nq, nk = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
+    # the residual mask array is saved unconditionally (all-ones when no
+    # kv_mask was given), so the backward ALWAYS applies it — masking with
+    # ones is the identity, and this removes any way for a caller to get a
+    # masked forward with an unmasked backward (kv_mask_shape is advisory)
+    has_mask = True
 
     qb, kb, vb = _bnsh(q), _bnsh(k), _bnsh(v)
     ob, gb = _bnsh(out), _bnsh(g)
 
-    def qmap(bh, qi, ki):
+    def qmap(bh, qi, ki, *_):
         return (bh // nh, bh % nh, qi, 0)
 
-    def kmap(bh, qi, ki):
-        return (bh // nh, bh % nh, ki, 0)
+    def kmap(bh, qi, ki, *_):
+        return (bh // nh, (bh % nh) // group, ki, 0)
 
-    def rowmap(bh, qi, ki):
-        return (bh // nh, bh % nh, qi, 0)
+    def mmap(bh, qi, ki, *_):
+        return (bh // nh, 0, ki)
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(B * nh, nq, nk),
         in_specs=[
             pl.BlockSpec((None, None, bq, hd), qmap),
@@ -316,27 +464,36 @@ def _flash_bwd(causal, interpret, res, g, block_q=512, block_k=512):
             pl.BlockSpec((None, None, bk, hd), kmap),
             pl.BlockSpec((None, None, bq, hd), qmap),
             pl.BlockSpec((None, None, bq, hd), qmap),
-            pl.BlockSpec((None, None, bq, 128), rowmap),
+            pl.BlockSpec((None, None, bq, 128), qmap),
+            pl.BlockSpec((None, 1, bk), mmap),
         ],
         out_specs=pl.BlockSpec((None, None, bq, hd), qmap),
-        out_shape=jax.ShapeDtypeStruct((B, nh, S, hd), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, hd), jnp.float32)],
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk, offset=Sk - Sq, rate=rate,
+                          has_mask=has_mask),
+        grid_spec=dq_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nh, Sq, hd), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb, ob, gb, lse)
+    )(seed_arr, qb, kb, vb, ob, gb, lse, mask_arr)
 
     # dkv: grid ordered (bh, ki, qi) — q is the sequential axis
-    def kmap2(bh, ki, qi):
+    def kmap2(bh, ki, qi, *_):
+        return (bh // nh, (bh % nh) // group, ki, 0)
+
+    def kout2(bh, ki, qi, *_):
         return (bh // nh, bh % nh, ki, 0)
 
-    def qmap2(bh, ki, qi):
+    def qmap2(bh, ki, qi, *_):
         return (bh // nh, bh % nh, qi, 0)
 
-    def rowmap2(bh, ki, qi):
-        return (bh // nh, bh % nh, qi, 0)
+    def mmap2(bh, ki, qi, *_):
+        return (bh // nh, 0, ki)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(B * nh, nk, nq),
         in_specs=[
             pl.BlockSpec((None, None, bq, hd), qmap2),
@@ -344,36 +501,65 @@ def _flash_bwd(causal, interpret, res, g, block_q=512, block_k=512):
             pl.BlockSpec((None, None, bk, hd), kmap2),
             pl.BlockSpec((None, None, bq, hd), qmap2),
             pl.BlockSpec((None, None, bq, hd), qmap2),
-            pl.BlockSpec((None, None, bq, 128), rowmap2),
+            pl.BlockSpec((None, None, bq, 128), qmap2),
+            pl.BlockSpec((None, 1, bk), mmap2),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, bk, hd), kmap2),
-            pl.BlockSpec((None, None, bk, hd), kmap2),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((B, nh, Sk, hd), k.dtype),
-            jax.ShapeDtypeStruct((B, nh, Sk, hd), v.dtype),
+            pl.BlockSpec((None, None, bk, hd), kout2),
+            pl.BlockSpec((None, None, bk, hd), kout2),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, hd), jnp.float32),
             pltpu.VMEM((bk, hd), jnp.float32),
         ],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, offset=Sk - Sq, rate=rate,
+                          has_mask=has_mask),
+        grid_spec=dkv_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, nh, Sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((B, nh, Sk, hd), v.dtype),
+        ],
         interpret=interpret,
-    )(qb, kb, vb, ob, gb, lse)
+    )(seed_arr, qb, kb, vb, ob, gb, lse, mask_arr)
+    if group > 1:
+        # GQA: reduce per-q-head grads over each kv head's group
+        dk = dk.reshape(B, nkv, group, Sk, hd).sum(axis=2, dtype=jnp.float32)
+        dv = dv.reshape(B, nkv, group, Sk, hd).sum(axis=2, dtype=jnp.float32)
+        dk = dk.astype(k.dtype)
+        dv = dv.astype(v.dtype)
     tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
-    return tr(dq), tr(dk), tr(dv)
+    return tr(dq), tr(dk), tr(dv), None, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, interpret=None):
-    """Flash attention; q, k, v: [B, S, nh, hd] -> [B, S, nh, hd]."""
-    out, _ = flash_attention_fwd(q, k, v, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8))
+def flash_attention(q, k, v, causal=False, interpret=None,
+                    kv_mask=None, seed=None, kv_mask_shape=None,
+                    dropout_rate=0.0):
+    """Flash attention; q [B, Sq, nh, hd], k/v [B, Sk, nkv, hd] ->
+    [B, Sq, nh, hd].  kv_mask: optional [B, Sk] 0/1 key-validity;
+    seed: optional int32 scalar for dropout.  `kv_mask_shape` mirrors
+    whether kv_mask is present (custom_vjp nondiff args must be static;
+    the Tensor-level wrapper in pallas_kernels.py fills it)."""
+    out, _ = flash_attention_fwd(q, k, v, causal, interpret,
+                                 kv_mask, dropout_rate, seed)
     return out
 
 
-def _fa_fwd(q, k, v, causal, interpret):
-    out, lse = flash_attention_fwd(q, k, v, causal, interpret)
-    return out, (q, k, v, out, lse)
+def _fa_fwd(q, k, v, causal, interpret, kv_mask, seed, kv_mask_shape,
+            dropout_rate):
+    out, lse = flash_attention_fwd(q, k, v, causal, interpret,
+                                   kv_mask, dropout_rate, seed)
+    B, Sk = k.shape[0], k.shape[1]
+    return out, (q, k, v, out, lse, _mask_arr(kv_mask, B, Sk),
+                 _seed_arr(seed))
 
 
-flash_attention.defvjp(_fa_fwd, _flash_bwd)
+def _fa_bwd(causal, interpret, kv_mask_shape, dropout_rate, res, g):
+    return _flash_bwd(causal, interpret, kv_mask_shape, dropout_rate,
+                      res, g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -8,9 +8,12 @@ dispatched op so the eager tape engine differentiates through the kernel's
 custom VJP.
 
 Gating: the kernel path is taken on a real TPU backend with supported
-shapes (seq divisible by the block, head_dim in {64, 128, 256}), no
-attention mask, and no dropout; anything else falls back to the fused XLA
-softmax(QK^T)V path, so the same model code runs on the CPU test mesh.
+shapes (seqs divisible by their blocks, head_dim in {64, 128, 256}, q
+heads a multiple of kv heads).  Key-padding masks ([B, 1, 1, Sk] bool /
+[B, Sk]) ride the kernel's kv_mask input; attention dropout runs inside
+the kernel (per-block reseeded TPU PRNG).  Anything else — additive
+biases, full [Sq, Sk] masks, probability outputs — falls back to the
+fused XLA softmax(QK^T)V path, so the same model code runs everywhere.
 """
 
 from __future__ import annotations
@@ -18,6 +21,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
 
 from .registry import dispatch as _d, register_op
 
@@ -26,7 +30,8 @@ try:
 except ImportError:  # pragma: no cover - jax build without pallas
     pallas_flash = None
 
-__all__ = ["flash_attention", "flash_attention_available"]
+__all__ = ["flash_attention", "flash_attention_available",
+           "as_kv_padding_mask"]
 
 
 @functools.cache
@@ -37,33 +42,71 @@ def _on_tpu() -> bool:
         return False
 
 
+def as_kv_padding_mask(attn_mask, B, Sk):
+    """If `attn_mask` (Tensor or array) is unambiguously a BOOLEAN
+    key-padding mask — shape [B, 1, Sk] or [B, 1, 1, Sk] (the broadcast
+    layouts models build, e.g. BERT's `unsqueeze(mask > 0, [1, 2])`) —
+    return it as a [B, Sk] array; else None (caller falls back to XLA).
+    Integer masks are NOT accepted: paddle's integer/float attn_mask is
+    ADDITIVE (0/-10000 style), the opposite semantics.  A bare 2-D mask
+    is also rejected: [B, Sk] is indistinguishable from a per-query
+    [Sq, Sk] mask when B == Sq."""
+    if attn_mask is None:
+        return None
+    v = getattr(attn_mask, "_value", attn_mask)
+    if v.dtype != jnp.bool_:
+        return None
+    shape = tuple(v.shape)
+    if shape == (B, 1, Sk) or shape == (B, 1, 1, Sk):
+        return v.reshape(B, Sk)
+    return None
+
+
 def flash_attention_available(q, k, v, mask=None) -> bool:
+    """Shape/backend applicability; `mask` here means a mask the kernel
+    CANNOT absorb (callers pass attn_mask only if as_kv_padding_mask
+    returned None for it)."""
     if pallas_flash is None or getattr(pallas_flash, "pltpu", None) is None:
         return False
     if mask is not None:
         return False
     if not _on_tpu():
         return False
-    if q.shape[1] != k.shape[1]:
-        return False  # cross/cached attention: fall back for now
-    return pallas_flash.supported(tuple(q.shape))
+    return pallas_flash.supported(tuple(q.shape), tuple(k.shape))
 
 
 if pallas_flash is not None:
-    register_op("flash_attention",
-                lambda q, k, v, *, causal: pallas_flash.flash_attention(
-                    q, k, v, causal, None),
+    def _fa_op(q, k, v, kv_mask, seed, *, causal, dropout_rate, mask_shape):
+        return pallas_flash.flash_attention(
+            q, k, v, causal, None, kv_mask, seed, mask_shape, dropout_rate)
+
+    register_op("flash_attention", _fa_op,
                 tags=("mxu", "fused", "pallas"))
 
 
-def flash_attention(q, k, v, causal=False, dropout_p=0.0):
+def flash_attention(q, k, v, causal=False, dropout_p=0.0, kv_mask=None):
     """Pallas flash-attention on [B, S, nh, hd] Tensors; differentiable
     through the kernel's custom VJP (FlashAttention-2 backward kernels).
 
-    Dropout inside the kernel is not supported — callers with dropout take
-    the XLA path (`flash_attention_available` returns False is enforced by
-    the caller passing dropout_p=0)."""
+    kv_mask: optional [B, Sk] 0/1 key-validity Tensor/array (padding);
+    dropout_p > 0 applies in-kernel attention dropout (seeded from the
+    framework RNG, so paddle.seed reproduces runs)."""
     from ..nn.functional.attention import sdpa_xla
-    if dropout_p > 0.0 or not flash_attention_available(q, k, v):
-        return sdpa_xla(q, k, v, None, dropout_p, causal, None, True)
-    return _d("flash_attention", (q, k, v), {"causal": bool(causal)})
+    if not flash_attention_available(q, k, v):
+        xla_mask = None
+        if kv_mask is not None:
+            # keep padding semantics on the fallback: [B, Sk] 0/1 ->
+            # [B, 1, 1, Sk] boolean keep-mask broadcast over heads/queries
+            mv = getattr(kv_mask, "_value", kv_mask)
+            xla_mask = (mv != 0).reshape(mv.shape[0], 1, 1, mv.shape[-1])
+        return sdpa_xla(q, k, v, xla_mask, dropout_p, causal, None, True)
+    seed = None
+    if dropout_p > 0.0:
+        from ..framework import random as _random
+        seed = jax.random.randint(_random.next_key(), (), 0,
+                                  jnp.iinfo(jnp.int32).max, jnp.int32)
+    mask_shape = None if kv_mask is None else \
+        tuple(getattr(kv_mask, "shape", ()))
+    return _d("flash_attention", (q, k, v, kv_mask, seed),
+              {"causal": bool(causal), "dropout_rate": float(dropout_p),
+               "mask_shape": mask_shape})
