@@ -65,21 +65,27 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     if cos is None or sin is None:
         if position_ids is not None:
             # decode-time offsets: rotate by the tokens' absolute positions;
-            # accepts (S,) or the reference's (B, S) per-row id matrix
-            # (eager-only: the table length needs the concrete max id)
+            # accepts (S,) or the reference's (B, S) per-row id matrix.
+            # Angles come straight from pids ⊗ inv_freq (identical to the
+            # reference's table lookup) so TRACED positions work — compiled
+            # decode loops pass the offset as a scalar program input
             pids = position_ids._value if isinstance(position_ids, Tensor) \
                 else jnp.asarray(position_ids)
-            length = int(pids.max()) + 1
-            cos_v, sin_v = _default_cos_sin(
-                length, q.shape[-1], q._value.dtype,
-                use_neox_rotary_style, rotary_emb_base)
-            table_c, table_s = cos_v[0, :, 0, :], sin_v[0, :, 0, :]  # (L, D)
+            hd = q.shape[-1]
+            inv = 1.0 / (rotary_emb_base
+                         ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+            freqs = pids.astype(jnp.float32)[..., None] * inv  # (..., D/2)
+            if use_neox_rotary_style:
+                emb = jnp.concatenate([freqs, freqs], axis=-1)
+            else:
+                emb = jnp.repeat(freqs, 2, axis=-1)
+            dtype = q._value.dtype
             if pids.ndim == 1:
-                cos_v = table_c[pids][None, :, None, :]
-                sin_v = table_s[pids][None, :, None, :]
+                cos_v = jnp.cos(emb)[None, :, None, :].astype(dtype)
+                sin_v = jnp.sin(emb)[None, :, None, :].astype(dtype)
             else:  # (B, S): per-row positions
-                cos_v = table_c[pids][:, :, None, :]
-                sin_v = table_s[pids][:, :, None, :]
+                cos_v = jnp.cos(emb)[:, :, None, :].astype(dtype)
+                sin_v = jnp.sin(emb)[:, :, None, :].astype(dtype)
         else:
             cos_v, sin_v = _default_cos_sin(
                 q.shape[1], q.shape[-1], q._value.dtype,

@@ -43,6 +43,86 @@ class GenerationMixin:
     `forward_with_cache(input_ids, caches, pos_offset) -> (logits, caches)`
     and `init_caches(batch_size) -> caches`."""
 
+    def _compiled_static_generate(self, ids, max_new_tokens, do_sample,
+                                  temperature, top_k, top_p, eos_token_id):
+        """Whole-generation XLA program for static caches: prefill + a
+        `lax.scan` over decode steps compile into ONE dispatch.
+
+        The eager host loop pays a host->device round trip per op per
+        token — through a tunneled device that is thousands of
+        dispatches; here the entire generation is one program (the
+        design the reference serves through its fused decoding ops,
+        `fused_multi_transformer_op.cu`).  Sequences that hit eos are
+        padded with eos to the full length (same contract as the eager
+        loop's docstring; no early host exit inside a compiled loop)."""
+        import jax
+        from ..framework.dygraph import no_grad
+
+        cap = getattr(getattr(self, "cfg", None), "max_seq_len", None)
+        if cap is not None and ids.shape[1] + max_new_tokens > cap:
+            # inside the compiled loop the cache length is a tracer, so the
+            # eager overflow guard can't fire — check before compiling
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len ({cap})")
+        sd = self.state_dict()
+        keys = sorted(sd.keys())
+        cache_key = (tuple(ids.shape), max_new_tokens, bool(do_sample),
+                     float(temperature), int(top_k), float(top_p),
+                     eos_token_id, str(ids.dtype))
+        store = getattr(self, "_static_gen_programs", None)
+        if store is None:
+            store = self._static_gen_programs = {}
+        fn = store.get(cache_key)
+        if fn is None:
+            def gen(param_vals, pids, rng_key):
+                for kk, vv in zip(keys, param_vals):
+                    sd[kk]._value = vv
+                B, prompt_len = pids.shape
+                with no_grad():
+                    caches = self.init_caches(B, cache_impl="static")
+                    logits_t, caches = self.forward_with_cache(
+                        Tensor._wrap(pids), caches, pos_offset=0)
+                logits0 = logits_t._value[:, -1, :]
+                finished0 = jnp.zeros((B,), bool)
+
+                def body(carry, step):
+                    logits, caches, finished = carry
+                    if do_sample:
+                        filtered = _process_logits(
+                            logits.astype(jnp.float32), temperature,
+                            top_k, top_p)
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(rng_key, step), filtered,
+                            axis=-1)
+                    else:
+                        nxt = jnp.argmax(logits, axis=-1)
+                    nxt = nxt.astype(pids.dtype)
+                    if eos_token_id is not None:
+                        nxt = jnp.where(finished, eos_token_id, nxt)
+                        finished = finished | (nxt == eos_token_id)
+                    lt, caches = self.forward_with_cache(
+                        Tensor._wrap(nxt[:, None]), caches,
+                        pos_offset=prompt_len + step)
+                    return (lt._value[:, -1, :], caches, finished), nxt
+
+                with no_grad():
+                    (_, _, _), toks = jax.lax.scan(
+                        body, (logits0, caches, finished0),
+                        jnp.arange(max_new_tokens))
+                return jnp.concatenate([pids, toks.T], axis=1)
+
+            fn = store[cache_key] = jax.jit(gen)
+        orig = {k: sd[k]._value for k in keys}
+        try:
+            import jax as _jax
+            key = _random.next_key() if do_sample else _jax.random.key(0)
+            out = fn([orig[k] for k in keys], ids, key)
+            return Tensor._wrap(out)
+        finally:
+            for k in keys:
+                sd[k]._value = orig[k]
+
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
@@ -64,6 +144,10 @@ class GenerationMixin:
             B, prompt_len = ids.shape
             import inspect
             sig = inspect.signature(self.init_caches)
+            if cache_impl == "static" and "cache_impl" in sig.parameters:
+                return self._compiled_static_generate(
+                    ids, max_new_tokens, do_sample, temperature, top_k,
+                    top_p, eos_token_id)
             if "cache_impl" in sig.parameters:
                 caches = self.init_caches(B, cache_impl=cache_impl)
             elif cache_impl != "dense":

@@ -79,9 +79,16 @@ class GPTAttention(nn.Layer):
         qkv = _m.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = _m.unbind(qkv, axis=2)
         if kv_cache is not None and not isinstance(kv_cache, tuple):
-            # non-tuple cache = BlockKVCache (dense caches are (k, v)
-            # tuples); checked structurally so the pallas import chain is
-            # only paid when paged decoding is actually used
+            from .kv_cache import StaticKVCache
+            if isinstance(kv_cache, StaticKVCache):
+                new_cache, out = kv_cache.update_and_attend(
+                    q._value, k._value, v._value)
+                out_t = Tensor._wrap(out.reshape(
+                    b, s, self.num_heads * self.head_dim))
+                return self.proj(out_t), new_cache
+            # non-tuple, non-static cache = BlockKVCache (dense caches are
+            # (k, v) tuples); checked structurally so the pallas import
+            # chain is only paid when paged decoding is actually used
             return self._paged_forward(q, k, v, kv_cache, b, s)
         if kv_cache is not None:
             pk, pv = kv_cache
@@ -205,7 +212,9 @@ class GPTModel(nn.Layer):
 
     def forward(self, input_ids, kv_caches=None, pos_offset=0):
         b, s = input_ids.shape[0], input_ids.shape[1]
-        pos = creation.arange(pos_offset, pos_offset + s, dtype="int32")
+        # arange(s) + offset keeps the program valid for a TRACED offset
+        # (compiled decode loops pass the position as a scalar input)
+        pos = creation.arange(s, dtype="int32") + pos_offset
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         if kv_caches is not None:
@@ -260,6 +269,11 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
                 head_dim=hd, batch=batch_size,
                 max_blocks_per_seq=max_blocks, dtype=dtype)
                 for _ in range(cfg.num_layers)]
+        if cache_impl == "static":
+            from .kv_cache import StaticKVCache
+            return [StaticKVCache(batch_size, cfg.max_seq_len,
+                                  cfg.num_heads, hd, dtype)
+                    for _ in range(cfg.num_layers)]
         empty = lambda: _T._wrap(jnp.zeros(
             (batch_size, 0, cfg.num_heads, hd), dtype))
         return [(empty(), empty()) for _ in range(cfg.num_layers)]

@@ -1,0 +1,110 @@
+"""Static (preallocated) KV cache for autoregressive decoding.
+
+Parity target: the reference's serving decode path keeps fixed-capacity
+KV buffers and writes each new token in place
+(`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu` and
+`masked_multihead_attention_kernel.cu` — the write-then-attend decode
+step against a preallocated cache).
+
+TPU-native redesign: the eager dense cache concatenates and grows
+([B, t, nh, hd] -> [B, t+1, nh, hd]), so every decode position is a NEW
+shape and XLA compiles a fresh program per token — fine on GPUs with
+cheap JIT-less kernels, pathological under XLA.  A StaticKVCache holds
+[B, max_len, nh, hd] buffers and a traced int32 write position: every
+step runs the SAME compiled program (`jax.lax.dynamic_update_slice` +
+masked attention over the full buffer), so a whole generation costs one
+compile.  The over-length attention work is masked dead weight but tiny
+at decode batch sizes; the paged Pallas kernel (`ops/pallas_paged.py`)
+is the bandwidth-optimal variant of the same idea.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["StaticKVCache"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _update_and_attend(cache_k, cache_v, length, q, k, v):
+    """Write (k, v) at `length` and attend q against the valid prefix.
+
+    cache_k/v: [B, L, nh, hd]; q/k/v: [B, s, nh, hd]; length: int32 [].
+    Returns (new_k, new_v, out[B, s, nh, hd]).  One program for every
+    decode step: shapes are static, the position is a traced scalar.
+    """
+    cache_k = jax.lax.dynamic_update_slice(
+        cache_k, k.astype(cache_k.dtype), (0, length, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(
+        cache_v, v.astype(cache_v.dtype), (0, length, 0, 0))
+    s, hd = q.shape[1], q.shape[3]
+    qpos = length + jnp.arange(s)[:, None]            # [s, 1] absolute
+    kpos = jnp.arange(cache_k.shape[1])[None, :]      # [1, L]
+    mask = kpos <= qpos                               # causal + valid-prefix
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, cache_k) / math.sqrt(hd)
+    logits = jnp.where(mask[None, None],
+                       logits.astype(jnp.float32), -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, cache_v)
+    return cache_k, cache_v, out
+
+
+class StaticKVCache:
+    """Fixed-capacity per-layer KV cache; functional update (returns a
+    new cache object, buffers donated to XLA so the update is in-place
+    on device).  Registered as a jax pytree so whole decode loops —
+    `lax.scan` with the cache as carry — compile into ONE program."""
+
+    def __init__(self, batch: int, max_len: int, num_heads: int,
+                 head_dim: int, dtype=jnp.float32):
+        self.k = jnp.zeros((batch, max_len, num_heads, head_dim), dtype)
+        self.v = jnp.zeros_like(self.k)
+        self.length = jnp.zeros((), jnp.int32)
+
+    def update_and_attend(self, q, k, v):
+        """q/k/v: jnp [B, s, nh, hd] (new tokens, post-RoPE).  Returns
+        (new_cache, out[B, s, nh, hd])."""
+        s = q.shape[1]
+        if s > self.k.shape[1]:
+            raise ValueError(f"prefill of {s} tokens exceeds cache "
+                             f"capacity {self.k.shape[1]}")
+        if not isinstance(self.k, jax.core.Tracer):
+            # eager path: length is concrete — writing past capacity would
+            # silently clamp (dynamic_update_slice semantics) and corrupt
+            # the last slots, so raise instead
+            if not isinstance(self.length, jax.core.Tracer) and \
+                    int(self.length) + s > self.k.shape[1]:
+                raise ValueError(
+                    f"decode past cache capacity: length {int(self.length)}"
+                    f" + {s} new > {self.k.shape[1]}")
+            new = StaticKVCache.__new__(StaticKVCache)
+            new.k, new.v, out = _update_and_attend(
+                self.k, self.v, self.length, q, k, v)
+            new.length = self.length + jnp.int32(s)
+            return new, out
+        # traced (inside an outer jit, e.g. a served decode graph): inline
+        new = StaticKVCache.__new__(StaticKVCache)
+        new.k, new.v, out = _update_and_attend.__wrapped__(
+            self.k, self.v, self.length, q, k, v)
+        new.length = self.length + jnp.int32(s)
+        return new, out
+
+
+def _cache_flatten(c):
+    return (c.k, c.v, c.length), None
+
+
+def _cache_unflatten(_, children):
+    c = StaticKVCache.__new__(StaticKVCache)
+    c.k, c.v, c.length = children
+    return c
+
+
+# pytree registration lets whole decode loops carry the cache through
+# lax.scan / jit boundaries (one compiled program per generation)
+jax.tree_util.register_pytree_node(
+    StaticKVCache, _cache_flatten, _cache_unflatten)

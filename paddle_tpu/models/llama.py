@@ -76,17 +76,29 @@ class LlamaAttention(nn.Layer):
         else:
             offset = kv_cache[0].shape[1] if kv_cache is not None else 0
         import numpy as _np
-        pos = _np.arange(offset, offset + s) if offset else None
+        if isinstance(offset, int):
+            pos = _np.arange(offset, offset + s) if offset else None
+        else:  # traced offset (compiled decode loop): keep shapes static
+            import jax.numpy as _jnp
+            pos = _jnp.arange(s) + offset
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=pos, use_neox_rotary_style=True,
             rotary_emb_base=cfg.rope_base)
         if kv_cache is not None and not isinstance(kv_cache, tuple):
-            # paged block cache (non-tuple): kernel attends one q head per
+            # paged/static cache (non-tuple): both attend one q head per
             # cached kv head, so GQA caches the repeated heads
             if cfg.num_kv_heads != cfg.num_heads:
                 rep = cfg.num_heads // cfg.num_kv_heads
                 k = _m.repeat_interleave(k, rep, axis=2)
                 v = _m.repeat_interleave(v, rep, axis=2)
+            from .kv_cache import StaticKVCache
+            if isinstance(kv_cache, StaticKVCache):
+                from ..framework.tensor import Tensor as _T
+                new_cache, out = kv_cache.update_and_attend(
+                    q._value, k._value, v._value)
+                out_t = _T._wrap(out.reshape(
+                    b, s, cfg.num_heads * self.head_dim))
+                return self.o_proj(out_t), new_cache
             return self._paged_forward(q, k, v, kv_cache, b, s)
         new_cache = None
         if kv_cache is not None:
@@ -94,10 +106,9 @@ class LlamaAttention(nn.Layer):
             k = _m.concat([pk, k], axis=1)
             v = _m.concat([pv, v], axis=1)
             new_cache = (k, v)
-        if cfg.num_kv_heads != cfg.num_heads:  # GQA: repeat kv heads
-            rep = cfg.num_heads // cfg.num_kv_heads
-            k = _m.repeat_interleave(k, rep, axis=2)
-            v = _m.repeat_interleave(v, rep, axis=2)
+        # GQA (num_kv_heads < num_heads) is resolved inside the attention
+        # functional: the Pallas kernel maps head groups via index maps
+        # (repeated K/V never reach HBM), the XLA fallback repeats there
         k_len = k.shape[1]
         if k_len == s:
             mask, causal = None, True
@@ -249,6 +260,13 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
                 head_dim=hd, batch=batch_size,
                 max_blocks_per_seq=max_blocks, dtype=dtype)
                 for _ in range(cfg.num_layers)]
+        if cache_impl == "static":
+            # like the paged cache, static caches hold the GQA-repeated
+            # heads (attention there is one q head per cached kv head)
+            from .kv_cache import StaticKVCache
+            return [StaticKVCache(batch_size, cfg.max_seq_len,
+                                  cfg.num_heads, hd, dtype)
+                    for _ in range(cfg.num_layers)]
         empty = lambda: _T._wrap(jnp.zeros(
             (batch_size, 0, cfg.num_kv_heads, hd), dtype))
         return [(empty(), empty()) for _ in range(cfg.num_layers)]

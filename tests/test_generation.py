@@ -43,6 +43,35 @@ def test_cached_greedy_matches_full_forward(build):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("build", [
+    lambda: GPTForCausalLM(gpt3_tiny()),
+    lambda: LlamaForCausalLM(tiny_llama()),
+], ids=["gpt", "llama"])
+def test_static_cache_matches_dense(build):
+    """StaticKVCache (preallocated, one compiled program per step shape)
+    must pick exactly the tokens the concat-and-grow dense cache picks."""
+    paddle.seed(0)
+    model = build()
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(3).randint(0, 100, (2, 7)).astype(np.int32))
+    dense = np.asarray(
+        model.generate(ids, max_new_tokens=6, cache_impl="dense")._value)
+    static = np.asarray(
+        model.generate(ids, max_new_tokens=6, cache_impl="static")._value)
+    np.testing.assert_array_equal(static, dense)
+
+
+def test_static_cache_overflow_raises():
+    from paddle_tpu.models.kv_cache import StaticKVCache
+    import jax.numpy as jnp
+    cache = StaticKVCache(1, 4, 2, 8)
+    with pytest.raises(ValueError, match="capacity"):
+        cache.update_and_attend(jnp.zeros((1, 5, 2, 8)),
+                                jnp.zeros((1, 5, 2, 8)),
+                                jnp.zeros((1, 5, 2, 8)))
+
+
 def test_generate_sampling_and_eos():
     paddle.seed(1)
     model = GPTForCausalLM(gpt3_tiny())
