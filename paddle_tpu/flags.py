@@ -142,3 +142,6 @@ define_flag("comm_watchdog_timeout_s", 300.0,
             "seconds before a host comm task is reported as hung")
 define_flag("comm_static_check", False,
             "verify shape/dtype across ranks before collectives")
+define_flag("tpu_fast_rng", True,
+            "use the fast 'rbg' PRNG for framework keys on TPU (an order "
+            "of magnitude cheaper dropout masks); 0 = threefry everywhere")
