@@ -68,9 +68,23 @@ class HybridConfig:
     pp: int = 2
     mp: int = 2
     dp: int = 2
+    vpp: int = 1  # virtual pipeline chunks per pp rank (interleaved sched)
     n_microbatches: int = 2
     sequence_parallel: bool = True
     remat: bool = True
+    # MoE / expert parallelism: with moe_num_experts > 0 every block's MLP
+    # becomes a top-1 (switch) mixture of experts; experts are sharded over
+    # the dp axis and tokens move by a sort-based all_to_all (the TPU-native
+    # global_scatter/global_gather, ref moe_utils.py / moe_layer.py:263).
+    # moe_capacity = per-destination-rank token capacity (0 = no dropping:
+    # capacity equals the local token count, what the parity tests use).
+    moe_num_experts: int = 0
+    moe_capacity: int = 0
+    # ZeRO stage over dp: 1 = all-reduce grads then update a 1/dp slice;
+    # 2 = reduce-scatter grads (each rank only ever holds its own grad
+    # shard — the SPMD form of sharded gradients,
+    # ref group_sharded_stage2.py) — strictly less HBM and comm.
+    zero_stage: int = 1
     # optimizer
     learning_rate: float = 1e-3
     beta1: float = 0.9
@@ -81,16 +95,27 @@ class HybridConfig:
     def __post_init__(self):
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
-        assert self.num_layers % self.pp == 0
+        assert self.num_layers % (self.pp * self.vpp) == 0
         assert self.num_heads % self.mp == 0
         assert self.hidden_size % self.num_heads == 0
         assert self.vocab_size % self.mp == 0
         if self.sequence_parallel:
             assert self.seq_len % self.mp == 0
+        if self.vpp > 1:
+            # the interleaved schedule processes microbatches in blocks of
+            # pp (same constraint as Megatron's num_microbatches % pp == 0)
+            assert self.n_microbatches % self.pp == 0
+        if self.moe_num_experts > 0:
+            assert self.moe_num_experts % self.dp == 0, \
+                "experts shard over the dp axis"
+            assert self.mp == 1 or self.sequence_parallel, \
+                "MoE with mp>1 needs sequence_parallel (each mp rank " \
+                "must route a disjoint token shard)"
 
     @property
     def layers_per_stage(self):
-        return self.num_layers // self.pp
+        """Layers per model CHUNK (a pp rank owns vpp chunks)."""
+        return self.num_layers // (self.pp * self.vpp)
 
     @property
     def head_dim(self):
@@ -118,10 +143,20 @@ def init_gpt_params(key, cfg: HybridConfig) -> Dict[str, Any]:
         "wproj": nrm(ks[1], (L, H, H), std / math.sqrt(2 * L)),
         "bproj": jnp.zeros((L, H), dt),
         "ln2_g": jnp.ones((L, H), dt), "ln2_b": jnp.zeros((L, H), dt),
-        "wfc1": nrm(ks[2], (L, H, I)), "bfc1": jnp.zeros((L, I), dt),
-        "wfc2": nrm(ks[3], (L, I, H), std / math.sqrt(2 * L)),
-        "bfc2": jnp.zeros((L, H), dt),
     }
+    if cfg.moe_num_experts > 0:
+        E = cfg.moe_num_experts
+        blocks.update({
+            "wgate": nrm(ks[7], (L, H, E)),
+            "wexp1": nrm(ks[2], (L, E, H, I)),
+            "wexp2": nrm(ks[3], (L, E, I, H), std / math.sqrt(2 * L)),
+        })
+    else:
+        blocks.update({
+            "wfc1": nrm(ks[2], (L, H, I)), "bfc1": jnp.zeros((L, I), dt),
+            "wfc2": nrm(ks[3], (L, I, H), std / math.sqrt(2 * L)),
+            "bfc2": jnp.zeros((L, H), dt),
+        })
     return {
         "blocks": blocks,
         "wte": nrm(ks[4], (V, H)),
@@ -132,11 +167,23 @@ def init_gpt_params(key, cfg: HybridConfig) -> Dict[str, Any]:
 
 
 def stack_for_pipeline(params: Dict[str, Any], cfg: HybridConfig):
-    """Reshape block leaves [L, ...] -> [pp, L/pp, ...] (leading pp dim)."""
+    """Reshape block leaves [L, ...] -> [pp, vpp, L/(pp*vpp), ...].
+
+    Global chunk g (layers [g*Lc, (g+1)*Lc)) lives on pp rank g % pp at
+    chunk slot g // pp — the Megatron interleaved assignment
+    (`pipeline_parallel.py:986`); with vpp=1 this is plain contiguous
+    stage stacking."""
     out = dict(params)
-    out["blocks"] = {
-        k: v.reshape((cfg.pp, cfg.layers_per_stage) + v.shape[1:])
-        for k, v in params["blocks"].items()}
+
+    def restack(v):
+        lc = cfg.layers_per_stage
+        # [L, ...] -> [vpp*pp, Lc, ...] (global chunk major) ->
+        # [vpp, pp, Lc, ...] -> [pp, vpp, Lc, ...]
+        w = v.reshape((cfg.vpp * cfg.pp, lc) + v.shape[1:])
+        w = w.reshape((cfg.vpp, cfg.pp, lc) + v.shape[1:])
+        return jnp.swapaxes(w, 0, 1)
+
+    out["blocks"] = {k: restack(v) for k, v in params["blocks"].items()}
     return out
 
 
@@ -145,16 +192,31 @@ def hybrid_param_specs(cfg: HybridConfig) -> Dict[str, Any]:
 
     TP layout mirrors the reference mp_layers: qkv/fc1 column-parallel
     (out-dim on mp), proj/fc2 row-parallel (in-dim on mp), embedding
-    vocab-parallel, LM head column-parallel over vocab."""
+    vocab-parallel, LM head column-parallel over vocab.  Block leaves are
+    [pp, vpp, Lc, ...]: pp sharded, vpp/Lc replicated locally."""
+    blocks = {
+        "ln1_g": P("pp"), "ln1_b": P("pp"),
+        "wqkv": P("pp", None, None, None, "mp"),
+        "bqkv": P("pp", None, None, "mp"),
+        "wproj": P("pp", None, None, "mp", None), "bproj": P("pp"),
+        "ln2_g": P("pp"), "ln2_b": P("pp"),
+    }
+    if cfg.moe_num_experts > 0:
+        # expert parallelism: the expert dim shards over dp (the reference's
+        # EP-in-DP layout); gate replicated, tokens move via all_to_all
+        blocks.update({
+            "wgate": P("pp"),
+            "wexp1": P("pp", None, None, "dp", None, None),
+            "wexp2": P("pp", None, None, "dp", None, None),
+        })
+    else:
+        blocks.update({
+            "wfc1": P("pp", None, None, None, "mp"),
+            "bfc1": P("pp", None, None, "mp"),
+            "wfc2": P("pp", None, None, "mp", None), "bfc2": P("pp"),
+        })
     return {
-        "blocks": {
-            "ln1_g": P("pp"), "ln1_b": P("pp"),
-            "wqkv": P("pp", None, None, "mp"), "bqkv": P("pp", None, "mp"),
-            "wproj": P("pp", None, "mp", None), "bproj": P("pp"),
-            "ln2_g": P("pp"), "ln2_b": P("pp"),
-            "wfc1": P("pp", None, None, "mp"), "bfc1": P("pp", None, "mp"),
-            "wfc2": P("pp", None, "mp", None), "bfc2": P("pp"),
-        },
+        "blocks": blocks,
         "wte": P("mp", None),
         "wpe": P(),
         "lnf_g": P(), "lnf_b": P(),
@@ -174,13 +236,20 @@ def _flatten_with_specs(tree, specs):
     return leaves, spec_leaves, treedef
 
 
+def _opt_spec(s: P) -> P:
+    """Opt-state spec for a param spec: ZeRO shards the flattened state
+    over dp — unless the param itself is dp-sharded (expert-parallel
+    leaves), where the state follows the param layout positionally."""
+    axes = _spec_axes(s)
+    return s if "dp" in axes else P(*axes, "dp")
+
+
 def zero_state_specs(specs: Dict[str, Any]):
-    """Opt-state PartitionSpec tree (P(*param_axes, 'dp') per leaf) without
-    materializing any state arrays."""
+    """Opt-state PartitionSpec tree without materializing any state."""
     leaves, treedef = jax.tree_util.tree_flatten(
         specs, is_leaf=lambda x: isinstance(x, P))
     return jax.tree_util.tree_unflatten(
-        treedef, [P(*_spec_axes(s), "dp") for s in leaves])
+        treedef, [_opt_spec(s) for s in leaves])
 
 
 def init_zero_state(stacked: Dict[str, Any], specs: Dict[str, Any],
@@ -198,6 +267,9 @@ def init_zero_state(stacked: Dict[str, Any], specs: Dict[str, Any],
 
     def leaf_state(p, spec):
         axes = _spec_axes(spec)
+        if "dp" in axes:
+            # expert-parallel leaf: state follows the param layout exactly
+            return jnp.zeros(p.shape, p.dtype)
         local_shape = list(p.shape)
         for i, a in enumerate(spec):
             if a is not None:
@@ -208,7 +280,7 @@ def init_zero_state(stacked: Dict[str, Any], specs: Dict[str, Any],
         return jnp.zeros(gshape, p.dtype)
 
     m = [leaf_state(p, s) for p, s in zip(leaves, spec_leaves)]
-    opt_spec_leaves = [P(*_spec_axes(s), "dp") for s in spec_leaves]
+    opt_spec_leaves = [_opt_spec(s) for s in spec_leaves]
     un = jax.tree_util.tree_unflatten
     return (un(treedef, m), un(treedef, [jnp.copy(x) for x in m]),
             un(treedef, opt_spec_leaves))
@@ -236,14 +308,97 @@ def _attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False):
+def _gate_top1(h2, wg):
+    """Switch (top-1) router.  h2 [T, H], wg [H, E] -> (expert [T] int32,
+    prob [T]); grads flow through the chosen expert's softmax prob."""
+    logits = (h2 @ wg).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    a = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    p = jnp.take_along_axis(probs, a[:, None], axis=1)[:, 0]
+    return a, p.astype(h2.dtype)
+
+
+def _moe_ffn_serial(blocks, x, lidx, cfg):
+    """Reference-math switch FFN: every token to its argmax expert, no
+    capacity dropping, output scaled by the gate prob."""
+    B, S, H = x.shape
+    h2 = x.reshape(B * S, H)
+    a, p = _gate_top1(h2, blocks["wgate"][lidx])
+    y = jnp.zeros_like(h2)
+    for e in range(cfg.moe_num_experts):
+        ye = jax.nn.gelu(h2 @ blocks["wexp1"][lidx, e], approximate=True)
+        ye = ye @ blocks["wexp2"][lidx, e]
+        y = y + jnp.where((a == e)[:, None], ye, 0.0)
+    return (y * p[:, None]).reshape(B, S, H)
+
+
+def _moe_ffn_dist(blocks, x, lidx, cfg, dp_axis="dp"):
+    """Expert-parallel switch FFN inside shard_map: the TPU-native
+    global_scatter/global_gather (ref
+    `python/paddle/distributed/utils/moe_utils.py`,
+    `moe/moe_layer.py:99,:152` MoEScatter/MoEGather).
+
+    Tokens are sorted by destination rank, packed into fixed [DP, C, H]
+    lanes (C = per-destination capacity; static shapes are the XLA
+    constraint the reference's ragged NCCL alltoall doesn't have), moved
+    with `lax.all_to_all`, run through the local expert shard, moved back
+    and unsorted.  Dropped tokens (beyond C) contribute zero — their
+    residual path passes through.  The sort/scatter indices are integer
+    (non-differentiable); gradients ride the gathered values and the gate
+    prob, and the all_to_all transposes to the reverse all_to_all."""
+    DP = jax.lax.axis_size(dp_axis)
+    E = cfg.moe_num_experts
+    El = E // DP
+    B, S, H = x.shape
+    T = B * S
+    C = cfg.moe_capacity if cfg.moe_capacity > 0 else T
+    h2 = x.reshape(T, H)
+    a, p = _gate_top1(h2, blocks["wgate"][lidx])
+    dest = a // El                              # destination dp rank [T]
+    order = jnp.argsort(dest, stable=True)
+    d_s = dest[order]
+    # position of each sorted token within its destination lane
+    onehot = jax.nn.one_hot(d_s, DP, dtype=jnp.int32)
+    pos_s = jnp.take_along_axis(jnp.cumsum(onehot, axis=0), d_s[:, None],
+                                axis=1)[:, 0] - 1
+    keep = pos_s < C
+    # pack tokens + local expert ids ('drop' mode discards over-capacity)
+    send_x = jnp.zeros((DP, C, H), x.dtype).at[d_s, pos_s].set(
+        jnp.where(keep[:, None], h2[order], 0.0), mode="drop")
+    loc_e = a[order] - d_s * El
+    send_e = jnp.full((DP, C), El, jnp.int32).at[d_s, pos_s].set(
+        jnp.where(keep, loc_e, El), mode="drop")   # El = invalid marker
+    recv_x = jax.lax.all_to_all(send_x, dp_axis, 0, 0)   # [DP, C, H]
+    recv_e = jax.lax.all_to_all(send_e, dp_axis, 0, 0)
+    rx = recv_x.reshape(DP * C, H)
+    re = recv_e.reshape(DP * C)
+    y = jnp.zeros_like(rx)
+    # static loop over the few local experts; masked compute (a sorted
+    # segment matmul would avoid the (El-1)x waste — El is small here)
+    for e in range(El):
+        ye = jax.nn.gelu(rx @ blocks["wexp1"][lidx, e],
+                         approximate=True) @ blocks["wexp2"][lidx, e]
+        y = y + jnp.where((re == e)[:, None], ye, 0.0)
+    back = jax.lax.all_to_all(y.reshape(DP, C, H), dp_axis, 0, 0)
+    y_sorted = back[d_s, pos_s] * keep[:, None]
+    y_tok = jnp.zeros((T, H), x.dtype).at[order].set(y_sorted)
+    return (y_tok * p[:, None]).reshape(B, S, H)
+
+
+def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False,
+           cfg=None, dp_axis=None):
     """One pre-LN transformer block.  Serial when mp_axis is None.
 
     With seq_parallel, x enters/leaves sequence-sharded [B, S/mp, H]; the
     TP regions (QKV..proj, FC1..FC2) see the full sequence via all-gather
     in / reduce-scatter out (the AllGatherOp/ReduceScatterOp pair of
     `sequence_parallel_utils.py:85-137`, as plain XLA collectives whose
-    transposes give the backward)."""
+    transposes give the backward).
+
+    With cfg.moe_num_experts > 0 the MLP is a switch MoE; in the
+    distributed path (dp_axis set) it runs on the LOCAL tokens (the
+    seq-sharded activations — no mp collectives), expert-parallel over
+    dp via all_to_all."""
     take = lambda leaf: p[leaf][lidx]
 
     def enter_tp(h):  # [B, s, H] -> [B, S, H]
@@ -272,6 +427,13 @@ def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False):
     a = leave_tp(a @ take("wproj"))
     x = x + a + take("bproj")
     h = _layer_norm(x, take("ln2_g"), take("ln2_b"))
+    if cfg is not None and cfg.moe_num_experts > 0:
+        # MoE replaces the dense MLP; runs on the local (possibly
+        # seq-sharded) tokens — token parallelism over mp, expert
+        # parallelism over dp
+        if dp_axis is not None:
+            return x + _moe_ffn_dist(p, h, lidx, cfg, dp_axis)
+        return x + _moe_ffn_serial(p, h, lidx, cfg)
     h = enter_tp(h)
     f = jax.nn.gelu(h @ take("wfc1") + take("bfc1"), approximate=True)
     f = leave_tp(f @ take("wfc2"))
@@ -316,7 +478,7 @@ def serial_forward(params, ids, cfg: HybridConfig):
     S = ids.shape[1]
     x = params["wte"][ids] + params["wpe"][:S]
     for l in range(cfg.num_layers):
-        x = _block(params["blocks"], x, l, cfg.num_heads)
+        x = _block(params["blocks"], x, l, cfg.num_heads, cfg=cfg)
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
     logits = x @ params["head"]
     labels = jnp.roll(ids, -1, axis=1)
@@ -368,7 +530,7 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     XLA's latency-hiding scheduler overlaps the ppermutes and TP collectives
     with compute."""
     specs = hybrid_param_specs(cfg)
-    PP, MP, DP = cfg.pp, cfg.mp, cfg.dp
+    PP, MP, DP, VPP = cfg.pp, cfg.mp, cfg.dp, cfg.vpp
     M = cfg.n_microbatches
     nh_local = cfg.num_heads // MP
     Vloc = cfg.vocab_size // MP
@@ -384,7 +546,8 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
         pp_i = jax.lax.axis_index("pp")
         mp_i = jax.lax.axis_index("mp")
         dp_i = jax.lax.axis_index("dp")
-        # drop the unit leading pp dim of the local stage-param shards
+        # drop the unit leading pp dim of the local stage-param shards;
+        # block leaves keep their [vpp, Lc, ...] chunk stack
         local = dict(params)
         local["blocks"] = {k: leaf[0]
                            for k, leaf in params["blocks"].items()}
@@ -406,10 +569,10 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
                 pos = ps["wpe"][:ids.shape[1]]
             return e + pos
 
-        def stage(ps, h):
+        def stage(chunk, h):
             for l in range(cfg.layers_per_stage):
-                h = _block(ps["blocks"], h, l, nh_local, mp_axis="mp",
-                           seq_parallel=sp)
+                h = _block(chunk, h, l, nh_local, mp_axis="mp",
+                           seq_parallel=sp, cfg=cfg, dp_axis="dp")
             return h
 
         stage_fn = jax.checkpoint(stage) if cfg.remat else stage
@@ -425,21 +588,46 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
         labels_all = jnp.roll(ids_local, -1, axis=2)     # [M, b, S]
 
         def loss_fn(ps):
+            """Interleaved (VPP) pipeline, vpp=1 = plain GPipe schedule.
+
+            Per tick each rank computes ONE chunk.  Rank p at tick t works
+            logical step u = t - p; u decomposes (blocks of PP microbatches
+            sweeping chunk slots depth-first, `pipeline_parallel.py:986`)
+            as b = u // (PP*VPP), j = (u % (PP*VPP)) // PP (chunk slot),
+            m = b*PP + u % PP (microbatch).  The ring ppermute delivers
+            rank PP-1's slot-j output to rank 0 exactly when rank 0 starts
+            slot j+1 of that microbatch — no extra hop for the wrap.
+
+            embed / stage / head run under `lax.cond`, so warm-up/drain
+            bubble ticks and non-owner ranks SKIP the compute instead of
+            masking it (all ranks of a pp row share the predicate, so the
+            mp collectives inside each branch stay consistent)."""
             B, S = ids_local.shape[1], ids_local.shape[2]
             s = S // MP if sp else S
             carry = jnp.zeros((B, s, cfg.hidden_size), cfg.dtype)
             loss_acc = jnp.zeros((), jnp.float32)
             perm = [(i, (i + 1) % PP) for i in range(PP)]
-            for t in range(M + PP - 1):
-                feed = jnp.clip(t, 0, M - 1)
-                h_in = jnp.where(pp_i == 0, embed(ps, ids_local[feed]),
-                                 carry)
-                h_out = stage_fn(ps, h_in)
-                mb = t - (PP - 1)
-                lab = labels_all[jnp.clip(mb, 0, M - 1)]
-                l = head_loss(ps, h_out, lab)
-                valid = (pp_i == PP - 1) & (mb >= 0) & (mb < M)
-                loss_acc = loss_acc + jnp.where(valid, l, 0.0)
+            period = PP * VPP
+            for t in range(M * VPP + PP - 1):
+                u = t - pp_i                       # traced (per pp row)
+                active = (u >= 0) & (u < M * VPP)
+                uc = jnp.clip(u, 0, M * VPP - 1)
+                jslot = (uc % period) // PP        # chunk slot on this rank
+                m = (uc // period) * PP + uc % PP  # microbatch index
+                ids_mb = jnp.take(ids_local, m, axis=0)
+                h_in = jax.lax.cond(
+                    active & (pp_i == 0) & (jslot == 0),
+                    lambda: embed(ps, ids_mb), lambda: carry)
+                chunk = jax.tree_util.tree_map(
+                    lambda leaf: jnp.take(leaf, jslot, axis=0), ps["blocks"])
+                h_out = jax.lax.cond(
+                    active, lambda: stage_fn(chunk, h_in), lambda: h_in)
+                lab = jnp.take(labels_all, m, axis=0)
+                l = jax.lax.cond(
+                    active & (pp_i == PP - 1) & (jslot == VPP - 1),
+                    lambda: head_loss(ps, h_out, lab),
+                    lambda: jnp.zeros((), jnp.float32))
+                loss_acc = loss_acc + l
                 carry = jax.lax.ppermute(h_out, "pp", perm)
             total = jax.lax.psum(loss_acc / M, "pp")
             return jax.lax.pmean(total, "dp")
@@ -459,20 +647,39 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
         new_p, new_m, new_v = [], [], []
         for p, g, mm, vv, spec in zip(p_leaves, g_leaves, m_leaves,
                                       v_leaves, spec_leaves):
+            axes = _spec_axes(spec)
             # gradients: sum the per-rank contributions over every mesh
             # axis the leaf is NOT sharded on (GSPMD's replica all-reduce,
-            # done explicitly)
-            for ax in ("pp", "dp", "mp"):
-                if ax not in _spec_axes(spec):
+            # done explicitly).  dp is handled below: ZeRO-2 reduce-
+            # scatters it instead of all-reducing.
+            for ax in ("pp", "mp"):
+                if ax not in axes:
                     g = jax.lax.psum(g, ax)
-            # ZeRO-1 Adam: update only this dp rank's 1/dp slice, then
-            # all-gather the updated parameter
+            if "dp" in axes:
+                # expert-parallel leaf: each dp rank owns its expert shard
+                # outright — plain local Adam, no ZeRO slicing/gather
+                p2, m2, v2 = _adam_math(p.reshape(-1), g.reshape(-1),
+                                        mm.reshape(-1), vv.reshape(-1),
+                                        step_no, cfg)
+                new_p.append(p2.reshape(p.shape))
+                new_m.append(m2.reshape(mm.shape))
+                new_v.append(v2.reshape(vv.shape))
+                continue
+            # ZeRO Adam: update only this dp rank's 1/dp slice, then
+            # all-gather the updated parameter.  Stage 1 all-reduces the
+            # grad and slices; stage 2 reduce-scatters — the full gradient
+            # never materializes on any rank
             shp, F = p.shape, p.size
             k = mm.size                                   # Fp/dp (local)
             flat_p = jnp.pad(p.reshape(-1), (0, DP * k - F))
             flat_g = jnp.pad(g.reshape(-1), (0, DP * k - F))
             psh = jax.lax.dynamic_slice(flat_p, (dp_i * k,), (k,))
-            gsh = jax.lax.dynamic_slice(flat_g, (dp_i * k,), (k,))
+            if cfg.zero_stage >= 2:
+                gsh = jax.lax.psum_scatter(flat_g, "dp",
+                                           scatter_dimension=0, tiled=True)
+            else:
+                flat_g = jax.lax.psum(flat_g, "dp")
+                gsh = jax.lax.dynamic_slice(flat_g, (dp_i * k,), (k,))
             p2sh, m2, v2 = _adam_math(psh, gsh, mm.reshape(-1),
                                       vv.reshape(-1), step_no, cfg)
             p2 = jax.lax.all_gather(p2sh, "dp", tiled=True)

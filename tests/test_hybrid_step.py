@@ -65,3 +65,28 @@ def test_hybrid_pp4_deep_pipeline():
 
 def test_hybrid_mp_only():
     _run_parity(HybridConfig(pp=1, dp=1, mp=4, n_microbatches=2), 4)
+
+
+def test_hybrid_interleaved_vpp():
+    """Megatron interleaved schedule: pp=4 ranks x vpp=2 chunks, with the
+    chunk assignment of pipeline_parallel.py:986."""
+    _run_parity(HybridConfig(num_layers=8, pp=4, dp=2, mp=1, vpp=2,
+                             sequence_parallel=False, n_microbatches=4), 8)
+
+
+def test_hybrid_zero2_reduce_scatter():
+    """ZeRO-2: gradients reduce-scattered over dp (never materialized
+    whole) — loss parity must be identical to stage 1."""
+    _run_parity(HybridConfig(zero_stage=2), 8)
+
+
+def test_hybrid_moe_expert_parallel():
+    """Switch-MoE MLP with experts sharded over dp and tokens moved by the
+    sort-based all_to_all dispatch (global_scatter/gather equivalent),
+    composed with pp x mp x SP + ZeRO-2."""
+    _run_parity(HybridConfig(moe_num_experts=4, zero_stage=2), 8)
+
+
+def test_hybrid_moe_with_vpp():
+    _run_parity(HybridConfig(num_layers=8, pp=2, dp=2, mp=2, vpp=2,
+                             moe_num_experts=4, n_microbatches=2), 8)
