@@ -87,8 +87,17 @@ class _RpcAgent:
                 payload = {"ok": False, "error": e}
             try:
                 self.store.set(reply_key, pickle.dumps(payload))
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # unpicklable return value / exception: degrade the payload
+                # so the caller's Future fails fast with a message instead
+                # of hanging to its timeout
+                try:
+                    fallback = {"ok": False, "error": RuntimeError(
+                        f"rpc reply could not be serialized: {e!r}; "
+                        f"original payload repr: {payload!r:.500}")}
+                    self.store.set(reply_key, pickle.dumps(fallback))
+                except Exception:  # noqa: BLE001 - store itself is down
+                    pass
 
     # -------------------------------------------------------------- client
     def invoke(self, to: str, fn, args, kwargs,

@@ -230,11 +230,14 @@ class Heartbeat:
                 self.beat()
                 failures = 0
             except Exception:
-                # one transient store error must not silently kill a live
-                # rank's heartbeat (later hang reports would name THIS rank
-                # dead); give up only after sustained failure = store gone
+                # a store outage must not permanently kill a live rank's
+                # heartbeat (later hang reports would name THIS rank dead):
+                # back off — capped at 8x the interval — and keep retrying
+                # for as long as the rank lives; the beat resumes the
+                # moment the store does
                 failures += 1
-                if failures >= 5:
+                extra = self._interval * min(2 ** min(failures, 3) - 1, 8)
+                if self._stop.wait(extra):
                     return
 
     def stop(self):

@@ -229,6 +229,16 @@ _fast_fwd: Dict[Any, Any] = {}
 _fast_bwd: Dict[Any, Any] = {}
 _fast_disabled: set = set()
 
+# Errors that mean "this op cannot trace under jit" (dynamic output shape /
+# value-dependent Python branch) — the only condition that permanently
+# disables an op's fast path.  Runtime execution failures (OOM, transient
+# device errors) retry eagerly without poisoning the op process-wide.
+_TRACE_ERRORS = (jax.errors.ConcretizationTypeError,
+                 jax.errors.TracerArrayConversionError,
+                 jax.errors.TracerIntegerConversionError,
+                 jax.errors.UnexpectedTracerError,
+                 jax.errors.NonConcreteBooleanIndexError)
+
 
 def _freeze_val(v):
     if isinstance(v, (list, tuple)):
@@ -330,11 +340,15 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
             fwd_j, _ = _fast_programs(name, treedef, skey, fn_flat)
             try:
                 outs = fwd_j(*vals)
-            except Exception:
+            except _TRACE_ERRORS:
                 outs = fn_flat(*vals)  # user error re-raises right here
                 # the eager run succeeded, so the op itself is untraceable
                 # (dynamic output shape / value-dependent branch): disable
                 _fast_disabled.add(name)
+            except Exception:
+                # runtime execution failure (e.g. RESOURCE_EXHAUSTED) —
+                # retry eagerly but DON'T permanently degrade the op
+                outs = fn_flat(*vals)
         if outs is None:
             outs = fn_flat(*vals)
         multi = isinstance(outs, tuple)
@@ -355,22 +369,28 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
             fwd_j, bwd_j = _fast_programs(name, treedef, skey, fn_flat)
             try:
                 outs = fwd_j(*vals)
-            except Exception:
+            except _TRACE_ERRORS:
                 # eager linearization below re-raises genuine user errors
                 # (bad shapes); if it succeeds the op itself is untraceable
                 # under jit (dynamic output shape / value-dependent branch)
                 outs, vjp_fn = jax.vjp(fn_flat, *vals)
                 _fast_disabled.add(name)
+            except Exception:
+                # runtime execution failure: fall back this once without
+                # permanently degrading the op to eager dispatch
+                outs, vjp_fn = jax.vjp(fn_flat, *vals)
             else:
                 primals = tuple(vals)
 
                 def vjp_fn(cot, _p=primals, _bwd=bwd_j, _f=fn_flat):
                     try:
                         return _bwd(_p, cot)
-                    except Exception:
+                    except _TRACE_ERRORS:
                         # degrade to the eager linearization rather than
                         # poisoning every later step
                         _fast_disabled.add(name)
+                        return jax.vjp(_f, *_p)[1](cot)
+                    except Exception:
                         return jax.vjp(_f, *_p)[1](cot)
         if outs is None:
             outs, vjp_fn = jax.vjp(fn_flat, *vals)

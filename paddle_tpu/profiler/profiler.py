@@ -144,12 +144,13 @@ class _HostTracer:
         return s.replace("\t", " ").replace("\n", " ")
 
     def add(self, name, start, end, category="user"):
-        if self._lib is not None:
-            if _native_owner is self:
-                self._lib.ht_record(
-                    self._clean(name).encode(), self._clean(category).encode(),
-                    start - self._t0, end - self._t0)
+        if self._lib is not None and _native_owner is self:
+            self._lib.ht_record(
+                self._clean(name).encode(), self._clean(category).encode(),
+                start - self._t0, end - self._t0)
             return
+        # native tracer owned by another (overlapping) profiler: fall
+        # through to the locked Python list so these events still record
         with self._lock:
             self.events.append(_HostEvent(
                 name, start - self._t0, end - self._t0,
