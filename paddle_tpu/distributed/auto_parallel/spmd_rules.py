@@ -344,3 +344,364 @@ def flash_attention_rule(q: DistAttr, k: DistAttr, v: DistAttr,
         h = -1  # one mesh axis cannot back two tensor dims
     attr = DistAttr([b, -1, h, -1])
     return [attr, attr, attr], DistAttr([b, -1, h, -1])
+
+
+@register_spmd_rule("scale")
+@register_spmd_rule("cast")
+@register_spmd_rule("assign")
+def unary_linear_rule(x: DistAttr, **_):
+    """Parity: `spmd_rules/unary.cc`-class ops (linear: partial flows)."""
+    return [x], DistAttr(list(x.dims_mapping), sorted(x.partial_dims))
+
+
+@register_spmd_rule("squeeze")
+def squeeze_rule(x: DistAttr, axis=None):
+    """Parity: `spmd_rules/squeeze.cc` (via dim_trans): removed size-1
+    dims must be replicated; others keep their shard."""
+    ndim = x.ndim
+    if axis is None:
+        raise ValueError("squeeze rule needs explicit axes")
+    axes = {a % ndim for a in ([axis] if isinstance(axis, int) else axis)}
+    out_mapping = [dm for i, dm in enumerate(x.dims_mapping)
+                   if i not in axes]
+    xi = [dm if i not in axes else -1
+          for i, dm in enumerate(x.dims_mapping)]
+    return [DistAttr(xi, sorted(x.partial_dims))], \
+        DistAttr(out_mapping, sorted(x.partial_dims))
+
+
+@register_spmd_rule("unsqueeze")
+def unsqueeze_rule(x: DistAttr, axis):
+    """Parity: `spmd_rules/unsqueeze.cc` — new size-1 dims replicated."""
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    out_ndim = x.ndim + len(axes)
+    axes = sorted(a % out_ndim for a in axes)
+    out_mapping, src = [], iter(x.dims_mapping)
+    for i in range(out_ndim):
+        out_mapping.append(-1 if i in axes else next(src))
+    return [x], DistAttr(out_mapping, sorted(x.partial_dims))
+
+
+@register_spmd_rule("slice")
+def slice_rule(x: DistAttr, axes, **_):
+    """Parity: `spmd_rules/slice.cc` — sliced axes must be replicated
+    (a local slice of a sharded dim is not the global slice)."""
+    ndim = x.ndim
+    cut = {a % ndim for a in axes}
+    mapping = [dm if i not in cut else -1
+               for i, dm in enumerate(x.dims_mapping)]
+    xi = DistAttr(mapping, sorted(x.partial_dims))
+    return [xi], DistAttr(list(mapping), sorted(x.partial_dims))
+
+
+@register_spmd_rule("stack")
+def stack_rule(attrs: List[DistAttr], axis=0):
+    """Parity: `spmd_rules/stack.cc` — like concat but a NEW axis is
+    inserted (replicated)."""
+    ndim = attrs[0].ndim
+    merged = [-1] * ndim
+    for a in attrs:
+        for i, dm in enumerate(a.dims_mapping):
+            merged[i] = _merge_dim(merged[i], dm)
+    common = None
+    for a in attrs:
+        common = set(a.partial_dims) if common is None \
+            else common & a.partial_dims
+    common = common or set()
+    inferred = [DistAttr(list(merged), sorted(a.partial_dims & common))
+                for a in attrs]
+    out = list(merged)
+    out.insert(axis % (ndim + 1), -1)
+    return inferred, DistAttr(out, sorted(common))
+
+
+@register_spmd_rule("tile")
+def tile_rule(x: DistAttr, repeat_times):
+    """Parity: `spmd_rules/tile.cc` — tiled dims (repeat > 1) must be
+    replicated; repeat==1 dims keep their shard."""
+    reps = list(repeat_times)
+    out_ndim = max(x.ndim, len(reps))
+    reps = [1] * (out_ndim - len(reps)) + reps
+    in_mapping = list(x.dims_mapping)
+    off = out_ndim - x.ndim
+    out_mapping = []
+    for i in range(out_ndim):
+        xi_dim = i - off
+        dm = x.dims_mapping[xi_dim] if xi_dim >= 0 else -1
+        if reps[i] != 1:
+            if xi_dim >= 0:
+                in_mapping[xi_dim] = -1
+            dm = -1
+        out_mapping.append(dm)
+    return [DistAttr(in_mapping, sorted(x.partial_dims))], \
+        DistAttr(out_mapping, sorted(x.partial_dims))
+
+
+@register_spmd_rule("expand")
+def expand_rule(x: DistAttr, shape, src_shape=None):
+    """Parity: `spmd_rules/expand_as.cc` — broadcast (size-1 -> n) dims
+    replicated, copied dims keep shards; leading new dims replicated."""
+    out_ndim = len(shape)
+    off = out_ndim - x.ndim
+    in_mapping = list(x.dims_mapping)
+    out_mapping = [-1] * out_ndim
+    for i in range(x.ndim):
+        if src_shape is not None and src_shape[i] == 1 and shape[off + i] != 1:
+            in_mapping[i] = -1
+        else:
+            out_mapping[off + i] = x.dims_mapping[i]
+    return [DistAttr(in_mapping, sorted(x.partial_dims))], \
+        DistAttr(out_mapping, sorted(x.partial_dims))
+
+
+@register_spmd_rule("gather")
+@register_spmd_rule("index_select")
+def gather_rule(x: DistAttr, index: DistAttr, axis=0):
+    """Parity: `spmd_rules/gather.cc` — the gathered axis of x must be
+    replicated; index dims splice in."""
+    axis = axis % x.ndim
+    x_mapping = list(x.dims_mapping)
+    x_mapping[axis] = -1
+    out_mapping = (x_mapping[:axis] + list(index.dims_mapping)
+                   + x_mapping[axis + 1:])
+    return [DistAttr(x_mapping, sorted(x.partial_dims)), index], \
+        DistAttr(out_mapping, sorted(x.partial_dims))
+
+
+@register_spmd_rule("scatter")
+@register_spmd_rule("scatter_add")
+def scatter_rule(x: DistAttr, index: DistAttr, updates: DistAttr, axis=0):
+    """Parity: `spmd_rules/scatter.cc` — scattered axis replicated on all
+    operands (cross-shard writes are not local)."""
+    axis = axis % x.ndim
+    x_mapping = list(x.dims_mapping)
+    x_mapping[axis] = -1
+    idx = DistAttr([-1] * index.ndim)
+    upd = DistAttr([-1] * updates.ndim)
+    return [DistAttr(x_mapping, sorted(x.partial_dims)), idx, upd], \
+        DistAttr(list(x_mapping), sorted(x.partial_dims))
+
+
+@register_spmd_rule("cumsum")
+@register_spmd_rule("cumprod")
+def cumsum_rule(x: DistAttr, axis=0):
+    """Parity: `spmd_rules/cumsum.cc` — the scan axis must be unsharded
+    (a local prefix-sum of a shard is not the global prefix)."""
+    axis = axis % x.ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    xi = DistAttr(mapping)  # nonlinear-ish: partial must resolve first
+    return [xi], DistAttr(list(mapping))
+
+
+@register_spmd_rule("dropout")
+def dropout_rule(x: DistAttr, p=0.5):
+    """Parity: `spmd_rules/dropout.cc`-class elementwise-with-rng: shards
+    flow; partial must resolve first (masking a pending sum is wrong)."""
+    xi = DistAttr(list(x.dims_mapping))
+    return [xi], DistAttr(list(x.dims_mapping))
+
+
+@register_spmd_rule("rms_norm")
+def rms_norm_rule(x: DistAttr, scale: DistAttr, begin_norm_axis=-1):
+    """Parity: `spmd_rules/rms_norm.cc` — normalized trailing dims
+    unsharded, scale replicated, nonlinear (partial resolves first)."""
+    axis = begin_norm_axis % x.ndim
+    mapping = list(x.dims_mapping)
+    for i in range(axis, x.ndim):
+        mapping[i] = -1
+    return [DistAttr(mapping), DistAttr([-1] * scale.ndim)], \
+        DistAttr(list(mapping))
+
+
+@register_spmd_rule("fused_rope")
+def fused_rope_rule(q: DistAttr, k: Optional[DistAttr] = None, **_):
+    """Parity: `spmd_rules/fused_rope.cc` — [B, S, H, D]: batch/head
+    shards flow, sequence and head_dim replicated (the rotation pairs
+    lanes within head_dim and positions index S)."""
+    def fix(a):
+        m = list(a.dims_mapping)
+        m[1] = -1
+        m[3] = -1
+        return DistAttr(m)
+    outs = [fix(q)] + ([fix(k)] if k is not None else [])
+    return outs, outs[0] if k is None else outs
+
+
+@register_spmd_rule("where")
+def where_rule(cond: DistAttr, x: DistAttr, y: DistAttr):
+    """Parity: `spmd_rules/where.cc` — elementwise merge of all three;
+    partial never flows through a select."""
+    (ci, xi, yi), out = elementwise_rule(
+        DistAttr(cond.dims_mapping), DistAttr(x.dims_mapping),
+        DistAttr(y.dims_mapping))
+    return [ci, xi, yi], out
+
+
+@register_spmd_rule("topk")
+@register_spmd_rule("kthvalue")
+def topk_rule(x: DistAttr, k=1, axis=-1):
+    """Parity: `spmd_rules/topk.cc` — the searched axis must be
+    replicated; outputs (values, indices) share the mapping."""
+    axis = axis % x.ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    xi = DistAttr(mapping)
+    return [xi], [DistAttr(list(mapping)), DistAttr(list(mapping))]
+
+
+@register_spmd_rule("argsort")
+@register_spmd_rule("sort")
+def sort_rule(x: DistAttr, axis=-1):
+    """Sort/argsort: the sorted axis must be replicated."""
+    axis = axis % x.ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    xi = DistAttr(mapping)
+    return [xi], DistAttr(list(mapping))
+
+
+@register_spmd_rule("argmax")
+@register_spmd_rule("argmin")
+def argmax_rule(x: DistAttr, axis=None, keep_dim=False):
+    """Arg-reductions are nonlinear: reduced axis must be replicated (a
+    shard-local argmax is meaningless globally)."""
+    ndim = x.ndim
+    if axis is None:
+        mapping_in = [-1] * ndim
+        out = DistAttr([])
+        return [DistAttr(mapping_in)], out
+    axis = axis % ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    out_mapping = [dm for i, dm in enumerate(mapping) if i != axis] \
+        if not keep_dim else list(mapping)
+    return [DistAttr(mapping)], DistAttr(out_mapping)
+
+
+@register_spmd_rule("one_hot")
+def one_hot_rule(x: DistAttr, num_classes):
+    """Parity: `spmd_rules/one_hot.cc` — new class dim replicated."""
+    return [x], DistAttr(list(x.dims_mapping) + [-1],
+                         sorted(x.partial_dims))
+
+
+@register_spmd_rule("pad")
+def pad_rule(x: DistAttr, paddings):
+    """Parity: `spmd_rules/pad.cc` — padded dims must be replicated."""
+    mapping = list(x.dims_mapping)
+    for i in range(x.ndim):
+        lo, hi = paddings[2 * i], paddings[2 * i + 1]
+        if lo or hi:
+            mapping[i] = -1
+    xi = DistAttr(mapping, sorted(x.partial_dims))
+    return [xi], DistAttr(list(mapping), sorted(x.partial_dims))
+
+
+@register_spmd_rule("flip")
+def flip_rule(x: DistAttr, axis):
+    """Flipped dims must be replicated (local flip != global flip)."""
+    axes = {a % x.ndim for a in ([axis] if isinstance(axis, int) else axis)}
+    mapping = [dm if i not in axes else -1
+               for i, dm in enumerate(x.dims_mapping)]
+    xi = DistAttr(mapping, sorted(x.partial_dims))
+    return [xi], DistAttr(list(mapping), sorted(x.partial_dims))
+
+
+@register_spmd_rule("roll")
+def roll_rule(x: DistAttr, shifts, axis=None):
+    """Rolled dims must be replicated (elements cross shard boundaries)."""
+    if axis is None:
+        mapping = [-1] * x.ndim
+    else:
+        axes = {a % x.ndim
+                for a in ([axis] if isinstance(axis, int) else axis)}
+        mapping = [dm if i not in axes else -1
+                   for i, dm in enumerate(x.dims_mapping)]
+    xi = DistAttr(mapping, sorted(x.partial_dims))
+    return [xi], DistAttr(list(mapping), sorted(x.partial_dims))
+
+
+@register_spmd_rule("unbind")
+def unbind_rule(x: DistAttr, axis=0):
+    """Parity: `spmd_rules/unbind.cc` — unbound axis replicated; one
+    output attr per slice is the mapping minus that axis."""
+    axis = axis % x.ndim
+    mapping = list(x.dims_mapping)
+    mapping[axis] = -1
+    out = [dm for i, dm in enumerate(mapping) if i != axis]
+    return [DistAttr(mapping, sorted(x.partial_dims))], \
+        DistAttr(out, sorted(x.partial_dims))
+
+
+@register_spmd_rule("take_along_axis")
+def take_along_axis_rule(x: DistAttr, index: DistAttr, axis=0):
+    """The indexed axis replicated on both; other dims merge."""
+    axis = axis % x.ndim
+    merged = [_merge_dim(a, b) for a, b in
+              zip(x.dims_mapping, index.dims_mapping)]
+    merged[axis] = -1
+    xi = DistAttr(merged, sorted(x.partial_dims))
+    return [xi, DistAttr(list(merged))], \
+        DistAttr(list(merged), sorted(x.partial_dims))
+
+
+@register_spmd_rule("triu")
+@register_spmd_rule("tril")
+def triu_rule(x: DistAttr, diagonal=0):
+    """Parity: `spmd_rules/triu.cc` — the last two (matrix) dims must be
+    replicated: the kept triangle depends on global row/col indices."""
+    mapping = list(x.dims_mapping)
+    mapping[-1] = -1
+    if x.ndim >= 2:
+        mapping[-2] = -1
+    xi = DistAttr(mapping, sorted(x.partial_dims))
+    return [xi], DistAttr(list(mapping), sorted(x.partial_dims))
+
+
+def _optimizer_update_rule(param: DistAttr, grad: DistAttr,
+                           *state: DistAttr):
+    """Shared rule for sgd/momentum/adam-style updates (parity:
+    `spmd_rules/optimizer.cc`): param and grad mappings merge; every
+    state tensor follows the merged param layout; grads must not be
+    partial (resolve pending sums before the update)."""
+    merged = [_merge_dim(p, g) for p, g in
+              zip(param.dims_mapping, grad.dims_mapping)]
+    attr = DistAttr(merged)
+    return [attr, attr] + [DistAttr(list(merged)) for _ in state], \
+        DistAttr(list(merged))
+
+
+@register_spmd_rule("sgd")
+def sgd_rule(param: DistAttr, grad: DistAttr):
+    return _optimizer_update_rule(param, grad)
+
+
+@register_spmd_rule("momentum")
+def momentum_rule(param: DistAttr, grad: DistAttr, velocity: DistAttr):
+    return _optimizer_update_rule(param, grad, velocity)
+
+
+@register_spmd_rule("adam")
+@register_spmd_rule("adamw")
+def adam_rule(param: DistAttr, grad: DistAttr, m: DistAttr, v: DistAttr):
+    return _optimizer_update_rule(param, grad, m, v)
+
+
+# ---------------------------------------------------------- op-rule bindings
+# Which RULE an op name uses (e.g. 'kron' -> 'elementwise'); populated by
+# hand here for the core ops and by the YAML codegen (`spmd:` field) for
+# generated ops — the reference's PD_REGISTER_SPMD_RULE registration.
+_OP_RULE_BINDINGS: Dict[str, str] = {}
+
+
+def bind_op_rule(op_name: str, rule_name: str) -> None:
+    _OP_RULE_BINDINGS[op_name] = rule_name
+
+
+def rule_for_op(op_name: str) -> Optional[Callable]:
+    """The rule callable an op is bound to (None when unbound)."""
+    rule = _OP_RULE_BINDINGS.get(op_name)
+    if rule is None and op_name in _RULES:
+        rule = op_name
+    return _RULES.get(rule) if rule else None

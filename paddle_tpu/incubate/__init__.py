@@ -5,3 +5,9 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+
+# ---- ops from the YAML single source ----
+from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
+_exp(globals(), "incubate")
+del _exp

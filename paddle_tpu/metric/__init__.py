@@ -3,3 +3,9 @@
 from .metrics import Accuracy, Auc, Metric, Precision, Recall, accuracy
 
 __all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+# ---- ops from the YAML single source ----
+from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
+_exp(globals(), "metric")
+del _exp

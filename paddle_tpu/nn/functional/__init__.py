@@ -7,3 +7,9 @@ from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
 
 from ...ops.manipulation import one_hot  # noqa: F401
+
+
+# ---- ops from the YAML single source ----
+from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
+_exp(globals(), "nn_functional")
+del _exp

@@ -14,7 +14,7 @@ from ...ops.manipulation import pad  # noqa: F401  (re-exported, paddle parity)
 __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout", "embedding",
     "normalize", "interpolate", "upsample", "cosine_similarity", "pad",
-    "unfold", "fold", "pixel_shuffle", "pixel_unshuffle", "label_smooth",
+    "unfold", "pixel_shuffle", "pixel_unshuffle", "label_smooth",
     "channel_shuffle",
 ]
 
@@ -264,6 +264,5 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
                                "paddings": tuple(pads), "dilations": (dh, dw)})
 
 
-def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
-         name=None):
-    raise NotImplementedError("fold: planned (inverse of unfold)")
+# fold (col2im) is supplied by the YAML single source (ops/specs/ops.yaml
+# `fold`, namespace nn_functional) — no stub here.

@@ -26,3 +26,947 @@ def logcumsumexp(x, *, axis=-1):
     negative axes)."""
     import jax
     return jax.lax.cumlogsumexp(x, axis=axis % x.ndim)
+
+
+def _next_key():
+    from ..framework import random as _random
+    return _random.next_key()
+
+
+def polygamma(x, *, n=1):
+    import jax
+    return jax.scipy.special.polygamma(n, x)
+
+
+def renorm(x, *, p=2.0, axis=0, max_norm=1.0):
+    """Per-slice p-norm clamp along `axis` (paddle.renorm)."""
+    import jax.numpy as jnp
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def frobenius_norm(x, *, axis=None, keepdim=False):
+    import jax.numpy as jnp
+    if axis is None:
+        axis = (-2, -1) if x.ndim >= 2 else (-1,)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def squared_l2_norm(x):
+    import jax.numpy as jnp
+    return jnp.sum(jnp.square(x)).reshape(1)
+
+
+def cholesky_solve(x, y, *, upper=False):
+    """Solve A X = B given the Cholesky factor `y` of A (paddle order:
+    cholesky_solve(b, factor))."""
+    import jax
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def lu_unpack(lu_data, pivots, *, unpack_ludata=True, unpack_pivots=True):
+    """Unpack jax lu_factor output into (P, L, U) (paddle.linalg.lu_unpack).
+    Batched `[..., m, n]` inputs are vmapped over the leading dims."""
+    import jax
+    import jax.numpy as jnp
+    if lu_data.ndim > 2:
+        batch = lu_data.shape[:-2]
+        flat = lu_data.reshape((-1,) + lu_data.shape[-2:])
+        pflat = pivots.reshape((-1, pivots.shape[-1]))
+        P, L, U = jax.vmap(
+            lambda a, p: lu_unpack(a, p, unpack_ludata=unpack_ludata,
+                                   unpack_pivots=unpack_pivots))(flat, pflat)
+        return (P.reshape(batch + P.shape[-2:]),
+                L.reshape(batch + L.shape[-2:]),
+                U.reshape(batch + U.shape[-2:]))
+    m, n = lu_data.shape
+    k = min(m, n)
+    L = jnp.tril(lu_data[:, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[:k, :])
+    # pivots (1-based sequential row swaps) -> permutation
+    piv = pivots.astype(jnp.int32) - 1
+
+    def body(i, p):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+    perm = jax.lax.fori_loop(0, piv.shape[0], body, jnp.arange(m))
+    P = jnp.eye(m, dtype=lu_data.dtype)[perm].swapaxes(-1, -2)
+    return P, L, U
+
+
+def fill_diagonal(x, *, value=0.0, offset=0, wrap=False):
+    import jax.numpy as jnp
+    n = min(x.shape[-2], x.shape[-1]) - abs(offset)
+    idx = jnp.arange(max(n, 0))
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    return x.at[..., rows, cols].set(value)
+
+
+def index_fill(x, index, *, axis=0, value=0.0):
+    import jax.numpy as jnp
+    sl = [slice(None)] * x.ndim
+    sl[axis % x.ndim] = index
+    return x.at[tuple(sl)].set(value)
+
+
+def reverse(x, *, axis):
+    import jax.numpy as jnp
+    return jnp.flip(x, axis=tuple(axis) if isinstance(axis, (list, tuple))
+                    else axis)
+
+
+def split_with_num(x, *, num, axis=0):
+    import jax.numpy as jnp
+    return tuple(jnp.split(x, num, axis=axis))
+
+
+def tensor_split(x, *, num_or_indices, axis=0):
+    import jax.numpy as jnp
+    arg = num_or_indices if isinstance(num_or_indices, int) \
+        else list(num_or_indices)
+    return tuple(jnp.array_split(x, arg, axis=axis)) \
+        if isinstance(arg, int) else tuple(jnp.split(x, arg, axis=axis))
+
+
+def hsplit(x, *, num_or_indices):
+    import jax.numpy as jnp
+    return tuple(jnp.hsplit(x, num_or_indices))
+
+
+def vsplit(x, *, num_or_indices):
+    import jax.numpy as jnp
+    return tuple(jnp.vsplit(x, num_or_indices))
+
+
+def dsplit(x, *, num_or_indices):
+    import jax.numpy as jnp
+    return tuple(jnp.dsplit(x, num_or_indices))
+
+
+def sequence_mask(lengths, *, maxlen=None, dtype="bool"):
+    import jax
+    import jax.numpy as jnp
+    if maxlen is None:
+        # paddle default: longest length in the batch; needs concrete
+        # data (under jit the output shape would be value-dependent)
+        jax.core.concrete_or_error(
+            None, lengths, "sequence_mask with maxlen=None needs concrete "
+            "lengths; pass maxlen explicitly under jit")
+        maxlen = int(lengths.max())
+    mask = jnp.arange(int(maxlen)) < lengths[..., None]
+    return mask.astype(dtype)
+
+
+def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Bilinear/nearest 2-D grid sampling (paddle.nn.functional.grid_sample;
+    ref `phi/kernels/gpu/grid_sample_kernel.cu`).  x [N, C, H, W], grid
+    [N, Hg, Wg, 2] in [-1, 1]."""
+    import jax.numpy as jnp
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode={padding_mode!r}: only 'zeros' and "
+            "'border' (clamp) are implemented")
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (W - 1)
+        fy = (gy + 1) * 0.5 * (H - 1)
+    else:
+        fx = ((gx + 1) * W - 1) * 0.5
+        fy = ((gy + 1) * H - 1) * 0.5
+
+    def sample(ix, iy):
+        okx = (ix >= 0) & (ix <= W - 1)
+        oky = (iy >= 0) & (iy <= H - 1)
+        cx = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        # advanced indices split by ':' put the advanced dims first:
+        # [broadcast(N, Hg, Wg), C]
+        v = x[jnp.arange(N)[:, None, None], :, cy, cx]
+        if padding_mode == "zeros":
+            v = jnp.where((okx & oky)[..., None], v, 0.0)
+        return v
+
+    if mode == "nearest":
+        out = sample(jnp.round(fx), jnp.round(fy))
+    else:
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (fx - x0) * (y1 - fy)
+        wc = (x1 - fx) * (fy - y0)
+        wd = (fx - x0) * (fy - y0)
+        out = (sample(x0, y0) * wa[..., None] + sample(x1, y0) * wb[..., None]
+               + sample(x0, y1) * wc[..., None]
+               + sample(x1, y1) * wd[..., None])
+    return jnp.transpose(out, (0, 3, 1, 2))
+
+
+def affine_grid(theta, *, out_shape, align_corners=True):
+    """paddle.nn.functional.affine_grid: theta [N, 2, 3] -> grid
+    [N, H, W, 2]."""
+    import jax.numpy as jnp
+    N, _, H, W = out_shape
+
+    def axis(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        step = 2.0 / n
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, n)
+
+    ys, xs = jnp.meshgrid(axis(H), axis(W), indexing="ij")
+    base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # [H, W, 3]
+    return jnp.einsum("hwk,nak->nhwa", base, theta)
+
+
+def temporal_shift(x, *, seg_num, shift_ratio=0.25):
+    """paddle.nn.functional.temporal_shift: x [N*T, C, H, W]."""
+    import jax.numpy as jnp
+    NT, C, H, W = x.shape
+    T = seg_num
+    v = x.reshape(NT // T, T, C, H, W)
+    fold = int(C * shift_ratio)
+    left = jnp.pad(v[:, 1:, :fold], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    right = jnp.pad(v[:, :-1, fold:2 * fold],
+                    ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(x.shape)
+
+
+def pad3d(x, *, paddings, mode="constant", value=0.0,
+          data_format="NCDHW"):
+    import jax.numpy as jnp
+    l, r, t, b, f, bk = paddings
+    if data_format == "NCDHW":
+        cfg = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+    else:
+        cfg = [(0, 0), (f, bk), (t, b), (l, r), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def dirichlet(alpha):
+    import jax
+    return jax.random.dirichlet(_next_key(), alpha)
+
+
+def standard_gamma(alpha):
+    import jax
+    return jax.random.gamma(_next_key(), alpha)
+
+
+def binomial(count, prob):
+    import jax
+    return jax.random.binomial(_next_key(), count, prob)
+
+
+def frame(x, *, frame_length, hop_length, axis=-1):
+    """paddle.signal.frame: sliding windows over the last axis."""
+    import jax.numpy as jnp
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("frame supports axis=-1")
+    n = x.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = x[..., idx]                     # [..., num, frame_length]
+    return jnp.swapaxes(out, -1, -2)      # paddle: [..., frame_length, num]
+
+
+def overlap_add(x, *, hop_length, axis=-1):
+    """paddle.signal.overlap_add: inverse of frame ([..., FL, num])."""
+    import jax.numpy as jnp
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add supports axis=-1")
+    fl, num = x.shape[-2], x.shape[-1]
+    n = fl + hop_length * (num - 1)
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(fl)[None, :]    # [num, fl]
+    return out.at[..., idx].add(jnp.swapaxes(x, -1, -2))
+
+
+def top_p_sampling(probs, *, p=0.95):
+    """Nucleus sampling over the last axis (ref top_p_sampling op):
+    returns (samples, chosen probs)."""
+    import jax
+    import jax.numpy as jnp
+    sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(sorted_p, axis=-1)
+    cutoff_idx = jnp.sum(cum < p, axis=-1, keepdims=True)
+    kth = jnp.take_along_axis(sorted_p, cutoff_idx, axis=-1)
+    filtered = jnp.where(probs < kth, 0.0, probs)
+    filtered = filtered / filtered.sum(-1, keepdims=True)
+    ids = jax.random.categorical(_next_key(),
+                                 jnp.log(filtered + 1e-20), axis=-1)
+    chosen = jnp.take_along_axis(filtered, ids[..., None], axis=-1)
+    return ids[..., None], chosen
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, *, blank=0,
+             reduction="mean"):
+    """CTC loss (ref warpctc op / paddle.nn.functional.ctc_loss).
+    log_probs [T, B, C] (paddle layout), labels [B, L] int32."""
+    import jax.numpy as jnp
+    import optax
+    logits = jnp.swapaxes(log_probs, 0, 1)        # [B, T, C]
+    T, L = logits.shape[1], labels.shape[1]
+    logit_pad = (jnp.arange(T)[None, :] >= input_lengths[:, None]) \
+        .astype(logits.dtype)
+    label_pad = (jnp.arange(L)[None, :] >= label_lengths[:, None]) \
+        .astype(logits.dtype)
+    loss = optax.ctc_loss(logits, logit_pad, labels, label_pad,
+                          blank_id=blank)
+    if reduction == "mean":
+        # paddle divides by label length
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def huber_loss(input, label, *, delta=1.0, reduction="mean"):
+    import jax.numpy as jnp
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(logits, labels, *, normalize=False):
+    import jax.numpy as jnp
+    loss = jnp.maximum(logits, 0) - logits * labels + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    if normalize:
+        return loss / jnp.maximum(jnp.sum(labels > 0), 1)
+    return loss
+
+
+def identity_loss(x, *, reduction="none"):
+    import jax.numpy as jnp
+    if reduction in ("mean", 0):
+        return jnp.mean(x)
+    if reduction in ("sum", 1):
+        return jnp.sum(x)
+    return x
+
+
+def accuracy(pred, label, *, k=1):
+    """Top-k accuracy metric (ref accuracy op): pred [N, C] scores,
+    label [N] or [N, 1]."""
+    import jax.numpy as jnp
+    lab = label.reshape(label.shape[0], -1)[:, 0]
+    topk = jnp.argsort(pred, axis=-1)[:, -k:]
+    correct = jnp.any(topk == lab[:, None], axis=-1)
+    return jnp.mean(correct.astype(jnp.float32))
+
+
+def multi_margin_loss(input, label, *, p=1, margin=1.0, reduction="mean"):
+    import jax.numpy as jnp
+    N, C = input.shape
+    correct = jnp.take_along_axis(input, label[:, None], axis=1)
+    m = jnp.maximum(0.0, margin - correct + input) ** p
+    m = m.at[jnp.arange(N), label].set(0.0)
+    loss = jnp.sum(m, axis=1) / C
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def rrelu(x, *, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    import jax
+    import jax.numpy as jnp
+    if training:
+        a = jax.random.uniform(_next_key(), x.shape, minval=lower,
+                               maxval=upper)
+    else:
+        a = (lower + upper) / 2
+    return jnp.where(x >= 0, x, a * x)
+
+
+def select_scatter(x, values, *, axis=0, index=0):
+    sl = [slice(None)] * x.ndim
+    sl[axis % x.ndim] = index
+    return x.at[tuple(sl)].set(values)
+
+
+def diagonal_scatter(x, y, *, offset=0, axis1=0, axis2=1):
+    import jax.numpy as jnp
+    nd = x.ndim
+    a1, a2 = axis1 % nd, axis2 % nd
+    moved = jnp.moveaxis(x, (a1, a2), (-2, -1))
+    n = min(moved.shape[-2], moved.shape[-1]) - abs(offset)
+    idx = jnp.arange(max(n, 0))
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    moved = moved.at[..., rows, cols].set(y)
+    return jnp.moveaxis(moved, (-2, -1), (a1, a2))
+
+
+def slice_scatter(x, value, *, axes, starts, ends, strides):
+    sl = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        sl[a] = slice(s, e, st)
+    return x.at[tuple(sl)].set(value)
+
+
+def masked_scatter(x, mask, value):
+    """Fill masked positions with consecutive values (paddle
+    masked_scatter); value is consumed flat in order."""
+    import jax.numpy as jnp
+    m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    xf = x.reshape(-1)
+    v = value.reshape(-1)
+    pos = jnp.cumsum(m) - 1
+    take = v[jnp.clip(pos, 0, v.size - 1)]
+    return jnp.where(m, take, xf).reshape(x.shape)
+
+
+def isreal(x):
+    import jax.numpy as jnp
+    if jnp.iscomplexobj(x):
+        return x.imag == 0
+    return jnp.ones(x.shape, bool)
+
+
+def pdist(x, *, p=2.0):
+    import jax.numpy as jnp
+    n = x.shape[0]
+    d = cdist(x, x, p=p)
+    iu = jnp.triu_indices(n, 1)
+    return d[iu]
+
+
+def cdist(x, y, *, p=2.0):
+    import jax.numpy as jnp
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    if p == float("inf"):
+        return jnp.max(diff, axis=-1)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+def cartesian_prod(xs):
+    import jax.numpy as jnp
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+def combinations(x, *, r=2, with_replacement=False):
+    import numpy as np
+    import itertools
+    import jax.numpy as jnp
+    n = x.shape[0]
+    it = itertools.combinations_with_replacement(range(n), r) \
+        if with_replacement else itertools.combinations(range(n), r)
+    idx = np.array(list(it), dtype=np.int32).reshape(-1, r)
+    return x[idx]
+
+
+def orgqr(x, tau):
+    import jax
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+def geqrf(x):
+    import jax
+    return jax.lax.linalg.geqrf(x)
+
+
+def svd_lowrank(x, *, q=6):
+    import jax.numpy as jnp
+    u, s, vt = jnp.linalg.svd(x, full_matrices=False)
+    k = min(q, s.shape[-1])
+    return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+
+
+def pca_lowrank(x, *, q=6, center=True):
+    import jax.numpy as jnp
+    if center:
+        x = x - x.mean(axis=-2, keepdims=True)
+    return svd_lowrank(x, q=q)
+
+
+def block_diag(xs):
+    import jax.scipy.linalg as jsl
+    return jsl.block_diag(*xs)
+
+
+def dstack(xs):
+    import jax.numpy as jnp
+    return jnp.dstack(xs)
+
+
+def trapezoid(y, *, x=None, dx=1.0, axis=-1):
+    import jax.numpy as jnp
+    from jax.scipy.integrate import trapezoid as _tz
+    if x is None:
+        return _tz(y, dx=dx, axis=axis)
+    return _tz(y, x=jnp.asarray(x), axis=axis)
+
+
+def cumulative_trapezoid(y, *, x=None, dx=1.0, axis=-1):
+    import jax.numpy as jnp
+    y = jnp.moveaxis(y, axis, -1)
+    if x is None:
+        widths = dx
+        seg = (y[..., 1:] + y[..., :-1]) * 0.5 * widths
+    else:
+        xv = jnp.moveaxis(jnp.asarray(x), axis, -1) \
+            if jnp.asarray(x).ndim == y.ndim else jnp.asarray(x)
+        widths = xv[..., 1:] - xv[..., :-1]
+        seg = (y[..., 1:] + y[..., :-1]) * 0.5 * widths
+    return jnp.moveaxis(jnp.cumsum(seg, axis=-1), -1, axis)
+
+
+def fold(x, *, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im (inverse of unfold; ref fold op).  x [N, C*kh*kw, L]."""
+    import jax.numpy as jnp
+    as2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = as2(kernel_sizes)
+    sh, sw = as2(strides)
+    ph, pw = as2(paddings)
+    dh, dw = as2(dilations)
+    H, W = as2(output_sizes)
+    N, ckk, L = x.shape
+    C = ckk // (kh * kw)
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+    nh = (Hp - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (Wp - (dw * (kw - 1) + 1)) // sw + 1
+    v = x.reshape(N, C, kh, kw, nh, nw)
+    out = jnp.zeros((N, C, Hp, Wp), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            rows = i * dh + sh * jnp.arange(nh)
+            cols = j * dw + sw * jnp.arange(nw)
+            out = out.at[:, :, rows[:, None], cols[None, :]].add(
+                v[:, :, i, j])
+    return out[:, :, ph:ph + H, pw:pw + W]
+
+
+def edit_distance(hyp, ref, *, normalized=True):
+    """Levenshtein distance between two int sequences [B, L1], [B, L2]
+    (ref edit_distance op; scan over the DP rows)."""
+    import jax
+    import jax.numpy as jnp
+    B, L1 = hyp.shape
+    L2 = ref.shape[1]
+
+    def one(h, r):
+        row0 = jnp.arange(L2 + 1, dtype=jnp.float32)
+
+        def step(row, hi):
+            def inner(carry, j):
+                prev_diag, cur = carry
+                cost = jnp.where(hi == r[j - 1], 0.0, 1.0)
+                val = jnp.minimum(jnp.minimum(cur[j - 1] + 1, row[j] + 1),
+                                  prev_diag + cost)
+                cur = cur.at[j].set(val)
+                return (row[j], cur), None
+            cur0 = row.at[0].add(1.0)
+            (_, new_row), _ = jax.lax.scan(inner, (row[0], cur0),
+                                           jnp.arange(1, L2 + 1))
+            return new_row, None
+        final, _ = jax.lax.scan(step, row0, h)
+        return final[L2]
+
+    d = jax.vmap(one)(hyp, ref)
+    if normalized:
+        return d / jnp.maximum(L2, 1)
+    return d
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """paddle.nn.functional.bilinear: out[n,o] = x1[n,i] W[o,i,j] x2[n,j]."""
+    import jax.numpy as jnp
+    out = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref gather_tree op): ids/parents
+    [T, B, beam]; walk parents from the last step back."""
+    import jax
+    import jax.numpy as jnp
+    T, B, W = ids.shape
+    b = jnp.arange(B)[:, None]
+
+    def step(beam, t):
+        # beam [B, W]: which beam each final slot followed at step t+1
+        out = ids[t, b, beam]
+        prev = parents[t, b, beam]
+        return prev, out
+
+    init = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+    _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return outs[::-1]
+
+
+def increment(x, *, value=1.0):
+    return x + value
+
+
+def exponential(x, *, lam=1.0):
+    """Sample Exp(lam) with x's shape (ref exponential_ op)."""
+    import jax
+    return jax.random.exponential(_next_key(), x.shape, x.dtype) / lam
+
+
+def _segment(op, x, seg_ids):
+    import jax
+    import numpy as np
+    # concrete_or_error raises ConcretizationTypeError on tracers, which
+    # the registry fast path classifies as "untraceable op" and disables
+    # ONCE (a plain ValueError would re-pay a failed trace every call)
+    jax.core.concrete_or_error(
+        None, seg_ids, "segment ops need concrete segment ids (the "
+        "segment count defines the output shape)")
+    n = int(np.asarray(seg_ids).max()) + 1 if seg_ids.size else 0
+    return op(x, seg_ids, num_segments=n)
+
+
+def segment_sum(x, seg_ids):
+    import jax
+    return _segment(jax.ops.segment_sum, x, seg_ids)
+
+
+def segment_mean(x, seg_ids):
+    import jax
+    import jax.numpy as jnp
+    s = _segment(jax.ops.segment_sum, x, seg_ids)
+    cnt = _segment(jax.ops.segment_sum, jnp.ones_like(x), seg_ids)
+    return s / jnp.maximum(cnt, 1)
+
+
+def segment_max(x, seg_ids):
+    import jax
+    return _segment(jax.ops.segment_max, x, seg_ids)
+
+
+def segment_min(x, seg_ids):
+    import jax
+    return _segment(jax.ops.segment_min, x, seg_ids)
+
+
+def roi_align(x, boxes, boxes_num, *, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, aligned=True):
+    """RoIAlign (ref roi_align op): x [N, C, H, W], boxes [R, 4] in image
+    coords, boxes_num [N] rois per image."""
+    import jax.numpy as jnp
+    import numpy as np
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    # map each roi to its batch image
+    if hasattr(boxes_num, "tolist"):
+        counts = [int(c) for c in np.asarray(boxes_num)]
+    else:
+        counts = list(boxes_num)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(counts)), counts),
+                            jnp.int32)
+    off = 0.5 if aligned else 0.0
+    x0 = boxes[:, 0] * spatial_scale - off
+    y0 = boxes[:, 1] * spatial_scale - off
+    x1 = boxes[:, 2] * spatial_scale - off
+    y1 = boxes[:, 3] * spatial_scale - off
+    bw = jnp.maximum(x1 - x0, 1.0 if not aligned else 1e-6)
+    bh = jnp.maximum(y1 - y0, 1.0 if not aligned else 1e-6)
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+    ph, pw = pooled_height, pooled_width
+    # sample grid centers [R, ph*ratio, pw*ratio]
+    gy = (jnp.arange(ph * ratio) + 0.5) / (ph * ratio)
+    gx = (jnp.arange(pw * ratio) + 0.5) / (pw * ratio)
+    sy = y0[:, None] + bh[:, None] * gy[None, :]
+    sx = x0[:, None] + bw[:, None] * gx[None, :]
+
+    def bilin(r_img, yy, xx):
+        y0i = jnp.floor(yy).astype(jnp.int32)
+        x0i = jnp.floor(xx).astype(jnp.int32)
+        wy = yy - y0i
+        wx = xx - x0i
+
+        def at(yi, xi):
+            ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            v = x[r_img, :, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+            return jnp.where(ok[..., None], v, 0.0)
+        return (at(y0i, x0i) * ((1 - wy) * (1 - wx))[..., None]
+                + at(y0i, x0i + 1) * ((1 - wy) * wx)[..., None]
+                + at(y0i + 1, x0i) * (wy * (1 - wx))[..., None]
+                + at(y0i + 1, x0i + 1) * (wy * wx)[..., None])
+
+    yy = sy[:, :, None]                                   # [R, phr, 1]
+    xx = sx[:, None, :]                                   # [R, 1, pwr]
+    yy = jnp.broadcast_to(yy, (R, ph * ratio, pw * ratio))
+    xx = jnp.broadcast_to(xx, (R, ph * ratio, pw * ratio))
+    vals = bilin(batch_idx[:, None, None], yy, xx)        # [R, phr, pwr, C]
+    vals = vals.reshape(R, ph, ratio, pw, ratio, C).mean((2, 4))
+    return jnp.transpose(vals, (0, 3, 1, 2))              # [R, C, ph, pw]
+
+
+def nms(boxes, scores=None, *, iou_threshold=0.3):
+    """Greedy NMS returning kept indices sorted by score (ref nms op).
+    Dynamic output -> eager-only (jit falls back like nonzero/unique)."""
+    import jax.numpy as jnp
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores) if scores is not None else jnp.arange(n)
+    bs = boxes[order]
+    x0, y0, x1, y1 = bs[:, 0], bs[:, 1], bs[:, 2], bs[:, 3]
+    area = jnp.maximum(x1 - x0, 0) * jnp.maximum(y1 - y0, 0)
+    ix0 = jnp.maximum(x0[:, None], x0[None, :])
+    iy0 = jnp.maximum(y0[:, None], y0[None, :])
+    ix1 = jnp.minimum(x1[:, None], x1[None, :])
+    iy1 = jnp.minimum(y1[:, None], y1[None, :])
+    inter = jnp.maximum(ix1 - ix0, 0) * jnp.maximum(iy1 - iy0, 0)
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+    keep = []
+    alive = [True] * int(n)
+    import numpy as _np_
+    iou_host = _np_.asarray(iou)  # ONE transfer; per-element reads would
+    for i in range(int(n)):       # sync the device O(n^2) times
+        if not alive[i]:
+            continue
+        keep.append(i)
+        for j in range(i + 1, int(n)):
+            if alive[j] and float(iou_host[i, j]) > iou_threshold:
+                alive[j] = False
+    import numpy as np
+    return order[jnp.asarray(np.asarray(keep, np.int32))]
+
+
+def unique_consecutive(x, *, return_inverse=False, return_counts=False):
+    """Collapse equal consecutive values (ref unique_consecutive op).
+    Dynamic output -> eager-only."""
+    import numpy as np
+    import jax.numpy as jnp
+    xv = np.asarray(x)
+    flat = xv.reshape(-1)
+    if flat.size == 0:
+        outs = [jnp.asarray(flat)]
+    else:
+        change = np.empty(flat.shape, bool)
+        change[0] = True
+        change[1:] = flat[1:] != flat[:-1]
+        outs = [jnp.asarray(flat[change])]
+        if return_inverse:
+            outs.append(jnp.asarray(np.cumsum(change) - 1))
+        if return_counts:
+            idx = np.flatnonzero(change)
+            outs.append(jnp.asarray(np.diff(np.append(idx, flat.size))))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def sgd_update(param, grad, *, lr=0.01):
+    """Functional SGD kernel (ref sgd_ op)."""
+    return param - lr * grad
+
+
+def momentum_update(param, grad, velocity, *, lr=0.01, mu=0.9,
+                    use_nesterov=False):
+    """Functional momentum kernel (ref momentum_ op)."""
+    v2 = mu * velocity + grad
+    if use_nesterov:
+        return param - lr * (grad + mu * v2), v2
+    return param - lr * v2, v2
+
+
+def adam_update(param, grad, m, v, *, lr=1e-3, beta1=0.9, beta2=0.999,
+                eps=1e-8, step=1):
+    """Functional Adam kernel (ref adam_ op)."""
+    import jax.numpy as jnp
+    m2 = beta1 * m + (1 - beta1) * grad
+    v2 = beta2 * v + (1 - beta2) * grad * grad
+    mh = m2 / (1 - beta1 ** step)
+    vh = v2 / (1 - beta2 ** step)
+    return param - lr * mh / (jnp.sqrt(vh) + eps), m2, v2
+
+
+def adamw_update(param, grad, m, v, *, lr=1e-3, beta1=0.9, beta2=0.999,
+                 eps=1e-8, step=1, weight_decay=0.01):
+    """Functional AdamW kernel (ref adamw_ op): decoupled decay."""
+    p2, m2, v2 = adam_update(param, grad, m, v, lr=lr, beta1=beta1,
+                             beta2=beta2, eps=eps, step=step)
+    return p2 - lr * weight_decay * param, m2, v2
+
+
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask) over the last axis (ref fused_softmax_mask op)."""
+    import jax
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    """Causal-masked softmax (ref fused_softmax_mask_upper_triangle):
+    x [..., Sq, Sk], positions above the diagonal masked."""
+    import jax
+    import jax.numpy as jnp
+    Sq, Sk = x.shape[-2], x.shape[-1]
+    keep = jnp.arange(Sq)[:, None] >= jnp.arange(Sk)[None, :]
+    masked = jnp.where(keep, x, jnp.finfo(x.dtype).min)
+    return jax.nn.softmax(masked, axis=-1)
+
+
+def fused_dropout_add(x, y, *, p=0.5, training=True):
+    """dropout(x) + y in one op (ref fused_dropout_add)."""
+    import jax
+    import jax.numpy as jnp
+    if not training or p == 0.0:
+        return x + y
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_next_key(), keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype) + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias, scale,
+                                           ln_bias, *, p=0.0,
+                                           epsilon=1e-5, training=True):
+    """(x + bias) -> dropout -> + residual -> LayerNorm (ref
+    fused_bias_dropout_residual_layer_norm op)."""
+    import jax
+    import jax.numpy as jnp
+    h = x + bias
+    if training and p > 0.0:
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(_next_key(), keep, h.shape)
+        h = jnp.where(mask, h / keep, 0.0).astype(h.dtype)
+    h = h + residual
+    mu = h.mean(-1, keepdims=True)
+    var = jnp.square(h - mu).mean(-1, keepdims=True)
+    return (h - mu) * jax.lax.rsqrt(var + epsilon) * scale + ln_bias
+
+
+def box_coder(prior_box, prior_box_var, target_box, *,
+              code_type="encode_center_size", box_normalized=True):
+    """Encode/decode boxes against priors (ref box_coder op)."""
+    import jax.numpy as jnp
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        if prior_box_var is not None:
+            out = out / prior_box_var[None, :, :]
+        return out
+    # decode_center_size: target_box [N, M, 4] deltas
+    d = target_box * (prior_box_var[None, :, :]
+                      if prior_box_var is not None else 1.0)
+    cx = d[..., 0] * pw[None, :] + pcx[None, :]
+    cy = d[..., 1] * ph[None, :] + pcy[None, :]
+    w = jnp.exp(d[..., 2]) * pw[None, :]
+    h = jnp.exp(d[..., 3]) * ph[None, :]
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+def auc(preds, labels, *, num_thresholds=200):
+    """Approximate ROC-AUC from score histograms (ref auc op)."""
+    import jax.numpy as jnp
+    pos_score = preds[:, 1] if preds.ndim == 2 else preds
+    edges = jnp.linspace(0.0, 1.0, num_thresholds + 1)
+    idx = jnp.clip(jnp.searchsorted(edges, pos_score, side="right") - 1,
+                   0, num_thresholds - 1)
+    lab = labels.reshape(-1).astype(jnp.float32)
+    pos = jnp.zeros(num_thresholds).at[idx].add(lab)
+    neg = jnp.zeros(num_thresholds).at[idx].add(1.0 - lab)
+    # sweep thresholds high->low accumulating TP/FP
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_p = tp[-1]
+    tot_n = fp[-1]
+    tpr = tp / jnp.maximum(tot_p, 1.0)
+    fpr = fp / jnp.maximum(tot_n, 1.0)
+    return jnp.trapezoid(tpr, fpr)
+
+
+def viterbi_decode(potentials, transition, lengths, *,
+                   include_bos_eos_tag=True):
+    """Viterbi decoding (paddle.text.viterbi_decode): potentials
+    [B, T, N], transition [N, N] -> (scores [B], paths [B, T]).
+
+    With include_bos_eos_tag the last two tags are BOS/EOS (paddle's CRF
+    convention): BOS->tag start scores are added at t=0, tag->EOS stop
+    scores at the sequence end, and BOS/EOS never appear in the path."""
+    import jax
+    import jax.numpy as jnp
+    B, T, N = potentials.shape
+    eff = N - 2 if include_bos_eos_tag else N
+    trans = transition[:eff, :eff]
+
+    def one(emit, L):
+        def step(carry, t):
+            score = carry
+            cand = score[:, None] + trans + emit[t][None, :eff]
+            best = jnp.max(cand, axis=0)
+            back = jnp.argmax(cand, axis=0)
+            new = jnp.where(t < L, best, score)
+            back = jnp.where(t < L, back, jnp.arange(eff))
+            return new, back
+        init = emit[0][:eff]
+        if include_bos_eos_tag:
+            init = init + transition[N - 2, :eff]   # BOS -> tag
+        final, backs = jax.lax.scan(step, init, jnp.arange(1, T))
+        if include_bos_eos_tag:
+            final = final + transition[:eff, N - 1]  # tag -> EOS
+        last = jnp.argmax(final)
+        score = jnp.max(final)
+
+        def walk(tag, t):
+            prev = backs[t][tag]
+            return prev, prev   # emit the tag AT position t
+        _, path = jax.lax.scan(walk, last, jnp.arange(T - 2, -1, -1))
+        full = jnp.concatenate([path[::-1], last[None]])
+        return score, full
+    scores, paths = jax.vmap(one)(potentials, lengths)
+    return scores, paths
+
+
+def spectral_norm(weight, u, v, *, dim=0, power_iters=1, eps=1e-12):
+    """Spectral normalization (ref spectral_norm op): returns W / sigma."""
+    import jax.numpy as jnp
+    w = jnp.moveaxis(weight, dim, 0)
+    mat = w.reshape(w.shape[0], -1)
+    for _ in range(max(power_iters, 1)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    return weight / sigma
+
+
+def index_sample(x, index):
+    import jax.numpy as jnp
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    import jax.numpy as jnp
+    out = jnp.logspace(start, stop, int(num), base=base)
+    return out.astype(dtype) if dtype else out

@@ -310,3 +310,9 @@ register_op("vecdot", lambda x, y, *, axis: jnp.sum(x * y, axis=axis))
 
 def vecdot(x, y, axis=-1, name=None):
     return _d("vecdot", (x, y), {"axis": int(axis)})
+
+
+# ---- ops from the YAML single source ----
+from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
+_exp(globals(), "linalg")
+del _exp

@@ -218,3 +218,9 @@ def istft(x, n_fft: int, hop_length: Optional[int] = None,
     if squeeze:
         sig = _m.squeeze(sig, axis=0)
     return sig
+
+
+# ---- ops from the YAML single source ----
+from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
+_exp(globals(), "signal")
+del _exp

@@ -1,1 +1,2 @@
 from . import datasets, models, transforms  # noqa: F401
+from . import ops  # noqa: F401

@@ -1,0 +1,8 @@
+"""paddle.vision.ops — populated from the YAML single source
+(namespace: vision_ops).  Parity: python/paddle/vision/ops.py."""
+
+
+# ---- ops from the YAML single source ----
+from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
+_exp(globals(), "vision_ops")
+del _exp

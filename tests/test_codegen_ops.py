@@ -132,3 +132,224 @@ def test_column_row_stack():
         np.asarray(paddle.column_stack([a, b])._value), [[1, 3], [2, 4]])
     np.testing.assert_array_equal(
         np.asarray(paddle.row_stack([a, b])._value), [[1, 2], [3, 4]])
+
+
+# ------------------------------------------------ round-3 generated corpus
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def test_special_functions_match_scipy():
+    from scipy import special as sp
+    rng = np.random.RandomState(0)
+    x = np.abs(rng.randn(16).astype(np.float32)) + 0.1
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(_np(paddle.gammaln(t)), sp.gammaln(x),
+                               rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.i1(t)), sp.i1(x), rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.i0e(t)), sp.i0e(x), rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.i1e(t)), sp.i1e(x), rtol=1e-4)
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([0.5, 3.0], np.float32))
+    np.testing.assert_allclose(_np(paddle.gammainc(a, b)),
+                               sp.gammainc([1.0, 2.0], [0.5, 3.0]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(_np(paddle.polygamma(t, n=2)),
+                               sp.polygamma(2, x), rtol=2e-3)
+
+
+def test_kron_cdist_pdist_block_diag():
+    rng = np.random.RandomState(1)
+    a = rng.randn(3, 2).astype(np.float32)
+    b = rng.randn(2, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        _np(paddle.kron(paddle.to_tensor(a), paddle.to_tensor(b))),
+        np.kron(a, b), rtol=1e-5)
+    x = rng.randn(5, 3).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    from scipy.spatial.distance import cdist as sp_cdist, pdist as sp_pdist
+    np.testing.assert_allclose(
+        _np(paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y))),
+        sp_cdist(x, y), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        _np(paddle.pdist(paddle.to_tensor(x))), sp_pdist(x),
+        rtol=1e-4, atol=1e-5)
+    from scipy.linalg import block_diag as sp_bd
+    got = _np(paddle.block_diag([paddle.to_tensor(a), paddle.to_tensor(b)]))
+    np.testing.assert_allclose(got, sp_bd(a, b), rtol=1e-6)
+
+
+def test_splits_and_scatters():
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 4).astype(np.float32)
+    t = paddle.to_tensor(x)
+    parts = paddle.split_with_num(t, num=3, axis=0)
+    assert len(parts) == 3 and _np(parts[1]).shape == (2, 4)
+    np.testing.assert_allclose(_np(parts[1]), x[2:4])
+    hs = paddle.hsplit(t, 2)
+    np.testing.assert_allclose(_np(hs[0]), x[:, :2])
+    v = paddle.select_scatter(t, paddle.to_tensor(np.zeros(4, np.float32)),
+                              axis=0, index=1)
+    assert _np(v)[1].sum() == 0
+    d = paddle.diagonal_scatter(
+        paddle.to_tensor(np.zeros((3, 3), np.float32)),
+        paddle.to_tensor(np.ones(3, np.float32)))
+    np.testing.assert_allclose(_np(d), np.eye(3))
+
+
+def test_losses_and_metrics():
+    rng = np.random.RandomState(3)
+    logits = rng.randn(8, 5).astype(np.float32)
+    labels = rng.randint(0, 5, 8).astype(np.int32)
+    acc = _np(paddle.metric.auc(
+        paddle.to_tensor(np.abs(rng.rand(16)).astype(np.float32)),
+        paddle.to_tensor(rng.randint(0, 2, 16).astype(np.float32))))
+    assert 0.0 <= float(acc) <= 1.0
+    h = _np(paddle.nn.functional.huber_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(logits * 0.5),
+        delta=1.0))
+    assert np.isfinite(h)
+    # ctc_loss sanity: loss positive and finite
+    T, B, C, L = 12, 2, 6, 4
+    lp = paddle.to_tensor(
+        np.log(np.random.RandomState(4).dirichlet(np.ones(C), (T, B))
+               .astype(np.float32)))
+    lab = paddle.to_tensor(
+        np.random.RandomState(5).randint(1, C, (B, L)).astype(np.int32))
+    il = paddle.to_tensor(np.full((B,), T, np.int64))
+    ll = paddle.to_tensor(np.full((B,), L, np.int64))
+    loss = paddle.nn.functional.ctc_loss(lp, lab, il, ll)
+    assert float(_np(loss)) > 0
+
+
+def test_grid_sample_and_affine_grid():
+    # identity affine transform must reproduce the input
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    theta = paddle.to_tensor(
+        np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32))
+    grid = paddle.nn.functional.affine_grid(theta, out_shape=[1, 1, 4, 4])
+    out = paddle.nn.functional.grid_sample(paddle.to_tensor(x), grid)
+    np.testing.assert_allclose(_np(out), x, atol=1e-5)
+
+
+def test_frame_overlap_add_roundtrip():
+    rng = np.random.RandomState(6)
+    x = rng.randn(32).astype(np.float32)
+    fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                             hop_length=8)
+    back = paddle.signal.overlap_add(fr, hop_length=8)
+    np.testing.assert_allclose(_np(back), x, atol=1e-6)
+
+
+def test_segment_ops():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1, 1], np.int32))
+    np.testing.assert_allclose(_np(paddle.incubate.segment_sum(x, ids)),
+                               [3.0, 7.0])
+    np.testing.assert_allclose(_np(paddle.incubate.segment_mean(x, ids)),
+                               [1.5, 3.5])
+    np.testing.assert_allclose(_np(paddle.incubate.segment_max(x, ids)),
+                               [2.0, 4.0])
+
+
+def test_functional_optimizer_kernels():
+    p = paddle.to_tensor(np.ones(4, np.float32))
+    g = paddle.to_tensor(np.full(4, 0.5, np.float32))
+    out = paddle.incubate.sgd_update(p, g, lr=0.1)
+    np.testing.assert_allclose(_np(out), 0.95)
+    m = paddle.to_tensor(np.zeros(4, np.float32))
+    v = paddle.to_tensor(np.zeros(4, np.float32))
+    p2, m2, v2 = paddle.incubate.adam_update(p, g, m, v, lr=0.1, step=1)
+    assert _np(p2).shape == (4,) and np.isfinite(_np(p2)).all()
+    # spmd binding from the YAML hook
+    from paddle_tpu.distributed.auto_parallel import spmd_rules as sr
+    assert sr.rule_for_op("adam_update") is sr._RULES["adam"]
+
+
+def test_edit_distance_and_gather_tree():
+    hyp = paddle.to_tensor(np.array([[1, 2, 3]], np.int32))
+    ref = paddle.to_tensor(np.array([[1, 3, 3]], np.int32))
+    d = paddle.edit_distance(hyp, ref, normalized=False)
+    np.testing.assert_allclose(_np(d), [1.0])
+    ids = paddle.to_tensor(np.array(
+        [[[1, 2]], [[3, 4]], [[5, 6]]], np.int32))     # [T=3, B=1, W=2]
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[0, 0]], [[0, 1]]], np.int32))
+    out = _np(paddle.gather_tree(ids, parents))
+    assert out.shape == (3, 1, 2)
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 3, 5])
+
+
+def test_roi_align_and_nms():
+    x = paddle.to_tensor(np.arange(16, np.float32).reshape(1, 1, 4, 4)
+                         if False else
+                         np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 4.0, 4.0]], np.float32))
+    bn = paddle.to_tensor(np.array([1], np.int32))
+    out = paddle.vision.ops.roi_align(x, boxes, bn, pooled_height=2,
+                                      pooled_width=2, aligned=False)
+    assert _np(out).shape == (1, 1, 2, 2)
+    assert np.isfinite(_np(out)).all()
+    b = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+    s = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    keep = _np(paddle.vision.ops.nms(b, s, iou_threshold=0.5))
+    assert 0 in keep and 2 in keep and 1 not in keep
+
+
+def test_generated_grad_flows():
+    """Generated ops differentiate through jax.vjp like hand-written."""
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = paddle.gammaln(x)
+    y.sum().backward()
+    from scipy.special import digamma
+    np.testing.assert_allclose(_np(x.grad), digamma([1.0, 2.0, 3.0]),
+                               rtol=1e-4)
+
+
+def test_unique_consecutive_eager():
+    x = paddle.to_tensor(np.array([1, 1, 2, 2, 2, 3, 1], np.int32))
+    out = paddle.unique_consecutive(x)
+    np.testing.assert_array_equal(_np(out), [1, 2, 3, 1])
+    u, inv, cnt = paddle.unique_consecutive(x, return_inverse=True,
+                                            return_counts=True)
+    np.testing.assert_array_equal(_np(cnt), [2, 3, 1, 1])
+
+
+def test_viterbi_matches_brute_force():
+    import itertools
+    rng = np.random.RandomState(0)
+    pot = rng.randn(1, 4, 3).astype(np.float32)
+    trans = rng.randn(3, 3).astype(np.float32)
+    best, bests = None, None
+    for path in itertools.product(range(3), repeat=4):
+        s = pot[0, 0, path[0]] + sum(
+            trans[path[t - 1], path[t]] + pot[0, t, path[t]]
+            for t in range(1, 4))
+        if best is None or s > best:
+            best, bests = s, path
+    sc, p = paddle.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(np.array([4])), include_bos_eos_tag=False)
+    np.testing.assert_array_equal(_np(p)[0], list(bests))
+    np.testing.assert_allclose(float(_np(sc)[0]), best, rtol=1e-5)
+
+
+def test_lu_unpack_batched_reconstructs():
+    import jax
+    import jax.scipy.linalg as jsl
+    rng = np.random.RandomState(1)
+    a = rng.randn(2, 4, 4).astype(np.float32)
+    lu, piv = jax.vmap(jsl.lu_factor)(a)
+    P, L, U = paddle.linalg.lu_unpack(
+        paddle.to_tensor(np.asarray(lu)),
+        paddle.to_tensor(np.asarray(piv) + 1))
+    rec = _np(P) @ _np(L) @ _np(U)
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+
+
+def test_sequence_mask_default_maxlen():
+    m = paddle.sequence_mask(paddle.to_tensor(np.array([2, 3])))
+    np.testing.assert_array_equal(
+        _np(m), [[True, True, False], [True, True, True]])

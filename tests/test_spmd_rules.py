@@ -331,3 +331,156 @@ def test_cross_mesh_reshard():
     # and back again with a different placement
     back = dist.reshard(out, mesh_a, [dist.Shard(1), dist.Replicate()])
     np.testing.assert_array_equal(np.asarray(back._value), x)
+
+
+# ---------------------------------------------------------------- new rules
+def _attr(*dm, partial=()):
+    return DistAttr(list(dm), partial)
+
+
+def test_squeeze_unsqueeze_rules():
+    ins, out = infer_spmd("squeeze", _attr(0, -1, 1), axis=1)
+    assert out.dims_mapping == [0, 1]
+    ins, out = infer_spmd("unsqueeze", _attr(0, 1), axis=1)
+    assert out.dims_mapping == [0, -1, 1]
+
+
+def test_slice_stack_tile_rules():
+    ins, out = infer_spmd("slice", _attr(0, 1), axes=[1])
+    assert out.dims_mapping == [0, -1] and ins[0].dims_mapping == [0, -1]
+    ins, out = infer_spmd("stack", [_attr(0, -1), _attr(-1, 1)], axis=0)
+    assert out.dims_mapping == [-1, 0, 1]
+    ins, out = infer_spmd("tile", _attr(0, 1), repeat_times=[1, 2])
+    assert out.dims_mapping == [0, -1] and ins[0].dims_mapping == [0, -1]
+
+
+def test_gather_scatter_rules():
+    ins, out = infer_spmd("gather", _attr(0, 1), _attr(-1), axis=0)
+    assert ins[0].dims_mapping == [-1, 1]
+    assert out.dims_mapping == [-1, 1]
+    ins, out = infer_spmd("scatter", _attr(0, 1), _attr(-1), _attr(-1, -1),
+                          axis=0)
+    assert ins[0].dims_mapping == [-1, 1]
+    assert out.dims_mapping == [-1, 1]
+
+
+def test_cumsum_dropout_rules_resolve_partial():
+    ins, out = infer_spmd("cumsum", _attr(0, 1, partial=[2]), axis=1)
+    assert out.dims_mapping == [0, -1] and not ins[0].partial_dims
+    ins, out = infer_spmd("dropout", _attr(0, -1, partial=[1]))
+    assert not ins[0].partial_dims and out.dims_mapping == [0, -1]
+
+
+def test_rms_norm_fused_rope_rules():
+    ins, out = infer_spmd("rms_norm", _attr(0, 1, 2), _attr(2),
+                          begin_norm_axis=2)
+    assert out.dims_mapping == [0, 1, -1]
+    assert ins[1].dims_mapping == [-1]
+    ins, outs = infer_spmd("fused_rope", _attr(0, 1, 2, -1),
+                           _attr(0, -1, 2, -1))
+    assert outs[0].dims_mapping == [0, -1, 2, -1]
+    assert outs[1].dims_mapping == [0, -1, 2, -1]
+
+
+def test_topk_sort_argmax_rules():
+    ins, outs = infer_spmd("topk", _attr(0, 1), k=2, axis=1)
+    assert outs[0].dims_mapping == [0, -1]
+    ins, out = infer_spmd("sort", _attr(0, 1), axis=0)
+    assert out.dims_mapping == [-1, 1]
+    ins, out = infer_spmd("argmax", _attr(0, 1), axis=1)
+    assert out.dims_mapping == [0]
+
+
+def test_pad_flip_roll_triu_rules():
+    ins, out = infer_spmd("pad", _attr(0, 1), paddings=[0, 0, 1, 1])
+    assert out.dims_mapping == [0, -1]
+    ins, out = infer_spmd("flip", _attr(0, 1), axis=0)
+    assert out.dims_mapping == [-1, 1]
+    ins, out = infer_spmd("roll", _attr(0, 1), shifts=1, axis=1)
+    assert out.dims_mapping == [0, -1]
+    ins, out = infer_spmd("triu", _attr(0, 1, 2))
+    assert out.dims_mapping == [0, -1, -1]
+
+
+def test_optimizer_update_rules():
+    ins, out = infer_spmd("adam", _attr(0, -1), _attr(-1, 1),
+                          _attr(-1, -1), _attr(-1, -1))
+    assert out.dims_mapping == [0, 1]
+    assert all(i.dims_mapping == [0, 1] for i in ins)
+    ins, out = infer_spmd("sgd", _attr(0), _attr(-1, ))
+    assert out.dims_mapping == [0]
+
+
+def test_where_one_hot_unbind_take_rules():
+    ins, out = infer_spmd("where", _attr(0, -1), _attr(-1, 1), _attr(-1, -1))
+    assert out.dims_mapping == [0, 1]
+    ins, out = infer_spmd("one_hot", _attr(0, 1), num_classes=8)
+    assert out.dims_mapping == [0, 1, -1]
+    ins, out = infer_spmd("unbind", _attr(0, 1), axis=0)
+    assert out.dims_mapping == [1]
+    ins, out = infer_spmd("take_along_axis", _attr(0, 1), _attr(0, -1),
+                          axis=1)
+    assert out.dims_mapping == [0, -1]
+
+
+# --------------------------------------------- property tests: rule vs GSPMD
+def _gspmd_decision(fn, in_attrs, shapes, mesh_axes=("dp", "mp")):
+    """Lay inputs out per the rule's INFERRED attrs, jit with no output
+    constraint, and return the output dims_mapping GSPMD chose."""
+    n = 4
+    devs = np.array(jax.devices()[:n]).reshape(2, 2)
+    mesh = Mesh(devs, mesh_axes)
+    args = []
+    for attr, shape in zip(in_attrs, shapes):
+        spec = P(*[mesh_axes[d] if d != -1 else None
+                   for d in attr.dims_mapping])
+        x = jnp.arange(np.prod(shape), dtype=jnp.float32).reshape(shape)
+        args.append(jax.device_put(x, NamedSharding(mesh, spec)))
+    out = jax.jit(fn)(*args)
+    spec = out.sharding.spec if hasattr(out.sharding, "spec") else ()
+    got = []
+    for i in range(out.ndim):
+        ax = spec[i] if i < len(spec) else None
+        got.append(-1 if ax is None else mesh_axes.index(ax))
+    return got
+
+
+@pytest.mark.parametrize("case", [
+    ("transpose", lambda x: jnp.transpose(x, (1, 0)),
+     [_attr(0, 1)], [(8, 8)], {"perm": (1, 0)}),
+    ("unsqueeze", lambda x: x[:, None, :],
+     [_attr(0, 1)], [(8, 8)], {"axis": 1}),
+    ("squeeze", lambda x: x[:, 0, :],
+     [_attr(0, -1, 1)], [(8, 1, 8)], {"axis": 1}),
+    ("one_hot", lambda x: jax.nn.one_hot(x.astype(jnp.int32), 4),
+     [_attr(0, 1)], [(8, 8)], {"num_classes": 4}),
+])
+def test_rule_matches_gspmd_decision(case):
+    """The rule's predicted output placement must match XLA's actual
+    propagation on the virtual mesh for shard-preserving ops."""
+    name, fn, attrs, shapes, kw = case
+    ins, out = infer_spmd(name, *attrs, **kw)
+    got = _gspmd_decision(fn, ins if isinstance(ins, list) else [ins],
+                          shapes)
+    want = out.dims_mapping
+    assert got == want, (name, got, want)
+
+
+def test_elementwise_matches_gspmd():
+    ins, out = infer_spmd("elementwise", _attr(0, -1), _attr(-1, 1))
+    got = _gspmd_decision(lambda a, b: a + b, ins, [(8, 8), (8, 8)])
+    assert got == out.dims_mapping
+
+
+def test_reduction_partial_matches_gspmd_allreduce():
+    """A linear reduction over a sharded axis: the rule says 'partial over
+    that mesh dim'; GSPMD realizes it as an immediate all-reduce — the
+    VALUES must equal the unsharded reduction."""
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    ins, out = infer_spmd("reduction", _attr(-1, 1), axis=1)
+    assert out.partial_dims == {1}
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "mp"))
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(None, "mp")))
+    got = jax.jit(lambda v: v.sum(1))(xs)
+    np.testing.assert_allclose(np.asarray(got), x.sum(1))
