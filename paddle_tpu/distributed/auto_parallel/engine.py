@@ -179,7 +179,8 @@ class Engine:
             return self._build_step(mode, n_inputs)
         if key not in self._compiled:
             from ...jit import to_static
-            self._compiled[key] = to_static(self._build_step(mode, n_inputs))
+            self._compiled[key] = to_static(
+                self._build_step(mode, n_inputs), full_graph=True)
         return self._compiled[key]
 
     # ------------------------------------------------------------ user API

@@ -146,3 +146,79 @@ def reshard_partial(pt: PartialTensor, dst: Placement) -> Tensor:
     """Materialize a PartialTensor under the destination placement."""
     fn = get_reshard_fn(Partial(), dst)
     return Tensor._wrap(fn(pt, dst))
+
+
+@register_reshard("r", "p")
+def r_to_p(val, dst: Placement, mesh=None, axis_name=None, **kw):
+    """Replicated -> pending-sum: rank 0 of the axis keeps the value,
+    every other rank holds zeros, so a later p->r restores the original
+    (`r_to_p_reshard_function.cc` semantics).  The unreduced stack is
+    laid out dim-0-sharded over the axis (PartialTensor's contract: one
+    slice per rank, not n replicated copies)."""
+    n = mesh.shape[axis_name]
+    tiles = jnp.stack([val] + [jnp.zeros_like(val)] * (n - 1))
+    tiles = _move(tiles, NamedSharding(
+        mesh, P(axis_name, *([None] * val.ndim))))
+    return PartialTensor(tiles, mesh, axis_name)
+
+
+def nd_mesh_reshard(value, mesh, src_placements, dst_placements,
+                    mesh_dim_names=None):
+    """Reshard over an N-D mesh by decomposing into per-axis pairwise
+    steps (`nd_mesh_reshard_function.cc`: SetVirtualMeshDim + one 1-D
+    reshard per changed axis).
+
+    value: jax array laid out per `src_placements` (one Placement per
+    mesh axis).  Returns the array laid out per `dst_placements`.
+    Partial placements are handled first (p->r / p->s on their axis),
+    then shard/replicate changes axis by axis — the same ordering the
+    reference uses so intermediate layouts stay materializable."""
+    names = list(mesh_dim_names or mesh.axis_names)
+    assert len(src_placements) == len(names) == len(dst_placements)
+
+    def spec_of(placements):
+        entries = [None] * value.ndim
+        for ax_name, p in zip(names, placements):
+            if _kind(p) == "s":
+                d = p.get_dim()
+                if entries[d] is None:
+                    entries[d] = ax_name
+                elif isinstance(entries[d], tuple):
+                    entries[d] = entries[d] + (ax_name,)
+                else:
+                    entries[d] = (entries[d], ax_name)
+        return P(*entries)
+
+    cur = list(src_placements)
+    # phase 1: resolve partials (their axis must reduce before any
+    # shard-dim juggling references the true values)
+    for i, (s, d) in enumerate(zip(list(cur), dst_placements)):
+        if _kind(s) == "p" and _kind(d) != "p":
+            psum_axis = names[i]
+            # value carries an unreduced leading stack only inside
+            # PartialTensor flows; at the jax-array level a partial axis
+            # means "sum over replicas of that axis" — express it as a
+            # shard_map psum over the axis
+            in_spec = spec_of(cur)
+            mid = list(cur)
+            mid[i] = Replicate()
+            out_spec = spec_of(mid)
+            value = jax.jit(jax.shard_map(
+                lambda x: jax.lax.psum(x, psum_axis), mesh=mesh,
+                in_specs=in_spec, out_specs=out_spec,
+                check_vma=False))(value)
+            cur = mid
+    # phase 2: one GSPMD relayout per remaining changed axis
+    for i, d in enumerate(dst_placements):
+        if _kind(cur[i]) == _kind(d) and (
+                _kind(d) != "s" or cur[i].get_dim() == d.get_dim()):
+            continue
+        if _kind(d) == "p":
+            raise NotImplementedError(
+                "nd reshard to a Partial placement (x->p) is not a "
+                "materializable layout; reshard to r or s instead")
+        step = list(cur)
+        step[i] = d
+        value = _move(value, NamedSharding(mesh, spec_of(step)))
+        cur = step
+    return value

@@ -11,3 +11,6 @@ step), and the same divisibility/memory prune rules cut the space first.
 from .tuner import AutoTuner, Trial, default_candidates, prune_by_memory
 
 __all__ = ["AutoTuner", "Trial", "default_candidates", "prune_by_memory"]
+from .cost_model import (Hardware, ModelSpec, estimate_memory,  # noqa: F401
+                         estimate_params, estimate_step_time,
+                         prune_by_model, rank_candidates)

@@ -36,12 +36,14 @@ class ElasticManager:
     """
 
     def __init__(self, store: TCPStore, node_id: int, nnodes: int,
-                 generation: int = 0, interval: float = 2.0):
+                 generation: int = 0, interval: float = 2.0,
+                 min_nodes: int = 0):
         self.store = store
         self.node_id = node_id
         self.nnodes = nnodes
         self.generation = generation
         self.interval = interval
+        self.min_nodes = min_nodes  # elastic lower bound (0 = fixed size)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -84,8 +86,93 @@ class ElasticManager:
 
     def status(self) -> ElasticStatus:
         dead = self.dead_nodes()
+        alive = self.nnodes - len(dead)
         if not dead:
             return ElasticStatus.COMPLETED
-        if len(dead) == self.nnodes:
+        if alive == 0:
             return ElasticStatus.EXIT
+        if self.min_nodes and alive < self.min_nodes:
+            return ElasticStatus.HOLD  # wait for replacements to join
         return ElasticStatus.RESTART
+
+    # ------------------------------------------------- membership registry
+    # Parity: the reference's etcd node registry (`elastic/manager.py:124`
+    # — np_path node entries, watch callbacks, endpoint rewriting).  The
+    # TCPStore plays etcd: nodes JOIN by taking an id off an atomic
+    # counter and publishing their endpoint; the launcher COLLECTS the
+    # roster, and `watch()` fires on membership change so the launcher
+    # can re-rendezvous with a rewritten endpoint list.
+
+    def _node_key(self, node: int) -> str:
+        return f"nodes/{self.generation}/{node}"
+
+    def register(self, endpoint: str) -> None:
+        """Publish this node's endpoint in the current generation, and
+        advance the id counter past ours so later join()ers never collide
+        with a statically-assigned id."""
+        self.store.set(self._node_key(self.node_id), endpoint.encode())
+        counter = f"nodes/{self.generation}/next_id"
+        cur = self.store.add(counter, 0)
+        if cur < self.node_id + 1:
+            # atomic increments only: overshoot under races just skips ids
+            self.store.add(counter, self.node_id + 1 - cur)
+
+    def join(self, endpoint: str) -> int:
+        """A NEW node (scale-up / replacement) takes the next free node id
+        and registers; returns the assigned id."""
+        self.node_id = self.store.add(
+            f"nodes/{self.generation}/next_id", 1) - 1
+        self.nnodes = max(self.nnodes, self.node_id + 1)
+        self.register(endpoint)
+        return self.node_id
+
+    def endpoints(self) -> List[str]:
+        """The registered endpoint roster (index = node id; '' = absent)."""
+        out = []
+        for n in range(self.nnodes):
+            k = self._node_key(n)
+            out.append(self.store.get(k).decode()
+                       if self.store.check(k) else "")
+        return out
+
+    def collect_endpoints(self, timeout: float = 60.0) -> List[str]:
+        """Block until every node has registered; returns the roster (the
+        rendezvous the launcher turns into PADDLE_TRAINER_ENDPOINTS)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            eps = self.endpoints()
+            if all(eps):
+                return eps
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"elastic rendezvous: only {sum(bool(e) for e in self.endpoints())}"
+            f"/{self.nnodes} nodes registered within {timeout}s")
+
+    def next_generation(self) -> int:
+        """Advance to a fresh generation (after a membership change the
+        launcher re-rendezvouses under the new namespace — the endpoint
+        REWRITE: survivors re-register, replacements join)."""
+        self.generation += 1
+        return self.generation
+
+    def watch(self, on_change, poll: float = 1.0) -> threading.Event:
+        """Daemon watch loop: calls `on_change(dead_nodes, endpoints)`
+        whenever the dead set or the roster changes (the reference's etcd
+        watch).  Returns the Event that stops the loop."""
+        stop = threading.Event()
+        state = {"dead": None, "eps": None}
+
+        def loop():
+            while not stop.wait(poll):
+                dead = tuple(self.dead_nodes())
+                eps = tuple(self.endpoints())
+                if dead != state["dead"] or eps != state["eps"]:
+                    changed = state["dead"] is not None
+                    state["dead"], state["eps"] = dead, eps
+                    if changed:
+                        try:
+                            on_change(list(dead), list(eps))
+                        except Exception:  # noqa: BLE001 - watcher survives
+                            pass
+        threading.Thread(target=loop, daemon=True).start()
+        return stop

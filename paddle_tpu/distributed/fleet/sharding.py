@@ -14,6 +14,14 @@ schedule:
   use sites (allgather-on-use exactly like GroupSharedStage3's hooks).
 The explicit bucketing/overlap machinery of the reference is XLA's
 latency-hiding scheduler's job.
+
+Offload (the reference's ZeRO-Offload `offload=True`): optimizer state
+LIVES in host memory between steps via jax's `memory_kind="pinned_host"`
+shardings; `step()` stages it to device for the update and back after —
+the TPU-native equivalent of the reference's CPU-side Adam.  Offload is
+an EAGER-path feature (the per-step host<->device staging is the cost
+model); under `to_static` capture use plain stage 1-3 sharding, which
+keeps state in HBM.
 """
 
 from __future__ import annotations
@@ -82,10 +90,12 @@ class DygraphShardingOptimizer:
     """ZeRO-1 wrapper: delegates to the inner optimizer but lays out every
     accumulator sharded over the 'sharding' axis."""
 
-    def __init__(self, optimizer: Optimizer, hcg=None, stage: int = 1):
+    def __init__(self, optimizer: Optimizer, hcg=None, stage: int = 1,
+                 offload: bool = False):
         self._inner = optimizer
         self._hcg = hcg
         self._stage = stage
+        self._offload = offload
         # intercept accumulator creation
         orig_get_state = optimizer._get_state
 
@@ -127,10 +137,36 @@ class DygraphShardingOptimizer:
                 p.grad._value = jax.lax.with_sharding_constraint(
                     p.grad._value, sh)
 
+    def _migrate_state(self, memory_kind):
+        """Move every accumulator to `memory_kind` (None = the backend's
+        default device memory), keeping its mesh layout."""
+        target = memory_kind or jax.local_devices()[0].default_memory().kind
+        for accs in self._inner._accumulators.values():
+            for key, arr in list(accs.items()):
+                sh = getattr(arr, "sharding", None)
+                if sh is None or getattr(sh, "memory_kind", None) == target:
+                    continue
+                if isinstance(sh, NamedSharding):
+                    new_sh = NamedSharding(sh.mesh, sh.spec,
+                                           memory_kind=target)
+                else:
+                    new_sh = jax.sharding.SingleDeviceSharding(
+                        jax.local_devices()[0], memory_kind=target)
+                accs[key] = jax.device_put(arr, new_sh)
+
     def step(self):
         if self._stage >= 2:
             self._shard_grads()
-        self._inner.step()
+        if self._offload:
+            # the state LIVES in host memory between steps (ZeRO-Offload,
+            # ref group_sharded_stage3.py offload=True): stage it into
+            # device memory for the update, push it back after — the
+            # device-resident window is one step's worth of state
+            self._migrate_state(None)
+            self._inner.step()
+            self._migrate_state("pinned_host")
+        else:
+            self._inner.step()
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
@@ -142,10 +178,6 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     `group` selects the sharding axis group (default hybrid topology)."""
 
     def __init__(self, params, optim, group=None, offload=False, **kwargs):
-        if offload:
-            raise NotImplementedError(
-                "CPU offload: PJRT owns placement; use ZeRO-3 "
-                "(level='p_g_os') to shard parameters instead")
         if group is not None:
             raise NotImplementedError(
                 "custom sharding groups: the TPU build shards over the "
@@ -159,7 +191,7 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
                 f"{len(missing)} params passed to "
                 "GroupShardedOptimizerStage2 are not held by the inner "
                 "optimizer")
-        super().__init__(optim, stage=2)
+        super().__init__(optim, stage=2, offload=offload)
 
 
 def apply_stage3_param_sharding(layer):
@@ -185,5 +217,6 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
     if stage == 3:
         apply_stage3_param_sharding(model)
-    opt = DygraphShardingOptimizer(optimizer, stage=min(stage, 2))
+    opt = DygraphShardingOptimizer(optimizer, stage=min(stage, 2),
+                                   offload=offload)
     return model, opt, scaler

@@ -59,6 +59,15 @@ def _from_host(obj, return_numpy=False):
 
 
 def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """Serialize `obj` (nested dicts/lists of Tensors/arrays/...) to a
+    path OR a writable file-like object (reference io.py:723 accepts
+    both; BytesIO round-trips support in-memory checkpoint shipping)."""
+    if not isinstance(protocol, int) or not 2 <= protocol <= 5:
+        raise ValueError(f"protocol must be 2..5, got {protocol!r}")
+    if hasattr(path, "write"):
+        path.write(_MAGIC)
+        pickle.dump(_to_host(obj), path, protocol=protocol)
+        return
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -67,10 +76,17 @@ def save(obj: Any, path: str, protocol: int = 4, **configs):
         pickle.dump(_to_host(obj), f, protocol=protocol)
 
 
-def load(path: str, return_numpy: bool = False, **configs) -> Any:
-    with open(path, "rb") as f:
-        head = f.read(len(_MAGIC))
-        if head != _MAGIC:
-            f.seek(0)
-        obj = pickle.load(f)
+def _load_stream(f, return_numpy):
+    head = f.read(len(_MAGIC))
+    if head != _MAGIC:
+        f.seek(0)
+    obj = pickle.load(f)
     return _from_host(obj, return_numpy)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    """Load from a path or a readable file-like object."""
+    if hasattr(path, "read"):
+        return _load_stream(path, return_numpy)
+    with open(path, "rb") as f:
+        return _load_stream(f, return_numpy)

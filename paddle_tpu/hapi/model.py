@@ -175,7 +175,8 @@ class Model:
         if self._jit_compile and not eager_needed:
             if key not in self._compiled:
                 from ..jit import to_static
-                self._compiled[key] = to_static(self._mode_fn(mode))
+                self._compiled[key] = to_static(self._mode_fn(mode),
+                                                full_graph=True)
             fn = self._compiled[key]
         else:
             fn = self._mode_fn(mode)

@@ -1,0 +1,86 @@
+"""Offline optimization passes over saved inference artifacts.
+
+Parity: the reference's save-side conversion utilities —
+`paddle.inference.convert_to_mixed_precision`
+(`python/paddle/inference/__init__.py`) and the analysis passes of
+`fluid/inference/api/analysis_predictor.h:100`.
+
+TPU-native split of responsibilities: graph-level passes the reference
+runs in its analysis pipeline (constant folding, fusion, layout) are
+XLA's job at predictor compile time — the StableHLO artifact is opaque
+and re-optimizing it by hand would fight the compiler.  What remains
+OURS is the artifact itself: parameter precision.  These passes rewrite
+the saved `.pdiparams.npz` (weights) and record the conversion in
+`.pdmeta.json`; `TranslatedLayer` casts at the call boundary, so the
+serving program keeps its exported signature while weights occupy half
+(bf16/fp16) the HBM — the weight side of the reference's
+mixed-precision conversion.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["convert_to_mixed_precision"]
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+           "float32": jnp.float32}
+
+
+def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
+                               mixed_precision: str = "bfloat16",
+                               backend: str = "tpu",
+                               keep_io_types: bool = True,
+                               black_list=None) -> None:
+    """Rewrite a `jit.save` artifact with reduced-precision weights.
+
+    Parity: `paddle.inference.convert_to_mixed_precision(src_model,
+    src_params, dst_model, dst_params, precision, backend, keep_io_types,
+    black_list)` — collapsed to prefix paths (our artifacts derive from
+    one prefix).  `black_list`: parameter-name substrings kept at fp32
+    (e.g. norm scales)."""
+    dtype = _DTYPES[mixed_precision]
+    black_list = list(black_list or [])
+    with open(src_prefix + ".pdmeta.json") as f:
+        meta = json.load(f)
+    if meta.get("weight_precision"):
+        raise ValueError(
+            f"artifact {src_prefix!r} is already precision-converted "
+            f"(weight_precision={meta['weight_precision']!r}); convert "
+            "from the original full-precision artifact")
+    keys = meta["param_keys"]
+    with np.load(src_prefix + ".pdiparams.npz") as z:
+        vals = [np.asarray(z[str(i)]) for i in range(len(z.files))]
+    out = []
+    converted_flags = []
+    converted = 0
+    for key, v in zip(keys, vals):
+        skip = any(b in key for b in black_list)
+        if not skip and np.issubdtype(v.dtype, np.floating) \
+                and v.dtype == np.float32:
+            c = np.asarray(jnp.asarray(v).astype(dtype))
+            if mixed_precision == "bfloat16":
+                # numpy has no bfloat16: store the uint16 bit pattern,
+                # TranslatedLayer bitcasts back at load
+                c = c.view(np.uint16)
+            out.append(c)
+            converted_flags.append(True)
+            converted += 1
+        else:
+            out.append(v)
+            converted_flags.append(False)
+    np.savez(dst_prefix + ".pdiparams.npz",
+             **{str(i): v for i, v in enumerate(out)})
+    meta["weight_precision"] = mixed_precision
+    meta["weight_precision_converted"] = converted
+    # explicit per-param flags: a param whose ORIGINAL dtype happens to
+    # equal the target precision must not be confused with a converted one
+    meta["param_converted"] = converted_flags
+    with open(dst_prefix + ".pdmeta.json", "w") as f:
+        json.dump(meta, f)
+    if src_prefix != dst_prefix:
+        shutil.copyfile(src_prefix + ".pdmodel", dst_prefix + ".pdmodel")

@@ -150,10 +150,15 @@ class StaticFunction:
     per-signature program cache with rollback-safe capture."""
 
     def __init__(self, function: Callable, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True, donate_state: bool = True):
-        self._fn = function
+                 backend=None, full_graph=False, donate_state: bool = True):
+        # dy2static pass: rewrite tensor-dependent if/while into
+        # lax.cond/while_loop converters (no-op when nothing converts)
+        from . import dy2static as _d2s
+        self._fn = _d2s.convert_function(function)
         self._cache: Dict[Any, Any] = {}
         self._donate_state = donate_state
+        self._full_graph = full_graph
+        self._broken_keys: set = set()
         self.__name__ = getattr(function, "__name__", "static_fn")
 
     # -------------------------------------------------------------- helpers
@@ -221,7 +226,14 @@ class StaticFunction:
                         store[key] = p._value.astype(jnp.float32) \
                             if p is not None else store[key]
                     else:
-                        store[key] = jnp.zeros_like(store[key])
+                        arr = store[key]
+                        z = jnp.zeros(arr.shape, arr.dtype)
+                        # zeros_like on a non-default-memory array (e.g.
+                        # pinned_host offloaded state) trips XLA's memory-
+                        # space check; build zeros then copy the placement
+                        if hasattr(arr, "sharding"):
+                            z = jax.device_put(z, arr.sharding)
+                        store[key] = z
                     slots.append(_DictSlot(store, key))
                     changed.append(True)
             o._global_step = gstep
@@ -299,7 +311,46 @@ class StaticFunction:
         return {"slots": slots, "mutable_idx": mutable_idx,
                 "readonly_idx": readonly_idx, "jitted": jitted, "spec": spec}
 
+    # errors that mean "this function cannot trace as one graph" (value-
+    # dependent branching / dynamic shapes) — graph-break material, unlike
+    # genuine user errors (bad shapes raise Type/ValueError and propagate)
+    _GRAPH_BREAK_ERRORS = (jax.errors.ConcretizationTypeError,
+                           jax.errors.TracerArrayConversionError,
+                           jax.errors.TracerIntegerConversionError,
+                           jax.errors.NonConcreteBooleanIndexError)
+
+    @property
+    def _graph_break_errors(self):
+        from .dy2static import GraphBreak
+        return self._GRAPH_BREAK_ERRORS + (GraphBreak,)
+
     def __call__(self, *args, **kwargs):
+        key = self._arg_key(args, kwargs)
+        if key in self._broken_keys:
+            return self._fn(*args, **kwargs)
+        try:
+            return self._compiled_call(args, kwargs)
+        except self._graph_break_errors as e:
+            if self._full_graph:
+                raise
+            # graph break for THIS argument signature only: other
+            # signatures keep their compiled programs (the reference's
+            # per-guard fallback-to-dygraph, not a function-wide switch)
+            import warnings
+            warnings.warn(
+                f"to_static({self.__name__}): value-dependent control "
+                f"flow could not be captured ({type(e).__name__}); "
+                "falling back to eager execution for this signature",
+                stacklevel=2)
+            self._broken_keys.add(key)
+            return self._fn(*args, **kwargs)
+
+    @property
+    def _eager_fallback(self):
+        """True when any signature has graph-broken (test/debug hook)."""
+        return bool(self._broken_keys)
+
+    def _compiled_call(self, args, kwargs):
         key = self._arg_key(args, kwargs)
         prog = self._cache.get(key)
         if prog is None:
@@ -367,8 +418,13 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
-    """paddle.jit.to_static equivalent: whole-graph XLA capture."""
+              backend=None, full_graph=False, **kwargs):
+    """paddle.jit.to_static equivalent: whole-graph XLA capture.
+
+    full_graph=False (the reference's modern default) allows GRAPH BREAKS:
+    a function whose control flow can't be captured — after the dy2static
+    AST pass has converted what it can — runs eagerly with a warning
+    instead of raising.  full_graph=True restores the hard error."""
     def deco(fn):
         if hasattr(fn, "forward") and not callable(fn):  # pragma: no cover
             raise TypeError("pass a function or Layer")

@@ -1,0 +1,432 @@
+"""Dynamic-to-static control-flow conversion (AST rewrite).
+
+Parity: `python/paddle/jit/dy2static/program_translator.py` and the
+transformer pipeline under `jit/dy2static/transformers/` — paddle
+rewrites Python `if`/`while` whose condition is a Tensor into
+`cond`/`while_loop` layer calls so data-dependent control flow survives
+graph capture; SOT (`jit/sot/translate.py`) adds guarded bytecode
+capture with graph breaks.
+
+TPU-native redesign: the rewrite targets `jax.lax.cond` /
+`jax.lax.while_loop`.  Each `if`/`while` statement becomes a call to a
+runtime converter that decides per execution:
+
+* condition is a plain Python value / concrete Tensor -> run the normal
+  Python branch (zero overhead, exact eager semantics);
+* condition is a TRACED Tensor (inside `to_static` capture) -> pack the
+  branch-assigned locals into a state tuple and lower to
+  `lax.cond` / `lax.while_loop`.
+
+Conversion is a best-effort subset (single-target assignments; no
+return/break/continue inside converted bodies — those statements leave
+the region as plain Python).  Anything the subset can't convert falls
+back to the untransformed function; if tracing then hits a
+value-dependent branch, `to_static` takes a GRAPH BREAK: the call runs
+eagerly (correct, uncompiled) with a one-time warning — the reference's
+fallback-to-dygraph behavior, not a hard error.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["convert_function", "convert_ifelse", "convert_while",
+           "UNDEF", "ensure_bound"]
+
+
+class _Undefined:
+    """Placeholder for names unbound before a converted branch (paddle's
+    UndefinedVar): reading one out of a branch that never assigned it
+    raises the NameError the original code would have."""
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def ensure_bound(local_vars, name):
+    """`name = ensure_bound(vars(), 'name')` — binds UNDEF when the name
+    wasn't defined before a converted region."""
+    return local_vars.get(name, UNDEF)
+
+
+class GraphBreak(Exception):
+    """Raised when a converted region can't lower to lax control flow
+    (e.g. branches disagree in non-tensor state); `to_static` treats it
+    like a trace failure and falls back to eager execution."""
+
+
+# ----------------------------------------------------------- state packing
+def _pack(state):
+    """State tuple -> (array leaves, meta).  Tensors unwrap to their
+    arrays; Python numbers become arrays (they may differ across
+    branches/iterations); anything else is 'static' and must agree
+    across branches."""
+    leaves, meta = [], []
+    for v in state:
+        if isinstance(v, Tensor):
+            leaves.append(v._value)
+            meta.append(("tensor", v.stop_gradient))
+        elif isinstance(v, (bool, int, float)) or hasattr(v, "dtype"):
+            leaves.append(jnp.asarray(v))
+            meta.append(("array", None))
+        else:
+            meta.append(("static", v))
+    return leaves, meta
+
+
+def _rebuild(flat, meta):
+    """Array leaves + meta -> state tuple."""
+    it = iter(flat)
+    out = []
+    for kind, extra in meta:
+        if kind == "tensor":
+            out.append(Tensor._wrap(next(it), stop_gradient=extra))
+        elif kind == "array":
+            out.append(next(it))
+        else:
+            out.append(extra)
+    return tuple(out)
+
+
+def _meta_equal(a, b):
+    if a is None or b is None or len(a) != len(b):
+        return False
+    for (ka, va), (kb, vb) in zip(a, b):
+        if ka != kb:
+            return False
+        if ka == "static":
+            try:
+                if va is not vb and va != vb:
+                    return False
+            except Exception:  # noqa: BLE001 - unorderable statics
+                return False
+    return True
+
+
+def _is_traced(v) -> bool:
+    if isinstance(v, Tensor):
+        v = v._value
+    return isinstance(v, jax.core.Tracer)
+
+
+def _check_consistent(state_in, state_out, what):
+    if len(state_in) != len(state_out):
+        raise GraphBreak(f"{what}: branch changed the number of locals")
+
+
+# ---------------------------------------------------------------- runtimes
+def convert_ifelse(cond, true_fn, false_fn, names, state):
+    """Runtime for a rewritten `if`: state is the tuple of branch-assigned
+    locals (pre-branch values, UNDEF when unbound)."""
+    c = cond._value if isinstance(cond, Tensor) else cond
+    if not _is_traced(c):
+        return true_fn(*state) if bool(c) else false_fn(*state)
+
+    in_leaves, in_meta = _pack(state)
+    out_metas = {}
+
+    def run(branch, tag):
+        def inner(flat):
+            res = branch(*_rebuild(list(flat), in_meta))
+            _check_consistent(state, res, "converted if")
+            l2, m2 = _pack(res)
+            out_metas[tag] = m2  # captured while lax.cond traces the branch
+            return tuple(l2)
+        return inner
+
+    pred = c.astype(bool) if getattr(c, "dtype", None) != jnp.bool_ else c
+    if getattr(pred, "ndim", 0) != 0:
+        pred = pred.reshape(())
+    try:
+        out = jax.lax.cond(pred, run(true_fn, "t"), run(false_fn, "f"),
+                           tuple(in_leaves))
+    except TypeError as e:  # branch output structures differ
+        raise GraphBreak(f"if branches returned mismatched structures: "
+                         f"{e}") from e
+    if not _meta_equal(out_metas.get("t"), out_metas.get("f")):
+        raise GraphBreak("if branches disagree in non-tensor state")
+    return _rebuild(list(out), out_metas["t"])
+
+
+def convert_while(cond_fn, body_fn, names, state):
+    """Runtime for a rewritten `while`."""
+    first = cond_fn(*state)
+    c = first._value if isinstance(first, Tensor) else first
+    if not _is_traced(c):
+        # plain Python loop (concrete condition each iteration)
+        while bool(cond_fn(*state)):
+            new = body_fn(*state)
+            _check_consistent(state, new, "converted while")
+            state = tuple(new)
+        return state
+
+    in_leaves, in_meta = _pack(state)
+
+    def cond_flat(flat):
+        r = cond_fn(*_rebuild(list(flat), in_meta))
+        r = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+        r = r.astype(bool) if r.dtype != jnp.bool_ else r
+        return r.reshape(())
+
+    def body_flat(flat):
+        res = body_fn(*_rebuild(list(flat), in_meta))
+        _check_consistent(state, res, "converted while")
+        l2, m2 = _pack(res)
+        if not _meta_equal(m2, in_meta):
+            raise GraphBreak("while body changed non-tensor state kinds")
+        return tuple(l2)
+
+    try:
+        out = jax.lax.while_loop(cond_flat, body_flat, tuple(in_leaves))
+    except TypeError as e:  # carry structure mismatch
+        raise GraphBreak(f"while carry structure mismatch: {e}") from e
+    return _rebuild(list(out), in_meta)
+
+
+# ----------------------------------------------------------- AST transform
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+        self.blocked = False  # construct outside the subset
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, ast.Tuple):
+            for e in t.elts:
+                self._target(e)
+        # attribute/subscript targets mutate objects in place — the state
+        # tuple can't roll those back; leave the region unconverted
+        elif isinstance(t, (ast.Attribute, ast.Subscript)):
+            self.blocked = True
+
+    def visit_Return(self, node):
+        self.blocked = True
+
+    def visit_Break(self, node):
+        self.blocked = True
+
+    def visit_Continue(self, node):
+        self.blocked = True
+
+    def visit_For(self, node):
+        self._target(node.target)  # loop targets stay bound after the loop
+        self.generic_visit(node)
+
+    def visit_With(self, node):
+        for item in node.items:
+            if item.optional_vars is not None:
+                self._target(item.optional_vars)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):  # walrus
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # nested user defs capture scope — out of subset; defs GENERATED by
+        # an inner conversion (__jst_*) are fine: the surrounding
+        # assignments carry the state
+        if not node.name.startswith("__jst_"):
+            self.blocked = True
+
+    def visit_Lambda(self, node):
+        pass  # lambdas don't assign
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names, v.blocked
+
+
+def _loaded_names(node) -> set:
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            out.add(n.id)
+    return out
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites convertible `if`/`while` statements into runtime calls."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _helper_defs(self, names, body, fn_name):
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load()))
+        return ast.FunctionDef(name=fn_name, args=args,
+                               body=(body or [ast.Pass()]) + [ret],
+                               decorator_list=[], returns=None)
+
+    def _bind_prelude(self, names):
+        # name = __jst_ensure(vars(), 'name') for names possibly unbound
+        stmts = []
+        for n in names:
+            stmts.append(ast.Assign(
+                targets=[ast.Name(id=n, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__jst_ensure", ctx=ast.Load()),
+                    args=[ast.Call(func=ast.Name(id="vars", ctx=ast.Load()),
+                                   args=[], keywords=[]),
+                          ast.Constant(value=n)],
+                    keywords=[])))
+        return stmts
+
+    def _unpack(self, names, call):
+        return ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in names],
+                ctx=ast.Store())],
+            value=call)
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        a1, b1 = _assigned(node.body)
+        a2, b2 = _assigned(node.orelse)
+        names = sorted(a1 | a2)
+        if b1 or b2 or not names:
+            return node
+        self.counter += 1
+        i = self.counter
+        tname, fname = f"__jst_true_{i}", f"__jst_false_{i}"
+        call = ast.Call(
+            func=ast.Name(id="__jst_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  ast.Constant(value=tuple(names)),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        return (self._bind_prelude(names)
+                + [self._helper_defs(names, node.body, tname),
+                   self._helper_defs(names, node.orelse, fname),
+                   self._unpack(names, call)])
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        assigned, blocked = _assigned(node.body)
+        if blocked or not assigned:
+            return node
+        # the state covers the body-mutated names; condition-only reads of
+        # loop invariants close over naturally
+        names = sorted(assigned)
+        self.counter += 1
+        i = self.counter
+        cname, bname = f"__jst_cond_{i}", f"__jst_body_{i}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_def = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None)
+        body_def = self._helper_defs(names, node.body, bname)
+        call = ast.Call(
+            func=ast.Name(id="__jst_while", ctx=ast.Load()),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Constant(value=tuple(names)),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        return (self._bind_prelude(names)
+                + [cond_def, body_def, self._unpack(names, call)])
+
+
+def convert_function(fn: Callable) -> Callable:
+    """Best-effort AST conversion of `fn`'s tensor-dependent control flow.
+    Returns the original function when the source is unavailable or the
+    rewrite produces nothing (no converted regions)."""
+    if inspect.ismethod(fn):
+        # convert the underlying function, rebind to the same instance
+        inner = convert_function(fn.__func__)
+        if inner is fn.__func__:
+            return fn
+        import types
+        return types.MethodType(inner, fn.__self__)
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # decorators already applied to `fn`
+    tr = _ControlFlowTransformer()
+    tr.visit(fdef)
+    if tr.counter == 0:
+        return fn
+    ast.fix_missing_locations(tree)
+
+    # rebuild closures: wrap the def in a factory taking the freevars
+    free = fn.__code__.co_freevars
+    factory_name = "__jst_factory"
+    factory = ast.FunctionDef(
+        name=factory_name,
+        args=ast.arguments(posonlyargs=[],
+                           args=[ast.arg(arg=n) for n in free],
+                           kwonlyargs=[], kw_defaults=[], defaults=[]),
+        body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                              ctx=ast.Load()))],
+        decorator_list=[], returns=None)
+    mod = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    glb = dict(fn.__globals__)
+    glb["__jst_ifelse"] = convert_ifelse
+    glb["__jst_while"] = convert_while
+    glb["__jst_ensure"] = ensure_bound
+    try:
+        code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        exec(code, glb)  # noqa: S102 - the compiled source IS fn's source
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        new_fn = glb[factory_name](*cells)
+    except Exception as e:  # noqa: BLE001 - conversion is best-effort
+        warnings.warn(f"dy2static conversion of {fn.__qualname__} failed "
+                      f"({e!r}); running unconverted", stacklevel=2)
+        return fn
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    return functools.wraps(fn)(new_fn)

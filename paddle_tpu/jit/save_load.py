@@ -137,11 +137,18 @@ class TranslatedLayer(Layer):
         super().__init__()
         with open(path + ".pdmodel", "rb") as f:
             self._exported = jax.export.deserialize(f.read())
-        with np.load(path + ".pdiparams.npz") as z:
-            param_vals = [jnp.asarray(z[str(i)])
-                          for i in range(len(z.files))]
         with open(path + ".pdmeta.json") as f:
             self._meta = json.load(f)
+        conv = self._meta.get("param_converted")
+        wp = self._meta.get("weight_precision")
+        with np.load(path + ".pdiparams.npz") as z:
+            param_vals = []
+            for i in range(len(z.files)):
+                v = z[str(i)]
+                if conv and conv[i] and wp == "bfloat16":
+                    # stored as uint16 bit patterns (numpy lacks bf16)
+                    v = jnp.asarray(v).view(jnp.bfloat16)
+                param_vals.append(jnp.asarray(v))
         from ..framework.tensor import Parameter
         for key, v in zip(self._meta["param_keys"], param_vals):
             p = Parameter(v, name=key, trainable=False)
@@ -149,7 +156,18 @@ class TranslatedLayer(Layer):
 
     @property
     def _param_vals(self):
-        return [p._value for p in self.parameters()]
+        vals = [p._value for p in self.parameters()]
+        conv = self._meta.get("param_converted")
+        if conv:
+            # weights stored reduced-precision by the offline
+            # convert_to_mixed_precision pass (inference/passes.py): cast
+            # ONLY the converted entries back (the pass converts float32
+            # params exclusively, so float32 is their signature dtype);
+            # params of other dtypes pass through untouched
+            vals = [v.astype(jnp.float32)
+                    if i < len(conv) and conv[i] else v
+                    for i, v in enumerate(vals)]
+        return vals
 
     @property
     def input_specs(self):

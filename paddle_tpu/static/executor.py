@@ -112,7 +112,7 @@ class CompiledProgram:
             def fn(*arrays):
                 env = self.program.replay(dict(zip(names, arrays)))
                 return _fetch(self.program, env, fetch, return_numpy=False)
-            self._compiled[key] = to_static(fn)
+            self._compiled[key] = to_static(fn, full_graph=True)
         outs = self._compiled[key](
             *[np.asarray(feed[n]) for n in names])
         if return_numpy:

@@ -111,3 +111,57 @@ def test_live_trial_on_cpu_mesh():
     best = AutoTuner(cands, trial_fn).search()
     assert best.metric is not None and best.error is None
     assert best.as_hybrid_configs()["dp_degree"] == best.dp
+
+
+# ------------------------------------------------------ cost/memory models
+def _spec():
+    from paddle_tpu.distributed.auto_tuner import ModelSpec
+    return ModelSpec(num_layers=24, hidden_size=2048, num_heads=16,
+                     vocab_size=50304, seq_len=2048, global_batch_size=64)
+
+
+def test_memory_model_prunes_impossible_configs():
+    from paddle_tpu.distributed.auto_tuner import (
+        Trial, Hardware, estimate_memory, prune_by_model)
+    spec = _spec()
+    dense = Trial(dp=8, mp=1, pp=1, sharding=1, micro_batch_size=8)
+    sharded = Trial(dp=1, mp=4, pp=2, sharding=1, micro_batch_size=1)
+    # a 1.3B model fully replicated (weights+grads+fp32 Adam) busts 16 GB
+    assert estimate_memory(dense, spec) > Hardware().hbm_bytes
+    kept = prune_by_model([dense, sharded], spec)
+    assert sharded in kept and dense not in kept
+    assert "est_memory_bytes" in dense.extra
+
+
+def test_cost_model_ranking_is_sane():
+    from paddle_tpu.distributed.auto_tuner import (
+        Trial, estimate_step_time, rank_candidates)
+    spec = _spec()
+    # more microbatches shrink the pipeline bubble -> strictly faster
+    pp_small_m = Trial(dp=1, mp=1, pp=4, sharding=2, micro_batch_size=32)
+    pp_big_m = Trial(dp=1, mp=1, pp=4, sharding=2, micro_batch_size=1)
+    assert estimate_step_time(pp_big_m, spec) \
+        < estimate_step_time(pp_small_m, spec)
+    # a pure-compute config with zero comm beats the same compute + TP comm
+    dp_only = Trial(dp=8, mp=1, pp=1, sharding=1, micro_batch_size=1)
+    mp_heavy = Trial(dp=1, mp=8, pp=1, sharding=1, micro_batch_size=1)
+    ranked = rank_candidates([mp_heavy, dp_only], spec)
+    assert all("est_step_seconds" in t.extra for t in ranked)
+    assert ranked == sorted(
+        ranked, key=lambda t: t.extra["est_step_seconds"])
+
+
+def test_rank_then_search_composes():
+    from paddle_tpu.distributed.auto_tuner import (
+        AutoTuner, default_candidates, prune_by_model, rank_candidates)
+    spec = _spec()
+    cands = default_candidates(8, spec.global_batch_size,
+                               spec.num_layers, spec.num_heads)
+    cands = prune_by_model(cands, spec)
+    assert cands, "model pruned everything"
+    ranked = rank_candidates(cands, spec)
+    # fake trial: real metric correlates with the model estimate
+    tuner = AutoTuner(ranked[:5],
+                      lambda t: t.extra["est_step_seconds"] * 1.1)
+    best = tuner.search()
+    assert best is ranked[0]

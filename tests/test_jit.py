@@ -183,3 +183,171 @@ def test_arg_tensor_grads_surface():
     sal = paddle.jit.to_static(saliency)
     sal(x)
     np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+
+
+# -------------------------------------------------- dy2static control flow
+def test_dy2static_tensor_if_compiles():
+    """Tensor-dependent `if` converts to lax.cond (both paths correct from
+    ONE compiled program — this raised TracerBoolConversionError before
+    the AST pass existed)."""
+    def f(x):
+        if (x.sum() > 0):
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y + 1.0
+
+    sf = paddle.jit.to_static(f)
+    xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    xn = paddle.to_tensor(np.array([-5.0, 1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(sf(xp)._value),
+                               np.asarray(f(xp)._value))
+    np.testing.assert_allclose(np.asarray(sf(xn)._value),
+                               np.asarray(f(xn)._value))
+    assert not sf._eager_fallback  # it actually compiled
+
+
+def test_dy2static_tensor_while_compiles():
+    """Tensor-dependent `while` converts to lax.while_loop; trip count is
+    data-dependent within one compiled program."""
+    def g(x):
+        while x.sum() < 10.0:
+            x = x * 2.0
+        return x
+
+    sg = paddle.jit.to_static(g)
+    out = sg(paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out._value), [8.0, 8.0])
+    out2 = sg(paddle.to_tensor(np.array([3.0, 3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out2._value), [6.0, 6.0])
+    assert not sg._eager_fallback
+
+
+def test_dy2static_python_counter_while():
+    def k(x):
+        i = 0
+        while i < 3:
+            x = x + 1.0
+            i = i + 1
+        return x
+
+    sk = paddle.jit.to_static(k)
+    x = paddle.to_tensor(np.array([0.0], np.float32))
+    np.testing.assert_allclose(np.asarray(sk(x)._value), [3.0])
+    assert not sk._eager_fallback
+
+
+def test_dy2static_graph_break_falls_back_to_eager():
+    """Constructs outside the conversion subset (return inside a traced
+    branch) take a GRAPH BREAK: correct eager execution + warning, not a
+    hard error (full_graph=True restores the error)."""
+    def h(x):
+        if (x.sum() > 0):
+            return x * 3.0
+        return x - 7.0
+
+    sh = paddle.jit.to_static(h)
+    xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    xn = paddle.to_tensor(np.array([-5.0, 1.0], np.float32))
+    with pytest.warns(UserWarning, match="falling back"):
+        r1 = sh(xp)
+    r2 = sh(xn)
+    np.testing.assert_allclose(np.asarray(r1._value),
+                               np.asarray((xp * 3.0)._value))
+    np.testing.assert_allclose(np.asarray(r2._value),
+                               np.asarray((xn - 7.0)._value))
+    assert sh._eager_fallback
+
+    strict = paddle.jit.to_static(h, full_graph=True)
+    with pytest.raises(Exception):
+        strict(xp)
+
+
+def test_dy2static_layer_forward_with_control_flow():
+    """Bound methods (Layer.forward) convert too — the instance binding
+    must survive the AST rebuild."""
+    class Gate(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if (h.sum() > 0):
+                out = h * 2.0
+            else:
+                out = h - 1.0
+            return out
+
+    paddle.seed(0)
+    net = Gate()
+    want = [np.asarray(net(paddle.to_tensor(
+        np.full((2, 4), v, np.float32)))._value) for v in (1.0, -1.0)]
+    snet = paddle.jit.to_static(Gate())
+    paddle.seed(0)
+    snet2 = paddle.jit.to_static(Gate())
+    got = [np.asarray(snet2(paddle.to_tensor(
+        np.full((2, 4), v, np.float32)))._value) for v in (1.0, -1.0)]
+    np.testing.assert_allclose(got[0], want[0], rtol=1e-5)
+    np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
+    assert not snet2.forward._eager_fallback
+
+
+def test_dy2static_nested_control_flow_compiles():
+    """An if nested in a while: the inner conversion's generated helpers
+    must not block the outer conversion."""
+    def g(x):
+        while x.sum() < 20.0:
+            if (x[0] > 1.5):
+                x = x + 1.0
+            else:
+                x = x * 2.0
+        return x
+
+    def ref(x):
+        v = np.asarray(x._value)
+        while v.sum() < 20.0:
+            v = v + 1.0 if v[0] > 1.5 else v * 2.0
+        return v
+
+    sg = paddle.jit.to_static(g)
+    x = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+    np.testing.assert_allclose(np.asarray(sg(x)._value), ref(x))
+    assert not sg._eager_fallback
+
+
+def test_dy2static_for_target_survives_branch():
+    def h(x):
+        if (x.sum() > 0):
+            acc = x
+            for i in range(3):
+                acc = acc + 1.0
+        else:
+            acc = x
+            i = 0
+        return acc, i
+
+    sh = paddle.jit.to_static(h)
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    out, i = sh(x)
+    np.testing.assert_allclose(np.asarray(out._value), [4.0])
+    assert not sh._eager_fallback
+
+
+def test_graph_break_is_per_signature():
+    """One graph-breaking signature must not disable compiled programs for
+    other signatures."""
+    def f(x, flag):
+        if flag:  # python branch on a STATIC arg: fine
+            return (x * 2.0).sum()
+        # dynamic-shape op -> graph break only for flag=False calls
+        return paddle.nonzero(x).sum()
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([1.0, 0.0, 3.0], np.float32))
+    assert float(sf(x, True).item()) == 8.0
+    with pytest.warns(UserWarning, match="falling back"):
+        sf(x, False)
+    assert float(sf(x, True).item()) == 8.0  # still compiled
+    key_true = sf._arg_key((x, True), {})
+    assert key_true not in sf._broken_keys

@@ -257,3 +257,51 @@ def test_elastic_manager_heartbeats():
     assert m0.should_restart()
     m0.stop()
 """Note: manager watch grace is 2.5*interval=0.25s; 0.6s sleep is ample."""
+
+
+def test_elastic_membership_registry_and_watch():
+    """Round-3 elastic depth (ref elastic/manager.py:124): node registry
+    with endpoint collection, scale-up join, membership watch callback,
+    and generation-advance endpoint rewrite."""
+    import threading
+    import time as _time
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.store import TCPStore
+
+    s = TCPStore(port=0, is_master=True, world_size=1)
+    try:
+        m0 = ElasticManager(s, node_id=0, nnodes=2, interval=0.1,
+                            min_nodes=2)
+        m1 = ElasticManager(TCPStore(port=s.port), node_id=1, nnodes=2,
+                            interval=0.1)
+        m0.register("10.0.0.1:8000")
+        m1.register("10.0.0.2:8000")
+        assert m0.collect_endpoints(timeout=5) == ["10.0.0.1:8000",
+                                                   "10.0.0.2:8000"]
+        # membership watch fires when the roster changes
+        changes = []
+        ev = threading.Event()
+
+        def on_change(dead, eps):
+            changes.append((dead, eps))
+            ev.set()
+
+        stop = m0.watch(on_change, poll=0.05)
+        _time.sleep(0.15)  # let the watcher take its baseline
+        joiner = ElasticManager(TCPStore(port=s.port), node_id=-1,
+                                nnodes=2, interval=0.1)
+        new_id = joiner.join("10.0.0.3:8000")
+        # ids 0 and 1 are taken by registered nodes: the joiner may NOT
+        # collide with them
+        assert new_id == 2
+        assert m0.endpoints()[:2] == ["10.0.0.1:8000", "10.0.0.2:8000"]
+        ev.wait(timeout=5)
+        stop.set()
+        assert changes, "watch never fired on membership change"
+        # generation advance = endpoint rewrite namespace
+        g = m0.next_generation()
+        assert g == 1
+        m0.register("10.0.0.1:9000")
+        assert m0.endpoints()[0] == "10.0.0.1:9000"
+    finally:
+        s.stop() if hasattr(s, "stop") else None

@@ -170,3 +170,90 @@ def test_ptq_calibrate_then_convert():
     q_out = np.asarray(final(x)._value)
     assert not np.allclose(q_out, calib_out)      # now actually quantized
     assert np.abs(q_out - calib_out).max() < 0.2  # but close
+
+
+def test_qat_lenet_roundtrips_through_predictor(tmp_path):
+    """VERDICT r2 item 10: a QAT fake-quantized LeNet must save ->
+    load -> predict with outputs matching the in-memory quantized model
+    (the fake-quant ops ride the exported StableHLO)."""
+    from paddle_tpu.quantization import QuantConfig, QAT
+    from paddle_tpu.quantization.quanters import FakeQuanterWithAbsMaxObserver
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    model = LeNet()
+    cfg = QuantConfig(activation=None,
+                      weight=FakeQuanterWithAbsMaxObserver)
+    try:
+        cfg.add_type_config(paddle.nn.Linear, activation=None,
+                            weight=FakeQuanterWithAbsMaxObserver)
+        cfg.add_type_config(paddle.nn.Conv2D, activation=None,
+                            weight=FakeQuanterWithAbsMaxObserver)
+    except AttributeError:
+        pass
+    q = QAT(cfg).quantize(model)
+    q.eval()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(4, 1, 28, 28).astype(np.float32))
+    want = np.asarray(q(x)._value)
+    # quantization must actually change the function (weights clamped to
+    # the 8-bit grid) yet stay close to the float model
+    base = np.asarray(model(x)._value)
+    assert not np.allclose(want, base)
+    np.testing.assert_allclose(want, base, rtol=0.5, atol=0.2)
+
+    prefix = str(tmp_path / "qlenet")
+    paddle.jit.save(q, prefix,
+                    input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+    from paddle_tpu import inference
+    pred = inference.create_predictor(inference.Config(prefix))
+    got = pred.run([np.asarray(x._value)])[0]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_convert_to_mixed_precision_pass(tmp_path):
+    """Offline weight-precision pass: params stored bf16, predictor
+    outputs stay close to fp32; norm-like names can be black-listed."""
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+
+    paddle.seed(1)
+    model = LeNet()
+    model.eval()
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 1, 28, 28).astype(np.float32)
+    want = np.asarray(model(paddle.to_tensor(x))._value)
+    src = str(tmp_path / "lenet_f32")
+    dst = str(tmp_path / "lenet_bf16")
+    paddle.jit.save(model, src,
+                    input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+    inference.convert_to_mixed_precision(src, dst,
+                                         mixed_precision="bfloat16")
+    import json
+    meta = json.load(open(dst + ".pdmeta.json"))
+    assert meta["weight_precision"] == "bfloat16"
+    assert meta["weight_precision_converted"] > 0
+    with np.load(dst + ".pdiparams.npz") as z:
+        dts = {z[k].dtype.name for k in z.files}
+    assert "float32" not in dts or len(dts) > 1  # weights converted
+    pred = inference.create_predictor(inference.Config(dst))
+    got = pred.run([x])[0]
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+
+def test_convert_to_mixed_precision_rejects_reconversion(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+    paddle.seed(2)
+    model = LeNet()
+    model.eval()
+    src = str(tmp_path / "m")
+    mid = str(tmp_path / "m16")
+    paddle.jit.save(model, src,
+                    input_spec=[InputSpec([None, 1, 28, 28], "float32")])
+    inference.convert_to_mixed_precision(src, mid)
+    with pytest.raises(ValueError, match="already precision-converted"):
+        inference.convert_to_mixed_precision(mid, str(tmp_path / "m8"))
