@@ -71,11 +71,38 @@ class ElasticManager:
             return None
         return float(self.store.get(self._key(node)).decode())
 
-    def dead_nodes(self, grace: Optional[float] = None) -> List[int]:
+    def _counter_key(self) -> str:
+        return f"nodes/{self.generation}/next_id"
+
+    def _allocated(self) -> int:
+        """Highest allocated id bound (read-only — no counter write)."""
+        k = self._counter_key()
+        alloc = int(self.store.get(k).decode()) if self.store.check(k) else 0
+        return max(self.nnodes, alloc)
+
+    def _roster(self) -> List[int]:
+        """Member ids of the current generation: every allocated id that
+        actually registered an endpoint or published a heartbeat.  The
+        join counter only *allocates* ids — `register()`'s
+        atomic-increment advancement can overshoot under races, so
+        allocated-but-never-claimed ids are NOT members (they would
+        otherwise read as permanently dead phantom nodes).  Statically
+        assigned ids likewise only become members once seen, so a
+        generation rescale (`next_generation(nnodes=k)`) isn't haunted by
+        a lost low id."""
+        members = []
+        for i in range(self._allocated()):
+            if (self.store.check(self._node_key(i))
+                    or self.store.check(self._key(i))):
+                members.append(i)
+        return members
+
+    def dead_nodes(self, grace: Optional[float] = None,
+                   roster: Optional[List[int]] = None) -> List[int]:
         grace = grace if grace is not None else 2.5 * self.interval
         now = time.time()
         dead = []
-        for n in range(self.nnodes):
+        for n in (self._roster() if roster is None else roster):
             beat = self.last_beat(n)
             if beat is None or now - beat > grace:
                 dead.append(n)
@@ -85,8 +112,11 @@ class ElasticManager:
         return len(self.dead_nodes()) > 0
 
     def status(self) -> ElasticStatus:
-        dead = self.dead_nodes()
-        alive = self.nnodes - len(dead)
+        roster = self._roster()
+        if not roster:
+            return ElasticStatus.HOLD  # fresh generation: nobody joined yet
+        dead = self.dead_nodes(roster=roster)
+        alive = len(roster) - len(dead)
         if not dead:
             return ElasticStatus.COMPLETED
         if alive == 0:
@@ -111,48 +141,79 @@ class ElasticManager:
         advance the id counter past ours so later join()ers never collide
         with a statically-assigned id."""
         self.store.set(self._node_key(self.node_id), endpoint.encode())
-        counter = f"nodes/{self.generation}/next_id"
-        cur = self.store.add(counter, 0)
+        cur = self.store.add(self._counter_key(), 0)
         if cur < self.node_id + 1:
             # atomic increments only: overshoot under races just skips ids
-            self.store.add(counter, self.node_id + 1 - cur)
+            # (skipped ids are never members — see _roster())
+            self.store.add(self._counter_key(), self.node_id + 1 - cur)
 
     def join(self, endpoint: str) -> int:
         """A NEW node (scale-up / replacement) takes the next free node id
         and registers; returns the assigned id."""
-        self.node_id = self.store.add(
-            f"nodes/{self.generation}/next_id", 1) - 1
+        self.node_id = self.store.add(self._counter_key(), 1) - 1
         self.nnodes = max(self.nnodes, self.node_id + 1)
         self.register(endpoint)
         return self.node_id
 
-    def endpoints(self) -> List[str]:
+    def endpoints(self, roster: Optional[List[int]] = None) -> List[str]:
         """The registered endpoint roster (index = node id; '' = absent)."""
-        out = []
-        for n in range(self.nnodes):
+        roster = self._roster() if roster is None else roster
+        out = ["" for _ in range(max(roster) + 1 if roster else 0)]
+        for n in roster:
             k = self._node_key(n)
-            out.append(self.store.get(k).decode()
-                       if self.store.check(k) else "")
+            if self.store.check(k):
+                out[n] = self.store.get(k).decode()
         return out
 
     def collect_endpoints(self, timeout: float = 60.0) -> List[str]:
-        """Block until every node has registered; returns the roster (the
-        rendezvous the launcher turns into PADDLE_TRAINER_ENDPOINTS)."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            eps = self.endpoints()
-            if all(eps):
-                return eps
-            time.sleep(0.1)
-        raise TimeoutError(
-            f"elastic rendezvous: only {sum(bool(e) for e in self.endpoints())}"
-            f"/{self.nnodes} nodes registered within {timeout}s")
+        """Block until `nnodes` members have registered; returns the roster
+        (the rendezvous the launcher turns into PADDLE_TRAINER_ENDPOINTS).
 
-    def next_generation(self) -> int:
+        The wait is on the registered COUNT, not on specific ids, so a
+        rescaled generation whose survivors keep non-contiguous ids (e.g.
+        0,1,3 after losing 2) still completes.  If the full size never
+        arrives but `min_nodes` is satisfied at the deadline, the partial
+        roster is returned — the elastic lower bound.  The satisfied
+        condition must hold for two consecutive polls so a joiner between
+        its counter allocation and its register() isn't silently dropped
+        from the rendezvous."""
+        deadline = time.time() + timeout
+        want = max(self.nnodes, 1)
+        prev = None
+        while time.time() < deadline:
+            roster = self._roster()
+            eps = self.endpoints(roster=roster)
+            done = [n for n in roster if eps[n]]
+            if len(done) >= want and len(done) == len(roster):
+                if prev == eps:
+                    return eps
+                prev = eps
+            else:
+                prev = None
+            time.sleep(0.1)
+        roster = self._roster()
+        eps = self.endpoints(roster=roster)
+        done = [n for n in roster if eps[n]]
+        if len(done) >= want and len(done) == len(roster):
+            return eps  # complete at the deadline: no confirmation needed
+        if self.min_nodes and len(done) >= self.min_nodes:
+            # the elastic lower bound: proceed with who actually registered
+            # (a heartbeat-only member that died before register() must not
+            # block the degraded rendezvous)
+            return eps
+        raise TimeoutError(
+            f"elastic rendezvous: only {len(done)}/{want} nodes "
+            f"registered within {timeout}s")
+
+    def next_generation(self, nnodes: Optional[int] = None) -> int:
         """Advance to a fresh generation (after a membership change the
         launcher re-rendezvouses under the new namespace — the endpoint
-        REWRITE: survivors re-register, replacements join)."""
+        REWRITE: survivors re-register, replacements join).  Pass `nnodes`
+        to rescale the static expectation (e.g. continuing smaller after
+        an unrecovered loss); otherwise the original size is kept."""
         self.generation += 1
+        if nnodes is not None:
+            self.nnodes = nnodes
         return self.generation
 
     def watch(self, on_change, poll: float = 1.0) -> threading.Event:
@@ -164,8 +225,9 @@ class ElasticManager:
 
         def loop():
             while not stop.wait(poll):
-                dead = tuple(self.dead_nodes())
-                eps = tuple(self.endpoints())
+                roster = self._roster()
+                dead = tuple(self.dead_nodes(roster=roster))
+                eps = tuple(self.endpoints(roster=roster))
                 if dead != state["dead"] or eps != state["eps"]:
                     changed = state["dead"] is not None
                     state["dead"], state["eps"] = dead, eps
