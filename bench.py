@@ -372,21 +372,68 @@ def bench_decode():
         rng.randint(0, cfg.vocab_size, (B, prompt)).astype(np.int32))
     results = {}
     for impl in ("static", "paged"):
-        # warm with the FULL length: the static impl compiles the whole
-        # generation (prefill + lax.scan over decode steps) into one
-        # program keyed by max_new_tokens; the paged impl warms its
-        # per-op programs on the first pass
+        # both impls compile the whole generation (prefill + lax.scan
+        # over decode steps) into one program on the first call
         out = model.generate(ids, max_new_tokens=new, cache_impl=impl)
         np.asarray(out._value)
-        t0 = time.perf_counter()
-        out = model.generate(ids, max_new_tokens=new, cache_impl=impl)
-        np.asarray(out._value)
-        dt = time.perf_counter() - t0
-        results[impl] = B * new / dt
+        best = float("inf")
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.perf_counter()
+            out = model.generate(ids, max_new_tokens=new, cache_impl=impl)
+            np.asarray(out._value)
+            best = min(best, time.perf_counter() - t0)
+        results[impl] = B * new / best
     log({"bench": "gpt124m_decode", "batch": B, "prompt": prompt,
          "new_tokens": new,
          "static_tokens_per_sec": round(results["static"], 1),
          "paged_tokens_per_sec": round(results["paged"], 1)})
+
+
+def bench_decode_longctx():
+    """Paged-KV long-context rung: the SAME model configured for a 32k
+    serving context.  The static cache preallocates the full
+    [B, max_seq_len] rectangle (~19.3 GB at B=8 — exceeds a v5e's HBM
+    and OOMs); the paged pool allocates only the context actually used
+    (prompt + new tokens), so serving works.  This is the capability the
+    reference's block_multihead_attention paging exists for."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m
+
+    if jax.devices()[0].platform != "tpu":
+        return  # the OOM contrast is only meaningful against real HBM
+    paddle.seed(0)
+    cfg = gpt3_124m(max_seq_len=32768)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    B, prompt, new = 8, 128, 64
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, prompt)).astype(np.int32))
+    static_result = "n/a"
+    try:
+        out = model.generate(ids, max_new_tokens=new, cache_impl="static")
+        np.asarray(out._value)
+        static_result = "fit"  # unexpected on 16 GB HBM
+    except Exception as e:  # noqa: BLE001 - OOM expected
+        msg = repr(e)
+        oom = any(k in msg for k in (
+            "RESOURCE_EXHAUSTED", "Out of memory", "Ran out of memory"))
+        import re
+        used = re.search(r"Used ([\d.]+[GM]) of ([\d.]+[GM]) hbm", msg)
+        static_result = ("OOM " + (f"({used.group(1)} needed, "
+                                   f"{used.group(2)} HBM)" if used else "")
+                         ).strip() if oom else f"error: {msg[:80]}"
+    _release_device_memory()
+    out = model.generate(ids, max_new_tokens=new, cache_impl="paged")
+    np.asarray(out._value)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new, cache_impl="paged")
+    np.asarray(out._value)
+    tps = B * new / (time.perf_counter() - t0)
+    log({"bench": "gpt124m_decode_32k_config", "batch": B,
+         "prompt": prompt, "new_tokens": new, "static": static_result,
+         "paged_tokens_per_sec": round(tps, 1)})
 
 
 def _release_device_memory():
@@ -435,6 +482,7 @@ def main():
     _run_rung("dispatch_overhead", bench_dispatch, 15, release=False)
     _run_rung("lenet_train", bench_lenet, 60)
     _run_rung("gpt124m_decode", bench_decode, 200)
+    _run_rung("gpt124m_decode_32k_config", bench_decode_longctx, 150)
     _run_rung("resnet50_train", bench_resnet50, 380)
     _run_rung("bert_base_mlm_train", bench_bert_base, 500)
 

@@ -43,10 +43,11 @@ class GenerationMixin:
     `forward_with_cache(input_ids, caches, pos_offset) -> (logits, caches)`
     and `init_caches(batch_size) -> caches`."""
 
-    def _compiled_static_generate(self, ids, max_new_tokens, do_sample,
-                                  temperature, top_k, top_p, eos_token_id):
-        """Whole-generation XLA program for static caches: prefill + a
-        `lax.scan` over decode steps compile into ONE dispatch.
+    def _compiled_generate(self, ids, max_new_tokens, do_sample,
+                           temperature, top_k, top_p, eos_token_id,
+                           cache_impl="static"):
+        """Whole-generation XLA program: prefill + a `lax.scan` over
+        decode steps compile into ONE dispatch.
 
         The eager host loop pays a host->device round trip per op per
         token — through a tunneled device that is thousands of
@@ -54,7 +55,13 @@ class GenerationMixin:
         design the reference serves through its fused decoding ops,
         `fused_multi_transformer_op.cu`).  Sequences that hit eos are
         padded with eos to the full length (same contract as the eager
-        loop's docstring; no early host exit inside a compiled loop)."""
+        loop's docstring; no early host exit inside a compiled loop).
+
+        cache_impl="static": fixed [B, max_seq_len] buffers.
+        cache_impl="paged": `PagedKVCache` block pool sized to
+        prompt + max_new_tokens; the pools and seq_lens ride the scan
+        carry, the paged Pallas kernel attends through the block table —
+        the reference's `block_multi_head_attention` seat, compiled."""
         import jax
         from ..framework.dygraph import no_grad
 
@@ -62,6 +69,7 @@ class GenerationMixin:
         if cap is not None and ids.shape[1] + max_new_tokens > cap:
             # inside the compiled loop the cache length is a tracer, so the
             # eager overflow guard can't fire — check before compiling
+            # (position embeddings bound BOTH cache impls)
             raise ValueError(
                 f"prompt ({ids.shape[1]}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len ({cap})")
@@ -69,18 +77,23 @@ class GenerationMixin:
         keys = sorted(sd.keys())
         cache_key = (tuple(ids.shape), max_new_tokens, bool(do_sample),
                      float(temperature), int(top_k), float(top_p),
-                     eos_token_id, str(ids.dtype))
+                     eos_token_id, str(ids.dtype), cache_impl)
         store = getattr(self, "_static_gen_programs", None)
         if store is None:
             store = self._static_gen_programs = {}
         fn = store.get(cache_key)
         if fn is None:
+            init_kwargs = {"cache_impl": cache_impl}
+            if cache_impl == "paged":
+                init_kwargs["max_context"] = \
+                    ids.shape[1] + max_new_tokens
+
             def gen(param_vals, pids, rng_key):
                 for kk, vv in zip(keys, param_vals):
                     sd[kk]._value = vv
                 B, prompt_len = pids.shape
                 with no_grad():
-                    caches = self.init_caches(B, cache_impl="static")
+                    caches = self.init_caches(B, **init_kwargs)
                     logits_t, caches = self.forward_with_cache(
                         Tensor._wrap(pids), caches, pos_offset=0)
                 logits0 = logits_t._value[:, -1, :]
@@ -133,7 +146,9 @@ class GenerationMixin:
 
         cache_impl="paged" (models supporting it) decodes against
         block-paged KV caches via the Pallas paged-attention kernel
-        instead of concat-and-grow dense caches."""
+        inside the whole-generation compiled program; "paged_eager"
+        keeps the host decode loop over a `BlockKVCache` (the
+        continuous-batching building block with free()/join)."""
         was_training = self.training
         self.eval()
         try:
@@ -144,10 +159,15 @@ class GenerationMixin:
             B, prompt_len = ids.shape
             import inspect
             sig = inspect.signature(self.init_caches)
-            if cache_impl == "static" and "cache_impl" in sig.parameters:
-                return self._compiled_static_generate(
+            if cache_impl in ("static", "paged") \
+                    and "cache_impl" in sig.parameters \
+                    and ("max_context" in sig.parameters
+                         or cache_impl == "static"):
+                return self._compiled_generate(
                     ids, max_new_tokens, do_sample, temperature, top_k,
-                    top_p, eos_token_id)
+                    top_p, eos_token_id, cache_impl=cache_impl)
+            if cache_impl == "paged_eager":
+                cache_impl = "paged"  # host-loop BlockKVCache path
             if "cache_impl" in sig.parameters:
                 caches = self.init_caches(B, cache_impl=cache_impl)
             elif cache_impl != "dense":

@@ -79,8 +79,8 @@ class GPTAttention(nn.Layer):
         qkv = _m.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = _m.unbind(qkv, axis=2)
         if kv_cache is not None and not isinstance(kv_cache, tuple):
-            from .kv_cache import StaticKVCache
-            if isinstance(kv_cache, StaticKVCache):
+            from .kv_cache import PagedKVCache, StaticKVCache
+            if isinstance(kv_cache, (StaticKVCache, PagedKVCache)):
                 new_cache, out = kv_cache.update_and_attend(
                     q._value, k._value, v._value)
                 out_t = Tensor._wrap(out.reshape(
@@ -254,13 +254,24 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         return matmul(h, self.gpt.wte.weight, transpose_y=True)
 
     def init_caches(self, batch_size, cache_impl: str = "dense",
-                    block_size: int = 16):
+                    block_size: int = None, max_context=None):
         import jax.numpy as jnp
         from ..framework.tensor import Tensor as _T
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         dtype = self.gpt.wte.weight._value.dtype
+        if cache_impl == "paged" and max_context is not None:
+            # compiled serving path: pool sized by the ACTUAL context of
+            # this generation, not the max_seq_len rectangle.  Pages of 64
+            # keep the decode kernel's [nh, bs, hd] blocks MXU-friendly
+            # (the eager BlockKVCache defaults to finer 16-token pages for
+            # allocation granularity under continuous batching).
+            from .kv_cache import PagedKVCache
+            return [PagedKVCache(batch_size, max_context, cfg.num_heads,
+                                 hd, dtype, block_size=block_size or 64)
+                    for _ in range(cfg.num_layers)]
         if cache_impl == "paged":
+            block_size = block_size or 16
             from ..ops.pallas_paged import BlockKVCache
             max_blocks = (cfg.max_seq_len + block_size - 1) // block_size
             return [BlockKVCache(
@@ -308,25 +319,30 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
 
 
 def gpt3_tiny(**kw):
-    return GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
-                     num_heads=4, max_seq_len=256, **kw)
+    return _preset(dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=256), kw)
+
+
+def _preset(defaults, kw):
+    defaults.update(kw)  # caller overrides win (e.g. max_seq_len)
+    return GPTConfig(**defaults)
 
 
 def gpt3_124m(**kw):
-    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
-                     max_seq_len=1024, **kw)
+    return _preset(dict(hidden_size=768, num_layers=12, num_heads=12,
+                        max_seq_len=1024), kw)
 
 
 def gpt3_350m(**kw):
-    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
-                     max_seq_len=1024, **kw)
+    return _preset(dict(hidden_size=1024, num_layers=24, num_heads=16,
+                        max_seq_len=1024), kw)
 
 
 def gpt3_1p3b(**kw):
-    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
-                     max_seq_len=2048, **kw)
+    return _preset(dict(hidden_size=2048, num_layers=24, num_heads=16,
+                        max_seq_len=2048), kw)
 
 
 def gpt3_6p7b(**kw):
-    return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
-                     max_seq_len=2048, **kw)
+    return _preset(dict(hidden_size=4096, num_layers=32, num_heads=32,
+                        max_seq_len=2048), kw)

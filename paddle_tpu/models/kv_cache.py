@@ -26,7 +26,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["StaticKVCache"]
+__all__ = ["StaticKVCache", "PagedKVCache"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -92,6 +92,109 @@ class StaticKVCache:
             self.k, self.v, self.length, q, k, v)
         new.length = self.length + jnp.int32(s)
         return new, out
+
+
+class PagedKVCache:
+    """Functional paged KV cache for COMPILED decode loops.
+
+    Parity seat: the reference's block-paged serving cache
+    (`paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu`,
+    `fused_multi_transformer_op.cu.h:171` cache-KV branch) — fixed-size
+    physical blocks, a per-sequence block table, decode attends through
+    the table.
+
+    TPU-native redesign: everything is a traced array so the WHOLE
+    generation (prefill write + `lax.scan` over decode steps) compiles
+    into one XLA program — round 3 drove the paged Pallas kernel through
+    per-token eager dispatch and measured 5.3 tok/s vs 2017 static.  The
+    block table is built host-side before tracing: a lockstep
+    `generate()` allocates deterministically (sequence b owns blocks
+    1 + b*nb .. 1 + (b+1)*nb - 1; block 0 is the pad block), which is the
+    same contiguous layout any pool allocator produces from empty.
+    Dynamic per-sequence allocation (continuous batching: join/free
+    between compiled segments) stays host-side in `BlockKVCache` —
+    exactly where serving schedulers do it.
+
+    The memory win vs `StaticKVCache`: the pool is sized by the ACTUAL
+    max context of this generation (prompt + new tokens), not the model's
+    max_seq_len rectangle — `bench.py`'s long-context rung runs a batch
+    whose static rectangle exceeds HBM.
+    """
+
+    def __init__(self, batch: int, max_context: int, num_heads: int,
+                 head_dim: int, dtype=jnp.float32, block_size: int = 64):
+        nb = (max_context + block_size - 1) // block_size
+        self.bs = block_size
+        # heads lead so each streamed block is a clean [bs, hd] tile
+        # (Mosaic tiling needs the trailing two dims tile-friendly)
+        self.k = jnp.zeros((num_heads, batch * nb + 1, block_size,
+                            head_dim), dtype)
+        self.v = jnp.zeros_like(self.k)
+        self.tables = (1 + jnp.arange(batch * nb, dtype=jnp.int32)
+                       ).reshape(batch, nb)
+        self.seq_lens = jnp.zeros((batch,), jnp.int32)
+
+    def update_and_attend(self, q, k, v):
+        """q/k/v: jnp [B, s, nh, hd] (post-RoPE).  s == 1 -> paged decode
+        kernel; s > 1 -> bulk prefill write + dense causal attention
+        (all sequences at equal length, the prefill contract).  Returns
+        (new_cache, out [B, s, nh, hd])."""
+        from ..ops import pallas_paged
+        B, s, nh, hd = q.shape
+        new = PagedKVCache.__new__(PagedKVCache)
+        new.bs, new.tables = self.bs, self.tables
+        if s == 1:
+            new.k, new.v = pallas_paged.paged_write_token(
+                self.k, self.v, self.tables, self.seq_lens,
+                k[:, 0], v[:, 0])
+            new.seq_lens = self.seq_lens + 1
+            out = pallas_paged.paged_attention(
+                q[:, 0], new.k, new.v, self.tables, new.seq_lens)
+            return new, out[:, None]
+        if not isinstance(self.seq_lens, jax.core.Tracer):
+            # prefill writes into each sequence's FIRST blocks and attends
+            # only within the chunk — valid solely from empty sequences.
+            # (Inside the compiled generate the cache is always freshly
+            # built, so the concrete-value check covers the misuse case.)
+            if int(jnp.max(self.seq_lens)) != 0:
+                raise NotImplementedError(
+                    "chunked prefill against a PagedKVCache: prefill in "
+                    "one chunk or use cache_impl='dense'")
+        new.k, new.v = pallas_paged.paged_write_prefill(
+            self.k, self.v, self.tables, k, v)
+        new.seq_lens = self.seq_lens + s
+        return new, _dense_causal(q, k, v)
+
+
+def _dense_causal(q, k, v):
+    """Prefill attention (no cache read needed: the prompt IS the whole
+    context).  Flash kernel when applicable, jnp oracle otherwise."""
+    from ..ops import pallas_flash, pallas_kernels
+    if pallas_kernels.flash_attention_available(q, k, v):
+        return pallas_flash.flash_attention_fwd(q, k, v, causal=True)[0]
+    B, s, nh, hd = q.shape
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / math.sqrt(hd)
+    mask = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _paged_flatten(c):
+    return (c.k, c.v, c.tables, c.seq_lens), c.bs
+
+
+def _paged_unflatten(bs, children):
+    c = PagedKVCache.__new__(PagedKVCache)
+    c.k, c.v, c.tables, c.seq_lens = children
+    c.bs = bs
+    return c
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache, _paged_flatten, _paged_unflatten)
 
 
 def _cache_flatten(c):

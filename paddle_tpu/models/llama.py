@@ -91,8 +91,8 @@ class LlamaAttention(nn.Layer):
                 rep = cfg.num_heads // cfg.num_kv_heads
                 k = _m.repeat_interleave(k, rep, axis=2)
                 v = _m.repeat_interleave(v, rep, axis=2)
-            from .kv_cache import StaticKVCache
-            if isinstance(kv_cache, StaticKVCache):
+            from .kv_cache import PagedKVCache, StaticKVCache
+            if isinstance(kv_cache, (StaticKVCache, PagedKVCache)):
                 from ..framework.tensor import Tensor as _T
                 new_cache, out = kv_cache.update_and_attend(
                     q._value, k._value, v._value)
@@ -245,13 +245,21 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         return self.lm_head(self.model(input_ids))
 
     def init_caches(self, batch_size, cache_impl: str = "dense",
-                    block_size: int = 16):
+                    block_size: int = None, max_context=None):
         import jax.numpy as jnp
         from ..framework.tensor import Tensor as _T
         cfg = self.cfg
         hd = cfg.hidden_size // cfg.num_heads
         dtype = self.model.embed_tokens.weight._value.dtype
+        if cache_impl == "paged" and max_context is not None:
+            # compiled serving path (see gpt.py): pool sized by the actual
+            # generation context; caches hold GQA-repeated heads
+            from .kv_cache import PagedKVCache
+            return [PagedKVCache(batch_size, max_context, cfg.num_heads,
+                                 hd, dtype, block_size=block_size or 64)
+                    for _ in range(cfg.num_layers)]
         if cache_impl == "paged":
+            block_size = block_size or 16
             from ..ops.pallas_paged import BlockKVCache
             max_blocks = (cfg.max_seq_len + block_size - 1) // block_size
             return [BlockKVCache(

@@ -158,9 +158,12 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
         m_prev = m_scr[:, 0]                         # [bq]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])              # [bq, bk]
-        if valid2d is not None:
+        if has_mask or (causal and offset < 0):
             # a fully-masked row in this block has m_new == s == _NEG_INF,
-            # making exp(s - m_new) = 1 on masked entries — zero explicitly
+            # making exp(s - m_new) = 1 on masked entries — zero explicitly.
+            # Only a kv mask or a negative causal offset can fully mask a
+            # row (offset >= 0 keeps at least key 0 valid for every query);
+            # plain causal self-attention skips this VPU pass.
             p = jnp.where(valid2d, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)              # [bq]
         l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
@@ -332,8 +335,9 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         if valid2d is not None:
             s = jnp.where(valid2d, s, _NEG_INF)
         p = jnp.exp(s - lse)                         # [bq, bk]
-        if valid2d is not None:
+        if has_mask or (causal and offset < 0):
             # fully-masked rows carry lse = _NEG_INF; zero explicitly
+            # (plain causal offset>=0 rows always keep key 0 — skip)
             p = jnp.where(valid2d, p, 0.0)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -392,8 +396,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         if valid2d is not None:
             s = jnp.where(valid2d, s, _NEG_INF)
         p = jnp.exp(s - lse)                         # [bq, bk]
-        if valid2d is not None:
+        if has_mask or (causal and offset < 0):
             # fully-masked rows carry lse = _NEG_INF; zero explicitly
+            # (plain causal offset>=0 rows always keep key 0 — skip)
             p = jnp.where(valid2d, p, 0.0)
         if rate > 0.0:
             # seeded by LOGICAL block coords (bh, qi, ki) — this kernel's
@@ -437,11 +442,13 @@ def _flash_bwd(causal, interpret, kv_mask_shape, rate, res, g,
     bk = _pick_block(Sk, block_k)
     nq, nk = Sq // bq, Sk // bk
     scale = 1.0 / math.sqrt(hd)
-    # the residual mask array is saved unconditionally (all-ones when no
-    # kv_mask was given), so the backward ALWAYS applies it — masking with
-    # ones is the identity, and this removes any way for a caller to get a
-    # masked forward with an unmasked backward (kv_mask_shape is advisory)
-    has_mask = True
+    # kv_mask_shape records whether the FORWARD had a user mask; when it
+    # didn't, the saved residual mask is the internally-built all-ones
+    # array (never user data), so applying it would be the identity — the
+    # unmasked train path skips the mask reads and both extra VPU
+    # `where` passes entirely (round-3 applied it unconditionally, which
+    # cost ~9% of the GPT-124M train step)
+    has_mask = kv_mask_shape is not None
 
     qb, kb, vb = _bnsh(q), _bnsh(k), _bnsh(v)
     ob, gb = _bnsh(out), _bnsh(g)
@@ -535,17 +542,26 @@ def _flash_bwd(causal, interpret, kv_mask_shape, rate, res, g,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8))
+def _flash_attention_core(q, k, v, causal, interpret,
+                          kv_mask, seed, kv_mask_shape, dropout_rate):
+    out, _ = flash_attention_fwd(q, k, v, causal, interpret,
+                                 kv_mask, dropout_rate, seed)
+    return out
+
+
 def flash_attention(q, k, v, causal=False, interpret=None,
                     kv_mask=None, seed=None, kv_mask_shape=None,
                     dropout_rate=0.0):
     """Flash attention; q [B, Sq, nh, hd], k/v [B, Sk, nkv, hd] ->
     [B, Sq, nh, hd].  kv_mask: optional [B, Sk] 0/1 key-validity;
-    seed: optional int32 scalar for dropout.  `kv_mask_shape` mirrors
-    whether kv_mask is present (custom_vjp nondiff args must be static;
-    the Tensor-level wrapper in pallas_kernels.py fills it)."""
-    out, _ = flash_attention_fwd(q, k, v, causal, interpret,
-                                 kv_mask, dropout_rate, seed)
-    return out
+    seed: optional int32 scalar for dropout.  `kv_mask_shape` is the
+    static mirror of kv_mask's presence (custom_vjp nondiff args must be
+    static); it is derived here so a direct caller can never get a
+    masked forward with an unmasked backward."""
+    if kv_mask is not None and kv_mask_shape is None:
+        kv_mask_shape = tuple(kv_mask.shape)
+    return _flash_attention_core(q, k, v, causal, interpret, kv_mask,
+                                 seed, kv_mask_shape, dropout_rate)
 
 
 def _fa_fwd(q, k, v, causal, interpret, kv_mask, seed, kv_mask_shape,
@@ -562,4 +578,4 @@ def _fa_bwd(causal, interpret, kv_mask_shape, dropout_rate, res, g):
                       res, g)
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash_attention_core.defvjp(_fa_fwd, _fa_bwd)

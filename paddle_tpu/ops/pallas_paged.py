@@ -35,16 +35,21 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-__all__ = ["paged_attention", "paged_attention_reference", "BlockKVCache"]
+__all__ = ["paged_attention", "paged_attention_reference", "BlockKVCache",
+           "paged_write_token", "paged_write_prefill"]
 
 _NEG_INF = -1e30
 
 
 def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, scale, bs, max_blocks, nh):
-    bh = pl.program_id(0)
+    """One grid instance = ALL heads of one sequence against one physical
+    block: grid (B, max_blocks), k/v blocks [nh, bs, hd].  Processing the
+    whole head dim per instance cuts the sequential grid by nh× and makes
+    each DMA nh× larger — the per-iteration launch overhead dominated the
+    per-head variant (round 3's kernel) at decode sizes."""
+    b = pl.program_id(0)
     blk = pl.program_id(1)
-    b = bh // nh
 
     @pl.when(blk == 0)
     def _():
@@ -57,30 +62,35 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(blk < n_blocks)
     def _():
-        q = q_ref[:, :]                                   # [1, hd]
-        k = k_ref[:, :]                                   # [bs, hd]
+        q = q_ref[:, :]                                   # [nh, hd]
+        k = k_ref[:, :, :]                                # [nh, bs, hd]
+        # batched matvec as [nh, 1, hd] x [nh, bs, hd]: Mosaic's dot
+        # lowering requires a non-empty lhs non-contracting dim set
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [1, bs]
-        pos = blk * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+            q[:, None, :], k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :] * scale  # [nh, bs]
+        pos = blk * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (nh, bs), 1)
         s = jnp.where(pos < seq_len, s, _NEG_INF)
-        m_prev = m_scr[0, 0]
-        m_new = jnp.maximum(m_prev, jnp.max(s))
-        p = jnp.exp(s - m_new)                            # [1, bs]
+        m_prev = m_scr[:, 0]                              # [nh]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])                   # [nh, bs]
         alpha = jnp.exp(m_prev - m_new)
-        v = v_ref[:, :]                                   # [bs, hd]
+        v = v_ref[:, :, :]                                # [nh, bs, hd]
         pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [1, hd]
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        l_scr[:] = l_scr[:] * alpha + jnp.sum(p)
-        m_scr[:] = jnp.full_like(m_scr, m_new)
+            p.astype(v.dtype)[:, None, :], v,
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:, 0, :]  # [nh, hd]
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + pv
+        l_scr[:] = l_scr[:] * alpha[:, None] + jnp.broadcast_to(
+            jnp.sum(p, axis=1)[:, None], l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
 
     @pl.when(blk == max_blocks - 1)
     def _():
-        l = l_scr[0, 0]
+        l = l_scr[:, 0]                                   # [nh]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[:, :] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        o_ref[:, :] = (acc_scr[:] / l_safe[:, None]).astype(o_ref.dtype)
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, seq_lens,
@@ -109,34 +119,33 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens,
     kern = functools.partial(_decode_kernel, scale=scale, bs=bs,
                              max_blocks=max_blocks, nh=nh)
 
-    def qmap(bh, blk, tables, lens):
-        return (bh // nh, bh % nh, 0, 0)
+    def qmap(b, blk, tables, lens):
+        return (b, 0, 0)
 
-    def kvmap(bh, blk, tables, lens):
-        return (bh % nh, tables[bh // nh, blk], 0, 0)
+    def kvmap(b, blk, tables, lens):
+        return (0, tables[b, blk], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B * nh, max_blocks),
+        grid=(B, max_blocks),
         in_specs=[
-            pl.BlockSpec((None, None, 1, hd), qmap),
-            pl.BlockSpec((None, None, bs, hd), kvmap),
-            pl.BlockSpec((None, None, bs, hd), kvmap),
+            pl.BlockSpec((None, nh, hd), qmap),
+            pl.BlockSpec((nh, None, bs, hd), kvmap),
+            pl.BlockSpec((nh, None, bs, hd), kvmap),
         ],
-        out_specs=pl.BlockSpec((None, None, 1, hd), qmap),
+        out_specs=pl.BlockSpec((None, nh, hd), qmap),
         scratch_shapes=[
-            pltpu.VMEM((1, 128), jnp.float32),
-            pltpu.VMEM((1, 128), jnp.float32),
-            pltpu.VMEM((1, hd), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, hd), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kern,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, nh, 1, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, nh, hd), q.dtype),
         interpret=interpret,
-    )(block_tables, seq_lens, q[:, :, None, :], k_cache, v_cache)
-    return out[:, :, 0, :]
+    )(block_tables, seq_lens, q, k_cache, v_cache)
 
 
 def paged_attention_reference(q, k_cache, v_cache, block_tables, seq_lens):
@@ -159,6 +168,48 @@ def paged_attention_reference(q, k_cache, v_cache, block_tables, seq_lens):
     # l == 0 guard), not a uniform average over pad blocks
     return jnp.einsum("bhs,bshd->bhd", p,
                       v.astype(jnp.float32)).astype(q.dtype)
+
+
+def paged_write_token(k_pool, v_pool, tables, seq_lens, k_step, v_step):
+    """Traced single-token cache write (the in-place decode store of the
+    reference's `fused_multi_transformer_op.cu.h:942-999`, as a
+    functional XLA scatter so it can live inside a `lax.scan` carry).
+
+    k_pool/v_pool: [nh, num_blocks, bs, hd]; tables: [B, max_blocks]
+    int32; seq_lens: [B] current lengths (write position); k_step/v_step:
+    [B, nh, hd].  Returns the updated pools."""
+    bs = k_pool.shape[2]
+    B = k_step.shape[0]
+    slot = seq_lens // bs                                   # [B]
+    off = seq_lens % bs                                     # [B]
+    blk = tables[jnp.arange(B), slot]                       # [B]
+    k_pool = k_pool.at[:, blk, off].set(
+        jnp.moveaxis(k_step, 0, 1).astype(k_pool.dtype))
+    v_pool = v_pool.at[:, blk, off].set(
+        jnp.moveaxis(v_step, 0, 1).astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def paged_write_prefill(k_pool, v_pool, tables, k, v):
+    """Traced bulk prefill write from empty sequences: k/v [B, S, nh, hd]
+    scatter into each sequence's first ceil(S/bs) table blocks (one
+    scatter per pool, not per token).  The pad tail of the last block
+    stays zero and is masked by seq_lens at attend time."""
+    bs = k_pool.shape[2]
+    B, S, nh, hd = k.shape
+    nb = (S + bs - 1) // bs
+    pad = nb * bs - S
+    if pad:
+        zeros = jnp.zeros((B, pad, nh, hd), k.dtype)
+        k = jnp.concatenate([k, zeros], axis=1)
+        v = jnp.concatenate([v, zeros], axis=1)
+    blks = tables[:, :nb].reshape(-1)                       # [B*nb]
+    # [B, nb*bs, nh, hd] -> [nh, B*nb, bs, hd]
+    kb = jnp.moveaxis(k.reshape(B * nb, bs, nh, hd), 2, 0)
+    vb = jnp.moveaxis(v.reshape(B * nb, bs, nh, hd), 2, 0)
+    k_pool = k_pool.at[:, blks].set(kb.astype(k_pool.dtype))
+    v_pool = v_pool.at[:, blks].set(vb.astype(v_pool.dtype))
+    return k_pool, v_pool
 
 
 class BlockKVCache:
