@@ -46,8 +46,64 @@ def enable_compile_cache():
         pass
 
 
+_RESULTS = []  # every rung line, for the end-of-run regression check
+
+
 def log(obj):
+    _RESULTS.append(obj)
     print(json.dumps(obj), file=sys.stderr, flush=True)
+
+
+# metric keys to diff against the previous round, per rung (higher=better)
+_REGRESSION_KEYS = {
+    "gpt124m_train": "tokens_per_sec",
+    "lenet_train": "jit_imgs_per_sec",
+    "resnet50_train": "imgs_per_sec",
+    "bert_base_mlm_train": "tokens_per_sec",
+    "gpt124m_decode": "static_tokens_per_sec",
+}
+
+
+def check_regressions():
+    """Compare this run's rungs against the newest BENCH_r*.json in the
+    repo (the driver's official record of the previous round) and log a
+    per-rung delta line.  VERDICT r3 flagged silent regressions (GPT
+    49.9->45.1% MFU, ResNet -11%) — this makes any backslide visible in
+    the official artifact itself."""
+    import glob
+    arts = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_r*.json")))
+    if not arts:
+        return
+    try:
+        prev_tail = json.load(open(arts[-1])).get("tail", "")
+    except Exception:  # noqa: BLE001
+        return
+    prev = {}
+    for line in prev_tail.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                if "bench" in d:
+                    prev[d["bench"]] = d
+            except json.JSONDecodeError:
+                continue
+    deltas = {}
+    for cur in _RESULTS:
+        name = cur.get("bench")
+        key = _REGRESSION_KEYS.get(name)
+        if not key or key not in cur or name not in prev \
+                or key not in prev[name]:
+            continue
+        old, new = float(prev[name][key]), float(cur[key])
+        if old > 0:
+            deltas[name] = round((new - old) / old, 4)
+    if deltas:
+        log({"bench": "regression_check",
+             "vs": os.path.basename(arts[-1]), "rel_delta": deltas,
+             "regressed": sorted(k for k, v in deltas.items()
+                                 if v < -0.03)})
 
 
 def marginal_step_s(run_steps, sync_read, n1=3, n2=13, reps=1):
@@ -427,13 +483,79 @@ def bench_decode_longctx():
     _release_device_memory()
     out = model.generate(ids, max_new_tokens=new, cache_impl="paged")
     np.asarray(out._value)
-    t0 = time.perf_counter()
-    out = model.generate(ids, max_new_tokens=new, cache_impl="paged")
-    np.asarray(out._value)
-    tps = B * new / (time.perf_counter() - t0)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new, cache_impl="paged")
+        np.asarray(out._value)
+        best = min(best, time.perf_counter() - t0)
+    tps = B * new / best
     log({"bench": "gpt124m_decode_32k_config", "batch": B,
          "prompt": prompt, "new_tokens": new, "static": static_result,
          "paged_tokens_per_sec": round(tps, 1)})
+
+
+def bench_ring_attention():
+    """Long-context rung (SURVEY §5.7): S=8192 causal attention fwd+bwd.
+
+    Compares the Pallas flash kernel over the full sequence against ONE
+    member of an 8-way sequence-parallel ring
+    (`ring_attention_chunked`: the busiest causal rank — last S/8
+    queries, 8 K/V hops — exactly the per-device program of
+    `ring_attention`).  Reports tokens/s (ring member tokens/s is
+    per-device; 8 members run concurrently on an 8-chip ring) plus each
+    compiled program's XLA temp memory: the member's (S/8, S/8) score
+    blocks are the memory shape that lets an 8-ring hold 8x the
+    context per chip."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.nn.functional.ring_attention import \
+        ring_attention_chunked
+    from paddle_tpu.ops import pallas_flash
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    B, nh, S, hd = (1, 12, 8192, 64) if on_tpu else (1, 2, 512, 64)
+    R = 8
+    key = jax.random.key(0)
+    qs = jax.random.normal(key, (B, S, nh, hd), jnp.bfloat16) * 0.1
+    ks, vs = qs * 0.7, qs * 1.3
+    bhsd = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # noqa: E731
+
+    def loss_flash(q, k, v):
+        o = pallas_flash.flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) * 1e-6)
+
+    def loss_ring(q, k, v):
+        o = ring_attention_chunked(q, k, v, n_chunks=R, causal=True,
+                                   q_off=S - S // R)
+        return jnp.sum(o.astype(jnp.float32) * 1e-6)
+
+    res = {}
+    for name, fn, args, toks in (
+            ("flash", loss_flash, (qs, ks, vs), B * S),
+            ("ring", loss_ring,
+             (bhsd(qs)[:, :, -(S // R):], bhsd(ks), bhsd(vs)),
+             B * S // R)):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        lowered = g.lower(*args).compile()
+        mem = lowered.memory_analysis()
+        temp = getattr(mem, "temp_size_in_bytes", 0)
+        r = lowered(*args)
+        np.asarray(r[0][0, 0, 0, :2])
+        best = float("inf")
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.perf_counter()
+            for _ in range(8):
+                r = g(*args)
+            np.asarray(r[0][0, 0, 0, :2])
+            best = min(best, (time.perf_counter() - t0) / 8)
+        res[name] = (toks / best, temp)
+    log({"bench": "ring_attention_8k", "batch": B, "seq": S, "heads": nh,
+         "ring_degree": R,
+         "flash_tokens_per_sec": round(res["flash"][0], 1),
+         "ring_member_tokens_per_sec": round(res["ring"][0], 1),
+         "flash_temp_mb": round(res["flash"][1] / 2**20, 1),
+         "ring_member_temp_mb": round(res["ring"][1] / 2**20, 1)})
 
 
 def _release_device_memory():
@@ -485,6 +607,8 @@ def main():
     _run_rung("gpt124m_decode_32k_config", bench_decode_longctx, 150)
     _run_rung("resnet50_train", bench_resnet50, 380)
     _run_rung("bert_base_mlm_train", bench_bert_base, 500)
+    _run_rung("ring_attention_8k", bench_ring_attention, 120)
+    check_regressions()
 
 
 if __name__ == "__main__":

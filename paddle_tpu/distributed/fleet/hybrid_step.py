@@ -700,3 +700,52 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
         out_specs=(P(), specs, opt_specs, opt_specs),
         check_vma=False)
     return jax.jit(mapped)
+
+
+# ---------------------------------------------------------------------------
+# schedule accounting (no execution): busy/bubble tick analysis
+# ---------------------------------------------------------------------------
+
+def schedule_table(pp: int, vpp: int, n_microbatches: int):
+    """Per-rank tick table of the interleaved schedule, computed from the
+    SAME index arithmetic as the tick loop in `make_hybrid_train_step`
+    (u = t - rank; active iff 0 <= u < M*vpp; chunk slot / microbatch
+    decomposition per `pipeline_parallel.py:986`'s block sweep).
+
+    Returns [rank][tick] entries: None for a bubble tick, else
+    (chunk_slot, microbatch)."""
+    M = n_microbatches
+    # mirrors HybridConfig's guard: the block sweep decomposition assumes
+    # whole blocks of pp microbatches (phantom microbatch ids otherwise)
+    assert M % pp == 0, f"n_microbatches {M} must divide by pp {pp}"
+    period = pp * vpp
+    T = M * vpp + pp - 1
+    table = []
+    for p in range(pp):
+        row = []
+        for t in range(T):
+            u = t - p
+            if 0 <= u < M * vpp:
+                jslot = (u % period) // pp
+                mb = (u // period) * pp + u % pp
+                row.append((jslot, mb))
+            else:
+                row.append(None)
+        table.append(row)
+    return table
+
+
+def bubble_fraction(pp: int, vpp: int, n_microbatches: int) -> float:
+    """Bubble time as a fraction of each rank's BUSY time.  Every tick
+    computes one chunk (1/vpp of the rank's layers), so ticks are
+    uniform within a schedule; per rank there are pp-1 bubble ticks and
+    M*vpp busy ticks -> (pp-1)/(M*vpp), the classic interleaved-schedule
+    bubble ratio (GPipe at vpp=1: (pp-1)/M)."""
+    table = schedule_table(pp, vpp, n_microbatches)
+    bubble = sum(e is None for row in table for e in row)
+    busy = sum(e is not None for row in table for e in row)
+    # sanity: every (chunk, microbatch) pair computed exactly once/rank
+    for row in table:
+        work = [e for e in row if e is not None]
+        assert len(set(work)) == len(work) == n_microbatches * vpp
+    return bubble / busy if busy else 0.0

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ring_attention_local", "ring_attention"]
+__all__ = ["ring_attention_local", "ring_attention",
+           "ring_attention_chunked"]
 
 _NEG = -1e30
 
@@ -134,3 +135,40 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
 
 
 _register()
+
+
+def ring_attention_chunked(q, k, v, n_chunks: int, causal: bool = False,
+                           scale: Optional[float] = None, q_off: int = 0):
+    """Single-device form of one ring member: the SAME `_block_update`
+    hop math, with the K/V rotation replaced by a `lax.scan` over the
+    chunks (all resident).  q is this member's query slice (q_off = its
+    absolute sequence offset, for the causal mask); k/v carry the FULL
+    context.  Scores only ever materialize as (B, H, S_q, S_k/n) blocks —
+    the memory shape that lets an n-device ring hold n× the context.
+
+    q: (B, H, S_q, D); k, v: (B, H, S_k, D), S_k divisible by n_chunks.
+    Exact (online softmax), matching the multi-device `ring_attention`
+    hop-for-hop.
+    """
+    B, H, Sq, D = q.shape
+    if scale is None:
+        scale = D ** -0.5
+    C = k.shape[2] // n_chunks
+    kc = k.reshape(B, H, n_chunks, C, D)
+    vc = v.reshape(B, H, n_chunks, C, D)
+
+    acc0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = jnp.full((B, H, Sq), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+
+    def hop(carry, i):
+        acc, m, l = carry
+        acc, m, l = _block_update(
+            q, kc[:, :, i], vc[:, :, i], acc, m, l,
+            q_off=q_off, k_off=i * C, causal=causal, scale=scale)
+        return (acc, m, l), None
+
+    (acc, m, l), _ = jax.lax.scan(hop, (acc0, m0, l0),
+                                  jnp.arange(n_chunks))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return out.astype(q.dtype)

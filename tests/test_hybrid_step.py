@@ -90,3 +90,27 @@ def test_hybrid_moe_expert_parallel():
 def test_hybrid_moe_with_vpp():
     _run_parity(HybridConfig(num_layers=8, pp=2, dp=2, mp=2, vpp=2,
                              moe_num_experts=4, n_microbatches=2), 8)
+
+
+def test_schedule_bubble_accounting():
+    """Interleaved-schedule tick table: every rank computes each
+    (chunk, microbatch) exactly once, bubble ratio matches
+    (pp-1)/(M*vpp), and vpp strictly shrinks it (ref
+    pipeline_parallel.py:986 interleaved schedule)."""
+    from paddle_tpu.distributed.fleet.hybrid_step import (bubble_fraction,
+                                                          schedule_table)
+    assert bubble_fraction(4, 1, 8) == 3 / 8
+    assert bubble_fraction(4, 2, 8) == 3 / 16
+    assert bubble_fraction(2, 1, 2) == 1 / 2
+    assert bubble_fraction(1, 1, 4) == 0.0
+    for pp, vpp, M in ((4, 1, 8), (4, 2, 8), (2, 2, 4), (8, 4, 16)):
+        assert bubble_fraction(pp, vpp, M) == (pp - 1) / (M * vpp)
+        if vpp > 1:
+            assert bubble_fraction(pp, vpp, M) < bubble_fraction(pp, 1, M)
+    # the tick a rank receives work must be one after the upstream rank
+    # produced it: rank p's first busy tick is t = p (ring latency 1)
+    table = schedule_table(4, 2, 8)
+    for p, row in enumerate(table):
+        first_busy = next(t for t, e in enumerate(row) if e is not None)
+        assert first_busy == p
+        assert row[first_busy] == (0, 0)  # starts on chunk 0, microbatch 0

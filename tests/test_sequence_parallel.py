@@ -145,3 +145,25 @@ def test_sp_mark_and_hooks(hybrid_mesh):
     assert is_sequence_parallel_parameter(ln.weight)
     assert not is_sequence_parallel_parameter(ln.bias)
     register_sequence_parallel_allreduce_hooks(ln)  # replicated: no raise
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_chunked_matches_full(causal):
+    """Single-device ring member (`ring_attention_chunked`): full-q form
+    matches dense attention, and the query-slice form (one member's
+    program, q_off set) matches the member's rows of the full result."""
+    from paddle_tpu.incubate.nn.functional.ring_attention import \
+        ring_attention_chunked
+    q, k, v = qkv()
+    want = full_attention(q, k, v, causal)
+    got = ring_attention_chunked(q, k, v, n_chunks=4, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # busiest member of an 8-ring: last S/8 queries over the full context
+    S = q.shape[2]
+    qs = q[:, :, -(S // 8):]
+    member = ring_attention_chunked(qs, k, v, n_chunks=8, causal=causal,
+                                    q_off=S - S // 8)
+    np.testing.assert_allclose(np.asarray(member),
+                               np.asarray(want[:, :, -(S // 8):]),
+                               rtol=2e-5, atol=2e-5)
