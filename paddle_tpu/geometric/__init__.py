@@ -31,11 +31,11 @@ def _num_segments(segment_ids) -> int:
     return int(jax.device_get(ids.max())) + 1
 
 
-register_op("segment_sum", lambda data, ids, *, n:
+register_op("geo_segment_sum", lambda data, ids, *, n:
             jax.ops.segment_sum(data, ids, num_segments=n))
-register_op("segment_min", lambda data, ids, *, n:
+register_op("geo_segment_min", lambda data, ids, *, n:
             jax.ops.segment_min(data, ids, num_segments=n))
-register_op("segment_max", lambda data, ids, *, n:
+register_op("geo_segment_max", lambda data, ids, *, n:
             jax.ops.segment_max(data, ids, num_segments=n))
 
 
@@ -47,26 +47,26 @@ def _segment_mean_impl(data, ids, *, n):
     return tot / jnp.maximum(cnt, 1).reshape(shape)
 
 
-register_op("segment_mean", _segment_mean_impl)
+register_op("geo_segment_mean", _segment_mean_impl)
 
 
 def segment_sum(data, segment_ids, name=None):
-    return _d("segment_sum", (data, segment_ids),
+    return _d("geo_segment_sum", (data, segment_ids),
               {"n": _num_segments(segment_ids)})
 
 
 def segment_mean(data, segment_ids, name=None):
-    return _d("segment_mean", (data, segment_ids),
+    return _d("geo_segment_mean", (data, segment_ids),
               {"n": _num_segments(segment_ids)})
 
 
 def segment_min(data, segment_ids, name=None):
-    return _d("segment_min", (data, segment_ids),
+    return _d("geo_segment_min", (data, segment_ids),
               {"n": _num_segments(segment_ids)})
 
 
 def segment_max(data, segment_ids, name=None):
-    return _d("segment_max", (data, segment_ids),
+    return _d("geo_segment_max", (data, segment_ids),
               {"n": _num_segments(segment_ids)})
 
 

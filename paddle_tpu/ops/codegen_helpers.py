@@ -970,3 +970,733 @@ def logspace(start, stop, num, base=10.0, dtype=None):
     import jax.numpy as jnp
     out = jnp.logspace(start, stop, int(num), base=base)
     return out.astype(dtype) if dtype else out
+
+
+# ---------------------------------------------------------------------------
+# round-4 tail ops (VERDICT missing list): pooling-with-index, deformable
+# conv, detection heads, margin losses, linalg stragglers
+# ---------------------------------------------------------------------------
+
+def matrix_exp(x):
+    """Matrix exponential.  Parity: python/paddle/tensor/linalg.py
+    matrix_exp (scaling-and-squaring Pade); here jax.scipy.linalg.expm."""
+    import jax
+    return jax.scipy.linalg.expm(x)
+
+
+def take(x, index, *, mode="raise"):
+    """Flattened-index gather.  Parity: python/paddle/tensor/math.py take
+    (modes raise/wrap/clip; 'raise' clamps under jit like 'clip' — XLA has
+    no throwing gather)."""
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    else:  # raise / clip
+        idx = jnp.clip(idx, -n, n - 1)
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def ormqr(x, tau, other, *, left=True, transpose=False):
+    """Multiply `other` by the (implicit, full m x m) Q of a geqrf
+    factorization (x, tau).  Parity: python/paddle/tensor/linalg.py
+    ormqr.  Q = H_1 ... H_k is applied reflector-by-reflector under a
+    lax.scan — Q is never materialized (LAPACK ormqr semantics)."""
+    import jax
+
+    def apply_left(c, trans):
+        m = x.shape[0]
+        rows = jnp.arange(m)
+
+        def refl(ci, i):
+            v = jnp.where(rows == i, 1.0,
+                          jnp.where(rows > i, x[:, i], 0.0))
+            return ci - tau[i] * jnp.outer(v, v @ ci), None
+
+        k = tau.shape[0]
+        order = jnp.arange(k) if trans else jnp.arange(k - 1, -1, -1)
+        out, _ = jax.lax.scan(refl, c, order)
+        return out
+
+    if left:
+        return apply_left(other, transpose)
+    # C @ Q = (Q^T C^T)^T ; C @ Q^T = (Q C^T)^T
+    return apply_left(other.swapaxes(-1, -2), not transpose) \
+        .swapaxes(-1, -2)
+
+
+def as_strided(x, *, shape, stride, offset=0):
+    """View with explicit strides over the flattened buffer.  Parity:
+    python/paddle/tensor/manipulation.py as_strided.  XLA has no aliasing
+    views; this materializes the gather (same numerics)."""
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset)
+    for dim, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(dim) * st
+    return flat[idx.reshape(tuple(shape))]
+
+
+def tensor_unfold(x, *, axis=0, size=1, step=1):
+    """Sliding windows of `size` every `step` along `axis` (appended as
+    the last dim).  Parity: python/paddle/tensor/manipulation.py unfold
+    (the Tensor method; the reference's tensor_unfold op)."""
+    axis = axis % x.ndim
+    n = (x.shape[axis] - size) // step + 1
+    starts = jnp.arange(n) * step
+    windows = starts[:, None] + jnp.arange(size)[None, :]   # [n, size]
+    out = jnp.take(x, windows.reshape(-1), axis=axis)
+    out = out.reshape(x.shape[:axis] + (n, size) + x.shape[axis + 1:])
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def fill_diagonal_tensor(x, y, *, offset=0, dim1=0, dim2=1):
+    """Write y into the (offset) diagonal plane of x spanned by
+    (dim1, dim2).  Parity: python/paddle/tensor/manipulation.py
+    fill_diagonal_tensor."""
+    nd = x.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    xm = jnp.moveaxis(x, (d1, d2), (nd - 2, nd - 1))
+    n = min(xm.shape[-2] - max(-offset, 0), xm.shape[-1] - max(offset, 0))
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    xm = xm.at[..., rows, cols].set(y)
+    return jnp.moveaxis(xm, (nd - 2, nd - 1), (d1, d2))
+
+
+def _pool_patches(x, ksize, strides, padding):
+    """[N, C, H, W] -> patches [N, C, OH, OW, kh*kw] + flat input indices
+    of each patch element (NCHW flat over H*W)."""
+    import jax
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = padding
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=-jnp.inf)
+    Hp, Wp = H + 2 * ph, W + 2 * pw
+    OH = (Hp - kh) // sh + 1
+    OW = (Wp - kw) // sw + 1
+    # window top-left coords
+    hs = jnp.arange(OH) * sh
+    ws = jnp.arange(OW) * sw
+    # per-window element coords [OH, OW, kh, kw]
+    hh = hs[:, None, None, None] + jnp.arange(kh)[None, None, :, None]
+    ww = ws[None, :, None, None] + jnp.arange(kw)[None, None, None, :]
+    patches = xp[:, :, hh, ww]                    # [N, C, OH, OW, kh, kw]
+    patches = patches.reshape(N, C, OH, OW, kh * kw)
+    # flat index into the UNpadded H*W plane (padding positions < 0 or
+    # >= H/W never win the max: they hold -inf)
+    uh = hh - ph
+    uw = ww - pw
+    flat = (uh * W + uw).reshape(OH, OW, kh * kw)
+    return patches, flat
+
+
+def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0):
+    """Max pooling returning (out, flat argmax indices over H*W) — the
+    reference's max_pool2d_with_index op (paddle
+    nn/functional/pooling.py max_pool2d return_mask=True)."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    patches, flat = _pool_patches(x, ks, st, pd)
+    arg = jnp.argmax(patches, axis=-1)            # [N, C, OH, OW]
+    out = jnp.max(patches, axis=-1)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(flat, patches.shape).astype(jnp.int32),
+        arg[..., None], axis=-1)[..., 0]
+    return out, idx
+
+
+def max_unpool2d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Inverse of max_pool2d_with_index: scatter pooled values back to
+    their argmax positions.  Parity: python/paddle/nn/functional/pooling.py
+    max_unpool2d (unpool op)."""
+    N, C, OH, OW = x.shape
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    if output_size is None:
+        H = (OH - 1) * st[0] + ks[0] - 2 * (
+            padding if isinstance(padding, int) else padding[0])
+        W = (OW - 1) * st[1] + ks[1] - 2 * (
+            padding if isinstance(padding, int) else padding[1])
+    else:
+        H, W = output_size[-2], output_size[-1]
+    flat_out = jnp.zeros((N, C, H * W), x.dtype)
+    flat_out = flat_out.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        indices.reshape(N, C, -1)].set(x.reshape(N, C, -1))
+    return flat_out.reshape(N, C, H, W)
+
+
+def max_unpool3d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """3-D unpool (scatter by flat D*H*W indices).  Parity: max_unpool3d
+    / unpool3d op."""
+    N, C, OD, OH, OW = x.shape
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    pd = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    if output_size is None:
+        D = (OD - 1) * st[0] + ks[0] - 2 * pd[0]
+        H = (OH - 1) * st[1] + ks[1] - 2 * pd[1]
+        W = (OW - 1) * st[2] + ks[2] - 2 * pd[2]
+    else:
+        D, H, W = output_size[-3], output_size[-2], output_size[-1]
+    flat_out = jnp.zeros((N, C, D * H * W), x.dtype)
+    flat_out = flat_out.at[
+        jnp.arange(N)[:, None, None],
+        jnp.arange(C)[None, :, None],
+        indices.reshape(N, C, -1)].set(x.reshape(N, C, -1))
+    return flat_out.reshape(N, C, D, H, W)
+
+
+def _fractional_starts(inp, out, u):
+    """Ben Graham fractional-pooling index sequence: ceil(alpha*(i+u)) -
+    ceil(alpha*u) per output cell, alpha = inp/out."""
+    alpha = inp / out
+    i = jnp.arange(out + 1)
+    pts = jnp.ceil(alpha * (i + u)).astype(jnp.int32) - \
+        jnp.ceil(alpha * u).astype(jnp.int32)
+    return jnp.clip(pts, 0, inp)
+
+
+def fractional_max_pool2d(x, *, output_size, kernel_size=None,
+                          random_u=None):
+    """Fractional max pooling (Graham 2014).  Parity:
+    python/paddle/nn/functional/pooling.py fractional_max_pool2d.
+    Deterministic pseudo-random regions from `random_u` (default 0.5).
+    kernel_size=None -> disjoint partition cells; an int/pair ->
+    OVERLAPPING windows of that size starting at the fractional starts
+    (the reference's overlapping mode)."""
+    N, C, H, W = x.shape
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    u = 0.5 if random_u is None else float(random_u)
+    hs = _fractional_starts(H, oh, u)
+    ws = _fractional_starts(W, ow, u)
+    if kernel_size is None:
+        kh = int(jnp.max(hs[1:] - hs[:-1]))
+        kw = int(jnp.max(ws[1:] - ws[:-1]))
+        hend, wend = hs[1:], ws[1:]
+    else:
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else tuple(kernel_size)
+        hend = jnp.minimum(hs[:-1] + kh, H)
+        wend = jnp.minimum(ws[:-1] + kw, W)
+    hh = jnp.minimum(hs[:-1, None] + jnp.arange(kh)[None, :], H - 1)
+    ww = jnp.minimum(ws[:-1, None] + jnp.arange(kw)[None, :], W - 1)
+    # mask out positions beyond each window's true extent
+    hvalid = (hs[:-1, None] + jnp.arange(kh)[None, :]) < hend[:, None]
+    wvalid = (ws[:-1, None] + jnp.arange(kw)[None, :]) < wend[:, None]
+    patches = x[:, :, hh[:, :, None, None], ww[None, None]]
+    patches = jnp.moveaxis(patches, 3, 4)  # [N, C, oh, ow, kh, kw]
+    valid = hvalid[:, None, :, None] & wvalid[None, :, None, :]
+    patches = jnp.where(valid[None, None], patches, -jnp.inf)
+    return jnp.max(patches.reshape(N, C, oh, ow, -1), axis=-1)
+
+
+def fractional_max_pool3d(x, *, output_size, kernel_size=None,
+                          random_u=None):
+    """3-D fractional max pooling: the 2-D rule applied per depth slab
+    (depth also fractionally partitioned)."""
+    N, C, D, H, W = x.shape
+    od, oh, ow = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+    u = 0.5 if random_u is None else float(random_u)
+    ds = _fractional_starts(D, od, u)
+    out = []
+    for i in range(od):
+        d0, d1 = int(ds[i]), max(int(ds[i + 1]), int(ds[i]) + 1)
+        slab = jnp.max(x[:, :, d0:d1], axis=2)
+        out.append(fractional_max_pool2d(slab, output_size=(oh, ow),
+                                         random_u=u))
+    return jnp.stack(out, axis=2)
+
+
+def class_center_sample(label, *, num_classes, num_samples, seed=None):
+    """Sample negative class centers for partial-FC margin softmax.
+    Parity: python/paddle/nn/functional/common.py:2104
+    class_center_sample — positives always kept, negatives filled up to
+    num_samples, labels remapped into the sampled index space.
+
+    Deterministic given `seed` (framework RNG when None).  Static output
+    shape [num_samples] (the reference's output is dense per rank too)."""
+    import jax
+    label = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.zeros((num_classes,), jnp.bool_).at[label].set(True)
+    key = _next_key() if seed is None else jax.random.key(seed)
+    noise = jax.random.uniform(key, (num_classes,))
+    # order: all positives first (score 2+noise), then random negatives
+    score = jnp.where(pos, 2.0 + noise, noise)
+    _, sampled = jax.lax.top_k(score, num_samples)    # class ids
+    # remap: position of each label among sampled ids
+    rank_of = jnp.full((num_classes,), -1, jnp.int32)
+    rank_of = rank_of.at[sampled].set(jnp.arange(num_samples,
+                                                 dtype=jnp.int32))
+    return rank_of[label], sampled
+
+
+def margin_cross_entropy(logits, label, *, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False):
+    """ArcFace-family margin softmax CE over cosine logits.  Parity:
+    python/paddle/nn/functional/common.py margin_cross_entropy
+    (margin_cross_entropy op): target logit cos(m1*theta + m2) - m3,
+    all scaled by `scale`."""
+    import jax
+    label = label.reshape(-1).astype(jnp.int32)
+    n, c = logits.shape
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, c, dtype=logits.dtype)
+    adj = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    loss = -jnp.take_along_axis(logp, label[:, None], axis=1)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def hsigmoid_loss(x, label, weight, bias=None, *, num_classes):
+    """Hierarchical sigmoid loss over the default complete binary tree.
+    Parity: python/paddle/nn/functional/loss.py hsigmoid_loss
+    (hsigmoid_loss op, default-tree path codes).
+
+    Tree: num_classes leaves under num_classes-1 internal nodes (heap
+    layout, root = node 1 in 1-based terms); a leaf's path is the bit
+    decomposition of (leaf + num_classes) from the MSB below the root."""
+    import jax
+    label = label.reshape(-1).astype(jnp.int32)
+    depth = int(num_classes - 1).bit_length()
+    code = label + num_classes                        # heap position
+    # path nodes: code >> (k+1) for k = depth-1 .. 0 while node >= 1
+    ks = jnp.arange(depth, 0, -1)                     # [depth]
+    nodes = code[:, None] >> ks[None, :]              # [N, depth]
+    bits = (code[:, None] >> (ks[None, :] - 1)) & 1   # child direction
+    valid = nodes >= 1
+    nodes = jnp.clip(nodes - 1, 0, num_classes - 2)   # weight row ids
+    w = weight[nodes]                                 # [N, depth, D]
+    logits = jnp.einsum("nd,nkd->nk", x, w)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[nodes]
+    # sigmoid CE per node: bit 0 -> positive class (paddle's convention)
+    lab = 1.0 - bits.astype(logits.dtype)
+    ce = jnp.maximum(logits, 0) - logits * lab + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+
+
+def _bilinear_sample_nchw(img, y, x):
+    """img [C, H, W]; y/x arbitrary equal shapes -> [C, *y.shape];
+    zero-padded outside (the deformable-conv border rule)."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+    out = 0.0
+    for dy, sy in ((0, 1 - wy), (1, wy)):
+        for dx, sx in ((0, 1 - wx), (1, wx)):
+            yy = y0 + dy
+            xx = x0 + dx
+            inside = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            v = img[:, yi, xi]                        # [C, ...]
+            out = out + jnp.where(inside, sy * sx, 0.0)[None] * v
+    return out
+
+
+def deformable_conv(x, offset, weight, mask=None, *, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1):
+    """Deformable convolution v1/v2 (mask=None -> v1).  Parity:
+    python/paddle/vision/ops.py:883 deform_conv2d / deformable_conv op.
+
+    TPU formulation: bilinear-sample the deformed receptive field into an
+    im2col tensor (gathers), then one big matmul onto the MXU — the
+    reference's CUDA kernel interleaves sampling and MAC; splitting them
+    lets XLA batch the FLOPs."""
+    import jax
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    N, Cin, H, W = x.shape
+    Cout, Cin_g, kh, kw = weight.shape
+    OH = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+    OW = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+    K = kh * kw
+    # base sampling grid [OH, OW, K]
+    hs = jnp.arange(OH) * st[0] - pd[0]
+    ws = jnp.arange(OW) * st[1] - pd[1]
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dl[0], jnp.arange(kw) * dl[1],
+                          indexing="ij")
+    base_y = hs[:, None, None] + ky.reshape(-1)[None, None, :]
+    base_x = ws[None, :, None] + kx.reshape(-1)[None, None, :]
+    off = offset.reshape(N, deformable_groups, K, 2, OH, OW)
+    cpd = Cin // deformable_groups     # channels per deformable group
+
+    def one_image(img, off_i, mask_i):
+        # per deformable group sampling coords [dg, OH, OW, K]
+        oy = jnp.moveaxis(off_i[:, :, 0], (2, 3), (1, 2))  # [dg, OH, OW, K]
+        ox = jnp.moveaxis(off_i[:, :, 1], (2, 3), (1, 2))
+        ys = base_y[None] + oy
+        xs = base_x[None] + ox
+        cols = []
+        for g in range(deformable_groups):
+            sub = _bilinear_sample_nchw(img[g * cpd:(g + 1) * cpd],
+                                        ys[g], xs[g])
+            if mask_i is not None:
+                m = jnp.moveaxis(mask_i[g], (1, 2), (0, 1))  # [OH, OW, K]
+                sub = sub * m[None]
+            cols.append(sub)                     # [C/dg, OH, OW, K]
+        return jnp.concatenate(cols, axis=0)     # [Cin, OH, OW, K]
+
+    if mask is not None:
+        mask_r = mask.reshape(N, deformable_groups, K, OH, OW)
+        cols = jax.vmap(one_image)(x, off, mask_r)
+    else:
+        cols = jax.vmap(lambda img, o: one_image(img, o, None))(x, off)
+    # cols [N, Cin, OH, OW, K] @ weight [Cout, Cin/g, kh*kw]
+    wmat = weight.reshape(Cout, Cin_g * K)
+    if groups == 1:
+        cm = cols.transpose(0, 2, 3, 1, 4).reshape(N, OH, OW, Cin * K)
+        out = cm @ wmat.T                         # [N, OH, OW, Cout]
+    else:
+        cg = cols.reshape(N, groups, Cin // groups, OH, OW, K)
+        wg = weight.reshape(groups, Cout // groups, Cin_g * K)
+        cm = cg.transpose(0, 1, 3, 4, 2, 5).reshape(
+            N, groups, OH, OW, (Cin // groups) * K)
+        out = jnp.einsum("nghwk,gok->ngohw", cm, wg)
+        return out.reshape(N, Cout, OH, OW)
+    return jnp.moveaxis(out, -1, 1)               # [N, Cout, OH, OW]
+
+
+def roi_pool(x, boxes, boxes_num=None, *, output_size=1,
+             spatial_scale=1.0):
+    """Max ROI pooling (quantized bins).  Parity:
+    python/paddle/vision/ops.py roi_pool / roi_pool op.  x [N, C, H, W],
+    boxes [R, 4] (x1, y1, x2, y2); boxes_num assigns rows to images."""
+    import jax
+    N, C, H, W = x.shape
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    R = boxes.shape[0]
+    if boxes_num is None:
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of = jnp.repeat(jnp.arange(len(boxes_num)),
+                            jnp.asarray(boxes_num), total_repeat_length=R)
+    b = jnp.round(boxes * spatial_scale).astype(jnp.int32)
+
+    def pool_one(box, img_i):
+        x1, y1, x2, y2 = box
+        bh = jnp.maximum(y2 - y1 + 1, 1)
+        bw = jnp.maximum(x2 - x1 + 1, 1)
+        # bin edges (quantized like the reference kernel)
+        ys = y1 + (jnp.arange(oh + 1) * bh) // oh
+        xs = x1 + (jnp.arange(ow + 1) * bw) // ow
+        rows = jnp.arange(H)[None, :]
+        cols = jnp.arange(W)[None, :]
+        rmask = (rows >= ys[:-1, None]) & (rows < jnp.maximum(
+            ys[1:, None], ys[:-1, None] + 1))          # [oh, H]
+        cmask = (cols >= xs[:-1, None]) & (cols < jnp.maximum(
+            xs[1:, None], xs[:-1, None] + 1))          # [ow, W]
+        img = x[img_i]                                 # [C, H, W]
+        m = rmask[:, None, :, None] & cmask[None, :, None, :]  # oh,ow,H,W
+        vals = jnp.where(m[None], img[:, None, None], -jnp.inf)
+        return jnp.max(vals, axis=(-2, -1))            # [C, oh, ow]
+
+    return jax.vmap(pool_one)(b, img_of)
+
+
+def psroi_pool(x, boxes, boxes_num=None, *, output_size=7,
+               spatial_scale=1.0):
+    """Position-sensitive ROI average pooling (R-FCN).  Parity:
+    python/paddle/vision/ops.py psroi_pool / psroi_pool op: input
+    channels C = out_c * oh * ow; bin (i, j) pools its OWN channel
+    group."""
+    import jax
+    N, C, H, W = x.shape
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    out_c = C // (oh * ow)
+    R = boxes.shape[0]
+    if boxes_num is None:
+        img_of = jnp.zeros((R,), jnp.int32)
+    else:
+        img_of = jnp.repeat(jnp.arange(len(boxes_num)),
+                            jnp.asarray(boxes_num), total_repeat_length=R)
+    bx = boxes * spatial_scale
+
+    def pool_one(box, img_i):
+        x1, y1, x2, y2 = box
+        bh = jnp.maximum(y2 - y1, 0.1)
+        bw = jnp.maximum(x2 - x1, 0.1)
+        ys = y1 + jnp.arange(oh + 1) * (bh / oh)
+        xs = x1 + jnp.arange(ow + 1) * (bw / ow)
+        rows = jnp.arange(H)[None, :] + 0.5
+        cols = jnp.arange(W)[None, :] + 0.5
+        rmask = (rows >= ys[:-1, None]) & (rows < ys[1:, None])
+        cmask = (cols >= xs[:-1, None]) & (cols < xs[1:, None])
+        img = x[img_i].reshape(out_c, oh, ow, H, W)
+        m = (rmask[:, None, :, None] & cmask[None, :, None, :])
+        w = m[None].astype(x.dtype)                    # [1, oh, ow, H, W]
+        num = jnp.sum(img * w, axis=(-2, -1))
+        den = jnp.maximum(jnp.sum(w, axis=(-2, -1)), 1.0)
+        return num / den                               # [out_c, oh, ow]
+
+    return jax.vmap(pool_one)(bx, img_of)
+
+
+def prior_box(input, image, *, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    """SSD prior (anchor) boxes.  Parity: python/paddle/vision/ops.py
+    prior_box / prior_box op.  Returns (boxes [H, W, P, 4],
+    variances [H, W, P, 4]) normalized to the image."""
+    H, W = input.shape[-2:]
+    IH, IW = image.shape[-2:]
+    sh = steps[1] or IH / H
+    sw = steps[0] or IW / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    whs = []
+    for mi, ms in enumerate(min_sizes):
+        whs.append((ms, ms))
+        if max_sizes:
+            mx = max_sizes[mi]
+            whs.append(((ms * mx) ** 0.5, (ms * mx) ** 0.5))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            whs.append((ms * ar ** 0.5, ms / ar ** 0.5))
+    P = len(whs)
+    cy = (jnp.arange(H) + offset) * sh
+    cx = (jnp.arange(W) + offset) * sw
+    wh = jnp.asarray(whs, jnp.float32)                # [P, 2]
+    boxes = jnp.stack(jnp.broadcast_arrays(
+        (cx[None, :, None] - wh[None, None, :, 0] / 2) / IW,
+        (cy[:, None, None] - wh[None, None, :, 1] / 2) / IH,
+        (cx[None, :, None] + wh[None, None, :, 0] / 2) / IW,
+        (cy[:, None, None] + wh[None, None, :, 1] / 2) / IH), axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """Decode YOLOv3 head predictions into boxes + scores.  Parity:
+    python/paddle/vision/ops.py yolo_box / yolo_box op.
+    x [N, A*(5+cls), H, W]; returns (boxes [N, A*H*W, 4],
+    scores [N, A*H*W, cls])."""
+    import jax
+    N, _, H, W = x.shape
+    A = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    p = x.reshape(N, A, 5 + class_num, H, W)
+    gx = (jnp.arange(W)[None, None, None, :] +
+          (jax.nn.sigmoid(p[:, :, 0]) - 0.5) * scale_x_y + 0.5) / W
+    gy = (jnp.arange(H)[None, None, :, None] +
+          (jax.nn.sigmoid(p[:, :, 1]) - 0.5) * scale_x_y + 0.5) / H
+    gw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample_ratio * W)
+    gh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample_ratio * H)
+    obj = jax.nn.sigmoid(p[:, :, 4])
+    cls = jnp.moveaxis(jax.nn.sigmoid(p[:, :, 5:]), 2, -1)
+    ih = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    iw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (gx - gw / 2) * iw
+    y1 = (gy - gh / 2) * ih
+    x2 = (gx + gw / 2) * iw
+    y2 = (gy + gh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    keep = obj[..., None] >= conf_thresh
+    scores = jnp.where(keep, cls * obj[..., None],
+                       0.0).reshape(N, -1, class_num)
+    return boxes, scores
+
+
+def yolo_loss(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
+              ignore_thresh=0.7, downsample_ratio=32, use_label_smooth=True,
+              scale_x_y=1.0):
+    """YOLOv3 training loss (core terms: xywh + objectness + class).
+    Parity: python/paddle/vision/ops.py yolo_loss / yolo_loss op.
+    x [N, A*(5+cls), H, W]; gt_box [N, B, 4] (cx, cy, w, h, normalized);
+    gt_label [N, B].  Returns [N] loss."""
+    import jax
+    N, _, H, W = x.shape
+    A = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask)]             # [A, 2] pixels
+    inp_w = downsample_ratio * W
+    inp_h = downsample_ratio * H
+    p = x.reshape(N, A, 5 + class_num, H, W)
+    B = gt_box.shape[1]
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)   # [N, B]
+    # responsible cell + best anchor per gt (max IoU on w/h)
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    gw = gt_box[..., 2] * inp_w
+    gh = gt_box[..., 3] * inp_h
+    inter = jnp.minimum(gw[..., None], an_all[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], an_all[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        (an_all[:, 0] * an_all[:, 1])[None, None] - inter
+    best = jnp.argmax(inter / union, axis=-1)         # [N, B] global id
+    mask_arr = jnp.asarray(anchor_mask)
+    local = jnp.argmax(best[..., None] == mask_arr[None, None], axis=-1)
+    owns = jnp.any(best[..., None] == mask_arr[None, None], axis=-1) & valid
+    tx = gt_box[..., 0] * W - gi
+    ty = gt_box[..., 1] * H - gj
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(an[local][..., 0], 1e-6),
+                             1e-9))
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(an[local][..., 1], 1e-6),
+                             1e-9))
+    tscale = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+
+    def bce(logit, lab):
+        return jnp.maximum(logit, 0) - logit * lab + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    bidx = jnp.arange(N)[:, None]
+    px = p[bidx, local, 0, gj, gi]
+    py = p[bidx, local, 1, gj, gi]
+    pw = p[bidx, local, 2, gj, gi]
+    ph = p[bidx, local, 3, gj, gi]
+    loss_xy = tscale * (bce(px, tx) + bce(py, ty))
+    loss_wh = tscale * 0.5 * ((pw - tw) ** 2 + (ph - th) ** 2)
+    # objectness: positives at gt cells, negatives elsewhere (ignore
+    # cells whose best-box IoU > thresh is approximated by gt cells)
+    obj_t = jnp.zeros((N, A, H, W))
+    obj_t = obj_t.at[bidx, local, gj, gi].max(owns.astype(jnp.float32))
+    seen = jnp.zeros((N, A, H, W), bool).at[bidx, local, gj, gi].set(owns)
+    obj_logit = p[:, :, 4]
+    loss_obj = jnp.where(seen | (obj_t == 0),
+                         bce(obj_logit, obj_t), 0.0).sum(axis=(1, 2, 3))
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    cls_t = jax.nn.one_hot(gt_label, class_num) * (1 - smooth) + \
+        smooth / class_num
+    pcls = p[bidx, local, 5:, gj, gi]                 # [N, B, cls]
+    loss_cls = jnp.sum(bce(pcls, cls_t), axis=-1)
+    per_gt = jnp.where(owns, loss_xy + loss_wh + loss_cls, 0.0)
+    return per_gt.sum(axis=1) + loss_obj
+
+
+def hfft2(x, *, s=None, axes=(-2, -1), norm="backward"):
+    """2-D Hermitian-input FFT: full c2c over axes[:-1], hfft (c2r) on the
+    last axis.  Parity: python/paddle/fft.py hfft2."""
+    for ax in tuple(axes)[:-1]:
+        x = jnp.fft.fft(x, axis=ax, norm=norm)
+    n = None if s is None else s[-1]
+    return jnp.fft.hfft(x, n=n, axis=tuple(axes)[-1], norm=norm)
+
+
+def ihfft2(x, *, s=None, axes=(-2, -1), norm="backward"):
+    """Inverse of hfft2.  Parity: python/paddle/fft.py ihfft2."""
+    n = None if s is None else s[-1]
+    x = jnp.fft.ihfft(x, n=n, axis=tuple(axes)[-1], norm=norm)
+    for ax in tuple(axes)[:-1]:
+        x = jnp.fft.ifft(x, axis=ax, norm=norm)
+    return x
+
+
+def hfftn(x, *, s=None, axes=None, norm="backward"):
+    axes = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
+    return hfft2(x, s=s, axes=axes, norm=norm)
+
+
+def ihfftn(x, *, s=None, axes=None, norm="backward"):
+    axes = tuple(range(-x.ndim, 0)) if axes is None else tuple(axes)
+    return ihfft2(x, s=s, axes=axes, norm=norm)
+
+
+def svdvals(x):
+    """Singular values only.  Parity: python/paddle/tensor/linalg.py
+    (torch-parity svdvals; svd with compute_uv=False)."""
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+def divide_no_nan(x, y):
+    """x / y with 0 where y == 0.  Parity: divide_no_nan op."""
+    safe = jnp.where(y == 0, 1, y)
+    return jnp.where(y == 0, 0.0, x / safe)
+
+
+def kaiser_window(window_length, beta=12.0, periodic=True):
+    n = window_length + 1 if periodic else window_length
+    w = jnp.kaiser(n, beta)
+    return w[:-1] if periodic else w
+
+
+def _window(fn, window_length, periodic=True):
+    n = window_length + 1 if periodic else window_length
+    w = fn(n)
+    return w[:-1] if periodic else w
+
+
+def hamming_window(window_length, periodic=True):
+    return _window(jnp.hamming, window_length, periodic)
+
+
+def hann_window(window_length, periodic=True):
+    return _window(jnp.hanning, window_length, periodic)
+
+
+def blackman_window(window_length, periodic=True):
+    return _window(jnp.blackman, window_length, periodic)
+
+
+def bartlett_window(window_length, periodic=True):
+    return _window(jnp.bartlett, window_length, periodic)
+
+
+def histc(x, *, bins=100, min=0, max=0):
+    """torch/paddle histc: fixed-range histogram; min == max uses the
+    data range (eager only in that case)."""
+    if min == max:
+        import jax
+        jax.core.concrete_or_error(
+            None, x, "histc with min == max needs concrete data; pass an "
+            "explicit range under jit")
+        lo, hi = float(x.min()), float(x.max())
+    else:
+        lo, hi = float(min), float(max)
+    edges = jnp.linspace(lo, hi, bins + 1)
+    return jnp.histogram(x.reshape(-1), bins=edges)[0].astype(x.dtype)
+
+
+def unique_counts(x, *, size=None):
+    if size is None:
+        import jax
+        jax.core.concrete_or_error(
+            None, x, "unique_counts without size= needs concrete data")
+        vals, counts = jnp.unique(x, return_counts=True)
+        return vals, counts
+    vals, counts = jnp.unique(x, return_counts=True, size=size)
+    return vals, counts

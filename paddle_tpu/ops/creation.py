@@ -210,6 +210,5 @@ def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
     return p
 
 
-import jax  # noqa: E402  (used by the complex op lowering)
-
-register_op("complex", lambda r, i: jax.lax.complex(r, i))
+# the "complex" registry op comes from the YAML single source
+# (ops/specs/ops.yaml `complex`); `complex_` above dispatches to it

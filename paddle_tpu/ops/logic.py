@@ -1,10 +1,21 @@
-"""Comparison / logical / bitwise ops. Parity: `python/paddle/tensor/logic.py`."""
+"""Comparison / logical / bitwise ops. Parity: `python/paddle/tensor/logic.py`.
+
+The comparison/logical corpus lives in the YAML single source
+(`ops/specs/ops.yaml` -> `generated_ops.py`); this module re-exports it
+and keeps only the wrappers that need axis normalization.
+"""
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from .generated_ops import (  # noqa: F401
+    allclose, bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or,
+    bitwise_right_shift, bitwise_xor, equal, equal_all, greater_equal,
+    greater_than, isclose, less_equal, less_than, logical_and, logical_not,
+    logical_or, logical_xor, not_equal,
+)
 from .registry import dispatch as _d, register_op
 
 __all__ = [
@@ -14,68 +25,6 @@ __all__ = [
     "bitwise_left_shift", "bitwise_right_shift",
     "isclose", "allclose", "all", "any", "is_empty",
 ]
-
-
-def _binary(op_name, jfn):
-    register_op(op_name, jfn)
-
-    def fn(x, y, name=None, _op=op_name):
-        return _d(_op, (x, y), {})
-    fn.__name__ = op_name
-    return fn
-
-
-equal = _binary("equal", jnp.equal)
-not_equal = _binary("not_equal", jnp.not_equal)
-greater_than = _binary("greater_than", jnp.greater)
-greater_equal = _binary("greater_equal", jnp.greater_equal)
-less_than = _binary("less_than", jnp.less)
-less_equal = _binary("less_equal", jnp.less_equal)
-logical_and = _binary("logical_and", jnp.logical_and)
-logical_or = _binary("logical_or", jnp.logical_or)
-logical_xor = _binary("logical_xor", jnp.logical_xor)
-bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
-bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
-bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
-bitwise_left_shift = _binary("bitwise_left_shift", jnp.left_shift)
-bitwise_right_shift = _binary("bitwise_right_shift", jnp.right_shift)
-
-register_op("logical_not", jnp.logical_not)
-register_op("bitwise_not", jnp.bitwise_not)
-
-
-def logical_not(x, name=None):
-    return _d("logical_not", (x,), {})
-
-
-def bitwise_not(x, name=None):
-    return _d("bitwise_not", (x,), {})
-
-
-register_op("equal_all", lambda x, y: jnp.array_equal(x, y))
-
-
-def equal_all(x, y, name=None):
-    return _d("equal_all", (x, y), {})
-
-
-register_op("isclose", lambda x, y, *, rtol, atol, equal_nan:
-            jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
-
-
-def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return _d("isclose", (x, y), {"rtol": rtol, "atol": atol,
-                                  "equal_nan": equal_nan})
-
-
-register_op("allclose", lambda x, y, *, rtol, atol, equal_nan:
-            jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan))
-
-
-def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return _d("allclose", (x, y), {"rtol": rtol, "atol": atol,
-                                   "equal_nan": equal_nan})
-
 
 register_op("all", lambda x, *, axis, keepdim: jnp.all(x, axis=axis, keepdims=keepdim))
 register_op("any", lambda x, *, axis, keepdim: jnp.any(x, axis=axis, keepdims=keepdim))

@@ -3,6 +3,12 @@
 Parity target: `python/paddle/tensor/math.py` + `ops.py` (reference wraps
 `_C_ops.*`; here every op's "kernel" is its jnp/lax lowering, registered in
 ops/registry.py).
+
+The elementwise corpus (unary/binary/comparisons) lives in the YAML single
+source (`ops/specs/ops.yaml` -> `generated_ops.py`), matching the
+reference's `phi/api/yaml/ops.yaml` pipeline; this module re-exports those
+and keeps only the ops whose python wrappers need real logic (axis
+normalization, Tensor-valued bounds, dtype plumbing).
 """
 
 from __future__ import annotations
@@ -10,7 +16,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .registry import dispatch as _d, primitive, register_op
+# YAML-generated single-source ops (registry name == public name, so AMP
+# lists and SPMD bindings apply to them like any hand op)
+from .generated_ops import (  # noqa: F401
+    abs, acos, acosh, add, addmm, asin, asinh, atan, atan2, atanh, ceil,
+    cos, cosh, deg2rad, digamma, divide, erf, erfinv, exp, expm1, float_power,
+    floor, floor_divide, fmax, fmin, frac, gcd, heaviside, hypot, inner,
+    isfinite, isinf, isnan, lcm, lerp, lgamma, log, log1p, log2, log10,
+    maximum, minimum, mod, multiply, nan_to_num, neg, outer, pow, rad2deg,
+    reciprocal, round, rsqrt, sign, sin, sinh, sqrt, square, stanh, subtract,
+    tan, tanh, trace, trunc,
+)
+from .registry import dispatch as _d, register_op
 
 __all__ = [
     "add", "subtract", "multiply", "divide", "floor_divide", "mod", "remainder",
@@ -27,107 +44,16 @@ __all__ = [
     "lerp", "addmm", "increment", "stanh", "multiplex", "gcd", "lcm",
 ]
 
-
-def _binary(op_name, jfn):
-    register_op(op_name, jfn)
-
-    def fn(x, y, name=None, _op=op_name):
-        return _d(_op, (x, y), {})
-    fn.__name__ = op_name
-    return fn
-
-
-add = _binary("add", jnp.add)
-subtract = _binary("subtract", jnp.subtract)
-multiply = _binary("multiply", jnp.multiply)
-divide = _binary("divide", jnp.divide)
-floor_divide = _binary("floor_divide", jnp.floor_divide)
-mod = _binary("mod", jnp.mod)
 remainder = mod
-maximum = _binary("maximum", jnp.maximum)
-minimum = _binary("minimum", jnp.minimum)
-fmax = _binary("fmax", jnp.fmax)
-fmin = _binary("fmin", jnp.fmin)
-atan2 = _binary("atan2", jnp.arctan2)
-hypot = _binary("hypot", jnp.hypot)
-heaviside = _binary("heaviside", jnp.heaviside)
-gcd = _binary("gcd", jnp.gcd)
-lcm = _binary("lcm", jnp.lcm)
-pow_ = _binary("pow", jnp.power)
-
-
-def pow(x, y, name=None):  # noqa: A001 - paddle API name
-    return pow_(x, y)
-
-
-float_power = _binary("float_power", lambda x, y: jnp.float_power(x, y))
-
-
-def _unary(op_name, jfn):
-    register_op(op_name, jfn)
-
-    def fn(x, name=None, _op=op_name):
-        return _d(_op, (x,), {})
-    fn.__name__ = op_name
-    return fn
-
-
-neg = _unary("neg", jnp.negative)
-abs = _unary("abs", jnp.abs)  # noqa: A001
-sign = _unary("sign", jnp.sign)
 sgn = sign
-sqrt = _unary("sqrt", jnp.sqrt)
-rsqrt = _unary("rsqrt", jax.lax.rsqrt)
-square = _unary("square", jnp.square)
-reciprocal = _unary("reciprocal", jnp.reciprocal)
-exp = _unary("exp", jnp.exp)
-expm1 = _unary("expm1", jnp.expm1)
-log = _unary("log", jnp.log)
-log2 = _unary("log2", jnp.log2)
-log10 = _unary("log10", jnp.log10)
-log1p = _unary("log1p", jnp.log1p)
-sin = _unary("sin", jnp.sin)
-cos = _unary("cos", jnp.cos)
-tan = _unary("tan", jnp.tan)
-asin = _unary("asin", jnp.arcsin)
-acos = _unary("acos", jnp.arccos)
-atan = _unary("atan", jnp.arctan)
-sinh = _unary("sinh", jnp.sinh)
-cosh = _unary("cosh", jnp.cosh)
-tanh = _unary("tanh", jnp.tanh)
-asinh = _unary("asinh", jnp.arcsinh)
-acosh = _unary("acosh", jnp.arccosh)
-atanh = _unary("atanh", jnp.arctanh)
-floor = _unary("floor", jnp.floor)
-ceil = _unary("ceil", jnp.ceil)
-# paddle rounds half away from zero, not banker's rounding
-round = _unary("round", lambda x: jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5))  # noqa: A001
-trunc = _unary("trunc", jnp.trunc)
-frac = _unary("frac", lambda x: x - jnp.trunc(x))
-erf = _unary("erf", jax.scipy.special.erf)
-erfinv = _unary("erfinv", jax.scipy.special.erfinv)
-lgamma = _unary("lgamma", jax.scipy.special.gammaln)
-digamma = _unary("digamma", jax.scipy.special.digamma)
-isnan = _unary("isnan", jnp.isnan)
-isinf = _unary("isinf", jnp.isinf)
-isfinite = _unary("isfinite", jnp.isfinite)
-logit_ = _unary("logit", jax.scipy.special.logit)
-rad2deg = _unary("rad2deg", jnp.rad2deg)
-deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+register_op("logit", jax.scipy.special.logit)
 
 
 def logit(x, eps=None, name=None):
     if eps is not None:
-        from . import manipulation as _m
         x = clip(x, eps, 1.0 - eps)
-    return logit_(x)
-
-
-register_op("stanh", lambda x, *, scale_a, scale_b: scale_b * jnp.tanh(scale_a * x))
-
-
-def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
-    return _d("stanh", (x,), {"scale_a": scale_a, "scale_b": scale_b})
+    return _d("logit", (x,), {})
 
 
 register_op("scale", lambda x, *, scale, bias, bias_after_scale:
@@ -151,29 +77,6 @@ def clip(x, min=None, max=None, name=None):
     mn = min.item() if isinstance(min, Tensor) else min
     mx = max.item() if isinstance(max, Tensor) else max
     return _d("clip", (x,), {"min": mn, "max": mx})
-
-
-register_op("nan_to_num", lambda x, *, nan, posinf, neginf:
-            jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
-
-
-def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
-    return _d("nan_to_num", (x,), {"nan": nan, "posinf": posinf, "neginf": neginf})
-
-
-register_op("lerp", lambda x, y, w: x + w * (y - x))
-
-
-def lerp(x, y, weight, name=None):
-    return _d("lerp", (x, y, weight), {})
-
-
-register_op("addmm", lambda input, x, y, *, beta, alpha:
-            beta * input + alpha * (x @ y))
-
-
-def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return _d("addmm", (input, x, y), {"beta": beta, "alpha": alpha})
 
 
 # ---------------------------------------------------------------- reductions
@@ -298,26 +201,6 @@ def add_n(inputs, name=None):
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
     return _d("add_n", (list(inputs),), {})
-
-
-register_op("trace", lambda x, *, offset, axis1, axis2:
-            jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
-
-
-def trace(x, offset=0, axis1=0, axis2=1, name=None):
-    return _d("trace", (x,), {"offset": offset, "axis1": axis1, "axis2": axis2})
-
-
-register_op("inner", lambda x, y: jnp.inner(x, y))
-register_op("outer", lambda x, y: jnp.outer(x, y))
-
-
-def inner(x, y, name=None):
-    return _d("inner", (x, y), {})
-
-
-def outer(x, y, name=None):
-    return _d("outer", (x, y), {})
 
 
 register_op("diff", lambda x, *, n, axis: jnp.diff(x, n=n, axis=axis))

@@ -103,10 +103,41 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
     return v, i
 
 
+def _mode_fwd(x, *, axis, keepdim):
+    """Most-frequent value along axis (paddle.mode / mode op): sort the
+    axis, count equal runs with a cummax-style scan-free trick, pick the
+    LAST value whose run is maximal (matches the reference's choice of
+    the highest value on count ties)."""
+    xm = jnp.moveaxis(x, axis, -1)
+    s = jnp.sort(xm, axis=-1)
+    si = jnp.argsort(xm, axis=-1)
+    n = s.shape[-1]
+    same = s[..., :, None] == s[..., None, :]          # [..., n, n]
+    counts = jnp.sum(same, axis=-1)                    # run length per pos
+    # LAST maximal run = highest tied value (the reference's tie rule)
+    best = (n - 1) - jnp.argmax(jnp.flip(counts, axis=-1), axis=-1)
+    # the last element of that run (highest original index in the run)
+    vals = jnp.take_along_axis(s, best[..., None], axis=-1)
+    run_last = (n - 1) - jnp.argmax(
+        jnp.flip(s == vals, axis=-1), axis=-1)
+    v = jnp.take_along_axis(s, run_last[..., None], axis=-1)
+    i = jnp.take_along_axis(si, run_last[..., None], axis=-1)
+    v = jnp.moveaxis(v, -1, axis)
+    i = jnp.moveaxis(i, -1, axis)
+    if not keepdim:
+        v = jnp.squeeze(v, axis)
+        i = jnp.squeeze(i, axis)
+    return v, i.astype(_ITYPE)
+
+
+register_op("mode", _mode_fwd)
+
+
 def mode(x, axis=-1, keepdim=False, name=None):
-    v = x._value if isinstance(x, Tensor) else x
-    from scipy import stats  # available via numpy ecosystem? fallback manual
-    raise NotImplementedError("mode: planned")
+    """Parity: python/paddle/tensor/search.py mode (mode op)."""
+    return _d("mode", (x,), {"axis": int(axis) % x.ndim
+                             if int(axis) < 0 else int(axis),
+                             "keepdim": bool(keepdim)})
 
 
 def nonzero(x, as_tuple=False):

@@ -6,3 +6,338 @@
 from paddle_tpu.ops.generated_ops import export_namespace as _exp  # noqa: E402
 _exp(globals(), "vision_ops")
 del _exp
+
+# ---- hand ops (optional-tensor inputs the generated wrappers can't
+# express: mask is a traced input only in the v2 form) ----
+import functools as _functools
+
+from paddle_tpu.ops import codegen_helpers as _h
+from paddle_tpu.ops.registry import dispatch as _d, register_op as _reg
+
+_reg("deformable_conv",
+     lambda x, offset, weight, mask, *, stride, padding, dilation,
+     deformable_groups, groups: _h.deformable_conv(
+         x, offset, weight, mask, stride=stride, padding=padding,
+         dilation=dilation, deformable_groups=deformable_groups,
+         groups=groups))
+_reg("deformable_conv_v1",
+     lambda x, offset, weight, *, stride, padding, dilation,
+     deformable_groups, groups: _h.deformable_conv(
+         x, offset, weight, None, stride=stride, padding=padding,
+         dilation=dilation, deformable_groups=deformable_groups,
+         groups=groups))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1 (mask=None) / v2.  Parity:
+    python/paddle/vision/ops.py:883 deform_conv2d (deformable_conv op):
+    bilinear-sampled im2col + one MXU matmul (see
+    ops/codegen_helpers.py deformable_conv)."""
+    statics = {"stride": stride, "padding": padding, "dilation": dilation,
+               "deformable_groups": deformable_groups, "groups": groups}
+    if mask is None:
+        out = _d("deformable_conv_v1", (x, offset, weight), statics)
+    else:
+        out = _d("deformable_conv", (x, offset, weight, mask), statics)
+    if bias is not None:
+        from paddle_tpu.ops import manipulation as _m
+        out = out + _m.reshape(bias, [1, -1, 1, 1])
+    return out
+
+
+deformable_conv = deform_conv2d
+
+
+# ---- eager detection ops (dynamic output sizes: NMS-style selection;
+# the reference returns LoD tensors here.  Deliberately eager-only — a
+# compiled serving graph uses fixed-topk variants instead) ----
+
+import numpy as _np
+
+from paddle_tpu.framework.tensor import Tensor as _T
+
+
+def _np_of(x):
+    return _np.asarray(x._value if isinstance(x, _T) else x)
+
+
+def _iou_matrix(a, b):
+    """[Na, 4] x [Nb, 4] (x1, y1, x2, y2) -> [Na, Nb] IoU."""
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    x1 = _np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = _np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = _np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = _np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = _np.clip(x2 - x1, 0, None) * _np.clip(y2 - y1, 0, None)
+    return inter / _np.maximum(area_a[:, None] + area_b[None] - inter,
+                               1e-10)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (FPN paper eq.1).  Parity:
+    python/paddle/vision/ops.py distribute_fpn_proposals /
+    distribute_fpn_proposals op.  Returns (multi_rois [per level],
+    restore_index, rois_num_per_level or None)."""
+    rois = _np_of(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = _np.sqrt(_np.clip((rois[:, 2] - rois[:, 0] + off) *
+                              (rois[:, 3] - rois[:, 1] + off), 1e-8, None))
+    lvl = _np.floor(_np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = _np.clip(lvl, min_level, max_level).astype(_np.int64)
+    import jax.numpy as jnp
+    multi, order, nums = [], [], []
+    for lv in range(min_level, max_level + 1):
+        idx = _np.nonzero(lvl == lv)[0]
+        multi.append(_T._wrap(jnp.asarray(rois[idx])))
+        order.append(idx)
+        nums.append(len(idx))
+    order = _np.concatenate(order) if order else _np.zeros((0,), _np.int64)
+    restore = _np.empty_like(order)
+    restore[order] = _np.arange(len(order))
+    restore_t = _T._wrap(jnp.asarray(restore.reshape(-1, 1)))
+    nums_t = [_T._wrap(jnp.asarray(_np.array([n], _np.int32)))
+              for n in nums] if rois_num is not None else None
+    return multi, restore_t, nums_t
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Hard NMS (optionally per-category).  Parity:
+    python/paddle/vision/ops.py nms."""
+    b = _np_of(boxes)
+    s = _np.arange(len(b))[::-1].astype(_np.float64) \
+        if scores is None else _np_of(scores).astype(_np.float64)
+    cats = None if category_idxs is None else _np_of(category_idxs)
+
+    def nms_single(idxs):
+        idxs = idxs[_np.argsort(-s[idxs], kind="stable")]
+        keep = []
+        while len(idxs):
+            i = idxs[0]
+            keep.append(i)
+            if len(idxs) == 1:
+                break
+            ious = _iou_matrix(b[i:i + 1], b[idxs[1:]])[0]
+            idxs = idxs[1:][ious <= iou_threshold]
+        return _np.asarray(keep, _np.int64)
+
+    if cats is None:
+        keep = nms_single(_np.arange(len(b)))
+    else:
+        parts = [nms_single(_np.nonzero(cats == c)[0])
+                 for c in (categories if categories is not None
+                           else _np.unique(cats))]
+        keep = _np.concatenate([p for p in parts if len(p)]) \
+            if parts else _np.zeros((0,), _np.int64)
+        keep = keep[_np.argsort(-s[keep], kind="stable")]
+    if top_k is not None:
+        keep = keep[:top_k]
+    import jax.numpy as jnp
+    return _T._wrap(jnp.asarray(keep))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): parallel soft suppression by pairwise IoU.
+    Parity: python/paddle/vision/ops.py matrix_nms / matrix_nms op.
+    bboxes [N, M, 4]; scores [N, C, M].  Returns (out [R, 6], optional
+    index, rois_num)."""
+    bb = _np_of(bboxes)
+    sc = _np_of(scores)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = _np.nonzero(s > score_threshold)[0]
+            if not len(sel):
+                continue
+            sel = sel[_np.argsort(-s[sel], kind="stable")][:nms_top_k]
+            boxes_c = bb[n, sel]
+            s_c = s[sel]
+            iou = _np.triu(_iou_matrix(boxes_c, boxes_c), 1)
+            # matrix-NMS decay (SOLOv2 eq.4): per pair (i, j) the decay is
+            # f(iou_ij)/f(compensate_i), compensate_i = max overlap that
+            # box i itself suffered from any higher-scored box; take the
+            # min over i < j
+            k = len(sel)
+            compensate = iou.max(axis=0) if k > 1 else _np.zeros(k)
+            comp_m = _np.broadcast_to(compensate[:, None], (k, k))
+            if use_gaussian:
+                ratio = _np.exp(-(iou ** 2 - comp_m ** 2) / gaussian_sigma)
+            else:
+                ratio = (1 - iou) / _np.maximum(1 - comp_m, 1e-10)
+            # pairs with i >= j don't suppress: neutral ratio 1
+            ratio = _np.where(_np.triu(_np.ones((k, k), bool), 1),
+                              ratio, 1.0)
+            decay = ratio.min(axis=0)
+            dec_s = s_c * decay
+            ok = dec_s >= post_threshold
+            for j in _np.nonzero(ok)[0]:
+                rows.append((c, dec_s[j], *boxes_c[j], sel[j] + n * M))
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:keep_top_k] if keep_top_k > 0 else rows
+        nums.append(len(rows))
+        for r in rows:
+            outs.append(r[:6])
+            idxs.append(r[6])
+    import jax.numpy as jnp
+    out = _T._wrap(jnp.asarray(_np.asarray(outs, _np.float32).reshape(
+        -1, 6)))
+    res = [out]
+    if return_index:
+        res.append(_T._wrap(jnp.asarray(
+            _np.asarray(idxs, _np.int64).reshape(-1, 1))))
+    if return_rois_num:
+        res.append(_T._wrap(jnp.asarray(_np.asarray(nums, _np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation: decode anchors, clip, filter small, NMS.
+    Parity: python/paddle/vision/ops.py generate_proposals /
+    generate_proposals_v2 op."""
+    sc = _np_of(scores)          # [N, A, H, W]
+    bd = _np_of(bbox_deltas)     # [N, 4A, H, W]
+    im = _np_of(img_size)        # [N, 2] (h, w)
+    an = _np_of(anchors).reshape(-1, 4)
+    var = _np_of(variances).reshape(-1, 4)
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    rois_all, num_all, scores_all = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # H*W*A
+        d = bd[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # anchors/variances come as [H, W, A, 4] (or already flat in the
+        # same H-major order the score flatten above produces)
+        aa, vv = an, var
+        order = _np.argsort(-s, kind="stable")[:pre_nms_top_n]
+        s, d, aa, vv = s[order], d[order], aa[order], vv[order]
+        # decode (cxcywh deltas on anchor boxes)
+        aw = aa[:, 2] - aa[:, 0] + off
+        ah = aa[:, 3] - aa[:, 1] + off
+        acx = aa[:, 0] + aw * 0.5
+        acy = aa[:, 1] + ah * 0.5
+        cx = vv[:, 0] * d[:, 0] * aw + acx
+        cy = vv[:, 1] * d[:, 1] * ah + acy
+        w = _np.exp(_np.clip(vv[:, 2] * d[:, 2], None, 10)) * aw
+        h = _np.exp(_np.clip(vv[:, 3] * d[:, 3], None, 10)) * ah
+        boxes = _np.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        ih, iw = im[n, 0], im[n, 1]
+        boxes[:, 0::2] = _np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = _np.clip(boxes[:, 1::2], 0, ih - off)
+        ok = ((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+              (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = []
+        idxs = _np.arange(len(boxes))
+        while len(idxs) and len(keep) < post_nms_top_n:
+            i = idxs[0]
+            keep.append(i)
+            if len(idxs) == 1:
+                break
+            ious = _iou_matrix(boxes[i:i + 1], boxes[idxs[1:]])[0]
+            idxs = idxs[1:][ious <= nms_thresh]
+        rois_all.append(boxes[keep])
+        scores_all.append(s[keep])
+        num_all.append(len(keep))
+    import jax.numpy as jnp
+    rois = _T._wrap(jnp.asarray(_np.concatenate(rois_all, axis=0)
+                                .astype(_np.float32)))
+    rscores = _T._wrap(jnp.asarray(_np.concatenate(scores_all, axis=0)
+                                   .astype(_np.float32).reshape(-1, 1)))
+    if return_rois_num:
+        return rois, rscores, _T._wrap(jnp.asarray(
+            _np.asarray(num_all, _np.int32)))
+    return rois, rscores
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8.  Parity:
+    python/paddle/vision/ops.py decode_jpeg (decode_jpeg op; the
+    reference decodes via nvjpeg on GPU — here PIL on host, an IO-path
+    op that has no place on the TPU)."""
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("decode_jpeg needs PIL in this build") from e
+    data = _np_of(x).astype(_np.uint8).tobytes()
+    img = Image.open(io.BytesIO(data))
+    if mode in ("gray", "grey", "L"):
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = _np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    import jax.numpy as jnp
+    return _T._wrap(jnp.asarray(arr))
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """Per-class hard NMS + cross-class keep_top_k.  Parity:
+    python/paddle/vision/ops.py multiclass_nms (multiclass_nms3 op).
+    bboxes [N, M, 4]; scores [N, C, M].  Returns (out [R, 6],
+    rois_num, optional index)."""
+    bb = _np_of(bboxes)
+    sc = _np_of(scores)
+    N, C, M = sc.shape
+    outs, idxs, nums = [], [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            sel = _np.nonzero(s > score_threshold)[0]
+            if not len(sel):
+                continue
+            sel = sel[_np.argsort(-s[sel], kind="stable")][:nms_top_k]
+            keep = []
+            cand = sel
+            while len(cand):
+                i = cand[0]
+                keep.append(i)
+                if len(cand) == 1:
+                    break
+                ious = _iou_matrix(bb[n, i:i + 1], bb[n, cand[1:]])[0]
+                cand = cand[1:][ious <= nms_threshold]
+            for i in keep:
+                rows.append((c, s[i], *bb[n, i], i + n * M))
+        rows.sort(key=lambda r: -r[1])
+        rows = rows[:keep_top_k] if keep_top_k > 0 else rows
+        nums.append(len(rows))
+        for r in rows:
+            outs.append(r[:6])
+            idxs.append(r[6])
+    import jax.numpy as jnp
+    out = _T._wrap(jnp.asarray(
+        _np.asarray(outs, _np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_rois_num:
+        res.append(_T._wrap(jnp.asarray(_np.asarray(nums, _np.int32))))
+    if return_index:
+        res.append(_T._wrap(jnp.asarray(
+            _np.asarray(idxs, _np.int64).reshape(-1, 1))))
+    return tuple(res) if len(res) > 1 else out

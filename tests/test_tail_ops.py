@@ -1,0 +1,298 @@
+"""Round-4 op-corpus tail (VERDICT missing list): linalg stragglers,
+pooling-with-index, margin losses, deformable conv, detection heads.
+
+Oracles: scipy/LAPACK for linalg, plain-conv equivalence for zero-offset
+deformable conv, structural invariants for pooling/sampling ops.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+rng = np.random.RandomState(0)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_matrix_exp():
+    import scipy.linalg as sl
+    m = rng.randn(3, 3).astype(np.float32)
+    np.testing.assert_allclose(paddle.linalg.matrix_exp(t(m)).numpy(),
+                               sl.expm(m), rtol=1e-4, atol=1e-4)
+
+
+def test_ormqr_against_lapack():
+    from scipy.linalg import lapack
+    a = rng.randn(4, 3).astype(np.float32)
+    lqr, tau, _, _ = lapack.sgeqrf(a)
+    c = rng.randn(4, 2).astype(np.float32)
+    for left, trans in ((True, False), (True, True)):
+        want = lapack.sormqr("L", "T" if trans else "N", lqr, tau, c,
+                            lwork=256)[0]
+        got = paddle.linalg.ormqr(t(lqr), t(tau), t(c), left=left,
+                                  transpose=trans).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    cr = rng.randn(2, 4).astype(np.float32)
+    want = lapack.sormqr("R", "N", lqr, tau, cr, lwork=256)[0]
+    got = paddle.linalg.ormqr(t(lqr), t(tau), t(cr), left=False).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_take_modes():
+    x = rng.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.take(t(x), t(np.array([0, 5, -1]))).numpy(),
+        x.reshape(-1)[[0, 5, -1]])
+    np.testing.assert_allclose(
+        paddle.take(t(x), t(np.array([13, -14])), mode="wrap").numpy(),
+        x.reshape(-1)[[1, 10]])
+    np.testing.assert_allclose(
+        paddle.take(t(x), t(np.array([100])), mode="clip").numpy(),
+        x.reshape(-1)[[11]])
+
+
+def test_as_strided_and_unfold():
+    base = np.arange(12, dtype=np.float32)
+    s = paddle.as_strided(t(base), shape=[3, 2], stride=[4, 1],
+                          offset=1).numpy()
+    np.testing.assert_allclose(
+        s, np.lib.stride_tricks.as_strided(base[1:], (3, 2), (16, 4)))
+    u = paddle.tensor_unfold(t(np.arange(10, dtype=np.float32)),
+                             axis=0, size=4, step=2).numpy()
+    assert u.shape == (4, 4)
+    np.testing.assert_allclose(u[1], [2, 3, 4, 5])
+
+
+def test_fill_diagonal_tensor_and_nanquantile():
+    fd = paddle.fill_diagonal_tensor(
+        t(np.zeros((3, 3), np.float32)),
+        t(np.array([1., 2., 3.], np.float32))).numpy()
+    np.testing.assert_allclose(np.diag(fd), [1, 2, 3])
+    nq = paddle.nanquantile(t(np.array([1., np.nan, 3.], np.float32)),
+                            q=0.5).numpy()
+    np.testing.assert_allclose(nq, 2.0)
+
+
+def test_max_pool_with_index_unpool_roundtrip():
+    img = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out, idx = F.max_pool2d_with_index(t(img), kernel_size=2, stride=2)
+    assert tuple(out.shape) == (2, 3, 4, 4)
+    # indices address the flat H*W plane; scatter-back must place every
+    # pooled max at its original position
+    back = F.max_unpool2d(out, idx, kernel_size=2, stride=2)
+    flat = back.numpy().reshape(2, 3, -1)
+    onp = out.numpy().reshape(2, 3, -1)
+    inp = img.reshape(2, 3, -1)
+    iflat = idx.numpy().reshape(2, 3, -1)
+    for n in range(2):
+        for c in range(3):
+            np.testing.assert_allclose(inp[n, c][iflat[n, c]], onp[n, c])
+            np.testing.assert_allclose(flat[n, c][iflat[n, c]], onp[n, c])
+
+
+def test_max_unpool3d_shape():
+    x = rng.randn(1, 2, 2, 2, 2).astype(np.float32)
+    idx = np.arange(16).reshape(1, 2, 2, 2, 2) % 64
+    out = F.max_unpool3d(t(x), t(idx.astype(np.int32)), kernel_size=2,
+                         stride=2)
+    assert tuple(out.shape) == (1, 2, 4, 4, 4)
+
+
+def test_fractional_pools():
+    img = rng.randn(2, 3, 8, 8).astype(np.float32)
+    fp = F.fractional_max_pool2d(t(img), output_size=3)
+    assert tuple(fp.shape) == (2, 3, 3, 3)
+    # each output cell is a max over a subset: bounded by the global max
+    assert (fp.numpy() <= img.max(axis=(2, 3), keepdims=True) + 1e-6).all()
+    fp3 = F.fractional_max_pool3d(
+        t(rng.randn(1, 2, 6, 6, 6).astype(np.float32)), output_size=2)
+    assert tuple(fp3.shape) == (1, 2, 2, 2, 2)
+
+
+def test_class_center_sample():
+    lab = np.array([0, 2, 1], np.int64)
+    paddle.seed(3)
+    rl, sampled = F.class_center_sample(t(lab), num_classes=10,
+                                        num_samples=4)
+    sn, rn = sampled.numpy(), rl.numpy()
+    assert set(lab) <= set(sn)          # positives always kept
+    assert len(set(sn.tolist())) == 4   # distinct classes
+    for i in range(3):                  # labels remapped into sample space
+        assert sn[rn[i]] == lab[i]
+
+
+def test_margin_cross_entropy_reduces_to_softmax_ce():
+    lab = np.array([0, 2, 1], np.int64)
+    logits = np.clip(rng.randn(3, 5).astype(np.float32), -0.9, 0.9)
+    # m1=1, m2=m3=0 -> plain scaled softmax CE
+    l0 = F.margin_cross_entropy(t(logits), t(lab), margin1=1.0,
+                                margin2=0.0, margin3=0.0, scale=1.0)
+    import scipy.special as sp
+    want = -np.take_along_axis(np.log(sp.softmax(logits, axis=1)),
+                               lab[:, None], axis=1)
+    np.testing.assert_allclose(l0.numpy(), want, rtol=1e-4, atol=1e-5)
+    # a real margin must make the target strictly harder (loss up)
+    lm = F.margin_cross_entropy(t(logits), t(lab), margin2=0.5, scale=1.0)
+    assert (lm.numpy() >= l0.numpy() - 1e-6).all()
+
+
+def test_hsigmoid_loss_trains_toward_labels():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.codegen_helpers import hsigmoid_loss
+    x = jnp.asarray(rng.randn(8, 6).astype(np.float32))
+    lab = jnp.asarray(rng.randint(0, 10, (8,)))
+    w = jnp.asarray(rng.randn(9, 6).astype(np.float32) * 0.1)
+
+    def loss(w):
+        return hsigmoid_loss(x, lab, w, None, num_classes=10).mean()
+
+    l0 = float(loss(w))
+    g = jax.grad(loss)(w)
+    l1 = float(loss(w - 0.5 * g))
+    assert l1 < l0  # differentiable and descending
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    import jax
+    import jax.numpy as jnp
+    dx = rng.randn(2, 4, 6, 6).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    w = rng.randn(5, 4, 3, 3).astype(np.float32) * 0.1
+    dc = V.deformable_conv(t(dx), t(off), t(w), padding=1)
+    ref = jax.lax.conv_general_dilated(jnp.asarray(dx), jnp.asarray(w),
+                                       (1, 1), [(1, 1), (1, 1)])
+    np.testing.assert_allclose(dc.numpy(), np.asarray(ref), rtol=2e-3,
+                               atol=2e-3)
+    # v2: all-ones mask is identity
+    ones = np.ones((2, 9, 6, 6), np.float32)
+    dc2 = V.deformable_conv(t(dx), t(off), t(w), mask=t(ones), padding=1)
+    np.testing.assert_allclose(dc2.numpy(), dc.numpy(), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_roi_and_psroi_pool():
+    img = rng.randn(1, 3, 8, 8).astype(np.float32)
+    boxes = np.array([[0., 0., 4., 4.], [2., 2., 7., 7.]], np.float32)
+    rp = V.roi_pool(t(img), t(boxes), output_size=2).numpy()
+    assert rp.shape == (2, 3, 2, 2)
+    # whole-image ROI with 1x1 bins = global max
+    whole = V.roi_pool(t(img), t(np.array([[0., 0., 7., 7.]], np.float32)),
+                       output_size=1).numpy()
+    np.testing.assert_allclose(whole[0, :, 0, 0], img[0].max(axis=(1, 2)),
+                               rtol=1e-6)
+    ps = V.psroi_pool(t(rng.randn(1, 8, 8, 8).astype(np.float32)),
+                      t(boxes), output_size=2).numpy()
+    assert ps.shape == (2, 2, 2, 2)
+
+
+def test_prior_box_and_yolo():
+    pb, pv = V.prior_box(t(rng.randn(1, 3, 4, 4).astype(np.float32)),
+                         t(rng.randn(1, 3, 32, 32).astype(np.float32)),
+                         min_sizes=[8.0], aspect_ratios=[2.0], clip=True)
+    pbn = pb.numpy()
+    assert pbn.shape[-1] == 4 and (pbn >= 0).all() and (pbn <= 1).all()
+    yx = rng.randn(2, 3 * 9, 5, 5).astype(np.float32)
+    yb, ys = V.yolo_box(t(yx), t(np.array([[64, 64], [32, 32]], np.int32)),
+                        anchors=[10, 13, 16, 30, 33, 23], class_num=4)
+    ybn = yb.numpy()
+    assert ybn.shape == (2, 75, 4) and tuple(ys.shape) == (2, 75, 4)
+    assert (ybn[..., 2] >= ybn[..., 0] - 1e-4).all()  # x2 >= x1
+    gtb = (np.abs(rng.rand(2, 3, 4)) * 0.4 + 0.1).astype(np.float32)
+    gtl = rng.randint(0, 4, (2, 3))
+    yl = V.yolo_loss(t(yx), t(gtb), t(gtl),
+                     anchors=[10, 13, 16, 30, 33, 23],
+                     anchor_mask=[0, 1, 2], class_num=4)
+    assert np.isfinite(yl.numpy()).all() and yl.shape[0] == 2
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small -> low level
+                     [0, 0, 300, 300],    # large -> high level
+                     [0, 0, 60, 60]], np.float32)
+    multi, restore, _ = V.distribute_fpn_proposals(
+        t(rois), 2, 5, 4, 224, rois_num=t(np.array([3], np.int32)))
+    assert len(multi) == 4
+    got = np.concatenate([m.numpy() for m in multi if m.numpy().size])
+    back = got[restore.numpy().reshape(-1)]
+    np.testing.assert_allclose(back, rois)
+
+
+def test_nms_and_matrix_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = V.nms(t(boxes), 0.5, scores=t(scores)).numpy()
+    assert list(keep) == [0, 2]  # box 1 suppressed by box 0
+    bb = boxes[None]
+    sc = np.array([[[0.0, 0.0, 0.0], scores]], np.float32)  # class 1 live
+    out, nums = V.matrix_nms(t(bb), t(sc), score_threshold=0.1,
+                             post_threshold=0.05, nms_top_k=10,
+                             keep_top_k=10, background_label=0)
+    o = out.numpy()
+    assert o.shape[1] == 6 and nums.numpy()[0] == o.shape[0] >= 2
+    assert (o[:, 0] == 1).all()  # class ids
+
+
+def test_generate_proposals():
+    rng2 = np.random.RandomState(1)
+    N, A, H, W = 1, 3, 4, 4
+    scores = rng2.rand(N, A, H, W).astype(np.float32)
+    deltas = (rng2.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+    anchors = np.abs(rng2.rand(H, W, A, 4)).astype(np.float32)
+    anchors[..., 2:] += anchors[..., :2] + 8.0
+    var = np.ones((H, W, A, 4), np.float32)
+    rois, rscores, n = V.generate_proposals(
+        t(scores), t(deltas), t(np.array([[32, 32]], np.float32)),
+        t(anchors), t(var), pre_nms_top_n=20, post_nms_top_n=5,
+        return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] == int(n.numpy()[0]) <= 5
+    assert (r[:, 0] <= r[:, 2] + 1e-5).all()
+    assert (r >= -1e-5).all() and (r <= 32.0 + 1e-5).all()
+
+
+def test_decode_jpeg_roundtrip():
+    pytest.importorskip("PIL")
+    import io
+    from PIL import Image
+    img = (rng.rand(8, 6, 3) * 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=95)
+    data = np.frombuffer(buf.getvalue(), np.uint8)
+    out = V.decode_jpeg(t(data)).numpy()
+    assert out.shape == (3, 8, 6)
+    assert np.abs(out.astype(int).mean() - img.mean()) < 20  # lossy
+
+
+def test_mode_matches_scipy():
+    from scipy import stats
+    x = rng.randint(0, 4, (5, 9)).astype(np.float32)
+    v, i = paddle.mode(t(x), axis=1)
+    vn = v.numpy()
+    # returned value's count must be maximal (scipy's count oracle)
+    want_count = stats.mode(x, axis=1, keepdims=False).count
+    got_count = (x == vn[:, None]).sum(axis=1)
+    np.testing.assert_array_equal(got_count, want_count)
+    # returned index must address an occurrence of the mode value
+    np.testing.assert_allclose(
+        np.take_along_axis(x, i.numpy()[:, None], axis=1)[:, 0], vn)
+    # tie rule: the HIGHEST tied value wins (reference semantics)
+    v2, _ = paddle.mode(t(np.array([[2., 2., 3., 3.]], np.float32)), axis=1)
+    assert float(v2.numpy()[0]) == 3.0
+
+
+def test_multiclass_nms():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]
+    out, nums = V.multiclass_nms(t(boxes), t(scores), nms_threshold=0.5)
+    o = out.numpy()
+    assert nums.numpy()[0] == o.shape[0] == 2
+    assert (o[:, 0] == 1).all() and o[0, 1] >= o[1, 1]
