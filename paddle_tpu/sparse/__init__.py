@@ -1,22 +1,29 @@
 """paddle.sparse — COO/CSR sparse tensors and ops.
 
 Parity: `python/paddle/sparse/` (creation.py sparse_coo_tensor/
-sparse_csr_tensor, unary/binary ops, matmul, nn.ReLU) and
-`paddle/phi/core/sparse_coo_tensor.h` / `sparse_csr_tensor.h`.
+sparse_csr_tensor, unary/binary/matmul ops, nn conv/norm/pool layers) and
+`paddle/phi/core/sparse_coo_tensor.h` / `sparse_csr_tensor.h` with the
+kernel corpus `paddle/phi/kernels/sparse/`.
 
-TPU-native: storage is `jax.experimental.sparse` BCOO (the XLA-lowerable
-batched-COO format); CSR creation converts to BCOO internally (XLA has no
-CSR kernels — crow/col views are materialised on demand for API parity).
-Dense results come back as regular paddle Tensors.
+TPU-native: a sparse tensor is (host-known int indices, autograd-tracked
+value Tensor); all value math rides the dense op registry (shared tape,
+AMP, NaN hooks), spatial rulebooks are built host-side, and the
+FLOP-carrying gathers/matmuls land on the MXU.  `jax.experimental.sparse`
+BCOO is an interop view (`._bcoo`).
 """
 
 from . import nn  # noqa: F401
-from .binary import add, matmul, multiply, subtract
-from .creation import (SparseCooTensor, SparseCsrTensor, sparse_coo_tensor,
-                       sparse_csr_tensor)
-from .unary import abs, cast, neg, pow, relu, sin, sqrt, square, tanh  # noqa: A004
+from .binary import (add, divide, masked_matmul, matmul, multiply,  # noqa: F401
+                     subtract)
+from .creation import (SparseCooTensor, SparseCsrTensor,  # noqa: F401
+                       sparse_coo_tensor, sparse_csr_tensor)
+from .unary import (abs, asin, asinh, atan, atanh, cast,  # noqa: F401,A004
+                    expm1, leaky_relu, log1p, neg, pow, relu, relu6, sin,
+                    sinh, softmax, sqrt, square, tanh)
 
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
-           "sparse_csr_tensor", "add", "subtract", "multiply", "matmul",
-           "relu", "abs", "neg", "sin", "tanh", "sqrt", "square", "pow",
-           "cast", "nn"]
+           "sparse_csr_tensor", "add", "subtract", "multiply", "divide",
+           "matmul", "masked_matmul",
+           "relu", "relu6", "leaky_relu", "softmax", "abs", "neg", "sin",
+           "sinh", "asin", "asinh", "atan", "atanh", "expm1", "log1p",
+           "tanh", "sqrt", "square", "pow", "cast", "nn"]

@@ -1,64 +1,118 @@
 """Sparse binary ops + spmm.
 
 Parity: `python/paddle/sparse/binary.py` (add/subtract/multiply `:330+`,
-matmul `:38` — sparse x dense -> dense, sparse x sparse elementwise).
+matmul `:38` — sparse x dense -> dense, sparse x sparse elementwise;
+kernels `paddle/phi/kernels/sparse/matmul_kernel.h`).
+
+All value math runs through the dense op registry on the values Tensor,
+so spmm and elementwise sparse ops are differentiable end-to-end (both
+toward the sparse values and the dense operand).
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.tensor import Tensor
+from ..ops import creation as _c, manipulation as _m
 from .creation import SparseCooTensor
 
-__all__ = ["add", "subtract", "multiply", "matmul"]
+__all__ = ["add", "subtract", "multiply", "divide", "matmul", "masked_matmul"]
 
 
-def _binary(fn):
-    def op(x, y, name=None):
-        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
-            out = fn(x._bcoo, y._bcoo)
-            return SparseCooTensor(out.sum_duplicates())
-        raise TypeError("sparse binary ops need two sparse tensors "
-                        "(mixed sparse/dense: use matmul or to_dense)")
-    return op
+def _concat_coo(x: SparseCooTensor, y: SparseCooTensor, y_scale=1.0):
+    """Union-form add: concatenate entries, coalesce merges duplicates."""
+    if tuple(x._shape) != tuple(y._shape):
+        raise ValueError(f"sparse add: shape mismatch {x.shape} vs {y.shape}")
+    idx = np.concatenate([np.asarray(x._indices), np.asarray(y._indices)])
+    yv = y.values() if y_scale == 1.0 else y_scale * y.values()
+    vals = _m.concat([x.values(), yv], axis=0)
+    return type(x)(idx, vals, x._shape).coalesce()
 
 
-add = _binary(lambda a, b: a + b)
-subtract = _binary(lambda a, b: a + (-b))
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _concat_coo(x, y)
+    raise TypeError("sparse.add needs two sparse tensors "
+                    "(mixed sparse/dense: use to_dense)")
+
+
+def subtract(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return _concat_coo(x, y, y_scale=-1.0)
+    raise TypeError("sparse.subtract needs two sparse tensors")
 
 
 def multiply(x: SparseCooTensor, y, name=None):
     """Elementwise product; sparse * scalar and sparse * sparse."""
     if isinstance(y, (int, float)):
-        return x._replace(x._bcoo.data * y)
+        return x._replace(x.values() * y)
     if isinstance(y, SparseCooTensor):
-        # product is nonzero only where both are: O(nnz log nnz) index
-        # intersection via sorted linear indices — never densify
-        yb = y._bcoo.sum_duplicates()
-        shape = jnp.asarray(x._bcoo.shape)
-        strides = jnp.cumprod(jnp.concatenate(
-            [shape[1:][::-1], jnp.ones(1, shape.dtype)]))[::-1]
-        xl = (x._bcoo.indices * strides).sum(axis=1)
-        yl = (yb.indices * strides).sum(axis=1)
-        order = jnp.argsort(yl)
-        yl_sorted = yl[order]
-        y_data_sorted = yb.data[order]
-        pos = jnp.searchsorted(yl_sorted, xl)
-        pos_c = jnp.clip(pos, 0, max(yl_sorted.shape[0] - 1, 0))
-        hit = (pos < yl_sorted.shape[0]) & (yl_sorted[pos_c] == xl)
-        gathered = jnp.where(hit, y_data_sorted[pos_c], 0)
-        return x._replace(x._bcoo.data * gathered)
+        # product is nonzero only where both are: index intersection on
+        # host (indices are host-known), value math on the tape
+        yc = y.coalesce()
+        dims = x._shape[:x.sparse_dim]
+        xl = np.ravel_multi_index(tuple(np.asarray(x._indices).T), dims)
+        yl = np.ravel_multi_index(tuple(np.asarray(yc._indices).T), dims)
+        pos = np.searchsorted(yl, xl)
+        pos_c = np.clip(pos, 0, max(len(yl) - 1, 0))
+        hit = (pos < len(yl)) & (yl[pos_c] == xl)
+        gathered = _m.gather(yc.values(),
+                             Tensor._wrap(jnp.asarray(pos_c)), axis=0)
+        mask = Tensor._wrap(jnp.asarray(hit.astype(np.float32)))
+        shape = [-1] + [1] * (len(x.values().shape) - 1)
+        return x._replace(x.values() * gathered * _m.reshape(mask, shape))
     raise TypeError(f"multiply: unsupported operand {type(y).__name__}")
 
 
+def divide(x: SparseCooTensor, y, name=None):
+    if isinstance(y, (int, float)):
+        return x._replace(x.values() / y)
+    raise TypeError("sparse.divide supports scalar divisors")
+
+
 def matmul(x, y, name=None):
-    """sparse @ dense -> dense Tensor (XLA lowers BCOO matmul to gather/
-    scatter + MXU matmul on the dense side)."""
+    """sparse [M, K] @ dense [K, N] -> dense Tensor (and dense @ sparse).
+
+    Lowering: gather the dense rows each nonzero touches, scale by the
+    values, scatter-add into the output rows — gathers/scatter-adds plus
+    one broadcasted multiply, all registry ops, so gradients flow to BOTH
+    operands (the reference's sparse matmul_grad pair)."""
     if isinstance(x, SparseCooTensor):
-        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
-        return Tensor._wrap(x._bcoo @ yv)
+        if x.sparse_dim != 2:
+            raise NotImplementedError("spmm: 2-D sparse lhs")
+        yv = y if isinstance(y, Tensor) else Tensor._wrap(jnp.asarray(y))
+        matvec = len(yv.shape) == 1
+        if matvec:
+            yv = _m.reshape(yv, [-1, 1])
+        idx = np.asarray(x._indices)
+        rows = Tensor._wrap(jnp.asarray(idx[:, :1]))        # [nnz, 1]
+        cols = Tensor._wrap(jnp.asarray(idx[:, 1]))
+        gathered = _m.gather(yv, cols, axis=0)              # [nnz, N]
+        contrib = _m.reshape(x.values(), [-1, 1]) * gathered
+        out = _c.zeros([x._shape[0], int(yv.shape[1])],
+                       dtype=str(contrib.dtype))
+        out = _m.scatter_nd_add(out, rows, contrib)
+        return _m.reshape(out, [-1]) if matvec else out
     if isinstance(y, SparseCooTensor):
-        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
-        return Tensor._wrap(xv @ y._bcoo)
-    raise TypeError("paddle.sparse.matmul needs at least one sparse operand")
+        # dense @ sparse = (sparse^T @ dense^T)^T
+        xt = _m.transpose(x if isinstance(x, Tensor)
+                          else Tensor._wrap(jnp.asarray(x)), [1, 0])
+        idx = np.asarray(y._indices)[:, ::-1]               # transpose
+        yt = SparseCooTensor(idx.copy(), y.values(),
+                             (y._shape[1], y._shape[0]))
+        return _m.transpose(matmul(yt, xt), [1, 0])
+    raise TypeError("paddle.sparse.matmul needs a sparse operand")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask: SparseCooTensor, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (SDDMM).  Parity:
+    python/paddle/sparse/binary.py masked_matmul."""
+    idx = np.asarray(mask._indices)
+    xr = _m.gather(x, Tensor._wrap(jnp.asarray(idx[:, 0])), axis=0)
+    yc = _m.gather(_m.transpose(y, [1, 0]),
+                   Tensor._wrap(jnp.asarray(idx[:, 1])), axis=0)
+    from ..ops import math as _math
+    vals = _math.sum(xr * yc, axis=-1)
+    return mask._replace(vals)

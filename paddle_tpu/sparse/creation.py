@@ -2,6 +2,14 @@
 
 Parity: `python/paddle/sparse/creation.py` (sparse_coo_tensor `:84`,
 sparse_csr_tensor `:183`), `paddle/phi/core/sparse_coo_tensor.h:30`.
+
+TPU-native design: a sparse tensor is (indices, values, shape) where the
+VALUES are a regular autograd-tracked `Tensor` — every sparse op routes
+its value math through the dense op registry, so `loss.backward()`
+differentiates through sparse networks exactly like dense ones (the
+reference registers separate sparse grad kernels under
+`paddle/phi/kernels/sparse/` — here the tape is shared).  The jax BCOO
+form is materialized on demand for XLA spmm interop.
 """
 
 from __future__ import annotations
@@ -12,47 +20,110 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import sparse as jsparse
 
-import paddle_tpu as paddle
 from ..framework.tensor import Tensor
 
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
            "sparse_csr_tensor"]
 
 
-class SparseCooTensor:
-    """COO sparse tensor over a jax BCOO matrix."""
+def _as_value_tensor(v):
+    if isinstance(v, Tensor):
+        return v
+    return Tensor._wrap(jnp.asarray(np.asarray(v)))
 
-    def __init__(self, bcoo: jsparse.BCOO):
-        self._bcoo = bcoo
+
+class SparseCooTensor:
+    """COO sparse tensor: indices [nnz, sparse_dim] (int32, host-known),
+    values Tensor [nnz, *dense_dims]."""
+
+    def __init__(self, indices, values=None, shape=None):
+        if isinstance(indices, jsparse.BCOO):  # legacy BCOO ctor path
+            bcoo = indices
+            self._indices = jnp.asarray(bcoo.indices, jnp.int32)
+            self._values = Tensor._wrap(bcoo.data)
+            self._shape = tuple(bcoo.shape)
+        else:
+            idx = jnp.asarray(indices)
+            if idx.dtype not in (jnp.int32, jnp.int64):
+                idx = idx.astype(jnp.int32)
+            self._indices = idx
+            self._values = _as_value_tensor(values)
+            self._shape = tuple(int(s) for s in shape)
 
     # -------------------------------------------------------------- views
     @property
+    def _bcoo(self) -> jsparse.BCOO:
+        return jsparse.BCOO((self._values._value, self._indices),
+                            shape=self._shape)
+
+    @property
     def shape(self):
-        return list(self._bcoo.shape)
+        return list(self._shape)
 
     @property
     def dtype(self):
-        return self._bcoo.dtype
+        return self._values.dtype
+
+    @property
+    def sparse_dim(self) -> int:
+        return int(self._indices.shape[1])
 
     @property
     def nnz(self) -> int:
-        return int(self._bcoo.nse)
+        return int(self._indices.shape[0])
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def grad(self):
+        return self._values.grad
 
     def indices(self) -> Tensor:
-        # paddle layout: (sparse_dim, nnz); BCOO stores (nnz, sparse_dim)
-        return Tensor._wrap(self._bcoo.indices.T)
+        # paddle layout: (sparse_dim, nnz); stored (nnz, sparse_dim)
+        return Tensor._wrap(self._indices.T)
 
     def values(self) -> Tensor:
-        return Tensor._wrap(self._bcoo.data)
+        return self._values
 
     def to_dense(self) -> Tensor:
-        return Tensor._wrap(self._bcoo.todense())
+        """Differentiable densify: scatter the value TENSOR so gradients
+        flow back into values()."""
+        from ..ops import creation as _c, manipulation as _m
+        dense = _c.zeros(list(self._shape), dtype=str(self.dtype))
+        idx = Tensor._wrap(self._indices)
+        return _m.scatter_nd_add(dense, idx, self._values)
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
-        return SparseCsrTensor._from_bcoo(self._bcoo)
+        c = self.coalesce()
+        return SparseCsrTensor(c._indices, c._values, c._shape)
 
     def coalesce(self) -> "SparseCooTensor":
-        return SparseCooTensor(self._bcoo.sum_duplicates())
+        """Merge duplicate indices (sums values; differentiable)."""
+        idx = np.asarray(self._indices)
+        lin = np.ravel_multi_index(
+            tuple(idx.T), self._shape[:idx.shape[1]]) if idx.size else \
+            np.zeros((0,), np.int64)
+        uniq, inv = np.unique(lin, return_inverse=True)
+        from ..ops import creation as _c, manipulation as _m
+        if len(uniq) == len(lin):
+            order = np.argsort(lin, kind="stable")
+            vals = _m.gather(self._values,
+                             Tensor._wrap(jnp.asarray(order)), axis=0)
+            return type(self)(idx[order], vals, self._shape)
+        segsum = _c.zeros([len(uniq)] + list(self._values.shape[1:]),
+                          dtype=str(self.dtype))
+        segsum = _m.scatter_nd_add(
+            segsum, Tensor._wrap(jnp.asarray(inv.reshape(-1, 1))),
+            self._values)
+        new_idx = np.stack(np.unravel_index(
+            uniq, self._shape[:idx.shape[1]]), axis=1).astype(np.int32)
+        return type(self)(new_idx, segsum, self._shape)
 
     def is_sparse(self) -> bool:
         return True
@@ -63,10 +134,12 @@ class SparseCooTensor:
     def is_sparse_csr(self) -> bool:
         return False
 
-    def _replace(self, data) -> "SparseCooTensor":
+    def _replace(self, values: Tensor) -> "SparseCooTensor":
         # preserves the concrete type: relu(csr) stays CSR
-        return type(self)(
-            jsparse.BCOO((data, self._bcoo.indices), shape=self._bcoo.shape))
+        return type(self)(self._indices, values, self._shape)
+
+    def backward(self, *a, **k):
+        return self._values.backward(*a, **k)
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
@@ -74,12 +147,8 @@ class SparseCooTensor:
 
 
 class SparseCsrTensor(SparseCooTensor):
-    """CSR view: same BCOO storage + materialised crows/cols on demand.
-    Parity: `sparse_csr_tensor.h:30`."""
-
-    @classmethod
-    def _from_bcoo(cls, bcoo):
-        return cls(bcoo.sum_duplicates())
+    """CSR view: same (indices, values) storage + materialised crows/cols
+    on demand.  Parity: `sparse_csr_tensor.h:30`."""
 
     def is_sparse_coo(self) -> bool:
         return False
@@ -88,7 +157,7 @@ class SparseCsrTensor(SparseCooTensor):
         return True
 
     def crows(self) -> Tensor:
-        idx = np.asarray(self._bcoo.indices)
+        idx = np.asarray(self._indices)
         rows = idx[:, 0]
         n_rows = self.shape[0]
         crows = np.zeros(n_rows + 1, np.int64)
@@ -96,11 +165,11 @@ class SparseCsrTensor(SparseCooTensor):
         return Tensor._wrap(jnp.asarray(np.cumsum(crows)))
 
     def cols(self) -> Tensor:
-        return Tensor._wrap(self._bcoo.indices[:, 1])
+        return Tensor._wrap(self._indices[:, 1])
 
     def to_sparse_coo(self, sparse_dim: Optional[int] = None) \
             -> SparseCooTensor:
-        return SparseCooTensor(self._bcoo)
+        return SparseCooTensor(self._indices, self._values, self._shape)
 
     def __repr__(self):
         return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
@@ -116,15 +185,24 @@ def _as_jnp(x):
 def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
                       dtype=None, place=None, stop_gradient=True) \
         -> SparseCooTensor:
-    """Build a COO tensor from (sparse_dim, nnz) indices + (nnz,) values."""
+    """Build a COO tensor from (sparse_dim, nnz) indices + values whose
+    leading dim is nnz (trailing dims are dense)."""
     idx = _as_jnp(indices).astype(jnp.int32).T  # -> (nnz, sparse_dim)
-    vals = _as_jnp(values)
+    vals = _as_value_tensor(values)
     if dtype is not None:
         from ..core import dtypes as _dtypes
-        vals = vals.astype(_dtypes.convert_dtype(dtype))
+        from ..ops import manipulation as _m
+        vals = _m.cast(vals, _dtypes.convert_dtype(dtype))
     if shape is None:
-        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
-    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+        sp = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+        shape = sp + tuple(vals.shape[1:])
+    out = SparseCooTensor(idx, vals, shape)
+    if not isinstance(values, Tensor):
+        # a freshly wrapped array takes the requested flag; a caller's
+        # Tensor keeps ITS OWN stop_gradient (mutating it here would
+        # silently freeze the tensor everywhere else it is used)
+        out.stop_gradient = stop_gradient
+    return out
 
 
 def sparse_csr_tensor(crows, cols, values,
@@ -134,19 +212,24 @@ def sparse_csr_tensor(crows, cols, values,
     crows_np = np.asarray(_as_jnp(crows))
     cols_np = np.asarray(_as_jnp(cols))
     rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    idx = jnp.asarray(np.stack([rows, cols_np], axis=1).astype(np.int32))
-    vals = _as_jnp(values)
+    idx = np.stack([rows, cols_np], axis=1).astype(np.int32)
+    vals = _as_value_tensor(values)
     if dtype is not None:
         from ..core import dtypes as _dtypes
-        vals = vals.astype(_dtypes.convert_dtype(dtype))
-    return SparseCsrTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+        from ..ops import manipulation as _m
+        vals = _m.cast(vals, _dtypes.convert_dtype(dtype))
+    out = SparseCsrTensor(idx, vals, tuple(shape))
+    if not isinstance(values, Tensor):
+        out.stop_gradient = stop_gradient  # see sparse_coo_tensor
+    return out
 
 
 # Tensor bridge methods (reference: Tensor.to_sparse_coo / to_dense)
 def _tensor_to_sparse_coo(self, sparse_dim: int) -> SparseCooTensor:
-    return SparseCooTensor(
-        jsparse.BCOO.fromdense(self._value, n_batch=0,
-                               n_dense=self._value.ndim - sparse_dim))
+    bcoo = jsparse.BCOO.fromdense(self._value, n_batch=0,
+                                  n_dense=self._value.ndim - sparse_dim)
+    return SparseCooTensor(jnp.asarray(bcoo.indices, jnp.int32),
+                           Tensor._wrap(bcoo.data), tuple(bcoo.shape))
 
 
 Tensor.to_sparse_coo = _tensor_to_sparse_coo

@@ -1,7 +1,10 @@
-"""paddle.sparse.nn — sparse activation layers.
+"""paddle.sparse.nn — sparse layers.
 
-Parity: `python/paddle/sparse/nn/` (layer/activation.py ReLU, LeakyReLU,
-Softmax subset).
+Parity: `python/paddle/sparse/nn/` — layer/activation.py (ReLU, ReLU6,
+LeakyReLU, Softmax), layer/conv.py (Conv3D `:252`, SubmConv3D `:375`,
+Conv2D, SubmConv2D), layer/norm.py (BatchNorm `:28`), layer/pooling.py
+(MaxPool3D).  Conv weights use the reference's sparse layout
+(*kernel, Cin, Cout); all value math rides the dense autograd tape.
 """
 
 from __future__ import annotations
@@ -9,15 +12,25 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ...nn.layer.layers import Layer
-from ..creation import SparseCooTensor
 from .. import unary as _unary
+from ..creation import SparseCooTensor
+from . import functional  # noqa: F401
+from .functional import (conv2d, conv3d, max_pool3d, subm_conv2d,  # noqa: F401
+                         subm_conv3d)
 
-__all__ = ["ReLU", "LeakyReLU"]
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv2D", "Conv3D",
+           "SubmConv2D", "SubmConv3D", "BatchNorm", "MaxPool3D",
+           "functional"]
 
 
 class ReLU(Layer):
     def forward(self, x: SparseCooTensor) -> SparseCooTensor:
         return _unary.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return _unary.relu6(x)
 
 
 class LeakyReLU(Layer):
@@ -26,5 +39,114 @@ class LeakyReLU(Layer):
         self.negative_slope = negative_slope
 
     def forward(self, x: SparseCooTensor) -> SparseCooTensor:
-        return x._replace(jnp.where(x._bcoo.data > 0, x._bcoo.data,
-                                    x._bcoo.data * self.negative_slope))
+        return _unary.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis: int = -1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return _unary.softmax(x, self.axis)
+
+
+class _SparseConvNd(Layer):
+    _d = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        from ...ops.creation import create_parameter
+        d = self._d
+        ks = (kernel_size,) * d if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self._ks = ks
+        fan_in = in_channels
+        for k in ks:
+            fan_in *= k
+        import math as _pm
+        bound = 1.0 / _pm.sqrt(fan_in)
+        self.weight = create_parameter(
+            list(ks) + [in_channels, out_channels], "float32")
+        import numpy as _np
+        rngw = _np.random.uniform(
+            -bound, bound, tuple(ks) + (in_channels, out_channels))
+        self.weight.set_value(jnp.asarray(rngw.astype(_np.float32)))
+        if bias_attr is not False:
+            self.bias = create_parameter([out_channels], "float32",
+                                         is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        fn = {(2, False): conv2d, (2, True): subm_conv2d,
+              (3, False): conv3d, (3, True): subm_conv3d}[
+                  (self._d, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self.stride,
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups)
+
+
+class Conv3D(_SparseConvNd):
+    """Parity: python/paddle/sparse/nn/layer/conv.py:252 Conv3D."""
+    _d, _subm = 3, False
+
+
+class SubmConv3D(_SparseConvNd):
+    """Parity: python/paddle/sparse/nn/layer/conv.py:375 SubmConv3D."""
+    _d, _subm = 3, True
+
+
+class Conv2D(_SparseConvNd):
+    _d, _subm = 2, False
+
+
+class SubmConv2D(_SparseConvNd):
+    _d, _subm = 2, True
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm: per-channel statistics over the PRESENT values
+    only (nnz rows), running stats for eval.  Parity:
+    python/paddle/sparse/nn/layer/norm.py:28 BatchNorm (wraps the dense
+    BN math over the value rows, as the reference does)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    @property
+    def weight(self):
+        return self._bn.weight
+
+    @property
+    def bias(self):
+        return self._bn.bias
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return x._replace(self._bn(x.values()))
+
+
+class MaxPool3D(Layer):
+    """Parity: python/paddle/sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x: SparseCooTensor) -> SparseCooTensor:
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding)

@@ -1,18 +1,23 @@
 """Sparse unary ops: applied to stored values, preserving sparsity.
 
 Parity: `python/paddle/sparse/unary.py` (relu/abs/sin/tanh/sqrt/square/
-pow/cast/neg — the zero-preserving subset the reference registers sparse
-kernels for).
+pow/cast/neg and friends — the zero-preserving subset the reference
+registers sparse kernels for, `paddle/phi/kernels/sparse/unary_kernel.h`).
+
+Every op routes the value math through the DENSE op registry, so the
+autograd tape, AMP hooks, and NaN checks apply to sparse values exactly
+like dense tensors (the reference maintains parallel sparse grad
+kernels; here the tape is shared by construction).
 """
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
+from ..ops import math as _math
 from .creation import SparseCooTensor
 
 __all__ = ["relu", "abs", "neg", "sin", "tanh", "sqrt", "square", "pow",
-           "cast"]
+           "cast", "asin", "asinh", "atan", "atanh", "sinh", "expm1",
+           "log1p", "leaky_relu", "relu6", "softmax"]
 
 
 def _unary(fn):
@@ -20,27 +25,88 @@ def _unary(fn):
         if not isinstance(x, SparseCooTensor):
             raise TypeError("paddle.sparse unary ops take sparse tensors; "
                             "use the dense op for dense tensors")
-        return x._replace(fn(x._bcoo.data, *args, **kwargs))
+        return x._replace(fn(x.values(), *args, **kwargs))
     return op
 
 
-relu = _unary(lambda v: jnp.maximum(v, 0))
-abs = _unary(jnp.abs)  # noqa: A001
-neg = _unary(jnp.negative)
-sin = _unary(jnp.sin)
-tanh = _unary(jnp.tanh)
-sqrt = _unary(jnp.sqrt)
-square = _unary(jnp.square)
-pow = _unary(lambda v, factor: jnp.power(v, factor))  # noqa: A001
+def _relu(v):
+    # scalar floor (0.0 * v would turn -inf values into NaN)
+    return _math.maximum(v, 0.0)
+
+
+relu = _unary(_relu)
+abs = _unary(_math.abs)  # noqa: A001
+neg = _unary(_math.neg)
+sin = _unary(_math.sin)
+tanh = _unary(_math.tanh)
+sqrt = _unary(_math.sqrt)
+square = _unary(_math.square)
+asin = _unary(_math.asin)
+asinh = _unary(_math.asinh)
+atan = _unary(_math.atan)
+atanh = _unary(_math.atanh)
+sinh = _unary(_math.sinh)
+expm1 = _unary(_math.expm1)
+log1p = _unary(_math.log1p)
+pow = _unary(lambda v, factor: _math.pow(v, factor))  # noqa: A001
+
+
+def relu6(x: SparseCooTensor, name=None):
+    v = x.values()
+    return x._replace(_math.clip(v, 0.0, 6.0))
+
+
+def leaky_relu(x: SparseCooTensor, negative_slope: float = 0.01, name=None):
+    v = x.values()
+    neg_part = _math.minimum(v, 0.0)
+    pos_part = _math.maximum(v, 0.0)
+    return x._replace(pos_part + negative_slope * neg_part)
+
+
+def softmax(x: SparseCooTensor, axis: int = -1, name=None):
+    """Sparse softmax over the last sparse axis: normalizes the stored
+    values per row (absent entries are -inf, not 0 — the reference's
+    sparse softmax semantics, `sparse/unary.py softmax`)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..framework.tensor import Tensor
+    from ..ops import creation as _c, manipulation as _m
+    if axis not in (-1, x.sparse_dim - 1):
+        raise NotImplementedError("sparse softmax: last sparse axis only")
+    idx = np.asarray(x._indices)
+    # segment = all leading sparse dims (the row)
+    if idx.shape[1] == 1:
+        seg = np.zeros((idx.shape[0],), np.int64)
+        n_seg = 1
+    else:
+        seg_idx = idx[:, :-1]
+        dims = x._shape[:idx.shape[1] - 1]
+        seg = np.ravel_multi_index(tuple(seg_idx.T), dims)
+        uniq, seg = np.unique(seg, return_inverse=True)
+        n_seg = len(uniq)
+    seg_t = Tensor._wrap(jnp.asarray(seg.reshape(-1, 1)))
+    v = x.values()
+    # segment max (host loop-free): scatter-max substitute via exp-sum on
+    # shifted values; numerical stability from per-segment max computed
+    # eagerly on the concrete values
+    vmax = np.full((n_seg,), -np.inf, np.float64)
+    np.maximum.at(vmax, seg, np.asarray(v._value, np.float64))
+    shift = Tensor._wrap(jnp.asarray(vmax[seg].astype(np.float32)))
+    e = _math.exp(v - shift)
+    denom = _c.zeros([n_seg], dtype=str(x.dtype))
+    denom = _m.scatter_nd_add(denom, seg_t, e)
+    gathered = _m.gather(denom, Tensor._wrap(jnp.asarray(seg)), axis=0)
+    return x._replace(e / gathered)
 
 
 def cast(x: SparseCooTensor, index_dtype=None, value_dtype=None, name=None):
     from ..core import dtypes as _dtypes
-    bcoo = x._bcoo
-    data, indices = bcoo.data, bcoo.indices
+    from ..ops import manipulation as _m
+    vals, indices = x.values(), x._indices
     if value_dtype is not None:
-        data = data.astype(_dtypes.convert_dtype(value_dtype))
+        vals = _m.cast(vals, _dtypes.convert_dtype(value_dtype))
     if index_dtype is not None:
         indices = indices.astype(_dtypes.convert_dtype(index_dtype))
-    from jax.experimental import sparse as jsparse
-    return type(x)(jsparse.BCOO((data, indices), shape=bcoo.shape))
+    out = type(x)(indices, vals, x._shape)
+    return out

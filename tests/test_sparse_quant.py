@@ -257,3 +257,139 @@ def test_convert_to_mixed_precision_rejects_reconversion(tmp_path):
     inference.convert_to_mixed_precision(src, mid)
     with pytest.raises(ValueError, match="already precision-converted"):
         inference.convert_to_mixed_precision(mid, str(tmp_path / "m8"))
+
+
+def test_sparse_values_carry_gradients():
+    """Round-4 sparse depth: values are tape-tracked Tensors — gradients
+    flow through sparse unary ops and spmm to BOTH operands."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import sparse
+    paddle.seed(0)
+    idx = np.array([[0, 1, 2], [1, 0, 2]])
+    vals = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+    vals.stop_gradient = False
+    s = sparse.sparse_coo_tensor(idx, vals, [3, 3], stop_gradient=False)
+    dense = paddle.to_tensor(np.ones((3, 2), np.float32))
+    dense.stop_gradient = False
+    out = sparse.matmul(sparse.relu(s), dense)      # [3, 2]
+    loss = (out ** 2).sum()
+    loss.backward()
+    assert vals.grad is not None
+    g = np.asarray(vals.grad._value)
+    assert g.shape == (3,) and g[1] == 0.0          # relu kills -2's grad
+    assert dense.grad is not None
+    assert np.isfinite(np.asarray(dense.grad._value)).all()
+
+
+def test_sparse_softmax_and_masked_matmul():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import sparse
+    idx = np.array([[0, 0, 1], [0, 2, 1]])
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, [2, 3])
+    sm = sparse.softmax(s)
+    v = np.asarray(sm.values()._value)
+    # row 0 has two entries (sum to 1), row 1 one entry (=1)
+    np.testing.assert_allclose(v[0] + v[1], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(v[2], 1.0, rtol=1e-5)
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype(np.float32))
+    y = paddle.to_tensor(np.ones((3, 3), np.float32))
+    mm = sparse.masked_matmul(x, y, s)
+    want_full = np.asarray(x._value) @ np.ones((3, 3), np.float32)
+    got = np.asarray(mm.values()._value)
+    np.testing.assert_allclose(got, want_full[idx[0], idx[1]], rtol=1e-5)
+
+
+def test_sparse_conv_matches_dense_conv():
+    """Sparse conv3d on a densified grid == dense conv (VALID region):
+    gather-GEMM-scatter rulebook oracle."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import sparse
+    paddle.seed(1)
+    rng = np.random.RandomState(0)
+    # a FULLY DENSE sparse tensor so dense conv is an exact oracle
+    N, D, H, W, C = 1, 3, 4, 4, 2
+    dense_np = rng.randn(N, D, H, W, C).astype(np.float32)
+    coords = np.stack(np.meshgrid(*[np.arange(n) for n in (N, D, H, W)],
+                                  indexing="ij"), axis=0).reshape(4, -1)
+    vals = dense_np.reshape(-1, C)
+    s = sparse.sparse_coo_tensor(coords, vals, [N, D, H, W, C])
+    w = rng.randn(2, 2, 2, C, 3).astype(np.float32) * 0.3
+    out = sparse.nn.functional.conv3d(s, paddle.to_tensor(w))
+    got = np.asarray(out.to_dense()._value)
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(dense_np), jnp.asarray(w), (1, 1, 1), "VALID",
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+def test_subm_conv_preserves_sparsity_pattern():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import sparse
+    idx = np.array([[0, 0], [0, 1], [1, 2], [2, 0]]).T  # (sparse_dim, nnz)
+    idx = np.vstack([np.zeros((1, 4), np.int64), idx])  # add batch dim
+    vals = np.ones((4, 2), np.float32)
+    s = sparse.sparse_coo_tensor(idx, vals, [1, 3, 3, 2])
+    w = paddle.to_tensor(np.ones((3, 3, 2, 5), np.float32))
+    out = sparse.nn.functional.subm_conv2d(s, w, padding=1)
+    assert out.nnz == 4                       # pattern unchanged
+    np.testing.assert_array_equal(np.asarray(out._indices),
+                                  np.asarray(s._indices))
+    assert tuple(out.shape) == (1, 3, 3, 5)
+
+
+def test_sparse_model_trains_end_to_end():
+    """VERDICT done-criterion: a small sparse conv net (SubmConv3D ->
+    BatchNorm -> ReLU -> Conv3D -> pooled logits) trains end-to-end;
+    loss decreases and conv weights receive gradients."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, sparse
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+
+    class SparseNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = sparse.nn.SubmConv3D(2, 8, 3, padding=1)
+            self.bn = sparse.nn.BatchNorm(8)
+            self.act = sparse.nn.ReLU()
+            self.conv2 = sparse.nn.Conv3D(8, 4, 2, stride=2)
+            self.head = nn.Linear(4, 3)
+
+        def forward(self, x):
+            x = self.act(self.bn(self.conv1(x)))
+            x = self.conv2(x)
+            # global average over present voxels (per batch=1 here)
+            pooled = x.values().mean(axis=0, keepdim=True)
+            return self.head(pooled)
+
+    # random voxel cloud
+    nnz = 20
+    coords = np.unique(np.stack([
+        np.zeros(nnz, np.int64),
+        rng.randint(0, 4, nnz), rng.randint(0, 4, nnz),
+        rng.randint(0, 4, nnz)], axis=1), axis=0)
+    vals = rng.randn(len(coords), 2).astype(np.float32)
+    x = sparse.sparse_coo_tensor(coords.T, vals, [1, 4, 4, 4, 2])
+    label = paddle.to_tensor(np.array([1]))
+    net = SparseNet()
+    opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    lossf = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(8):
+        logits = net(x)
+        loss = lossf(logits, label)
+        loss.backward()
+        assert net.conv1.weight.grad is not None  # grads reach conv1
+        assert net.conv2.weight.grad is not None
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses
