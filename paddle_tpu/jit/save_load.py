@@ -159,14 +159,21 @@ class TranslatedLayer(Layer):
         vals = [p._value for p in self.parameters()]
         conv = self._meta.get("param_converted")
         if conv:
-            # weights stored reduced-precision by the offline
-            # convert_to_mixed_precision pass (inference/passes.py): cast
-            # ONLY the converted entries back (the pass converts float32
-            # params exclusively, so float32 is their signature dtype);
-            # params of other dtypes pass through untouched
-            vals = [v.astype(jnp.float32)
-                    if i < len(conv) and conv[i] else v
-                    for i, v in enumerate(vals)]
+            # weights stored reduced-precision by the offline passes
+            # (inference/passes.py): cast ONLY the converted entries back
+            # (the passes convert float32 params exclusively, so float32
+            # is their signature dtype); params of other dtypes pass
+            # through untouched.  int8 storage (convert_to_int8) carries
+            # a per-tensor absmax scale: dequantize v * scale / 127.
+            scales = self._meta.get("int8_scales")
+            if self._meta.get("weight_precision") == "int8":
+                vals = [v.astype(jnp.float32) * (scales[i] / 127.0)
+                        if i < len(conv) and conv[i] else v
+                        for i, v in enumerate(vals)]
+            else:
+                vals = [v.astype(jnp.float32)
+                        if i < len(conv) and conv[i] else v
+                        for i, v in enumerate(vals)]
         return vals
 
     @property

@@ -26,6 +26,25 @@ class PTQ(QAT):
            final = q.convert(model_q)                  # freeze scales
     """
 
+    def calibrate(self, model: Layer, data_loader, num_batches=None,
+                  input_index=0):
+        """Drive calibration batches from a `paddle.io.DataLoader` (or
+        any iterable) through the observing model.  Parity: the loader
+        loop the reference's PTQ demo runs between quantize() and
+        convert().  Batches may be tensors or (input, label) tuples —
+        `input_index` selects the model input."""
+        import itertools
+
+        from ..framework.dygraph import no_grad
+        it = data_loader if num_batches is None \
+            else itertools.islice(data_loader, num_batches)
+        with no_grad():
+            for batch in it:
+                x = batch[input_index] \
+                    if isinstance(batch, (tuple, list)) else batch
+                model(x)
+        return model
+
     def convert(self, model: Layer, inplace: bool = True) -> Layer:
         model = model if inplace else copy.deepcopy(model)
         for layer in model.sublayers(include_self=True):
