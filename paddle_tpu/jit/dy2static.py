@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor
 
 __all__ = ["convert_function", "convert_ifelse", "convert_while",
-           "UNDEF", "ensure_bound"]
+           "convert_for_range", "convert_call", "UNDEF", "ensure_bound"]
 
 
 class _Undefined:
@@ -196,6 +196,104 @@ def convert_while(cond_fn, body_fn, names, state):
     return _rebuild(list(out), in_meta)
 
 
+def convert_for_range(start, stop, step, body_fn, names, state):
+    """Runtime for a rewritten `for i in range(...)`.
+
+    Concrete bounds run the plain Python loop (exact eager semantics —
+    under an outer trace this is loop unrolling, which is what tracing
+    the original code would do).  A TRACED bound lowers to
+    `jax.lax.fori_loop` with the body-assigned locals as the packed
+    carry — the case the untransformed code cannot trace at all.
+    Returns (*state, last_i) so the loop variable stays bound after the
+    loop, matching Python's leak semantics (for zero traced iterations
+    it is clamped to `start`, where Python would leave it unbound)."""
+    vals = [v._value if isinstance(v, Tensor) else v
+            for v in (start, stop, step)]
+    if not any(_is_traced(v) for v in vals):
+        s0, s1, st = (int(v) for v in vals)
+        i = s0
+        for i in range(s0, s1, st):
+            new = body_fn(i, *state)
+            _check_consistent(state, new, "converted for")
+            state = tuple(new)
+        return (*state, i)
+    start_v, stop_v, step_v = (jnp.asarray(v) for v in vals)
+    # sign-aware trip count: ceil((stop - start) / step), clamped at 0
+    # (the positive-step ceil-div identity is wrong for negative steps)
+    delta = stop_v - start_v
+    n = jnp.maximum(delta // step_v + (delta % step_v != 0), 0)
+    in_leaves, in_meta = _pack(state)
+
+    def body(k, flat):
+        i = start_v + k * step_v
+        res = body_fn(i, *_rebuild(list(flat), in_meta))
+        _check_consistent(state, res, "converted for")
+        l2, m2 = _pack(res)
+        if not _meta_equal(m2, in_meta):
+            raise GraphBreak("for body changed non-tensor state kinds")
+        return tuple(l2)
+
+    try:
+        out = jax.lax.fori_loop(0, n, body, tuple(in_leaves))
+    except TypeError as e:  # carry structure mismatch
+        raise GraphBreak(f"for carry structure mismatch: {e}") from e
+    last = start_v + jnp.maximum(n - 1, 0) * step_v
+    return (*_rebuild(list(out), in_meta), last)
+
+
+# ------------------------------------------------------- recursive convert
+# weak keys: redefined / per-instance functions don't pin memory forever
+import weakref  # noqa: E402
+
+_call_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# sentinel for "seen, conversion was a no-op" (storing f itself as the
+# value would strongly reference the weak key and pin the entry)
+_UNCONVERTED = object()
+
+# modules whose functions are trace-safe by construction — the framework
+# itself, jax, numpy — and never rewritten (the reference's convert_call
+# skips paddle internals + builtins the same way)
+_SKIP_MODULE_PREFIXES = ("jax", "numpy", "paddle_tpu", "builtins",
+                        "functools", "itertools", "math", "typing",
+                        "collections", "operator")
+
+
+def convert_call(fn):
+    """Per-call-site recursive conversion (the reference's
+    `jit/dy2static/convert_call_func.py convert_call`): plain Python
+    functions / bound methods from USER code are AST-converted (memoized)
+    before the call, so a callee's tensor-dependent `if`/`while`/`for`
+    lowers instead of graph-breaking the whole trace."""
+    import types
+    f = fn.__func__ if inspect.ismethod(fn) else fn
+    if not isinstance(f, types.FunctionType):
+        return fn  # builtins, callables, classes, Layers: call as-is
+    mod = getattr(f, "__module__", None) or ""
+    # dot boundary: skip 'jax' and 'jax.numpy' but NOT 'jaxtyping'
+    if mod.split(".")[0] in _SKIP_MODULE_PREFIXES:
+        return fn
+    if f.__name__.startswith("__jst_"):
+        return fn
+    conv = _call_cache.get(f)
+    if conv is None:
+        _call_cache[f] = _UNCONVERTED  # cycle guard for recursive fns
+        conv = convert_function(f)
+        if conv is f:
+            conv = _UNCONVERTED
+        else:
+            # a strong value->key ref would pin the weak cache entry
+            try:
+                del conv.__wrapped__
+            except AttributeError:
+                pass
+        _call_cache[f] = conv
+    if conv is _UNCONVERTED:
+        return fn
+    if inspect.ismethod(fn):
+        return types.MethodType(conv, fn.__self__)
+    return conv
+
+
 # ----------------------------------------------------------- AST transform
 class _AssignedNames(ast.NodeVisitor):
     def __init__(self):
@@ -277,10 +375,30 @@ def _loaded_names(node) -> set:
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
-    """Rewrites convertible `if`/`while` statements into runtime calls."""
+    """Rewrites convertible `if`/`while`/`for-range` statements into
+    runtime calls, and wraps call sites in `__jst_call` for recursive
+    conversion of user callees."""
+
+    # call-site funcs never wrapped (rewriter plumbing + the builtins
+    # whose identity the rewrite itself relies on)
+    _CALL_SKIP = {"range", "vars", "len", "isinstance", "super", "print",
+                  "type", "getattr", "setattr", "hasattr"}
 
     def __init__(self):
         self.counter = 0
+        self.call_wraps = 0
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and (f.id.startswith("__jst_")
+                                        or f.id in self._CALL_SKIP):
+            return node
+        self.call_wraps += 1
+        return ast.Call(
+            func=ast.Call(func=ast.Name(id="__jst_call", ctx=ast.Load()),
+                          args=[node.func], keywords=[]),
+            args=node.args, keywords=node.keywords)
 
     def _helper_defs(self, names, body, fn_name):
         args = ast.arguments(
@@ -337,6 +455,53 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 + [self._helper_defs(names, node.body, tname),
                    self._helper_defs(names, node.orelse, fname),
                    self._unpack(names, call)])
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return node
+        assigned, blocked = _assigned(node.body)
+        names = sorted(assigned - {node.target.id})
+        if blocked or not names:
+            return node
+        self.counter += 1
+        i = self.counter
+        bname = f"__jst_forbody_{i}"
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=node.target.id)] + [ast.arg(arg=n)
+                                                  for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+            ctx=ast.Load()))
+        body_def = ast.FunctionDef(name=bname, args=args,
+                                   body=node.body + [ret],
+                                   decorator_list=[], returns=None)
+        ra = list(it.args)
+        start = ra[0] if len(ra) >= 2 else ast.Constant(value=0)
+        stop = ra[1] if len(ra) >= 2 else ra[0]
+        step = ra[2] if len(ra) == 3 else ast.Constant(value=1)
+        call = ast.Call(
+            func=ast.Name(id="__jst_for_range", ctx=ast.Load()),
+            args=[start, stop, step,
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  ast.Constant(value=tuple(names)),
+                  ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Load())
+                                  for n in names], ctx=ast.Load())],
+            keywords=[])
+        unpack = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store())
+                      for n in names + [node.target.id]],
+                ctx=ast.Store())],
+            value=call)
+        return self._bind_prelude(names) + [body_def, unpack]
 
     def visit_While(self, node):
         self.generic_visit(node)
@@ -396,7 +561,8 @@ def convert_function(fn: Callable) -> Callable:
     fdef.decorator_list = []  # decorators already applied to `fn`
     tr = _ControlFlowTransformer()
     tr.visit(fdef)
-    if tr.counter == 0:
+    if tr.counter == 0 and tr.call_wraps == 0:
+        # nothing converted AND no call sites to convert recursively
         return fn
     ast.fix_missing_locations(tree)
 
@@ -413,17 +579,24 @@ def convert_function(fn: Callable) -> Callable:
         decorator_list=[], returns=None)
     mod = ast.Module(body=[factory], type_ignores=[])
     ast.fix_missing_locations(mod)
-    glb = dict(fn.__globals__)
+    # compile INTO the function's real globals so the converted code
+    # resolves module names LIVE (monkeypatching / late-defined globals
+    # keep working); only the __jst_* runtime helpers are added, and the
+    # factory name is removed again below
+    glb = fn.__globals__
     glb["__jst_ifelse"] = convert_ifelse
     glb["__jst_while"] = convert_while
+    glb["__jst_for_range"] = convert_for_range
+    glb["__jst_call"] = convert_call
     glb["__jst_ensure"] = ensure_bound
     try:
         code = compile(mod, filename=f"<dy2static {fn.__qualname__}>",
                        mode="exec")
         exec(code, glb)  # noqa: S102 - the compiled source IS fn's source
         cells = [c.cell_contents for c in (fn.__closure__ or ())]
-        new_fn = glb[factory_name](*cells)
+        new_fn = glb.pop(factory_name)(*cells)
     except Exception as e:  # noqa: BLE001 - conversion is best-effort
+        glb.pop(factory_name, None)
         warnings.warn(f"dy2static conversion of {fn.__qualname__} failed "
                       f"({e!r}); running unconverted", stacklevel=2)
         return fn

@@ -188,6 +188,12 @@ def test_gpt_moe_trains_and_ep_shards():
     model.eval()
     want = np.asarray(model(ids)._value)
     mesh = Mesh(np.array(jax.devices()[:4]), ("ep",))
+    # the donated train step left every param committed to one device;
+    # an SPMD eval needs the WHOLE model on the mesh: replicate
+    # non-expert params, shard expert stacks over ep (what shard_layer
+    # does for users)
+    for prm in model.parameters():
+        prm._value = jax.device_put(prm._value, NamedSharding(mesh, P()))
     for pname in ("w1", "b1", "w2", "b2"):
         prm = getattr(moe.experts, pname)
         prm._value = jax.device_put(prm._value,
