@@ -41,17 +41,46 @@ __all__ = ["DygraphShardingOptimizer", "GroupShardedOptimizerStage2",
 _warned_shapes = set()
 
 
-def _shard_spec_for(shape, existing=None):
-    """Spec placing 'sharding' on the first eligible dim: divisible by the
-    sharding degree AND not already claimed by another mesh axis (a TP
-    'mp'-sharded dim keeps its layout — ZeRO composes with, never
-    clobbers, tensor parallelism).  Dim 0 preferred; a fused QKV or
-    odd-vocab embedding still gets its ZeRO benefit through another dim.
-    Warns once per (shape, degree) when nothing is eligible (VERDICT r1
-    weak #7: silent replication).
+def _resolve_axis(group):
+    """Custom sharding groups the TPU way: a group IS a mesh axis.
+    Accepts None (the hybrid topology's 'sharding' axis), an axis-name
+    string, or a `distributed.collective.Group` whose `.axis` names one
+    (ref `group_sharded_optimizer_stage2.py:53` `group=` — the process
+    subset there is a mesh sub-axis here)."""
+    if group is None:
+        return "sharding"
+    if isinstance(group, str):
+        axis = group
+    else:
+        axis = getattr(group, "axis", None)
+        if not axis:
+            raise ValueError(
+                "custom sharding group must be a mesh-axis name or a "
+                "Group created with new_group(axis=...) — rank-list "
+                "groups have no mesh seat on TPU")
+        if getattr(group, "_ranks", None):
+            raise ValueError(
+                "custom sharding group: pass EITHER axis= or ranks= — "
+                "a rank subset of a mesh axis has no mesh seat on TPU")
+    m = _mesh.get_mesh()
+    if m is not None and axis not in m.axis_names:
+        raise ValueError(
+            f"custom sharding group axis {axis!r} is not a mesh axis "
+            f"(available: {tuple(m.axis_names)})")
+    return axis
+
+
+def _shard_spec_for(shape, existing=None, axis="sharding"):
+    """Spec placing the sharding axis on the first eligible dim:
+    divisible by the sharding degree AND not already claimed by another
+    mesh axis (a TP 'mp'-sharded dim keeps its layout — ZeRO composes
+    with, never clobbers, tensor parallelism).  Dim 0 preferred; a fused
+    QKV or odd-vocab embedding still gets its ZeRO benefit through
+    another dim.  Warns once per (shape, degree) when nothing is
+    eligible (VERDICT r1 weak #7: silent replication).
 
     `existing`: the value's current NamedSharding, if any."""
-    n = _mesh.axis_size("sharding")
+    n = _mesh.axis_size(axis)
     if n <= 1 or not shape:
         return None
     base = [None] * len(shape)
@@ -59,28 +88,28 @@ def _shard_spec_for(shape, existing=None):
             and len(existing.spec) <= len(shape):
         base = list(existing.spec) + [None] * (len(shape)
                                                - len(existing.spec))
-    if any("sharding" in (e if isinstance(e, tuple) else (e,))
+    if any(axis in (e if isinstance(e, tuple) else (e,))
            for e in base if e is not None):
         return None  # already ZeRO-sharded
     for dim, sz in enumerate(shape):
         taken = base[dim] is not None
         if not taken and sz >= n and sz % n == 0:
             entries = list(base)
-            entries[dim] = "sharding"
+            entries[dim] = axis
             return NamedSharding(_mesh.get_mesh(), P(*entries))
-    key = (tuple(shape), n)
+    key = (tuple(shape), n, axis)
     if key not in _warned_shapes:
         _warned_shapes.add(key)
         import warnings
         warnings.warn(
             f"ZeRO sharding: no free dim of shape {tuple(shape)} is "
-            f"divisible by sharding degree {n}; this buffer keeps its "
-            "current (unsharded-over-'sharding') layout")
+            f"divisible by the {axis!r} degree {n}; this buffer keeps "
+            f"its current (unsharded-over-{axis!r}) layout")
     return None
 
 
-def shard_accumulator_fn(arr):
-    sh = _shard_spec_for(arr.shape, getattr(arr, "sharding", None))
+def shard_accumulator_fn(arr, axis="sharding"):
+    sh = _shard_spec_for(arr.shape, getattr(arr, "sharding", None), axis)
     if sh is None:
         return arr
     return jax.device_put(arr, sh)
@@ -91,11 +120,13 @@ class DygraphShardingOptimizer:
     accumulator sharded over the 'sharding' axis."""
 
     def __init__(self, optimizer: Optimizer, hcg=None, stage: int = 1,
-                 offload: bool = False):
+                 offload: bool = False, group=None):
         self._inner = optimizer
         self._hcg = hcg
         self._stage = stage
         self._offload = offload
+        self._axis = _resolve_axis(group)
+        axis = self._axis
         # intercept accumulator creation
         orig_get_state = optimizer._get_state
 
@@ -105,7 +136,7 @@ class DygraphShardingOptimizer:
             created = key not in store
             arr = orig_get_state(name, p, like)
             if created:
-                arr = shard_accumulator_fn(arr)
+                arr = shard_accumulator_fn(arr, axis)
                 store[key] = arr
             return arr
         optimizer._get_state = sharded_get_state
@@ -117,7 +148,7 @@ class DygraphShardingOptimizer:
             created = key not in mw
             arr = orig_master(p)
             if created:
-                arr = shard_accumulator_fn(arr)
+                arr = shard_accumulator_fn(arr, axis)
                 mw[key] = arr
             return arr
         optimizer._create_master_weight = sharded_master
@@ -130,7 +161,7 @@ class DygraphShardingOptimizer:
             # the param's layout is the grad's layout (TP dims must be
             # preserved; param sharding is readable even mid-trace)
             existing = getattr(p._value, "sharding", None)
-            sh = _shard_spec_for(tuple(p.grad.shape), existing)
+            sh = _shard_spec_for(tuple(p.grad.shape), existing, self._axis)
             if sh is not None and not p.grad._is_traced():
                 p.grad._value = jax.device_put(p.grad._value, sh)
             elif sh is not None:
@@ -178,12 +209,6 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     `group` selects the sharding axis group (default hybrid topology)."""
 
     def __init__(self, params, optim, group=None, offload=False, **kwargs):
-        if group is not None:
-            raise NotImplementedError(
-                "custom sharding groups: the TPU build shards over the "
-                "global hybrid topology's 'sharding' mesh axis "
-                "(fleet.DistributedStrategy hybrid_configs "
-                "sharding_degree)")
         opt_params = {id(p) for p in optim._parameter_list}
         missing = [p for p in (params or []) if id(p) not in opt_params]
         if missing:
@@ -191,18 +216,19 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
                 f"{len(missing)} params passed to "
                 "GroupShardedOptimizerStage2 are not held by the inner "
                 "optimizer")
-        super().__init__(optim, stage=2, offload=offload)
+        super().__init__(optim, stage=2, offload=offload, group=group)
 
 
-def apply_stage3_param_sharding(layer):
-    """ZeRO-3: shard every parameter over 'sharding' (allgather-on-use is
-    GSPMD-inserted)."""
+def apply_stage3_param_sharding(layer, group=None):
+    """ZeRO-3: shard every parameter over the sharding axis
+    (allgather-on-use is GSPMD-inserted)."""
+    axis = _resolve_axis(group)
     m = _mesh.get_mesh()
-    if m is None or _mesh.axis_size("sharding") <= 1:
+    if m is None or _mesh.axis_size(axis) <= 1:
         return layer
     for p in layer.parameters():
         sh = _shard_spec_for(tuple(p.shape),
-                             getattr(p._value, "sharding", None))
+                             getattr(p._value, "sharding", None), axis)
         if sh is not None:
             p._value = jax.device_put(p._value, sh)
     return layer
@@ -216,7 +242,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
     level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3)."""
     stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
     if stage == 3:
-        apply_stage3_param_sharding(model)
+        apply_stage3_param_sharding(model, group=group)
     opt = DygraphShardingOptimizer(optimizer, stage=min(stage, 2),
-                                   offload=offload)
+                                   offload=offload, group=group)
     return model, opt, scaler

@@ -449,3 +449,59 @@ def test_stage3_param_layout_survives_jitted_steps(hybrid_env):
         step(x)
     spec = net.weight._value.sharding.spec
     assert "sharding" in tuple(spec), spec
+
+
+def test_stage2_custom_group_composes_with_tp(hybrid_mesh):
+    """VERDICT r3 item 10: custom sharding groups — a group IS a mesh
+    axis on TPU — compose eager ZeRO-2 with tensor parallelism: an
+    mp-sharded (column-parallel) weight keeps its TP layout while its
+    optimizer state and gradients shard over the CUSTOM group axis
+    ('dp' here, not the default 'sharding')."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import mesh as _mesh
+    from paddle_tpu.distributed.collective import new_group
+    from paddle_tpu.distributed.fleet.sharding import (
+        GroupShardedOptimizerStage2)
+
+    mesh = _mesh.get_mesh()
+    lin = paddle.nn.Linear(8, 8)
+    # TP: column-parallel weight layout over mp
+    lin.weight._value = jax.device_put(
+        lin.weight._value, NamedSharding(mesh, P(None, "mp")))
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=lin.parameters())
+    grp = new_group(axis="dp")
+    sharded = GroupShardedOptimizerStage2(lin.parameters(), opt, group=grp)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    losses = []
+    for _ in range(3):
+        loss = ((lin(x) - 1.0) ** 2).mean()
+        loss.backward()
+        sharded.step()
+        sharded.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # TP layout preserved on the param
+    assert "mp" in str(lin.weight._value.sharding.spec)
+    # optimizer moments sharded over the CUSTOM axis, composing with mp
+    m_acc = opt._accumulators["moment1"][id(lin.weight)]
+    spec = m_acc.sharding.spec
+    assert "dp" in str(spec), spec
+    assert "sharding" not in str(spec), spec
+
+
+def test_stage2_rejects_rank_list_groups(hybrid_mesh):
+    from paddle_tpu.distributed.collective import new_group
+    from paddle_tpu.distributed.fleet.sharding import (
+        GroupShardedOptimizerStage2)
+    import paddle_tpu as paddle
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    with pytest.raises(ValueError, match="mesh-axis"):
+        GroupShardedOptimizerStage2(lin.parameters(), opt,
+                                    group=new_group(ranks=[0, 1]))
