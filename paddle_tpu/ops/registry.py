@@ -104,6 +104,16 @@ def set_program_recorder(fn: Optional[Callable]) -> None:
     _program_recorder = fn
 
 
+# composite-op names whose dispatch is substituted by their primitive
+# decomposition rule (decomposition.enabled() sets/clears this)
+_decomp_active: Optional[set] = None
+
+
+def set_decomp_active(names: Optional[set]) -> None:
+    global _decomp_active
+    _decomp_active = names
+
+
 def register_op(name: str, fwd: Callable, custom_vjp: Optional[Callable] = None,
                 tags: Sequence[str] = ()) -> OpDef:
     op = OpDef(name, fwd, custom_vjp, tuple(tags))
@@ -298,6 +308,13 @@ def _autocast_vals(op_name: str, vals: List[Any]):
 def dispatch(name: str, diff_inputs: Sequence[Any], static: Dict[str, Any],
              op: Optional[OpDef] = None):
     """Execute one op eagerly with autograd tracking."""
+    if _decomp_active is not None and name in _decomp_active:
+        # composite -> primitives substitution (decomposition.enabled()):
+        # run the registered primitive rule on Tensors; its constituent
+        # ops re-enter dispatch individually (the reference's program
+        # decompose pass, applied at the dynamic dispatch seam)
+        from ..decomposition import get_decomp
+        return get_decomp(name)(*diff_inputs, **static)
     if _op_timer is not None:
         import time as _time
         t0 = _time.perf_counter()
