@@ -155,6 +155,8 @@ def enabled(*names, include_all: bool = False):
     if unknown:
         raise KeyError(f"no decomposition registered for {sorted(unknown)}")
     prev = _reg._decomp_active
+    if prev:
+        active = active | prev   # nested contexts UNION, never narrow
     _reg.set_decomp_active(active)
     try:
         yield
@@ -188,15 +190,17 @@ def _leaky_relu(x, negative_slope=0.01):
 @register_decomp("elu")
 def _elu(x, alpha=1.0):
     import paddle_tpu as paddle
-    return paddle.maximum(x, 0.0) + paddle.minimum(
-        alpha * (paddle.exp(paddle.minimum(x, 0.0)) - 1.0), 0.0)
+    # where-form: min/max clamping would zero the negative branch when
+    # alpha < 0 (jax.nn.elu semantics keep it positive there)
+    neg = alpha * (paddle.exp(paddle.minimum(x, 0.0)) - 1.0)
+    return paddle.where(x > 0, x, neg)
 
 
 @register_decomp("celu")
 def _celu(x, alpha=1.0):
     import paddle_tpu as paddle
-    return paddle.maximum(x, 0.0) + paddle.minimum(
-        alpha * (paddle.exp(paddle.minimum(x, 0.0) / alpha) - 1.0), 0.0)
+    neg = alpha * (paddle.exp(paddle.minimum(x, 0.0) / alpha) - 1.0)
+    return paddle.where(x > 0, x, neg)
 
 
 @register_decomp("selu")

@@ -17,7 +17,12 @@ __all__ = ["Config", "Predictor", "PredictHandle", "create_predictor"]
 
 
 class Config:
-    """Inference configuration.  Parity: `paddle_infer.Config`."""
+    """Inference configuration.  Parity: `paddle_infer.Config`
+    (`analysis_predictor.h:100` config surface).  Graph-level switches
+    the reference exposes (ir optim, TensorRT) are XLA's compile
+    pipeline here and accepted as no-ops for parity; the knobs with a
+    real TPU seat are precision (MXU matmul passes + input casting) and
+    profiling."""
 
     def __init__(self, prog_file: Optional[str] = None,
                  params_file: Optional[str] = None):
@@ -28,6 +33,11 @@ class Config:
         self.model_prefix = prog_file
         self._memory_pool_mb = 0
         self._device = "tpu"
+        self._mixed_precision: Optional[str] = None
+        self._cast_inputs = False
+        self._profile = False
+        self._ir_optim = True
+        self._threads = 1
 
     def set_prog_file(self, path: str):
         self.model_prefix = path[:-len(".pdmodel")] \
@@ -42,21 +52,82 @@ class Config:
     def enable_memory_optim(self):
         pass  # XLA buffer assignment already does this
 
+    # -------------------------------------------------- precision surface
+    def enable_mixed_precision(self, precision: str = "bfloat16",
+                               cast_inputs: bool = False):
+        """RUN-TIME mixed precision (the reference rewrites the graph to
+        fp16 compute in its analysis pass; the TPU seat is the MXU's
+        matmul pass precision).  f32 matmuls in the served program
+        execute with bf16 passes; `cast_inputs` additionally casts
+        floating inputs to the reduced dtype at the call boundary.
+        Composes with the OFFLINE weight passes
+        (`convert_to_mixed_precision` / `convert_to_int8`)."""
+        if precision not in ("bfloat16", "float16", "float32"):
+            raise ValueError(f"unsupported precision {precision!r}")
+        self._mixed_precision = precision
+        self._cast_inputs = cast_inputs
+
+    def exp_disable_mixed_precision_ops(self, *a, **k):
+        pass  # op-level black list: XLA decides per-fusion
+
+    # ------------------------------------------------ parity-only switches
+    def switch_ir_optim(self, on: bool = True):
+        self._ir_optim = bool(on)  # XLA always optimizes; recorded only
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = int(n)
+
+    def enable_profile(self):
+        self._profile = True
+
+    def disable_glog_info(self):
+        pass
+
+    def summary(self) -> str:
+        """Parity: `Config.Summary()` — a table of the effective config."""
+        rows = [("model_prefix", self.model_prefix),
+                ("device", self._device),
+                ("mixed_precision", self._mixed_precision or "off"),
+                ("cast_inputs", self._cast_inputs),
+                ("ir_optim (XLA)", self._ir_optim),
+                ("profile", self._profile)]
+        width = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k:<{width}}  {v}" for k, v in rows)
+
 
 class PredictHandle:
-    """Input/output tensor handle (copy_from_cpu / copy_to_cpu)."""
+    """Input/output tensor handle.  `copy_from_cpu`/`copy_to_cpu` move
+    host arrays; `share_external_data` BINDS a device array zero-copy
+    (the reference's IO-binding path — `Tensor.share_external_data` —
+    so a TPU-resident tensor feeds the program without a host trip)."""
 
     def __init__(self, name: str):
         self.name = name
-        self._value: Optional[np.ndarray] = None
+        self._value = None          # np.ndarray OR bound device array
 
     def copy_from_cpu(self, arr: np.ndarray):
         self._value = np.asarray(arr)
+
+    def share_external_data(self, tensor):
+        """Bind a device-resident tensor (paddle Tensor or jax array)
+        without copying through the host."""
+        self._value = getattr(tensor, "_value", tensor)
 
     def copy_to_cpu(self) -> np.ndarray:
         if self._value is None:
             raise RuntimeError(f"handle {self.name!r} has no value yet")
         return np.asarray(self._value)
+
+    def tensor(self):
+        """The bound value as a paddle Tensor; a device-resident value
+        wraps in place (no host round trip — jnp.asarray on a jax array
+        is the identity)."""
+        import jax.numpy as jnp
+
+        from ..framework.tensor import Tensor
+        if self._value is None:
+            raise RuntimeError(f"handle {self.name!r} has no value yet")
+        return Tensor._wrap(jnp.asarray(self._value))
 
     def shape(self):
         return None if self._value is None else list(self._value.shape)
@@ -69,6 +140,7 @@ class Predictor:
     def __init__(self, config: Config):
         if not config.model_prefix:
             raise ValueError("Config needs the jit.save path prefix")
+        self._config = config
         self._layer = TranslatedLayer(config.model_prefix)
         n_in = len(self._layer.input_specs)
         self._inputs = {f"input_{i}": PredictHandle(f"input_{i}")
@@ -95,17 +167,57 @@ class Predictor:
         """Execute; either pass arrays directly (returns arrays, the modern
         `predictor.run([x])` form) or use the input handles."""
         if inputs is None:
-            inputs = [h.copy_to_cpu() for h in self._inputs.values()]
+            # IO binding path: use the BOUND values (device arrays stay
+            # on device; no copy_to_cpu round trip)
+            inputs = [h._value for h in self._inputs.values()]
+            if any(v is None for v in inputs):
+                missing = [h.name for h in self._inputs.values()
+                           if h._value is None]
+                raise RuntimeError(f"input handles not set: {missing}")
             direct = False
         else:
             direct = True
-        outs = self._layer(*inputs)
+        cfg = self._config
+        if cfg._mixed_precision and cfg._cast_inputs \
+                and cfg._mixed_precision != "float32":
+            # the exported program's input signature is fixed: truncate
+            # the VALUES to the reduced precision, keep the dtype (the
+            # keep_io_types semantics of the reference's conversion)
+            import jax.numpy as jnp
+            tgt = jnp.bfloat16 if cfg._mixed_precision == "bfloat16" \
+                else jnp.float16
+            def trunc(v):
+                a = jnp.asarray(v)
+                if jnp.issubdtype(a.dtype, jnp.floating):
+                    return a.astype(tgt).astype(a.dtype)
+                return v
+            inputs = [trunc(v) for v in inputs]
+        import contextlib
+
+        import jax
+        prec = {"bfloat16": "default", "float16": "default",
+                "float32": "highest"}.get(cfg._mixed_precision)
+        ctx = jax.default_matmul_precision(prec) if prec \
+            else contextlib.nullcontext()
+        if cfg._profile:
+            import time as _time
+            t0 = _time.perf_counter()
+        with ctx:
+            outs = self._layer(*inputs)
+        if cfg._profile:
+            st = getattr(self, "_profile_stats",
+                         {"runs": 0, "total_s": 0.0})
+            st["runs"] += 1
+            st["total_s"] += _time.perf_counter() - t0
+            self._profile_stats = st
         outs = outs if isinstance(outs, tuple) else (outs,)
-        arrs = [np.asarray(o._value) for o in outs]
-        for i, a in enumerate(arrs):
-            # fill pre-fetched handles in place so references stay valid
-            self.get_output_handle(f"output_{i}").copy_from_cpu(a)
-        return arrs if direct else None
+        for i, o in enumerate(outs):
+            # bind the DEVICE array; copy_to_cpu materializes on demand,
+            # so the IO-binding path never forces a host transfer
+            self.get_output_handle(f"output_{i}")._value = o._value
+        if direct:
+            return [np.asarray(o._value) for o in outs]
+        return None
 
 
 def create_predictor(config: Config) -> Predictor:
