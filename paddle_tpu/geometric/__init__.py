@@ -21,7 +21,9 @@ from ..framework.tensor import Tensor
 from ..ops.registry import dispatch as _d, register_op
 
 __all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
-           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph"]
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "reindex_heter_graph", "sample_neighbors",
+           "weighted_sample_neighbors"]
 
 
 def _num_segments(segment_ids) -> int:
@@ -168,3 +170,130 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     mk = lambda a, dt: Tensor._wrap(jnp.asarray(a, dt))  # noqa: E731
     return (mk(reindex_src, jnp.int64), mk(reindex_dst, jnp.int64),
             mk(out_nodes, jnp.int64))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous reindex (`geometric/reindex.py reindex_heter_graph`):
+    per-edge-type neighbor lists share ONE id compaction keyed by the
+    center nodes; returns per-type (reindex_src) plus the shared
+    reindex_dst concatenation and the unified out_nodes."""
+    import numpy as np
+    xs = np.asarray(jax.device_get(
+        x._value if isinstance(x, Tensor) else x))
+    nbs = [np.asarray(jax.device_get(
+        n._value if isinstance(n, Tensor) else n)) for n in neighbors]
+    cnts = [np.asarray(jax.device_get(
+        c._value if isinstance(c, Tensor) else c)) for c in count]
+    order = {}
+    for v in np.concatenate([xs] + nbs):
+        if v not in order:
+            order[v] = len(order)
+    remap = np.vectorize(order.__getitem__, otypes=[np.int64])
+    srcs = [remap(nb) if len(nb) else nb.astype(np.int64) for nb in nbs]
+    dsts = [np.repeat(np.arange(len(xs)), c) for c in cnts]
+    out_nodes = np.array(sorted(order, key=order.__getitem__))
+    mk = lambda a: Tensor._wrap(jnp.asarray(a, jnp.int64))  # noqa: E731
+    return (mk(np.concatenate(srcs) if srcs else np.zeros(0)),
+            mk(np.concatenate(dsts) if dsts else np.zeros(0)),
+            mk(out_nodes))
+
+
+def _csc_of(row, colptr):
+    import numpy as np
+    r = np.asarray(jax.device_get(
+        row._value if isinstance(row, Tensor) else row))
+    cp = np.asarray(jax.device_get(
+        colptr._value if isinstance(colptr, Tensor) else colptr))
+    return r, cp
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph
+    (`geometric/sampling/neighbors.py sample_neighbors` /
+    graph_sample_neighbors op).  Eager-only (data-dependent output);
+    randomness from the framework RNG (paddle.seed reproduces runs)."""
+    import numpy as np
+
+    from ..framework import random as _random
+    r, cp = _csc_of(row, colptr)
+    nodes = np.asarray(jax.device_get(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes))
+    eid_arr = None
+    if eids is not None:
+        eid_arr = np.asarray(jax.device_get(
+            eids._value if isinstance(eids, Tensor) else eids))
+    seed = int(jax.device_get(jax.random.randint(
+        _random.next_key(), (), 0, 2**31 - 1)))
+    rng = np.random.RandomState(seed)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(r[pick])
+        out_c.append(len(pick))
+        if eid_arr is not None:
+            out_e.append(eid_arr[pick])
+    mk = lambda a: Tensor._wrap(jnp.asarray(a, jnp.int64))  # noqa: E731
+    neighbors = mk(np.concatenate(out_n) if out_n else np.zeros(0))
+    counts = mk(np.asarray(out_c))
+    if return_eids:
+        if eid_arr is None:
+            raise ValueError("return_eids=True needs eids")
+        return neighbors, counts, mk(np.concatenate(out_e)
+                                     if out_e else np.zeros(0))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional sampling without replacement
+    (`sampling/neighbors.py weighted_sample_neighbors` op).  Uses the
+    Gumbel top-k trick (Efraimidis-Spirakis keys), the same math the
+    reference's GPU kernel implements."""
+    import numpy as np
+
+    from ..framework import random as _random
+    r, cp = _csc_of(row, colptr)
+    w = np.asarray(jax.device_get(
+        edge_weight._value if isinstance(edge_weight, Tensor)
+        else edge_weight)).astype(np.float64)
+    nodes = np.asarray(jax.device_get(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes))
+    eid_arr = None
+    if eids is not None:
+        eid_arr = np.asarray(jax.device_get(
+            eids._value if isinstance(eids, Tensor) else eids))
+    seed = int(jax.device_get(jax.random.randint(
+        _random.next_key(), (), 0, 2**31 - 1)))
+    rng = np.random.RandomState(seed)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            keys = rng.rand(deg) ** (1.0 / np.maximum(w[beg:end], 1e-12))
+            pick = beg + np.argsort(-keys)[:sample_size]
+        out_n.append(r[pick])
+        out_c.append(len(pick))
+        if eid_arr is not None:
+            out_e.append(eid_arr[pick])
+    mk = lambda a: Tensor._wrap(jnp.asarray(a, jnp.int64))  # noqa: E731
+    neighbors = mk(np.concatenate(out_n) if out_n else np.zeros(0))
+    counts = mk(np.asarray(out_c))
+    if return_eids:
+        if eid_arr is None:
+            raise ValueError("return_eids=True needs eids")
+        return neighbors, counts, mk(np.concatenate(out_e)
+                                     if out_e else np.zeros(0))
+    return neighbors, counts

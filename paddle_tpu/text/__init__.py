@@ -4,8 +4,9 @@ Parity: `python/paddle/text/__init__.py` (viterbi_decode `:25`,
 ViterbiDecoder `:100`, datasets/).
 """
 
-from .datasets import Conll05st, Imdb, Imikolov, Movielens, UCIHousing
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,
+                       UCIHousing, WMT14, WMT16)
 from .viterbi_decode import ViterbiDecoder, viterbi_decode
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Imikolov",
-           "Movielens", "UCIHousing", "Conll05st"]
+           "Movielens", "UCIHousing", "Conll05st", "WMT14", "WMT16"]

@@ -170,3 +170,113 @@ class Conll05st(Dataset):
             "Conll05st parsing: the reference's preprocessed pickle is "
             "proprietary-format; load it with paddle.load and wrap in a "
             "paddle.io.Dataset")
+
+
+class WMT14(Dataset):
+    """WMT14 EN-FR translation (reference `wmt14.py` format: a tar with
+    `src.dict`/`trg.dict` vocab files + `{mode}/{mode}` members holding
+    tab-separated sentence pairs).  Local-file only in this build.
+
+    Yields (src_ids, trg_ids, trg_ids_next) with <s>/<e> framing and
+    <unk> (id 2) for out-of-dict words, sequences over 80 tokens
+    dropped — the published dataset contract.
+    """
+
+    _START, _END, _UNK_IDX = "<s>", "<e>", 2
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = -1, download: bool = False):
+        import tarfile
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(f"mode must be train/test/gen, got {mode!r}")
+        if dict_size <= 0:
+            raise ValueError("dict_size must be positive")
+        data_file = _need_file(data_file, type(self).__name__)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file, "r") as tf:
+            self.src_dict = self._vocab(tf, "src.dict", dict_size)
+            self.trg_dict = self._vocab(tf, "trg.dict", dict_size)
+            pair_members = [m for m in tf.getnames()
+                            if m.endswith(f"{mode}/{mode}")]
+            for member in pair_members:
+                for raw in tf.extractfile(member):
+                    parts = raw.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    self._add_pair(*parts)
+
+    def _vocab(self, tf, suffix, size):
+        import tarfile as _t
+        names = [m for m in tf.getnames() if m.endswith(suffix)]
+        if len(names) != 1:
+            raise ValueError(f"archive needs exactly one *{suffix}")
+        vocab = {}
+        for i, raw in enumerate(tf.extractfile(names[0])):
+            if i >= size:
+                break
+            vocab[raw.decode().strip()] = i
+        return vocab
+
+    def _add_pair(self, src_seq, trg_seq):
+        sd, td = self.src_dict, self.trg_dict
+        u = self._UNK_IDX
+        src = [sd.get(w, u) for w in
+               [self._START] + src_seq.split() + [self._END]]
+        trg = [td.get(w, u) for w in trg_seq.split()]
+        if len(src) > 80 or len(trg) > 80:
+            return
+        self.src_ids.append(src)
+        self.trg_ids.append([td[self._START]] + trg)
+        self.trg_ids_next.append(trg + [td[self._END]])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, i):
+        return (np.asarray(self.src_ids[i], np.int64),
+                np.asarray(self.trg_ids[i], np.int64),
+                np.asarray(self.trg_ids_next[i], np.int64))
+
+
+class WMT16(WMT14):
+    """WMT16 Multi30K EN-DE (reference `wmt16.py` format: tar with
+    `wmt16/{train,val,test}` tab-separated members and per-language
+    vocab built on first use).  `lang` selects the source side."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en", download: bool = False):
+        import tarfile
+        if mode not in ("train", "test", "val"):
+            raise ValueError(f"mode must be train/test/val, got {mode!r}")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict sizes must be positive")
+        data_file = _need_file(data_file, "WMT16")
+        src_col, trg_col = (0, 1) if lang == "en" else (1, 0)
+        with tarfile.open(data_file, "r") as tf:
+            members = [m for m in tf.getnames()
+                       if m.endswith(f"wmt16/{mode}")]
+            if not members:
+                raise ValueError(f"archive has no wmt16/{mode} member")
+            pairs = []
+            for raw in tf.extractfile(members[0]):
+                parts = raw.decode().strip().split("\t")
+                if len(parts) == 2:
+                    pairs.append((parts[src_col], parts[trg_col]))
+        self.src_dict = self._build_vocab((p[0] for p in pairs),
+                                          src_dict_size)
+        self.trg_dict = self._build_vocab((p[1] for p in pairs),
+                                          trg_dict_size)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for src_seq, trg_seq in pairs:
+            self._add_pair(src_seq, trg_seq)
+
+    def _build_vocab(self, seqs, size):
+        from collections import Counter
+        counts = Counter()
+        for s in seqs:
+            counts.update(s.split())
+        vocab = {self._START: 0, self._END: 1, "<unk>": 2}
+        for w, _ in counts.most_common(max(size - 3, 0)):
+            vocab[w] = len(vocab)
+        return vocab
