@@ -1,0 +1,42 @@
+"""QAT -> jit.save -> int8 artifact -> Predictor with runtime mixed
+precision: the full quantized-deployment loop."""
+from _mesh import ensure_devices
+
+ensure_devices(1)
+import tempfile  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import jit  # noqa: E402
+from paddle_tpu.inference import Config, convert_to_int8, create_predictor  # noqa: E402
+from paddle_tpu.quantization import (QAT, FakeQuanterWithAbsMaxObserver,  # noqa: E402
+                                     QuantConfig)
+from paddle_tpu.static import InputSpec  # noqa: E402
+
+paddle.seed(0)
+net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                           paddle.nn.Linear(16, 4))
+qnet = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                       weight=FakeQuanterWithAbsMaxObserver)).quantize(net)
+x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8).astype(np.float32))
+y = paddle.to_tensor(np.random.RandomState(1).randn(16, 4).astype(np.float32))
+opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=qnet.parameters())
+qnet.train()
+for i in range(10):
+    loss = paddle.mean((qnet(x) - y) ** 2)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+print("QAT loss:", float(loss.numpy()))
+
+with tempfile.TemporaryDirectory() as d:
+    qnet.eval()
+    jit.save(qnet, f"{d}/m", input_spec=[InputSpec([None, 8], "float32")])
+    convert_to_int8(f"{d}/m", f"{d}/m_int8", black_list=["bias"])
+    cfg = Config(f"{d}/m_int8")
+    cfg.enable_mixed_precision("bfloat16")
+    pred = create_predictor(cfg)
+    out = pred.run([np.asarray(x._value)])[0]
+    ref = np.asarray(qnet(x)._value)
+    print("int8-served vs QAT max err:", float(np.abs(out - ref).max()))

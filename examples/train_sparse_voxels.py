@@ -1,0 +1,44 @@
+"""Sparse 3-D conv net on a voxel cloud: SubmConv3D -> BatchNorm -> ReLU
+-> Conv3D, values tape-tracked so loss.backward() reaches conv weights."""
+from _mesh import ensure_devices
+
+ensure_devices(1)
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import nn, optimizer, sparse  # noqa: E402
+
+paddle.seed(0)
+rng = np.random.RandomState(0)
+coords = np.unique(np.stack([
+    np.zeros(30, np.int64), rng.randint(0, 4, 30),
+    rng.randint(0, 4, 30), rng.randint(0, 4, 30)], axis=1), axis=0)
+x = sparse.sparse_coo_tensor(
+    coords.T, rng.randn(len(coords), 2).astype(np.float32), [1, 4, 4, 4, 2])
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.c1 = sparse.nn.SubmConv3D(2, 8, 3, padding=1)
+        self.bn = sparse.nn.BatchNorm(8)
+        self.act = sparse.nn.ReLU()
+        self.c2 = sparse.nn.Conv3D(8, 4, 2, stride=2)
+        self.head = nn.Linear(4, 3)
+
+    def forward(self, s):
+        s = self.act(self.bn(self.c1(s)))
+        s = self.c2(s)
+        return self.head(s.values().mean(axis=0, keepdim=True))
+
+
+net = Net()
+opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+lossf = nn.CrossEntropyLoss()
+label = paddle.to_tensor(np.array([1]))
+for i in range(6):
+    loss = lossf(net(x), label)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    print(f"step {i}: loss {float(loss.numpy()):.4f}")

@@ -6,11 +6,12 @@ Executor.run replays them with feeds — see program.py.  CompiledProgram
 wraps the replay in jit.to_static for a single fused XLA executable.
 """
 
+from . import amp  # noqa: F401
 from .executor import CompiledProgram, Executor, global_scope, scope_guard  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
 from .program import (Program, data, default_main_program,  # noqa: F401
                       default_startup_program, program_guard)
 
-__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+__all__ = ["amp", "InputSpec", "Program", "program_guard", "default_main_program",
            "default_startup_program", "data", "Executor", "CompiledProgram",
            "global_scope", "scope_guard"]

@@ -1,0 +1,147 @@
+"""paddle.static.amp — mixed-precision surface for the static facade.
+
+Parity: `python/paddle/static/amp/` (decorator.py decorate,
+fp16_lists.py AutoMixedPrecisionLists/CustomOpLists, fp16_utils.py
+cast_model_to_fp16/cast_parameters_to_fp16/fp16_guard).
+
+TPU-native seat: the static Program here is a record-replay facade over
+the SAME eager dispatch the dynamic AMP hooks instrument, so static AMP
+*is* dynamic AMP — `decorate` wraps the optimizer with the shared
+GradScaler/auto_cast machinery, the op lists feed the same white/black
+sets, and the fp16 casts rewrite parameter storage the way the
+inference passes do.  (The reference maintains a parallel
+program-rewriting implementation because its static graph executes in
+C++; there is no second executor to rewrite here.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ...amp import auto_cast  # the context-manager class
+from ...amp.auto_cast import FP16_BLACK_LIST, FP16_WHITE_LIST
+
+__all__ = ["decorate", "AutoMixedPrecisionLists", "CustomOpLists",
+           "cast_model_to_fp16", "cast_parameters_to_fp16", "fp16_guard"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op-name lists.  Parity: fp16_lists.py
+    AutoMixedPrecisionLists(custom_white_list, custom_black_list,
+    custom_black_varnames)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(FP16_WHITE_LIST)
+        self.black_list = set(FP16_BLACK_LIST)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)
+        self.black_varnames = set(custom_black_varnames or ())
+        self.dtype = dtype
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class _DecoratedOptimizer:
+    """Optimizer wrapper running minimize/step under auto_cast with the
+    decorated lists + loss scaling.  Parity: decorator.py
+    OptimizerWithMixedPrecision (amp_init folded into construction)."""
+
+    def __init__(self, optimizer, amp_lists, level, dtype,
+                 init_loss_scaling, use_dynamic_loss_scaling,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.8):
+        self._inner = optimizer
+        self._lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
+        self._level = level
+        self._dtype = dtype
+        from ...amp.grad_scaler import GradScaler
+        self._scaler = GradScaler(
+            init_loss_scaling=init_loss_scaling,
+            incr_every_n_steps=incr_every_n_steps,
+            decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+            incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+            use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+    def _ctx(self):
+        return auto_cast(
+            True, custom_white_list=self._lists.white_list,
+            custom_black_list=self._lists.black_list,
+            level=self._level, dtype=self._dtype)
+
+    def amp_init(self, place=None, scope=None, test_program=None,
+                 use_fp16_test=False):
+        pass  # casts happen at dispatch; nothing to pre-rewrite
+
+    def backward(self, loss, **kw):
+        scaled = self._scaler.scale(loss)
+        scaled.backward()
+        return []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        # GradScaler.step() already runs the scale-update state machine
+        # internally — calling update() again would double-advance it
+        self.backward(loss)
+        self._scaler.step(self._inner)
+        self._inner.clear_grad()
+        return [], []
+
+    def step(self):
+        self._scaler.step(self._inner)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def decorate(optimizer, amp_lists=None, level="O1", dtype="float16",
+             init_loss_scaling=2.0 ** 15, incr_every_n_steps=1000,
+             decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=None, use_amp_guard=None,
+             use_master_grad=False, use_promote=False,
+             master_weight=None, **kw):
+    """Parity: static/amp/decorator.py decorate."""
+    if use_dynamic_loss_scaling is None:
+        use_dynamic_loss_scaling = dtype == "float16"
+    return _DecoratedOptimizer(optimizer, amp_lists, level, dtype,
+                               init_loss_scaling, use_dynamic_loss_scaling,
+                               incr_every_n_steps=incr_every_n_steps,
+                               decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+                               incr_ratio=incr_ratio, decr_ratio=decr_ratio)
+
+
+def cast_model_to_fp16(program_or_layer, amp_lists=None,
+                       use_fp16_guard=True, dtype="float16", **kw):
+    """Cast a Layer's floating parameters to the reduced dtype (the
+    static pass rewrites the program's var dtypes; the facade's
+    equivalent storage rewrite).  Parity: fp16_utils.cast_model_to_fp16."""
+    target = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+    lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
+    for p in getattr(program_or_layer, "parameters", lambda: [])():
+        if any(b in (p.name or "") for b in lists.black_varnames):
+            continue
+        if jnp.issubdtype(p._value.dtype, jnp.floating):
+            p._value = p._value.astype(target)
+    return program_or_layer
+
+
+def cast_parameters_to_fp16(place, program_or_layer, scope=None,
+                            to_fp16_var_names=None, dtype="float16"):
+    """Parity: fp16_utils.cast_parameters_to_fp16 (positional `place`
+    matches the reference's signature; unused on TPU)."""
+    return cast_model_to_fp16(program_or_layer, dtype=dtype)
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """Region marker: ops inside run under auto_cast O1 (the reference
+    tags program regions for the fp16 pass).  Parity: fp16_utils.fp16_guard."""
+    with auto_cast(True, level="O1", dtype="float16"):
+        yield
