@@ -1,7 +1,8 @@
 """paddle_tpu.nn — layers, functional, initializers, clipping.
 Parity: `python/paddle/nn/__init__.py`."""
 
-from . import functional  # noqa: F401
+from . import functional
+from . import quant  # noqa: F401  # noqa: F401
 from . import initializer  # noqa: F401
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,  # noqa: F401
                    clip_grad_norm_)

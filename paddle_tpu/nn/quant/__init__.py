@@ -1,0 +1,41 @@
+"""paddle.nn.quant — weight-only quantized serving ops.
+
+Parity: `python/paddle/nn/quant/quantized_linear.py` (weight_quantize,
+weight_dequantize, weight_only_linear, llm_int8_linear).  Weights stay
+int8 in HBM (quarter bandwidth); the dequant multiply fuses into the
+gemm epilogue on the MXU.
+"""
+
+from __future__ import annotations
+
+from ...ops import codegen_helpers as _h
+from ...ops.generated_ops import weight_dequantize, weight_quantize  # noqa: F401
+from ...ops.registry import dispatch as _d, register_op
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+register_op(
+    "weight_only_linear",
+    lambda x, weight, bias, weight_scale, *, weight_dtype, group_size:
+    _h.weight_only_linear(x, weight, bias, weight_scale,
+                          weight_dtype=weight_dtype,
+                          group_size=group_size))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
+    """Linear over int8-stored weights (paddle signature: bias and
+    weight_scale optional).  Parity: quantized_linear.py
+    weight_only_linear / weight_only_linear op."""
+    return _d("weight_only_linear", (x, weight, bias, weight_scale),
+              {"weight_dtype": weight_dtype, "group_size": int(group_size)})
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """Parity: quantized_linear.py llm_int8_linear (the outlier-threshold
+    split is a CUDA memory-layout optimization; numerically the int8
+    matmul + scale epilogue below is the same contract)."""
+    return weight_only_linear(x, weight, bias, weight_scale)

@@ -1700,3 +1700,47 @@ def unique_counts(x, *, size=None):
         return vals, counts
     vals, counts = jnp.unique(x, return_counts=True, size=size)
     return vals, counts
+
+
+def weight_quantize(x, *, algo="weight_only_int8", arch=None, group_size=-1):
+    """Per-output-channel symmetric int8 weight quantization.  Parity:
+    weight_quantize op (llm int8 serving family).  x [K, N] fp ->
+    (int8 [K, N], scale [N] fp32)."""
+    if algo not in ("weight_only_int8", "llm.int8"):
+        raise NotImplementedError(f"weight_quantize algo {algo!r}: int8 only")
+    if group_size != -1:
+        raise NotImplementedError("weight_quantize: per-channel scales only")
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=0)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127,
+                 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def weight_dequantize(x, scale, *, algo="weight_only_int8",
+                      out_dtype="float32", group_size=-1):
+    """Inverse of weight_quantize.  Parity: weight_dequantize op."""
+    if group_size != -1:
+        raise NotImplementedError("weight_dequantize: per-channel only")
+    return (x.astype(jnp.float32) * scale[None, :]).astype(
+        jnp.dtype(out_dtype))
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None, *,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """Linear with int8-stored weights dequantized at the MXU boundary.
+    Parity: weight_only_linear / llm_int8_linear ops
+    (`paddle/phi/kernels/fusion/gpu/` weight-only gemm family): the
+    weight stays int8 in HBM (quarter bandwidth), dequantizes into the
+    matmul — XLA fuses the scale multiply into the gemm epilogue."""
+    if weight_dtype != "int8":
+        raise NotImplementedError("weight_only_linear: int8 weights only")
+    if group_size != -1:
+        raise NotImplementedError("weight_only_linear: per-channel only")
+    w = weight.astype(x.dtype)
+    if weight_scale is not None:
+        w = w * weight_scale[None, :].astype(x.dtype)
+    out = x @ w
+    if bias is not None:
+        out = out + bias
+    return out

@@ -122,13 +122,11 @@ def cast_model_to_fp16(program_or_layer, amp_lists=None,
     """Cast a Layer's floating parameters to the reduced dtype (the
     static pass rewrites the program's var dtypes; the facade's
     equivalent storage rewrite).  Parity: fp16_utils.cast_model_to_fp16."""
+    from ...amp.auto_cast import _cast_model_keep_norms
     target = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
-    lists = amp_lists or AutoMixedPrecisionLists(dtype=dtype)
-    for p in getattr(program_or_layer, "parameters", lambda: [])():
-        if any(b in (p.name or "") for b in lists.black_varnames):
-            continue
-        if jnp.issubdtype(p._value.dtype, jnp.floating):
-            p._value = p._value.astype(target)
+    # shared O2 cast: norm layers stay fp32 (the reference's static pass
+    # keeps black-list ops fp32 for the same running-stat reason)
+    _cast_model_keep_norms(program_or_layer, target)
     return program_or_layer
 
 
