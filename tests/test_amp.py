@@ -135,3 +135,20 @@ def test_decorate_keeps_norm_layers_fp32():
     assert str(net[0].weight.dtype) == "bfloat16"
     assert str(net[1].weight.dtype) == "float32"
     assert str(net[1]._mean.dtype) == "float32"
+
+
+def test_amp_lists_govern_generated_ops():
+    """The round-4 plain-registry-name migration exists so AMP O1 lists
+    apply to YAML-generated ops: black-listed `exp` must compute in fp32
+    even when fed bf16, and white-listed matmul stays bf16."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+
+    x = paddle.to_tensor(np.full((4, 4), 0.5, np.float32)).astype("bfloat16")
+    with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+        e = paddle.exp(x)          # generated op, black list -> fp32
+        m = paddle.matmul(x, x)    # white list -> bf16
+    assert e._value.dtype == jnp.float32, e._value.dtype
+    assert m._value.dtype == jnp.bfloat16, m._value.dtype
