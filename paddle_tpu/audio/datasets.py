@@ -17,7 +17,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["TESS", "ESC50"]
+__all__ = ["TESS", "ESC50", "GTZAN", "UrbanSound8K", "HeySnips", "VoxCeleb"]
 
 
 class _AudioClassifyDataset(Dataset):
@@ -131,3 +131,75 @@ class ESC50(_AudioClassifyDataset):
             return int(stem.split("-")[-1]) % self.n_classes
         except ValueError:
             return 0
+
+
+class GTZAN(_AudioClassifyDataset):
+    """GTZAN music-genre set (reference `audio/datasets/gtzan.py`): 10
+    genres, files named `genre.NNNNN.wav` under per-genre folders."""
+
+    sample_rate = 22050
+    duration = 30.0
+    label_list = ["blues", "classical", "country", "disco", "hiphop",
+                  "jazz", "metal", "pop", "reggae", "rock"]
+    n_classes = 10
+
+    def _label_of(self, filename: str) -> int:
+        genre = os.path.basename(filename).split(".")[0].lower()
+        return self.label_list.index(genre) \
+            if genre in self.label_list else 0
+
+
+class UrbanSound8K(_AudioClassifyDataset):
+    """UrbanSound8K (reference `audio/datasets/urban_sound.py`): 10 urban
+    sound classes, the classID is the filename's second dash field
+    (`fsID-classID-occurrenceID-sliceID.wav`)."""
+
+    sample_rate = 44100
+    duration = 4.0
+    n_classes = 10
+    label_list = ["air_conditioner", "car_horn", "children_playing",
+                  "dog_bark", "drilling", "engine_idling", "gun_shot",
+                  "jackhammer", "siren", "street_music"]
+
+    def _label_of(self, filename: str) -> int:
+        stem = os.path.splitext(filename)[0]
+        parts = stem.split("-")
+        try:
+            return int(parts[1]) % self.n_classes
+        except (IndexError, ValueError):
+            return 0
+
+
+class HeySnips(_AudioClassifyDataset):
+    """Hey-Snips keyword spotting (reference `audio/datasets/hey_snips.py`):
+    binary wake-word detection; positives carry 'hey_snips' in the path."""
+
+    sample_rate = 16000
+    duration = 2.0
+    n_classes = 2
+    label_list = ["negative", "hey_snips"]
+
+    def _label_of(self, filename: str) -> int:
+        return int("hey_snips" in filename.lower())
+
+
+class VoxCeleb(_AudioClassifyDataset):
+    """VoxCeleb speaker identification (reference
+    `audio/datasets/voxceleb.py`): the speaker id is the `idNNNNN`
+    directory/file prefix; labels are assigned by first-seen order."""
+
+    sample_rate = 16000
+    duration = 3.0
+    n_classes = 40  # synthetic default; real scans grow the table
+
+    def __init__(self, *args, **kwargs):
+        self._speakers = {}
+        super().__init__(*args, **kwargs)
+
+    def _label_of(self, filename: str) -> int:
+        import re
+        m = re.search(r"(id\d+)", filename)
+        key = m.group(1) if m else filename.split("_")[0]
+        if key not in self._speakers:
+            self._speakers[key] = len(self._speakers)
+        return self._speakers[key]

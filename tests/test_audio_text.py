@@ -235,3 +235,22 @@ def test_wmt14_and_wmt16_datasets(tmp_path):
     ds16d = WMT16(data_file=str(p16), mode="train", src_dict_size=10,
                   trg_dict_size=10, lang="de")
     assert "katze" in ds16d.src_dict
+
+
+def test_audio_dataset_families_label_rules():
+    """Round-4 audio datasets: each family's filename->label rule (the
+    published naming conventions) plus the synthetic fallback."""
+    from paddle_tpu.audio.datasets import (GTZAN, HeySnips, UrbanSound8K,
+                                           VoxCeleb)
+    g = GTZAN(mode="train", synthetic_size=4)
+    assert g._label_of("jazz.00012.wav") == 5
+    assert len(g) == 4 and g[0][1] in range(10)
+    u = UrbanSound8K(mode="train", synthetic_size=4)
+    assert u._label_of("100032-3-0-0.wav") == 3
+    h = HeySnips(mode="train", synthetic_size=4)
+    assert h._label_of("hey_snips_001.wav") == 1
+    assert h._label_of("background_7.wav") == 0
+    v = VoxCeleb(mode="train", synthetic_size=4)
+    assert v._label_of("id10001_clip1.wav") == 0
+    assert v._label_of("id10002_clip1.wav") == 1
+    assert v._label_of("id10001_clip2.wav") == 0  # same speaker, same id
