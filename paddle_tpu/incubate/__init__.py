@@ -1,6 +1,7 @@
 """paddle.incubate namespace: fused ops + experimental features.
 Parity: `python/paddle/incubate/` (fused_rope, fused_rms_norm, MoE ...)."""
 
+from . import autograd, autotune, jit  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401

@@ -1,0 +1,52 @@
+"""paddle.incubate.autotune — tuning-config facade.
+
+Parity: `python/paddle/incubate/autotune.py:24` set_config (kernel /
+layout / dataloader tuning).  TPU seat: XLA owns kernel autotuning; the
+knobs with real effect here are the persistent compilation cache
+(kernel.enable) and dataloader tuning (accepted and recorded — the
+io.DataLoader picks worker counts itself on this host).
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["set_config"]
+
+_config = {"kernel": {"enable": False},
+           "layout": {"enable": False},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    """Accepts a dict or a JSON file path (the reference's contract)."""
+    if config is None:
+        _config["kernel"]["enable"] = True
+        _config["layout"]["enable"] = True
+        _config["dataloader"]["enable"] = True
+    elif isinstance(config, str):
+        with open(config) as f:
+            set_config(json.load(f))
+        return
+    elif isinstance(config, dict):
+        for k, v in config.items():
+            if k not in _config:
+                warnings.warn(f"autotune.set_config: unknown field {k!r}")
+                continue
+            _config[k].update(v)
+    if _config["kernel"]["enable"]:
+        # XLA's kernel autotune runs unconditionally; the persistent
+        # compile cache is the knob that saves its results across runs
+        import jax
+        try:
+            import os
+            d = os.path.join(os.path.expanduser("~"), ".paddle_tpu_cache")
+            os.makedirs(d, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", d)
+        except Exception:  # noqa: BLE001 - cache dir is best-effort
+            pass
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}

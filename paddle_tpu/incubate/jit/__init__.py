@@ -1,0 +1,32 @@
+"""paddle.incubate.jit — inference decorator.
+
+Parity: `python/paddle/incubate/jit/inference_decorator.py` (the
+`@incubate.jit.inference` wrapper that captures a model's forward for
+serving).  TPU seat: `jit.to_static` whole-graph capture with eval-mode
+no-grad semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["inference"]
+
+
+def inference(function=None, cache_static_model=True, **kwargs):
+    """Decorate a function/Layer method for compiled inference: captured
+    by to_static, run under no_grad, per-signature program cache."""
+    from ...framework.dygraph import no_grad
+    from ...jit import to_static
+
+    def deco(fn):
+        compiled = to_static(fn)
+
+        @functools.wraps(fn)
+        def run(*a, **k):
+            with no_grad():
+                return compiled(*a, **k)
+        run._compiled = compiled
+        return run
+
+    return deco(function) if function is not None else deco

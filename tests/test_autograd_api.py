@@ -303,3 +303,45 @@ def test_grad_prunes_unrelated_subgraph():
         assert lin1.weight.grad is None and lin2.weight.grad is None
     finally:
         registry._op_stats_sink = sink
+
+
+def test_incubate_autograd_jvp_vjp_forward_grad():
+    """Round-4 incubate.autograd (functional.py jvp:27 / vjp:91 +
+    primapi forward_grad): forward- and reverse-mode functionals over
+    paddle Tensors via jax's native transforms."""
+    import numpy as np
+    import paddle_tpu as paddle
+    inc = paddle.incubate
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out, dot = inc.autograd.jvp(lambda t: t * t, x)
+    np.testing.assert_allclose(out.numpy(), [1.0, 4.0, 9.0])
+    np.testing.assert_allclose(dot.numpy(), [2.0, 4.0, 6.0])
+    # directional tangent
+    v = paddle.to_tensor(np.array([0.0, 1.0, 0.0], np.float32))
+    _, dv = inc.autograd.jvp(lambda t: t * t, x, v)
+    np.testing.assert_allclose(dv.numpy(), [0.0, 4.0, 0.0])
+    out, grad = inc.autograd.vjp(lambda t: (t ** 3).sum(), x)
+    np.testing.assert_allclose(grad.numpy(), 3 * np.array([1, 4, 9.0]),
+                               rtol=1e-6)
+    fg = inc.autograd.forward_grad(lambda t: paddle.sin(t), x, v)
+    np.testing.assert_allclose(fg.numpy(), np.cos([1, 2, 3.0])
+                               * np.array([0, 1, 0.0]), rtol=1e-6)
+    # multi-input jvp
+    y = paddle.to_tensor(np.array([2.0], np.float32))
+    _, d2 = inc.autograd.jvp(lambda a, b: a * b, [x, y])
+    np.testing.assert_allclose(d2.numpy(), x.numpy() + y.numpy(),
+                               rtol=1e-6)
+
+
+def test_incubate_jit_inference_decorator():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    @paddle.incubate.jit.inference
+    def head(t):
+        return t * 2.0 + 1.0
+
+    x = paddle.to_tensor(np.ones(3, np.float32))
+    out = head(x)
+    np.testing.assert_allclose(out.numpy(), [3.0, 3.0, 3.0])
+    assert out.stop_gradient  # ran under no_grad
