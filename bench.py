@@ -60,7 +60,7 @@ _REGRESSION_KEYS = {
     "lenet_train": "jit_imgs_per_sec",
     "resnet50_train": "imgs_per_sec",
     "bert_base_mlm_train": "tokens_per_sec",
-    "gpt124m_decode": "static_tokens_per_sec",
+    "gpt124m_decode": "paged_tokens_per_sec",
 }
 
 
