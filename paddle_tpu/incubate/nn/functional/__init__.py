@@ -15,9 +15,13 @@ from ....ops.registry import dispatch as _d, register_op
 from ....nn.functional.norm import rms_norm as fused_rms_norm  # noqa: F401
 from ....nn.functional.norm import layer_norm as fused_layer_norm  # noqa: F401
 
-from .ring_attention import ring_attention, ring_attention_local  # noqa: F401,E402
+from .ring_attention import (  # noqa: F401,E402
+    ring_attention, ring_attention_local, ring_attention_chunked,
+    ulysses_attention, ulysses_attention_local)
 
 __all__ = ["ring_attention", "ring_attention_local",
+           "ring_attention_chunked", "ulysses_attention",
+           "ulysses_attention_local",
            "fused_rotary_position_embedding", "rope", "swiglu",
            "fused_rms_norm", "fused_layer_norm", "fused_bias_act",
            "fused_linear", "fused_multi_head_attention",

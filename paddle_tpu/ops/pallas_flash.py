@@ -54,7 +54,8 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-__all__ = ["flash_attention", "flash_attention_fwd", "supported"]
+__all__ = ["flash_attention", "flash_attention_fwd",
+           "flash_attention_bwd", "supported"]
 
 _NEG_INF = -1e30
 
@@ -539,6 +540,21 @@ def _flash_bwd(causal, interpret, kv_mask_shape, rate, res, g,
         dv = dv.astype(v.dtype)
     tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
     return tr(dq), tr(dk), tr(dv), None, None
+
+
+def flash_attention_bwd(q, k, v, out, lse, g, causal=False, interpret=None):
+    """Public backward entry point: gradients (dq, dk, dv) of
+    `flash_attention_fwd`'s output w.r.t. q/k/v, given the forward's
+    residuals.  `lse` is the [B, nh, Sq, 128] lane-broadcast logsumexp the
+    forward returns (callers holding [B, nh, Sq] rows may broadcast them —
+    only lane 0 is read).  The FA2 identities hold for any *global*
+    normalizer, so chunked/ring callers may pass a combined lse to get this
+    chunk's contribution to the global gradients."""
+    B, Sk = k.shape[0], k.shape[1]
+    dq, dk, dv, _, _ = _flash_bwd(
+        causal, interpret, None, 0.0,
+        (q, k, v, out, lse, _mask_arr(None, B, Sk), _seed_arr(None)), g)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8))

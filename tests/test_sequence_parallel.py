@@ -147,6 +147,113 @@ def test_sp_mark_and_hooks(hybrid_mesh):
     register_sequence_parallel_allreduce_hooks(ln)  # replicated: no raise
 
 
+def qkv64(B=1, H=2, S=256, D=64, seed=3):
+    """Shapes inside the Pallas kernel envelope (hd=64, 8-aligned seqs)."""
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_pallas_path_matches_full(causal):
+    """hd=64 routes through the Pallas flash hop kernels (interpret mode on
+    CPU); parity against dense attention, fwd + grads."""
+    from paddle_tpu.incubate.nn.functional.ring_attention import _pallas_ok
+    q, k, v = qkv64()
+    assert _pallas_ok((1, 64, 2, 64), (1, 64, 2, 64))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    got = ring_attention(q, k, v, mesh, "sp", causal=causal)
+    want = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    g_ring = jax.grad(lambda a, b, c: jnp.sum(
+        ring_attention(a, b, c, mesh, "sp", causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(lambda a, b, c: jnp.sum(
+        full_attention(a, b, c, causal) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_ring_attention_chunked_pallas_member_grads():
+    """The busiest-member program (q slice + q_off) on the Pallas path:
+    fwd + grads against the member's rows of dense attention."""
+    from paddle_tpu.incubate.nn.functional.ring_attention import \
+        ring_attention_chunked
+    q, k, v = qkv64()
+    S = q.shape[2]
+    qs = q[:, :, -(S // 8):]
+
+    def loss_member(qs, k, v):
+        return jnp.sum(ring_attention_chunked(
+            qs, k, v, n_chunks=8, causal=True, q_off=S - S // 8) ** 2)
+
+    def loss_full(qs, k, v):
+        full_q = jnp.concatenate([q[:, :, :-(S // 8)], qs], axis=2)
+        out = full_attention(full_q, k, v, True)
+        return jnp.sum(out[:, :, -(S // 8):] ** 2)
+
+    got = ring_attention_chunked(qs, k, v, n_chunks=8, causal=True,
+                                 q_off=S - S // 8)
+    want = full_attention(q, k, v, True)[:, :, -(S // 8):]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+    gm = jax.grad(loss_member, argnums=(0, 1, 2))(qs, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(qs, k, v)
+    for a, b in zip(gm, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("shape", ["pallas", "dense"])
+def test_ulysses_attention_matches_full(causal, shape):
+    """Ulysses head-alltoall attention (ref segment_parallel.py sep axis):
+    parity vs dense on both the Pallas (hd=64) and fallback (hd=16) paths."""
+    from paddle_tpu.incubate.nn.functional.ring_attention import \
+        ulysses_attention
+    q, k, v = (qkv64(H=4) if shape == "pallas"
+               else qkv(B=2, H=4, S=64, D=16))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    got = ulysses_attention(q, k, v, mesh, "sep", causal=causal)
+    want = full_attention(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_attention_grads_and_tensor_wrapper():
+    from paddle_tpu.incubate.nn.functional.ring_attention import \
+        ulysses_attention
+    q, k, v = qkv(B=2, H=4, S=64, D=16)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    gu = jax.grad(lambda a, b, c: jnp.sum(
+        ulysses_attention(a, b, c, mesh, "sep", causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda a, b, c: jnp.sum(
+        full_attention(a, b, c, True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-5, atol=3e-6)
+    tq, tk, tv = (paddle.Tensor._wrap(x, stop_gradient=False)
+                  for x in (q, k, v))
+    out = ulysses_attention(tq, tk, tv, mesh, "sep", causal=True)
+    assert isinstance(out, paddle.Tensor)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(tq.grad._value),
+                               np.asarray(gu[0]), rtol=3e-5, atol=3e-6)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from paddle_tpu.incubate.nn.functional.ring_attention import \
+        ulysses_attention
+    q, k, v = qkv(B=1, H=3, S=64, D=16)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, k, v, mesh, "sep", causal=False)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention_chunked_matches_full(causal):
     """Single-device ring member (`ring_attention_chunked`): full-q form
