@@ -71,6 +71,14 @@ class HybridConfig:
     vpp: int = 1  # virtual pipeline chunks per pp rank (interleaved sched)
     n_microbatches: int = 2
     sequence_parallel: bool = True
+    # context parallelism (the reference's sep axis, `fleet/base/
+    # topology.py` sep dim): activations stay sequence-sharded over the
+    # 'cp' mesh axis through the WHOLE block; attention crosses the axis
+    # by ring ppermute (`ring_attention_local`) or head all-to-all
+    # (`ulysses_attention_local`), labels cross the shard boundary by a
+    # one-token ppermute, and the LM loss reduces over cp.
+    cp: int = 1
+    cp_attention: str = "ring"    # "ring" | "ulysses"
     remat: bool = True
     # MoE / expert parallelism: with moe_num_experts > 0 every block's MLP
     # becomes a top-1 (switch) mixture of experts; experts are sharded over
@@ -105,6 +113,15 @@ class HybridConfig:
             # the interleaved schedule processes microbatches in blocks of
             # pp (same constraint as Megatron's num_microbatches % pp == 0)
             assert self.n_microbatches % self.pp == 0
+        assert self.cp_attention in ("ring", "ulysses")
+        if self.cp > 1:
+            assert self.mp == 1 and not self.sequence_parallel, \
+                "context parallel composes with pp/dp; combine with " \
+                "Megatron TP-SP per-config, not both in one block"
+            assert self.seq_len % self.cp == 0
+            assert self.moe_num_experts == 0
+            if self.cp_attention == "ulysses":
+                assert self.num_heads % self.cp == 0
         if self.moe_num_experts > 0:
             assert self.moe_num_experts % self.dp == 0, \
                 "experts shard over the dp axis"
@@ -308,6 +325,17 @@ def _attention(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _attention_cp(q, k, v, cp_axis, mode):
+    """Causal attention with the sequence sharded over `cp_axis`: ring
+    ppermute hops or Ulysses head-alltoall (SURVEY §5.7; ref
+    `fleet/meta_parallel/segment_parallel.py`).  q/k/v [B, s, nh, hd]."""
+    from ...incubate.nn.functional.ring_attention import (
+        ring_attention_local, ulysses_attention_local)
+    fn = ring_attention_local if mode == "ring" else ulysses_attention_local
+    tb = lambda x: jnp.transpose(x, (0, 2, 1, 3))  # [B,s,nh,hd]<->[B,nh,s,hd]
+    return tb(fn(tb(q), tb(k), tb(v), cp_axis, causal=True))
+
+
 def _gate_top1(h2, wg):
     """Switch (top-1) router.  h2 [T, H], wg [H, E] -> (expert [T] int32,
     prob [T]); grads flow through the chosen expert's softmax prob."""
@@ -386,7 +414,7 @@ def _moe_ffn_dist(blocks, x, lidx, cfg, dp_axis="dp"):
 
 
 def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False,
-           cfg=None, dp_axis=None):
+           cfg=None, dp_axis=None, cp_axis=None):
     """One pre-LN transformer block.  Serial when mp_axis is None.
 
     With seq_parallel, x enters/leaves sequence-sharded [B, S/mp, H]; the
@@ -423,7 +451,11 @@ def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False,
     qkv = h @ take("wqkv") + take("bqkv")      # [B, S, 3*H/mp]
     qkv = qkv.reshape(B, S, nh_local, 3, -1)
     q, k, v = qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
-    a = _attention(q, k, v).reshape(B, S, -1)
+    if cp_axis is not None:
+        a = _attention_cp(q, k, v, cp_axis, cfg.cp_attention)
+        a = a.reshape(B, S, -1)
+    else:
+        a = _attention(q, k, v).reshape(B, S, -1)
     a = leave_tp(a @ take("wproj"))
     x = x + a + take("bproj")
     h = _layer_norm(x, take("ln2_g"), take("ln2_b"))
@@ -440,7 +472,8 @@ def _block(p, x, lidx, nh_local, *, mp_axis=None, seq_parallel=False,
     return x + f + take("bfc2")
 
 
-def _lm_loss(logits, labels, *, mp_axis=None, vstart=0):
+def _lm_loss(logits, labels, *, mp_axis=None, vstart=0, sstart=0,
+             seq_total=None, seq_axis=None):
     """Causal-LM loss over logits [B, S, V(/mp)]; ignores the last position.
 
     With mp_axis set this is the parallel softmax cross-entropy of
@@ -465,8 +498,15 @@ def _lm_loss(logits, labels, *, mp_axis=None, vstart=0):
     if mp_axis is not None:
         tgt = jax.lax.psum(tgt, mp_axis)
     nll = logz - tgt                                   # [B, S]
-    mask = jnp.arange(nll.shape[1]) < nll.shape[1] - 1
-    return jnp.sum(nll * mask) / jnp.sum(mask) / nll.shape[0]
+    S_tot = seq_total if seq_total is not None else nll.shape[1]
+    # ignore the GLOBAL last position (sstart/seq_total place a
+    # seq-sharded rank's rows on the global axis)
+    mask = (sstart + jnp.arange(nll.shape[1])) < S_tot - 1
+    tot = jnp.sum(nll * mask)
+    if seq_axis is not None:
+        tot = jax.lax.psum(tot, seq_axis)
+        return tot / (S_tot - 1) / nll.shape[0]
+    return tot / jnp.sum(mask) / nll.shape[0]
 
 
 # --------------------------------------------------------------------------
@@ -530,7 +570,7 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     XLA's latency-hiding scheduler overlaps the ppermutes and TP collectives
     with compute."""
     specs = hybrid_param_specs(cfg)
-    PP, MP, DP, VPP = cfg.pp, cfg.mp, cfg.dp, cfg.vpp
+    PP, MP, DP, VPP, CP = cfg.pp, cfg.mp, cfg.dp, cfg.vpp, cfg.cp
     M = cfg.n_microbatches
     nh_local = cfg.num_heads // MP
     Vloc = cfg.vocab_size // MP
@@ -546,6 +586,7 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
         pp_i = jax.lax.axis_index("pp")
         mp_i = jax.lax.axis_index("mp")
         dp_i = jax.lax.axis_index("dp")
+        cp_i = jax.lax.axis_index("cp") if CP > 1 else 0
         # drop the unit leading pp dim of the local stage-param shards;
         # block leaves keep their [vpp, Lc, ...] chunk stack
         local = dict(params)
@@ -566,13 +607,19 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
                     ps["wpe"], mp_i * s, s, axis=0)
             else:
                 e = jax.lax.psum(e, "mp")
-                pos = ps["wpe"][:ids.shape[1]]
+                if CP > 1:   # rows of the cp-sharded sequence
+                    pos = jax.lax.dynamic_slice_in_dim(
+                        ps["wpe"], cp_i * ids.shape[1], ids.shape[1],
+                        axis=0)
+                else:
+                    pos = ps["wpe"][:ids.shape[1]]
             return e + pos
 
         def stage(chunk, h):
             for l in range(cfg.layers_per_stage):
                 h = _block(chunk, h, l, nh_local, mp_axis="mp",
-                           seq_parallel=sp, cfg=cfg, dp_axis="dp")
+                           seq_parallel=sp, cfg=cfg, dp_axis="dp",
+                           cp_axis="cp" if CP > 1 else None)
             return h
 
         stage_fn = jax.checkpoint(stage) if cfg.remat else stage
@@ -582,10 +629,24 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
             if sp:
                 h = jax.lax.all_gather(h, "mp", axis=1, tiled=True)
             logits = h @ ps["head"]
+            if CP > 1:
+                s_loc = labels.shape[1]
+                return _lm_loss(logits, labels, mp_axis="mp",
+                                vstart=mp_i * Vloc, sstart=cp_i * s_loc,
+                                seq_total=s_loc * CP, seq_axis="cp")
             return _lm_loss(logits, labels, mp_axis="mp",
                             vstart=mp_i * Vloc)
 
-        labels_all = jnp.roll(ids_local, -1, axis=2)     # [M, b, S]
+        if CP > 1:
+            # label of a shard's last token is the NEXT shard's first
+            # token (rank CP-1 wraps to rank 0's first = global roll)
+            nxt = jax.lax.ppermute(
+                ids_local[:, :, :1], "cp",
+                [((i + 1) % CP, i) for i in range(CP)])
+            labels_all = jnp.concatenate([ids_local[:, :, 1:], nxt],
+                                         axis=2)   # [M, b, s]
+        else:
+            labels_all = jnp.roll(ids_local, -1, axis=2)     # [M, b, S]
 
         def loss_fn(ps):
             """Interleaved (VPP) pipeline, vpp=1 = plain GPipe schedule.
@@ -620,8 +681,22 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
                     lambda: embed(ps, ids_mb), lambda: carry)
                 chunk = jax.tree_util.tree_map(
                     lambda leaf: jnp.take(leaf, jslot, axis=0), ps["blocks"])
-                h_out = jax.lax.cond(
-                    active, lambda: stage_fn(chunk, h_in), lambda: h_in)
+                if CP > 1:
+                    # ring attention's ppermute over cp must execute in the
+                    # SAME program order on every rank of the mesh — a
+                    # collective permute under a predicate that differs
+                    # across pp rows pairs ranks across rows (XLA gives
+                    # collective-permute a global rendezvous, unlike the
+                    # per-subgroup all_gather/psum/all_to_all the mp/SP
+                    # branches use).  Run the stage unconditionally and
+                    # select the output; bubble ticks pay compute, never
+                    # correctness.
+                    h_stage = stage_fn(chunk, h_in)
+                    h_out = jnp.where(active, h_stage, h_in)
+                else:
+                    h_out = jax.lax.cond(
+                        active, lambda: stage_fn(chunk, h_in),
+                        lambda: h_in)
                 lab = jnp.take(labels_all, m, axis=0)
                 l = jax.lax.cond(
                     active & (pp_i == PP - 1) & (jslot == VPP - 1),
@@ -652,7 +727,8 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
             # axis the leaf is NOT sharded on (GSPMD's replica all-reduce,
             # done explicitly).  dp is handled below: ZeRO-2 reduce-
             # scatters it instead of all-reducing.
-            for ax in ("pp", "mp"):
+            replica_axes = ("pp", "mp", "cp") if CP > 1 else ("pp", "mp")
+            for ax in replica_axes:
                 if ax not in axes:
                     g = jax.lax.psum(g, ax)
             if "dp" in axes:
@@ -694,9 +770,10 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     # check_vma=False: the updated params ARE dp-replicated (grads are
     # psum'd over dp before the update and shards all-gathered after), but
     # the static varying-axes analysis can't prove it through all_gather
+    ids_spec = P(None, "dp", "cp") if CP > 1 else P(None, "dp", None)
     mapped = jax.shard_map(
         device_fn, mesh=mesh,
-        in_specs=(specs, opt_specs, opt_specs, P(), P(None, "dp", None)),
+        in_specs=(specs, opt_specs, opt_specs, P(), ids_spec),
         out_specs=(P(), specs, opt_specs, opt_specs),
         check_vma=False)
     return jax.jit(mapped)

@@ -18,9 +18,14 @@ from paddle_tpu.distributed.fleet.hybrid_step import (
 
 
 def _run_parity(cfg, n_devices, steps=3):
-    shape = (cfg.pp, cfg.dp, cfg.mp)
+    if cfg.cp > 1:
+        shape = (cfg.pp, cfg.dp, cfg.cp, cfg.mp)
+        axes = ("pp", "dp", "cp", "mp")
+    else:
+        shape = (cfg.pp, cfg.dp, cfg.mp)
+        axes = ("pp", "dp", "mp")
     devs = np.array(jax.devices()[:n_devices]).reshape(shape)
-    mesh = Mesh(devs, ("pp", "dp", "mp"))
+    mesh = Mesh(devs, axes)
     key = jax.random.key(42)
     params = init_gpt_params(key, cfg)
     stacked = stack_for_pipeline(params, cfg)
@@ -114,3 +119,13 @@ def test_schedule_bubble_accounting():
         first_busy = next(t for t, e in enumerate(row) if e is not None)
         assert first_busy == p
         assert row[first_busy] == (0, 0)  # starts on chunk 0, microbatch 0
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_hybrid_context_parallel(mode):
+    """Context parallelism over a 'cp' mesh axis (ref sep dim,
+    fleet/base/topology.py): sequence sharded through the whole block,
+    attention crossing the axis by ring ppermute or Ulysses head-alltoall,
+    composed with pp and dp — loss parity vs serial."""
+    _run_parity(HybridConfig(pp=2, dp=2, mp=1, cp=2, cp_attention=mode,
+                             sequence_parallel=False), 8)
