@@ -90,20 +90,118 @@ def check_regressions():
             except json.JSONDecodeError:
                 continue
     deltas = {}
+    cur_by_name = {}
     for cur in _RESULTS:
         name = cur.get("bench")
         key = _REGRESSION_KEYS.get(name)
         if not key or key not in cur or name not in prev \
                 or key not in prev[name]:
             continue
+        cur_by_name[name] = cur
         old, new = float(prev[name][key]), float(cur[key])
         if old > 0:
             deltas[name] = round((new - old) / old, 4)
     if deltas:
+        # Separate code regressions from tunnel-window artifacts (r04
+        # shipped an unexplained lenet -42% that was the dispatch floor
+        # doubling).  A drop is ENV-SUSPECT, not a regression, when:
+        #  - the rung is latency-bound (its step rides the dispatch
+        #    floor) and the floor worsened at least half as much as the
+        #    metric did, or
+        #  - the previous artifact has an env_probe and this window's
+        #    matmul throughput or floor is >15% worse.
+        prev_env = prev.get("env_probe", {})
+        regressed, env_suspect = [], {}
+        for name, v in sorted(deltas.items()):
+            if v >= -0.03:
+                continue
+            cur = cur_by_name[name]
+            reason = None
+            floor = _ENV_PROBE.get("dispatch_floor_ms")
+            pfloor = prev_env.get("dispatch_floor_ms")
+            ptf = prev_env.get("matmul_tflops")
+            tf = _ENV_PROBE.get("matmul_tflops")
+            if cur.get("latency_bound") and floor:
+                if pfloor:
+                    floor_worsening = (floor - pfloor) / pfloor
+                else:
+                    # no previous probe (first banded round): a floor far
+                    # above the quiet-window ~1.5 ms is the explanation
+                    floor_worsening = (floor - 1.5) / 1.5
+                if floor_worsening > -v / 2:
+                    reason = (f"latency-bound rung; dispatch floor "
+                              f"{floor} ms vs prev "
+                              f"{pfloor if pfloor else '~1.5 (quiet)'} ms")
+            if reason is None and ptf and tf and tf < 0.85 * ptf:
+                reason = f"chip window degraded: {tf} vs {ptf} TFLOP/s"
+            if reason is None and pfloor and floor \
+                    and floor > 1.15 * pfloor:
+                reason = (f"dispatch floor degraded: {floor} vs "
+                          f"{pfloor} ms")
+            if reason:
+                env_suspect[name] = reason
+            else:
+                regressed.append(name)
         log({"bench": "regression_check",
              "vs": os.path.basename(arts[-1]), "rel_delta": deltas,
-             "regressed": sorted(k for k, v in deltas.items()
-                                 if v < -0.03)})
+             "env": _ENV_PROBE or None,
+             "regressed": regressed, "env_suspect": env_suspect})
+
+
+_ENV_PROBE = {}
+
+
+def bench_env_probe():
+    """Chip/tunnel health, logged in-artifact so every perf number can be
+    read against the window it was measured in (the tunneled chip has
+    co-tenant windows: the same compiled GPT step measured 35->81 ms
+    across an hour with byte-identical numerics; r04's lenet -42% was this
+    probe's dispatch floor doubling, not a code change).
+
+    - matmul_tflops: sustained 8192^2 bf16 matmul (healthy ~96 on v5e).
+    - tiny_rtt_ms: median round trip of a tiny op + host read.
+    - dispatch_floor_ms: per-op cost of a 200-deep chained tiny program —
+      the lower bound any latency-bound rung's step time can reach.
+    """
+    import jax
+    import jax.numpy as jnp
+    x = jax.random.normal(jax.random.key(0), (8192, 8192), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    f(x).block_until_ready()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = f(x)
+        for _ in range(9):
+            r = f(r)
+        np.asarray(r[:2, :2])
+        best = min(best, (time.perf_counter() - t0) / 10)
+    tflops = 2 * 8192 ** 3 / best / 1e12
+
+    t = jnp.ones((8, 8), jnp.float32)
+    g = jax.jit(lambda a: a + 1)
+    np.asarray(g(t))
+    ts = sorted(
+        _timeit(lambda: np.asarray(g(t))) for _ in range(15))
+    rtt = ts[len(ts) // 2]
+
+    t0 = time.perf_counter()
+    r = t
+    for _ in range(200):
+        r = g(r)
+    np.asarray(r[:1, :1])
+    floor = (time.perf_counter() - t0) / 200
+
+    _ENV_PROBE.update(matmul_tflops=round(tflops, 1),
+                      tiny_rtt_ms=round(rtt * 1e3, 2),
+                      dispatch_floor_ms=round(floor * 1e3, 3))
+    log(dict({"bench": "env_probe"}, **_ENV_PROBE))
+
+
+def _timeit(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
 
 
 def marginal_step_s(run_steps, sync_read, n1=3, n2=13, reps=1):
@@ -260,11 +358,25 @@ def bench_lenet():
         for _ in range(n):
             step(x, y)
 
-    jit_dt = marginal_step_s(run_jit, sync, 5, 30)
+    # three measurement windows a few seconds apart: the step is ONE
+    # compiled program whose compute is microseconds, so its wall time sits
+    # on the tunnel dispatch floor — band the windows so a noisy window is
+    # visible in-artifact instead of masquerading as a code regression
+    jit_dts = []
+    for w in range(3):
+        if w:
+            time.sleep(3)
+        jit_dts.append(marginal_step_s(run_jit, sync, 5, 30))
+    jit_dts.sort()
+    jit_dt = jit_dts[1]   # median window
+    band = [round(B / d, 1) for d in reversed(jit_dts)]  # [min..max] imgs/s
+    floor = _ENV_PROBE.get("dispatch_floor_ms", 0.0)
     log({"bench": "lenet_train", "batch": B,
          "eager_imgs_per_sec": round(B / eager_dt, 1),
          "jit_imgs_per_sec": round(B / jit_dt, 1),
-         "jit_step_ms": round(jit_dt * 1e3, 3)})
+         "jit_imgs_per_sec_band": band,
+         "jit_step_ms": round(jit_dt * 1e3, 3),
+         "latency_bound": bool(floor and jit_dt * 1e3 < 2.5 * floor)})
 
 
 def bench_resnet50():
@@ -601,6 +713,7 @@ def main():
     # cheap rungs and the decode rung (round 2's casualty) go before the
     # two big secondary compiles; estimates are cold-compile worst cases,
     # cache hits come in far under them
+    _run_rung("env_probe", bench_env_probe, 30, release=False)
     _run_rung("dispatch_overhead", bench_dispatch, 15, release=False)
     _run_rung("lenet_train", bench_lenet, 60)
     _run_rung("gpt124m_decode", bench_decode, 200)
