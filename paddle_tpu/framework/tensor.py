@@ -218,7 +218,8 @@ class Tensor:
         return np.asarray(self._value)
 
     def item(self):
-        return self._value.item()
+        from ..jit import sot as _sot
+        return _sot.intercept("item", self, lambda: self._value.item())
 
     def tolist(self):
         return np.asarray(self._value).tolist()
@@ -291,13 +292,19 @@ class Tensor:
         return self.shape[0]
 
     def __bool__(self):
-        return bool(self._value)
+        # concretizations route through the SOT hook: under guarded
+        # capture (jit/sot.py) a traced value burns the recorded branch
+        # and emits a guard instead of raising ConcretizationTypeError
+        from ..jit import sot as _sot
+        return _sot.intercept("bool", self, lambda: bool(self._value))
 
     def __int__(self):
-        return int(self._value)
+        from ..jit import sot as _sot
+        return _sot.intercept("int", self, lambda: int(self._value))
 
     def __float__(self):
-        return float(self._value)
+        from ..jit import sot as _sot
+        return _sot.intercept("float", self, lambda: float(self._value))
 
     def __hash__(self):
         return id(self)

@@ -1,2 +1,3 @@
 from .api import StaticFunction, ignore_module, not_to_static, to_static  # noqa: F401
 from .save_load import TranslatedLayer, load, save  # noqa: F401
+from .sot import status  # noqa: F401  (capture/guard/break report)

@@ -41,6 +41,7 @@ import numpy as np
 from ..framework import random as _random
 from ..framework.tensor import Tensor
 from ..ops import registry as _registry
+from . import sot as _sot
 
 __all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module"]
 
@@ -160,6 +161,10 @@ class StaticFunction:
         self._full_graph = full_graph
         self._broken_keys: set = set()
         self.__name__ = getattr(function, "__name__", "static_fn")
+        self._stats = {"signatures": 0, "sot_specializations": 0,
+                       "guard_misses": 0, "eager_calls": 0,
+                       "graph_breaks": []}
+        _sot.register(self)
 
     # -------------------------------------------------------------- helpers
     def _arg_key(self, args, kwargs):
@@ -176,8 +181,10 @@ class StaticFunction:
                 sig.append(("S", leaf))
         return (treedef, tuple(sig))
 
-    def _discover_state(self, args, kwargs):
-        """Recording pass: eager run + rollback; returns (slots, changed)."""
+    def _discover_state(self, args, kwargs, sot_record=False):
+        """Recording pass: eager run + rollback; returns
+        (slots, changed, burned) — `burned` is the ordered concretization
+        list when sot_record is on (see jit/sot.py), else None."""
         from ..optimizer.optimizer import _live_optimizers
         rec = _Recorder()
         # snapshot optimizer state for rollback
@@ -187,8 +194,14 @@ class StaticFunction:
         rng_state = _random.get_rng_state()
         _registry.set_trace_recorder(rec.on_inputs)
         _registry.set_trace_out_recorder(rec.on_outputs)
+        burned = None
         try:
-            self._fn(*args, **kwargs)
+            if sot_record:
+                with _sot.recording() as srec:
+                    self._fn(*args, **kwargs)
+                burned = srec.values
+            else:
+                self._fn(*args, **kwargs)
         finally:
             _registry.set_trace_recorder(None)
             _registry.set_trace_out_recorder(None)
@@ -252,10 +265,11 @@ class StaticFunction:
                 continue
             slots.append(_TensorSlot(t))
             changed.append(ch)
-        return slots, changed
+        return slots, changed, burned
 
-    def _build(self, args, kwargs):
-        slots, changed = self._discover_state(args, kwargs)
+    def _build(self, args, kwargs, sot=False):
+        slots, changed, burned = self._discover_state(args, kwargs,
+                                                      sot_record=sot)
         mutable_idx = [i for i, c in enumerate(changed) if c]
         readonly_idx = [i for i, c in enumerate(changed) if not c]
         spec: Dict[str, Any] = {}
@@ -278,8 +292,24 @@ class StaticFunction:
                 return w
 
             t_args, t_kwargs = _map_tensors(spec["arg_proto"], wrap_arg)
+            guard_vals = []
             with _random.key_source_guard(_random.TracedKeySource(key)):
-                out = fn(*t_args, **t_kwargs)
+                if burned is not None:
+                    # value-specialized trace: replay the recorded
+                    # concretizations (Python takes the burned branches)
+                    # and surface the traced predicates as guard outputs
+                    with _sot.replaying(burned) as rep:
+                        out = fn(*t_args, **t_kwargs)
+                    guard_vals = rep.guards
+                    if rep.consumed != len(burned):
+                        # the trace concretized fewer values than the
+                        # record pass — an unguarded burn would commit
+                        # wrong-branch results silently; graph-break
+                        raise _sot.SotUnsupported(
+                            f"trace consumed {rep.consumed} of "
+                            f"{len(burned)} recorded values")
+                else:
+                    out = fn(*t_args, **t_kwargs)
             out_vals = _map_tensors(out, lambda t: t._value)
             new_mutable = [slots[i].get() for i in mutable_idx]
             # grads left on state tensors leak tracers; surface them
@@ -301,15 +331,21 @@ class StaticFunction:
                     arg_grad_outs.append(w._grad._value)
                     arg_grad_pos.append(pos)
             spec["arg_grad_pos"] = arg_grad_pos
-            return out_vals, new_mutable, grad_outs, arg_grad_outs
+            return (out_vals, new_mutable, grad_outs, arg_grad_outs,
+                    guard_vals)
 
         # donation lets XLA update param/opt-state buffers in place in HBM;
-        # CPU PJRT doesn't support it (warning spam), so gate on backend
-        donate = (0,) if self._donate_state and \
+        # CPU PJRT doesn't support it (warning spam), so gate on backend.
+        # Guarded (SOT) programs never donate: a guard miss discards the
+        # run and re-executes, which needs the input buffers intact.
+        donate = (0,) if self._donate_state and not sot and \
             jax.default_backend() != "cpu" else ()
         jitted = jax.jit(functional, donate_argnums=donate)
+        self._stats["signatures"] += 1
         return {"slots": slots, "mutable_idx": mutable_idx,
-                "readonly_idx": readonly_idx, "jitted": jitted, "spec": spec}
+                "readonly_idx": readonly_idx, "jitted": jitted,
+                "spec": spec,
+                "burned": tuple(burned) if burned is not None else None}
 
     # errors that mean "this function cannot trace as one graph" (value-
     # dependent branching / dynamic shapes) — graph-break material, unlike
@@ -327,23 +363,102 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         key = self._arg_key(args, kwargs)
         if key in self._broken_keys:
+            self._stats["eager_calls"] += 1
             return self._fn(*args, **kwargs)
+        entry = self._cache.get(key)
+        if isinstance(entry, dict) and entry.get("sot"):
+            return self._sot_dispatch(key, entry, args, kwargs)
         try:
             return self._compiled_call(args, kwargs)
         except self._graph_break_errors as e:
             if self._full_graph:
                 raise
-            # graph break for THIS argument signature only: other
-            # signatures keep their compiled programs (the reference's
-            # per-guard fallback-to-dygraph, not a function-wide switch)
-            import warnings
-            warnings.warn(
-                f"to_static({self.__name__}): value-dependent control "
-                f"flow could not be captured ({type(e).__name__}); "
-                "falling back to eager execution for this signature",
-                stacklevel=2)
-            self._broken_keys.add(key)
-            return self._fn(*args, **kwargs)
+            # Before giving up on compilation, try SOT value
+            # specialization: burn the concretized values (bool/int/float/
+            # item on traced tensors) into a guarded program (jit/sot.py —
+            # the reference's jit/sot/translate.py seat).  Only if THAT
+            # also fails (dynamic shapes, .numpy() on tracers, diverging
+            # replay) does this signature fall back to eager.
+            try:
+                return self._sot_capture(key, args, kwargs)
+            except self._graph_break_errors + (
+                    _sot.SotUnsupported, _sot.GuardMiss) as e2:
+                # GuardMiss on the capture call itself = the function's
+                # burned values depend on Python state it mutates
+                # (record/trace divergence) — unguardable, go eager
+                self._graph_break(key, e, e2)
+                return self._fn(*args, **kwargs)
+
+    def _graph_break(self, key, first_err, sot_err):
+        """Per-signature fallback to eager, with the break reason kept for
+        `paddle.jit.status()` (the reference SOT's break-reason log)."""
+        import warnings
+        reason = (f"{type(first_err).__name__} -> SOT: "
+                  f"{type(sot_err).__name__}: {sot_err}")
+        self._stats["graph_breaks"].append(
+            {"signature": repr(key[1])[:120], "reason": reason[:300]})
+        self._stats["eager_calls"] += 1
+        warnings.warn(
+            f"to_static({self.__name__}): could not be captured "
+            f"({reason}); falling back to eager execution for this "
+            "signature (see paddle.jit.status())", stacklevel=3)
+        self._broken_keys.add(key)
+
+    def _sot_capture(self, key, args, kwargs):
+        """First value-specialized build for this signature."""
+        entry = {"sot": True, "specs": {}, "last": None}
+        prog = self._build(args, kwargs, sot=True)
+        if prog["burned"] is not None and len(prog["burned"]) == 0:
+            # nothing was concretized: the break came from something the
+            # hooks cannot guard (dynamic shapes, host reads) — replaying
+            # would just re-raise at run time; decline SOT
+            raise _sot.SotUnsupported(
+                "no concretized values to guard on")
+        self._cache[key] = entry
+        entry["specs"][prog["burned"]] = prog
+        entry["last"] = prog["burned"]
+        self._stats["sot_specializations"] += 1
+        return self._run_prog(prog, args, kwargs)
+
+    def _sot_dispatch(self, key, entry, args, kwargs):
+        """Guard-checked dispatch over this signature's specializations:
+        run the last-hit program; on a guard miss use the trustworthy
+        guard prefix to pick (or record + compile) the right one."""
+        burned = entry["last"]
+        tried = set()
+        while True:
+            prog = entry["specs"][burned]
+            try:
+                out = self._run_prog(prog, args, kwargs)
+                entry["last"] = burned
+                return out
+            except _sot.GuardMiss as miss:
+                self._stats["guard_misses"] += 1
+                tried.add(burned)
+                nxt = _sot.match_prefix(
+                    [b for b in entry["specs"] if b not in tried],
+                    miss.observed, miss.diverged_at)
+                if nxt is not None:
+                    burned = nxt
+                    continue
+                if len(entry["specs"]) >= _sot.MAX_SPECIALIZATIONS:
+                    self._graph_break(
+                        key, miss, _sot.SotUnsupported(
+                            f"guard thrash: {len(entry['specs'])} "
+                            "specializations for one signature"))
+                    return self._fn(*args, **kwargs)
+                prog = self._build(args, kwargs, sot=True)
+                entry["specs"][prog["burned"]] = prog
+                entry["last"] = prog["burned"]
+                self._stats["sot_specializations"] += 1
+                try:
+                    return self._run_prog(prog, args, kwargs)
+                except (_sot.GuardMiss, _sot.SotUnsupported) as e:
+                    # a fresh specialization must match its own recording;
+                    # a miss here means the burns depend on state the
+                    # function itself mutates — unguardable
+                    self._graph_break(key, miss, e)
+                    return self._fn(*args, **kwargs)
 
     @property
     def _eager_fallback(self):
@@ -356,6 +471,9 @@ class StaticFunction:
         if prog is None:
             prog = self._build(args, kwargs)
             self._cache[key] = prog
+        return self._run_prog(prog, args, kwargs)
+
+    def _run_prog(self, prog, args, kwargs):
         slots = prog["slots"]
         spec = prog["spec"]
         # build arg value list + proto mapping (order by traversal)
@@ -377,7 +495,8 @@ class StaticFunction:
         saved_grads = [(s, s.ref()._grad) for s in slots
                        if isinstance(s, _TensorSlot) and s.ref() is not None]
         try:
-            out_vals, new_mutable, grad_outs, arg_grad_outs = prog["jitted"](
+            (out_vals, new_mutable, grad_outs, arg_grad_outs,
+             guard_vals) = prog["jitted"](
                 mutable_vals, readonly_vals, _random.next_key(), arg_vals)
         finally:
             for s, v in saved:
@@ -386,6 +505,10 @@ class StaticFunction:
                 t = s.ref()
                 if t is not None:
                     t._grad = g
+        if prog.get("burned"):
+            # guard check BEFORE any state commit: a miss discards this
+            # run (inputs were not donated) and re-dispatches
+            _sot.check_guards(prog["burned"], guard_vals)
         for i, v in zip(prog["mutable_idx"], new_mutable):
             slots[i].set(v)
         for slot_i, g in zip(spec.get("grad_targets", []), grad_outs):

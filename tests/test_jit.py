@@ -237,10 +237,12 @@ def test_dy2static_python_counter_while():
     assert not sk._eager_fallback
 
 
-def test_dy2static_graph_break_falls_back_to_eager():
-    """Constructs outside the conversion subset (return inside a traced
-    branch) take a GRAPH BREAK: correct eager execution + warning, not a
-    hard error (full_graph=True restores the error)."""
+def test_dy2static_unconvertible_branch_takes_sot_path():
+    """Constructs outside the AST conversion subset (return inside a
+    traced branch) no longer graph-break: SOT-lite (jit/sot.py) burns the
+    taken branch into a guarded specialization per observed value, still
+    COMPILED — the reference's jit/sot/translate.py behavior.
+    full_graph=True keeps the hard error."""
     def h(x):
         if (x.sum() > 0):
             return x * 3.0
@@ -249,18 +251,36 @@ def test_dy2static_graph_break_falls_back_to_eager():
     sh = paddle.jit.to_static(h)
     xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
     xn = paddle.to_tensor(np.array([-5.0, 1.0], np.float32))
-    with pytest.warns(UserWarning, match="falling back"):
-        r1 = sh(xp)
-    r2 = sh(xn)
+    r1 = sh(xp)
+    r2 = sh(xn)         # guard miss -> second specialization
     np.testing.assert_allclose(np.asarray(r1._value),
                                np.asarray((xp * 3.0)._value))
     np.testing.assert_allclose(np.asarray(r2._value),
                                np.asarray((xn - 7.0)._value))
-    assert sh._eager_fallback
+    assert not sh._eager_fallback
+    assert sh._stats["sot_specializations"] == 2
 
     strict = paddle.jit.to_static(h, full_graph=True)
     with pytest.raises(Exception):
         strict(xp)
+
+
+def test_dy2static_graph_break_falls_back_to_eager():
+    """Host reads of traced values (.numpy()) stay a GRAPH BREAK: correct
+    eager execution + warning, with the reason in paddle.jit.status()."""
+    def h(x):
+        a = x.numpy()          # host materialization: unguardable
+        return x * float(a.sum())
+
+    sh = paddle.jit.to_static(h)
+    xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    with pytest.warns(UserWarning, match="falling back"):
+        r1 = sh(xp)
+    np.testing.assert_allclose(np.asarray(r1._value), [3.0, 6.0])
+    assert sh._eager_fallback
+    report = paddle.jit.status()
+    st = next(v for k, v in report.items() if k.startswith("h"))
+    assert st["graph_breaks"] and "SOT" in st["graph_breaks"][0]["reason"]
 
 
 def test_dy2static_layer_forward_with_control_flow():
