@@ -255,6 +255,15 @@ def _maybe_static_check(op_name: str, tensor, group=None) -> None:
         generation=_generation())
 
 
+def _eager_multiproc(group) -> bool:
+    """True when this is a real multi-process job and the collective is
+    called eagerly (no axis context): route to the cached jitted
+    global-array programs in `eager_comm.py` — the seat of the
+    reference's eager ProcessGroup (`process_group.h:47`)."""
+    from . import eager_comm
+    return eager_comm.in_multiprocess()
+
+
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
     """In-place all-reduce (paddle semantics: mutates `tensor`)."""
@@ -269,11 +278,13 @@ def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = 
         return tensor
     if _single_rank(group):
         return tensor
-    # eager global-array mode: data replicated per rank — reduce across the
-    # group axis of the mesh-sharded value
+    if _eager_multiproc(group):
+        from . import eager_comm
+        tensor._value = eager_comm.all_reduce(tensor._value, op, group)
+        return tensor
     raise NotImplementedError(
-        "eager cross-process all_reduce outside an axis context needs the "
-        "multi-host runtime; wrap the step in jit/shard_map (recommended) ")
+        "eager cross-process all_reduce outside an axis context needs a "
+        "multi-process runtime (init_parallel_env under distributed.launch)")
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -299,6 +310,13 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor,
         tensor_list.clear()
         tensor_list.append(tensor)
         return tensor_list
+    if _eager_multiproc(group):
+        from . import eager_comm
+        stacked = eager_comm.all_gather(tensor._value, group)
+        tensor_list.clear()
+        tensor_list.extend(Tensor._wrap(stacked[i])
+                           for i in range(stacked.shape[0]))
+        return tensor_list
     raise NotImplementedError("eager cross-process all_gather: use jit/shard_map")
 
 
@@ -312,7 +330,58 @@ def all_gather_into_tensor(out: Tensor, tensor: Tensor, group=None,
     if _single_rank(group):
         out._value = tensor._value
         return out
+    if _eager_multiproc(group):
+        from . import eager_comm
+        stacked = eager_comm.all_gather(tensor._value, group)
+        out._value = stacked.reshape(
+            (stacked.shape[0] * stacked.shape[1],) + stacked.shape[2:])
+        return out
     raise NotImplementedError
+
+
+_NON_MEMBER = object()   # sentinel: caller is not in the group
+
+
+def _store_object_exchange(obj, op_name, group):
+    """Object collectives ride the launcher's TCPStore (the reference's
+    ProcessGroup::AllGatherObject path uses the NCCL byte transport; the
+    control-plane store is the TPU-native seat — object payloads are
+    pickles, not device data).  Returns the ordered per-rank object list."""
+    import os
+    import pickle
+    store = _host_store()
+    if store is None:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ranks = (group._ranks if group is not None
+             and getattr(group, "_ranks", None) is not None
+             else list(range(world)))
+    if rank not in ranks:
+        # paddle group semantics: only members call; tolerate a stray
+        # call from a non-member without touching the members' barrier
+        return _NON_MEMBER
+    seqs = _store_state.setdefault("obj_seq", {})
+    seq = seqs.get(op_name, 0)
+    seqs[op_name] = seq + 1
+    gen = _generation()
+    key = lambda r: f"objcoll/{gen}/{op_name}/{seq}/{r}"  # noqa: E731
+    store.set(key(rank), pickle.dumps(obj))
+    out = []
+    from .watchdog import comm_task
+    with comm_task(f"{op_name}#{seq}", rank=rank, world_size=len(ranks),
+                   store=store, generation=gen):
+        for r in ranks:
+            store.wait(key(r))
+            out.append(pickle.loads(store.get(key(r))))
+    # everyone has read every payload once the member barrier passes;
+    # each member then deletes only ITS OWN key
+    store.barrier(f"objcoll/{gen}/{op_name}/{seq}/done", len(ranks))
+    try:
+        store.delete_key(key(rank))
+    except Exception:  # noqa: BLE001 - cleanup is best-effort
+        pass
+    return out
 
 
 def all_gather_object(object_list: list, obj: Any, group=None):
@@ -320,7 +389,14 @@ def all_gather_object(object_list: list, obj: Any, group=None):
         object_list.clear()
         object_list.append(obj)
         return object_list
-    raise NotImplementedError("object collectives: use the host store")
+    got = _store_object_exchange(obj, "all_gather_object", group)
+    if got is _NON_MEMBER:
+        return object_list
+    if got is not None:
+        object_list.clear()
+        object_list.extend(got)
+        return object_list
+    raise NotImplementedError("object collectives need the launcher store")
 
 
 def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
@@ -339,6 +415,11 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
         return tensor
     if _single_rank(group):
         tensor._value = src._value
+        return tensor
+    if _eager_multiproc(group):
+        from . import eager_comm
+        out = eager_comm.reduce_scatter(src._value, op, group)
+        tensor._value = out
         return tensor
     raise NotImplementedError
 
@@ -360,6 +441,14 @@ def alltoall(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
         out_tensor_list.clear()
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
+    if _eager_multiproc(group):
+        from . import eager_comm
+        rows = jnp.stack([t._value for t in in_tensor_list], axis=0)
+        got = eager_comm.alltoall(rows, group)
+        out_tensor_list.clear()
+        out_tensor_list.extend(Tensor._wrap(got[i])
+                               for i in range(got.shape[0]))
+        return out_tensor_list
     raise NotImplementedError
 
 
@@ -375,6 +464,15 @@ def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
     if _single_rank(group):
         out_tensor._value = in_tensor._value
         return out_tensor
+    if _eager_multiproc(group):
+        from . import eager_comm
+        W = eager_comm.group_size(group)
+        rows = in_tensor._value.reshape(
+            (W, in_tensor.shape[0] // W) + tuple(in_tensor.shape[1:]))
+        got = eager_comm.alltoall(rows, group)
+        out_tensor._value = got.reshape(
+            (got.shape[0] * got.shape[1],) + got.shape[2:])
+        return out_tensor
     raise NotImplementedError
 
 
@@ -389,11 +487,24 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
         return tensor
     if _single_rank(group):
         return tensor
+    if _eager_multiproc(group):
+        from . import eager_comm
+        tensor._value = eager_comm.broadcast(
+            tensor._value, eager_comm.row_of(group, src), group)
+        return tensor
     raise NotImplementedError
 
 
 def broadcast_object_list(object_list, src=0, group=None):
     if _single_rank(group):
+        return object_list
+    got = _store_object_exchange(list(object_list), "broadcast_object_list",
+                                 group)
+    if got is _NON_MEMBER:
+        return object_list
+    if got is not None:
+        from . import eager_comm
+        object_list[:] = got[eager_comm.row_of(group, src)]
         return object_list
     raise NotImplementedError
 
@@ -411,6 +522,19 @@ def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
         return tensor
     if _single_rank(group):
         tensor._value = tensor_list[src]._value if tensor_list else tensor._value
+        return tensor
+    if _eager_multiproc(group):
+        from . import eager_comm
+        W = eager_comm.group_size(group)
+        me = eager_comm.my_row(group)
+        src_row = eager_comm.row_of(group, src)
+        if me == src_row:
+            stacked = jnp.stack([t._value for t in tensor_list], axis=0)
+        else:
+            stacked = jnp.zeros(
+                (W,) + tuple(tensor.shape), tensor._value.dtype)
+        full = eager_comm.broadcast(stacked, src_row, group)
+        tensor._value = full[me]
         return tensor
     raise NotImplementedError
 

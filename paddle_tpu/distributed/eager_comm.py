@@ -1,0 +1,182 @@
+"""Eager cross-process collectives on global arrays.
+
+Role of the reference's eager ProcessGroup
+(`paddle/fluid/distributed/collective/process_group.h:47`,
+`process_group_nccl.cc` — every rank calls `all_reduce(tensor)` and NCCL
+moves the bytes): in a multi-process JAX job the equivalent is a tiny
+cached jitted program over a one-device-per-process mesh:
+
+1. each process wraps its local value as its shard of a global
+   [W, *shape] array (`jax.make_array_from_process_local_data`);
+2. all processes enter the SAME cached compiled program in lockstep (an
+   eager collective call is already a lockstep point — identical to a
+   NCCL kernel launch);
+3. the program reduces/gathers/permutes over the leading axis with the
+   output replicated, and each process reads back its addressable shard.
+
+Programs cache per (op, shape, dtype, group) — after the first call a
+collective is one executable launch, the same cost model as a cached
+NCCL plan.  These paths are for EAGER tensors between jit regions (DDP
+grad sync, metric reduction); code inside shard_map/jit keeps using the
+axis-context lowering in `collective.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_AXIS = "world"
+
+
+def in_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def group_size(group) -> int:
+    """Number of PARTICIPATING PROCESSES (the eager collective's world;
+    a process may own many local devices — e.g. a virtual 8-device CPU
+    mesh — but contributes one row)."""
+    ranks = group_ranks(group)
+    return len(ranks) if ranks is not None else jax.process_count()
+
+
+def group_ranks(group) -> Optional[Sequence[int]]:
+    """Process ids participating; None = every process."""
+    if group is None or getattr(group, "_ranks", None) is None:
+        return None
+    return tuple(group._ranks)
+
+
+@functools.lru_cache(maxsize=None)
+def _group_mesh(ranks: Optional[tuple]) -> Mesh:
+    """1-D mesh with ONE device per participating process (a process may
+    own several local devices; the collective's unit is the process, as in
+    the reference's one-rank-per-GPU model)."""
+    per_proc = {}
+    for d in jax.devices():
+        if ranks is None or d.process_index in ranks:
+            cur = per_proc.get(d.process_index)
+            if cur is None or d.id < cur.id:
+                per_proc[d.process_index] = d
+    devs = [per_proc[p] for p in sorted(per_proc)]
+    return Mesh(np.array(devs), (_AXIS,))
+
+
+def row_of(group, global_rank: int) -> int:
+    """Row of a GLOBAL process rank in the stacked [W, *shape] layout
+    (mesh rows are the group's process ids in sorted order)."""
+    ranks = group_ranks(group)
+    if ranks is None:
+        return global_rank
+    return sorted(ranks).index(global_rank)
+
+
+def my_row(group=None) -> int:
+    """This process's row in the stacked [W, *shape] layout."""
+    return row_of(group, jax.process_index())
+
+
+def _stack(mesh: Mesh, value: jax.Array) -> jax.Array:
+    """Local [*s] -> global [W, *s], row w owned by process w.
+
+    Assembled from the existing device buffer
+    (make_array_from_single_device_arrays) — no host round trip; a DDP
+    reducer hook's per-parameter collective stays device-side."""
+    sharding = NamedSharding(mesh, P(_AXIS, *([None] * value.ndim)))
+    mine = [d for d in mesh.devices.flat
+            if d.process_index == jax.process_index()]
+    local = jax.device_put(jnp.asarray(value)[None], mine[0])
+    W = mesh.devices.size
+    return jax.make_array_from_single_device_arrays(
+        (W,) + tuple(value.shape), sharding, [local])
+
+
+def _local_view(garr: jax.Array) -> jax.Array:
+    """The replicated result's addressable shard (no host round trip)."""
+    return garr.addressable_shards[0].data
+
+
+_REDUCERS = {
+    "sum": lambda x: jnp.sum(x, axis=0),
+    "avg": lambda x: jnp.mean(x, axis=0),
+    "mean": lambda x: jnp.mean(x, axis=0),
+    "max": lambda x: jnp.max(x, axis=0),
+    "min": lambda x: jnp.min(x, axis=0),
+    "prod": lambda x: jnp.prod(x, axis=0),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _program(kind: str, ranks: Optional[tuple], arg: Optional[int] = None):
+    """Cached compiled collective: global [W, *s] in, replicated out."""
+    mesh = _group_mesh(ranks)
+    rep = NamedSharding(mesh, P())
+
+    if kind in _REDUCERS:
+        fn = _REDUCERS[kind]
+    elif kind == "broadcast":
+        fn = lambda x: x[arg]                          # noqa: E731
+    elif kind == "all_gather":
+        fn = lambda x: x                               # noqa: E731
+    elif kind == "reduce_scatter":
+        W = mesh.devices.size
+
+        def fn(x):                                     # [W, W*m, ...]
+            s = jnp.sum(x, axis=0)
+            return s.reshape((W, -1) + s.shape[1:])    # rows per rank
+    elif kind == "alltoall":
+        fn = lambda x: jnp.swapaxes(x, 0, 1)           # noqa: E731
+    else:  # pragma: no cover
+        raise ValueError(kind)
+    return jax.jit(fn, out_shardings=rep)
+
+
+def all_reduce(value: jax.Array, op: str = "sum", group=None) -> jax.Array:
+    ranks = group_ranks(group)
+    g = _stack(_group_mesh(ranks), value)
+    return _local_view(_program(op, ranks)(g))
+
+
+def broadcast(value: jax.Array, src_row: int, group=None) -> jax.Array:
+    ranks = group_ranks(group)
+    g = _stack(_group_mesh(ranks), value)
+    return _local_view(_program("broadcast", ranks, src_row)(g))
+
+
+def all_gather(value: jax.Array, group=None) -> jax.Array:
+    """Returns the stacked [W, *shape] result (callers split/reshape)."""
+    ranks = group_ranks(group)
+    g = _stack(_group_mesh(ranks), value)
+    return _local_view(_program("all_gather", ranks)(g))
+
+
+def reduce_scatter(value: jax.Array, op: str = "sum", group=None):
+    """value [W*m, ...] per rank; returns this rank's [m, ...] of the
+    summed result.  Only sum (the DDP/ZeRO op) is defined, as in the
+    reference's reduce-scatter use."""
+    if op not in ("sum", "avg", "mean"):
+        raise ValueError("reduce_scatter supports sum/avg")
+    ranks = group_ranks(group)
+    mesh = _group_mesh(ranks)
+    g = _stack(mesh, value)
+    rows = _local_view(_program("reduce_scatter", ranks)(g))
+    out = rows[my_row(group)]
+    if op in ("avg", "mean"):
+        out = out / mesh.devices.size
+    return out
+
+
+def alltoall(value: jax.Array, group=None) -> jax.Array:
+    """value [W, ...] per rank (row r bound for rank r); returns this
+    rank's received [W, ...] stack."""
+    ranks = group_ranks(group)
+    mesh = _group_mesh(ranks)
+    g = _stack(mesh, value)                            # [W, W, ...]
+    swapped = _local_view(_program("alltoall", ranks)(g))
+    return swapped[my_row(group)]

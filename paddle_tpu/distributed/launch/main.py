@@ -96,6 +96,26 @@ class CollectiveController:
             self.node_rank = store.add(f"node_rank/{gen}", 1) - 1
         store.barrier(f"rendezvous/{gen}", self.nnodes,
                       timeout=self.args.elastic_timeout)
+        # allocate the jax.distributed coordinator endpoint: a DIFFERENT
+        # port from the TCPStore (two services can't share one listener);
+        # node 0 binds an ephemeral port and publishes it per generation
+        host = self.master.rsplit(":", 1)[0]
+        if self.node_rank == 0:
+            # bind-probe-then-close has an inherent TOCTOU window before
+            # worker 0's coordinator re-binds the port (torchrun's
+            # rendezvous has the same race); ephemeral-range churn makes a
+            # collision rare, and a hit fails loudly at initialize() and
+            # is retried by the elastic restart path
+            import socket
+            s = socket.socket()
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+            s.close()
+            self.coordinator = f"{host}:{port}"
+            store.set(f"jax_coord/{gen}", self.coordinator.encode())
+        else:
+            store.wait(f"jax_coord/{gen}")
+            self.coordinator = store.get(f"jax_coord/{gen}").decode()
 
     # --------------------------------------------------------------- workers
     def _worker_env(self, local_rank: int):
@@ -111,6 +131,8 @@ class CollectiveController:
             "PADDLE_JOB_ID": self.args.job_id,
             "PADDLE_RESTART_GENERATION": str(self.restarts),
         })
+        if getattr(self, "coordinator", None):
+            env["COORDINATOR_ADDRESS"] = self.coordinator
         if self.args.devices:
             devs = self.args.devices.split(",")
             env["PADDLE_DEVICES"] = devs[local_rank % len(devs)]

@@ -37,12 +37,15 @@ def init_parallel_env(backend: Optional[str] = None):
     Multi-host: jax.distributed.initialize from the launcher env
     (coordinator address replaces the reference's TCPStore rendezvous)."""
     import os
-    if "PADDLE_MASTER" in os.environ or "COORDINATOR_ADDRESS" in os.environ:
-        addr = os.environ.get("COORDINATOR_ADDRESS") or \
-            os.environ.get("PADDLE_MASTER")
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    # the jax.distributed coordinator is its OWN endpoint (the launcher
+    # publishes COORDINATOR_ADDRESS) — PADDLE_MASTER is the TCPStore and
+    # cannot double as the coordinator port
+    addr = os.environ.get("COORDINATOR_ADDRESS")
+    if addr and world > 1 and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=addr,
-            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            num_processes=world,
             process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
     if _mesh.get_mesh() is None:
         _mesh.set_mesh(_mesh.build_mesh({"dp": -1}))
@@ -78,16 +81,56 @@ def shard_batch(tensor: Tensor, axis: str = "dp", dim: int = 0) -> Tensor:
 
 
 class DataParallel(Layer):
+    """DDP wrapper (ref `python/paddle/DataParallel`, reducer.h:88).
+
+    In-process SPMD mode (one process, many devices): forward shards the
+    batch over the 'dp' mesh axis and XLA inserts the gradient psums.
+
+    Multi-process eager mode (under `distributed.launch`): each process
+    computes grads on its own batch; reducer hooks on every parameter's
+    accumulation node all-reduce(avg) the gradient the moment it lands in
+    `loss.backward()` — the reference's Reducer, with the cached jitted
+    global-array programs of `eager_comm.py` as the transport."""
+
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):
         super().__init__()
         self._layers = layers
         self._sync = True
+        self._group = group
         self.find_unused_parameters = find_unused_parameters
+        from . import eager_comm
+        self._multiproc = eager_comm.in_multiprocess()
+        if self._multiproc:
+            self._register_reducer_hooks()
+            self._broadcast_initial_params()
+
+    def _register_reducer_hooks(self):
+        from .collective import ReduceOp, all_reduce
+        dp = self
+
+        def sync(t):
+            if not dp._sync:
+                return
+            g = t._grad
+            if g is not None:
+                all_reduce(g, op=ReduceOp.AVG, group=dp._group)
+
+        for p in self._layers.parameters():
+            if not p.stop_gradient:
+                node = p._get_accum_node()
+                node.reducer_hooks.append(sync)
+
+    def _broadcast_initial_params(self):
+        """Rank-0 weights win at construction (the reference broadcasts
+        parameters in DataParallel.__init__ so ranks start identical)."""
+        from .collective import broadcast
+        for p in self._layers.parameters():
+            broadcast(p, src=0, group=self._group)
 
     def forward(self, *inputs, **kwargs):
-        if self._sync:
+        if self._sync and not self._multiproc:
             inputs = tuple(shard_batch(i) if isinstance(i, Tensor) else i
                            for i in inputs)
             kwargs = {k: shard_batch(v) if isinstance(v, Tensor) else v
@@ -138,4 +181,11 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        pass
+        """Manual grad sync after `no_sync` accumulation (reference
+        `DataParallel.apply_collective_grads`)."""
+        if not self._multiproc:
+            return
+        from .collective import ReduceOp, all_reduce
+        for p in self._layers.parameters():
+            if p._grad is not None:
+                all_reduce(p._grad, op=ReduceOp.AVG, group=self._group)

@@ -40,7 +40,9 @@ def _key_impl():
 
 def _host_cpu():
     try:
-        return jax.devices("cpu")[0]
+        # local_devices, not devices: in a multi-process job global CPU
+        # device 0 belongs to process 0 and is not addressable elsewhere
+        return jax.local_devices(backend="cpu")[0]
     except Exception:  # pragma: no cover - no CPU backend registered
         return None
 
@@ -56,16 +58,27 @@ class StatefulKeySource:
     arguments."""
 
     def __init__(self, seed_val: int = 0):
+        # LAZY: touching a device here would initialize the XLA backend at
+        # `import paddle_tpu` time, which breaks jax.distributed.initialize
+        # (it must run before any backend use — init_parallel_env's seat)
+        self._seed_val = seed_val
+        self._cpu = None
+        self._key = None
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        if self._key is not None:
+            return
         self._cpu = _host_cpu()
         if self._cpu is not None:
             with jax.default_device(self._cpu):
-                self._key = jax.random.key(seed_val, impl=_key_impl())
+                self._key = jax.random.key(self._seed_val, impl=_key_impl())
         else:
-            self._key = jax.random.key(seed_val, impl=_key_impl())
-        self._lock = threading.Lock()
+            self._key = jax.random.key(self._seed_val, impl=_key_impl())
 
     def next_key(self):
         with self._lock:
+            self._ensure()
             if self._cpu is not None:
                 with jax.default_device(self._cpu):
                     self._key, sub = jax.random.split(self._key)
@@ -81,9 +94,13 @@ class StatefulKeySource:
             return sub
 
     def get_state(self):
+        with self._lock:
+            self._ensure()
         return self._key
 
     def set_state(self, key):
+        with self._lock:
+            self._ensure()
         if self._cpu is not None and hasattr(key, "devices"):
             key = jax.device_put(key, self._cpu)
         self._key = key
