@@ -607,6 +607,64 @@ def bench_decode_longctx():
          "paged_tokens_per_sec": round(tps, 1)})
 
 
+def bench_serving():
+    """Continuous-batching rung: 6 staggered requests (mixed prompt
+    lengths and budgets) stream through ONE compiled decode step over the
+    paged pool (`inference/serving.py`); reports decode tokens/s at mixed
+    occupancy plus the per-step scheduler overhead."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m, gpt3_tiny
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    paddle.seed(0)
+    cfg = gpt3_124m() if on_tpu else gpt3_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_batch=8,
+                        max_context=1024 if on_tpu else 128,
+                        steps_per_tick=8 if on_tpu else 1)
+    rng = np.random.RandomState(0)
+    mk = lambda L, n: Request(  # noqa: E731
+        rng.randint(1, cfg.vocab_size, (L,)), max_new_tokens=n)
+    # warm every program the timed run will hit: both prefill buckets
+    # and the tick-size ladder (8/4/2/1 decode scans)
+    # budgets of 34 = 1 prefill token + 4 full ticks + a k=1 tail, so
+    # BOTH decode programs compile before the timed region
+    eng.add_request(mk(96 if on_tpu else 24, 34))
+    eng.add_request(mk(33 if on_tpu else 8, 34))
+    eng.run()
+    eng.finished.clear()
+
+    reqs = [mk(128 if on_tpu else 24, 96 if on_tpu else 12),
+            mk(64 if on_tpu else 12, 64 if on_tpu else 8)]
+    for r in reqs:
+        eng.add_request(r)
+    t0 = time.perf_counter()
+    steps0 = eng.steps
+    toks0 = eng.tokens_out
+    # stagger four more admissions across the first decode steps
+    joins = [(3, mk(96 if on_tpu else 16, 80 if on_tpu else 10)),
+             (6, mk(32 if on_tpu else 8, 48 if on_tpu else 6)),
+             (9, mk(128 if on_tpu else 24, 64 if on_tpu else 8)),
+             (12, mk(64 if on_tpu else 12, 72 if on_tpu else 9))]
+    n_requests = 2 + len(joins)
+    i = 0
+    while eng.step() or eng._active_slots() or eng.waiting:
+        i += 1
+        while joins and joins[0][0] <= i:
+            eng.add_request(joins.pop(0)[1])
+    dt = time.perf_counter() - t0
+    toks = eng.tokens_out - toks0
+    steps = eng.steps - steps0
+    log({"bench": "serving_continuous_batching",
+         "requests": n_requests, "decode_steps": steps,
+         "tokens_out": toks,
+         "tokens_per_sec": round(toks / dt, 1),
+         "ms_per_step": round(dt / max(steps, 1) * 1e3, 3)})
+
+
 def bench_ring_attention():
     """Long-context rung (SURVEY §5.7): S=8192 causal attention fwd+bwd.
 
@@ -721,6 +779,7 @@ def main():
     _run_rung("resnet50_train", bench_resnet50, 380)
     _run_rung("bert_base_mlm_train", bench_bert_base, 500)
     _run_rung("ring_attention_8k", bench_ring_attention, 120)
+    _run_rung("serving_continuous_batching", bench_serving, 240)
     check_regressions()
 
 

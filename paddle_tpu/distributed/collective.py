@@ -342,7 +342,7 @@ def all_gather_into_tensor(out: Tensor, tensor: Tensor, group=None,
 _NON_MEMBER = object()   # sentinel: caller is not in the group
 
 
-def _store_object_exchange(obj, op_name, group):
+def _store_object_exchange(obj, op_name, group, src_only=None):
     """Object collectives ride the launcher's TCPStore (the reference's
     ProcessGroup::AllGatherObject path uses the NCCL byte transport; the
     control-plane store is the TPU-native seat — object payloads are
@@ -361,26 +361,34 @@ def _store_object_exchange(obj, op_name, group):
         # paddle group semantics: only members call; tolerate a stray
         # call from a non-member without touching the members' barrier
         return _NON_MEMBER
+    # seq counters are PER (op, group): a member and a non-member of some
+    # subgroup must still agree on the sequence numbers of every group
+    # they are BOTH in (a global counter would desynchronize them)
+    gkey = (op_name, tuple(sorted(ranks)))
     seqs = _store_state.setdefault("obj_seq", {})
-    seq = seqs.get(op_name, 0)
-    seqs[op_name] = seq + 1
+    seq = seqs.get(gkey, 0)
+    seqs[gkey] = seq + 1
     gen = _generation()
-    key = lambda r: f"objcoll/{gen}/{op_name}/{seq}/{r}"  # noqa: E731
-    store.set(key(rank), pickle.dumps(obj))
+    gid = "-".join(map(str, sorted(ranks)))
+    key = lambda r: f"objcoll/{gen}/{op_name}/{gid}/{seq}/{r}"  # noqa: E731
+    if src_only is None or rank == src_only:
+        store.set(key(rank), pickle.dumps(obj))
     out = []
+    read_from = ranks if src_only is None else [src_only]
     from .watchdog import comm_task
     with comm_task(f"{op_name}#{seq}", rank=rank, world_size=len(ranks),
                    store=store, generation=gen):
-        for r in ranks:
+        for r in read_from:
             store.wait(key(r))
             out.append(pickle.loads(store.get(key(r))))
     # everyone has read every payload once the member barrier passes;
     # each member then deletes only ITS OWN key
-    store.barrier(f"objcoll/{gen}/{op_name}/{seq}/done", len(ranks))
-    try:
-        store.delete_key(key(rank))
-    except Exception:  # noqa: BLE001 - cleanup is best-effort
-        pass
+    store.barrier(f"objcoll/{gen}/{op_name}/{gid}/{seq}/done", len(ranks))
+    if src_only is None or rank == src_only:
+        try:
+            store.delete_key(key(rank))
+        except Exception:  # noqa: BLE001 - cleanup is best-effort
+            pass
     return out
 
 
@@ -499,12 +507,11 @@ def broadcast_object_list(object_list, src=0, group=None):
     if _single_rank(group):
         return object_list
     got = _store_object_exchange(list(object_list), "broadcast_object_list",
-                                 group)
+                                 group, src_only=src)
     if got is _NON_MEMBER:
         return object_list
     if got is not None:
-        from . import eager_comm
-        object_list[:] = got[eager_comm.row_of(group, src)]
+        object_list[:] = got[0]
         return object_list
     raise NotImplementedError
 

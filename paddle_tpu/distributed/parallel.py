@@ -123,11 +123,16 @@ class DataParallel(Layer):
                 node.reducer_hooks.append(sync)
 
     def _broadcast_initial_params(self):
-        """Rank-0 weights win at construction (the reference broadcasts
-        parameters in DataParallel.__init__ so ranks start identical)."""
+        """Rank-0 weights AND buffers win at construction (the
+        reference's sync_params_buffers: BatchNorm running stats are
+        buffers, outside parameters(), and must start identical too)."""
         from .collective import broadcast
         for p in self._layers.parameters():
             broadcast(p, src=0, group=self._group)
+        buffers = getattr(self._layers, "buffers", None)
+        if callable(buffers):
+            for b in buffers():
+                broadcast(b, src=0, group=self._group)
 
     def forward(self, *inputs, **kwargs):
         if self._sync and not self._multiproc:

@@ -11,6 +11,8 @@ first run, and serves through input/output handles.
 
 from .predictor import Config, PredictHandle, Predictor, create_predictor
 from .passes import convert_to_int8, convert_to_mixed_precision
+from .serving import Request, ServingEngine
 
 __all__ = ["Config", "Predictor", "PredictHandle", "create_predictor",
-           "convert_to_mixed_precision", "convert_to_int8"]
+           "convert_to_mixed_precision", "convert_to_int8",
+           "Request", "ServingEngine"]

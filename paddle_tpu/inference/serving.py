@@ -1,0 +1,435 @@
+"""Continuous-batching serving loop over the paged KV cache.
+
+Role of the reference's production decode service: the paged cache-KV
+branch of `fused_multi_transformer_op.cu.h` (+ `block_multi_head_
+attention_kernel.cu`) driven by a request scheduler behind
+`analysis_predictor.h:100`.  TPU-native shape:
+
+* ONE compiled decode step for the whole engine, regardless of batch
+  mix: fixed `max_batch` slots, a shared physical block pool per layer,
+  per-slot block tables and seq_lens as device inputs.  Admissions,
+  evictions, and block allocation are HOST-side bookkeeping between
+  compiled steps (exactly where serving schedulers live), so joining or
+  finishing a sequence never recompiles anything.
+* Admission runs a compiled prefill program (cached per padded prompt
+  bucket) that writes the prompt's K/V into the new slot's blocks
+  through the SAME pools and returns the last real token's logits.
+* Free slots ride through the decode program as seq_len-0 rows: their
+  writes land in the reserved pad block 0 and their attention output is
+  ignored, so occupancy changes cost nothing.
+* Sampling happens host-side on the returned last-token logits (the
+  engine reads one [B] token vector per step anyway), so per-request
+  sampling parameters never enter the compiled program.
+
+Block accounting reserves the worst case (prompt + max_new_tokens) at
+admission, so a running sequence can never hit pool exhaustion
+mid-flight (no preemption needed — the reference scheduler's "no-evict"
+configuration).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Request", "ServingEngine"]
+
+
+class Request:
+    """One generation request; results accumulate in `output_ids`."""
+
+    _counter = 0
+
+    def __init__(self, prompt_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 seed: Optional[int] = None):
+        Request._counter += 1
+        self.rid = Request._counter
+        self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.do_sample = do_sample
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self._rng = np.random.RandomState(seed if seed is not None
+                                          else self.rid)
+        self.output_ids: List[int] = []
+        self.done = False
+        self.slot: Optional[int] = None
+
+    def _sample(self, logits_row: np.ndarray) -> int:
+        if not self.do_sample:
+            return int(np.argmax(logits_row))
+        from ..models.generation import _process_logits
+        filtered = np.asarray(_process_logits(
+            jnp.asarray(logits_row, jnp.float32)[None],
+            self.temperature, self.top_k, self.top_p))[0]
+        p = np.exp(filtered - filtered.max())
+        p = p / p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+
+def _bucket(n: int, minimum: int) -> int:
+    b = max(minimum, 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+class ServingEngine:
+    """Continuous batching over a model with `forward_with_cache` +
+    paged caches (GPT/Llama families).
+
+    engine = ServingEngine(model, max_batch=4, max_context=512)
+    engine.add_request(Request([1, 2, 3], max_new_tokens=16))
+    finished = engine.run()          # or engine.step() incrementally
+    """
+
+    def __init__(self, model, max_batch: int = 4,
+                 max_context: Optional[int] = None, block_size: int = 64,
+                 num_blocks: Optional[int] = None,
+                 steps_per_tick: int = 1):
+        # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
+        # host round trip harvests k tokens per slot (the tunnel's RTT
+        # otherwise caps serving at ~1/RTT steps); admissions join at
+        # tick boundaries — the standard iteration-level scheduling
+        # granularity tradeoff.  Sampling requests force k=1 ticks (their
+        # sampling happens host-side).
+        self.model = model
+        cfg = model.cfg
+        self.B = max_batch
+        self.bs = block_size
+        self.max_context = int(max_context or cfg.max_seq_len)
+        self.nb_per_seq = math.ceil(self.max_context / block_size)
+        if num_blocks is None:
+            num_blocks = max_batch * self.nb_per_seq
+        self.num_blocks = num_blocks
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        self.nh, self.hd = nh, hd
+        dtype = model.state_dict()[sorted(model.state_dict())[0]]._value.dtype
+        # physical pools per layer; block 0 is the pad/scratch block
+        self.pools = [
+            (jnp.zeros((nh, num_blocks + 1, block_size, hd), dtype),
+             jnp.zeros((nh, num_blocks + 1, block_size, hd), dtype))
+            for _ in range(cfg.num_layers)]
+        # host-side scheduler state
+        self.tables = np.zeros((max_batch, self.nb_per_seq), np.int32)
+        self.seq_lens = np.zeros((max_batch,), np.int32)
+        self.last_tok = np.zeros((max_batch,), np.int32)
+        self.free_blocks = deque(range(1, num_blocks + 1))
+        self.free_slots = deque(range(max_batch))
+        self.reserved = 0                      # growth blocks promised
+        self.slot_req: List[Optional[Request]] = [None] * max_batch
+        self.waiting: deque = deque()
+        self.finished: List[Request] = []
+        self.steps = 0
+        self.tokens_out = 0
+        self._sd = model.state_dict()
+        self._keys = sorted(self._sd)
+        self.steps_per_tick = max(1, int(steps_per_tick))
+        self._decode_fn = None
+        self._decode_multi_fns = {}
+        self._prefill_fns = {}
+
+    # ------------------------------------------------------------ programs
+    def _views(self, pools, tables, seq_lens):
+        from ..models.kv_cache import PagedKVCache
+        views = []
+        for k, v in pools:
+            c = PagedKVCache.__new__(PagedKVCache)
+            c.bs, c.k, c.v, c.tables, c.seq_lens = (
+                self.bs, k, v, tables, seq_lens)
+            views.append(c)
+        return views
+
+    def _bind(self, param_vals):
+        for k, v in zip(self._keys, param_vals):
+            self._sd[k]._value = v
+
+    def _decode_program(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        from ..framework.dygraph import no_grad
+
+        def step(param_vals, pools, tables, seq_lens, last_tok):
+            self._bind(param_vals)
+            views = self._views(pools, tables, seq_lens)
+            with no_grad():
+                logits_t, new_views = self.model.forward_with_cache(
+                    Tensor._wrap(last_tok[:, None]), views,
+                    pos_offset=Tensor._wrap(seq_lens[:, None]))
+            logits = logits_t._value[:, -1, :]
+            new_pools = [(c.k, c.v) for c in new_views]
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                logits, new_pools
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fn = jax.jit(step, donate_argnums=donate)
+        return self._decode_fn
+
+    def _decode_multi_program(self, k: int):
+        fn = self._decode_multi_fns.get(k)
+        if fn is not None:
+            return fn
+        from ..framework.dygraph import no_grad
+
+        def tick(param_vals, pools, tables, seq_lens, last_tok):
+            self._bind(param_vals)
+
+            def body(carry, _):
+                pools, lens, last = carry
+                views = self._views(pools, tables, lens)
+                with no_grad():
+                    logits_t, new_views = self.model.forward_with_cache(
+                        Tensor._wrap(last[:, None]), views,
+                        pos_offset=Tensor._wrap(lens[:, None]))
+                nxt = jnp.argmax(
+                    logits_t._value[:, -1, :], axis=-1).astype(jnp.int32)
+                active = lens > 0
+                nxt = jnp.where(active, nxt, 0)
+                lens = jnp.where(active, lens + 1, 0)
+                new_pools = [(c.k, c.v) for c in new_views]
+                return (new_pools, lens, nxt), nxt
+
+            (pools, _, _), toks = jax.lax.scan(
+                body, (pools, seq_lens, last_tok), None, length=k)
+            return jnp.transpose(toks), pools        # [B, k]
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = self._decode_multi_fns[k] = jax.jit(
+            tick, donate_argnums=donate)
+        return fn
+
+    def _prefill_program(self, L_pad: int):
+        fn = self._prefill_fns.get(L_pad)
+        if fn is not None:
+            return fn
+        from ..framework.dygraph import no_grad
+
+        def prefill(param_vals, pools, table_row, prompt, true_len):
+            self._bind(param_vals)
+            zero = jnp.zeros((1,), jnp.int32)
+            views = self._views(pools, table_row, zero)
+            with no_grad():
+                logits_t, new_views = self.model.forward_with_cache(
+                    Tensor._wrap(prompt), views, pos_offset=0)
+            # last REAL token's logits (prompt is right-padded to L_pad)
+            row = jax.lax.dynamic_index_in_dim(
+                logits_t._value[0], true_len - 1, axis=0, keepdims=False)
+            new_pools = [(c.k, c.v) for c in new_views]
+            return row, new_pools
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = self._prefill_fns[L_pad] = jax.jit(
+            prefill, donate_argnums=donate)
+        return fn
+
+    # ----------------------------------------------------------- scheduler
+    def add_request(self, req: Request):
+        L = len(req.prompt_ids)
+        if L + req.max_new_tokens > self.max_context:
+            raise ValueError(
+                f"request needs {L + req.max_new_tokens}"
+                f" tokens > max_context {self.max_context}")
+        # worst-case block need must fit the POOL outright, or admission
+        # can never succeed and run() would spin on the waiting queue
+        worst = self._blocks_for(_bucket(L, self.bs)) + max(
+            0, self._blocks_for(L + req.max_new_tokens)
+            - self._blocks_for(L))
+        if worst > self.num_blocks:
+            raise ValueError(
+                f"request needs {worst} blocks worst-case but the pool "
+                f"has {self.num_blocks}; raise num_blocks or lower "
+                "max_new_tokens")
+        self.waiting.append(req)
+        return req
+
+    def _blocks_for(self, n_tokens: int) -> int:
+        return math.ceil(n_tokens / self.bs)
+
+    def _try_admit(self) -> bool:
+        if not self.waiting or not self.free_slots:
+            return False
+        req = self.waiting[0]
+        L = len(req.prompt_ids)
+        L_pad = _bucket(L, self.bs)
+        need_now = self._blocks_for(L_pad)
+        # full reservation: prompt blocks now + growth to the worst case
+        total_need = self._blocks_for(L + req.max_new_tokens)
+        growth = max(0, total_need - self._blocks_for(L))
+        if len(self.free_blocks) - self.reserved < need_now + growth:
+            return False
+        self.waiting.popleft()
+        slot = self.free_slots.popleft()
+        blocks = [self.free_blocks.popleft() for _ in range(need_now)]
+        self.tables[slot, :] = 0
+        self.tables[slot, :need_now] = blocks
+        req._growth_left = growth
+        self.reserved += growth
+
+        param_vals = [self._sd[k]._value for k in self._keys]
+        prompt = np.zeros((1, L_pad), np.int32)
+        prompt[0, :L] = req.prompt_ids
+        saved = dict((k, self._sd[k]._value) for k in self._keys)
+        try:
+            row, self.pools = self._prefill_program(L_pad)(
+                param_vals, self.pools,
+                jnp.asarray(self.tables[slot:slot + 1]),
+                jnp.asarray(prompt), jnp.int32(L))
+        finally:
+            for k, v in saved.items():
+                self._sd[k]._value = v
+        # release pad-bucket blocks beyond the prompt's real span (their
+        # stale contents are masked by seq_lens and overwritten by any
+        # future owner before becoming visible)
+        keep = self._blocks_for(L)
+        for col in range(keep, need_now):
+            self.free_blocks.append(int(self.tables[slot, col]))
+            self.tables[slot, col] = 0
+        first = req._sample(np.asarray(row))
+        req.output_ids.append(first)
+        req.slot = slot
+        self.slot_req[slot] = req
+        self.seq_lens[slot] = L
+        self.last_tok[slot] = first
+        self.tokens_out += 1
+        self._maybe_finish(req, first)
+        return True
+
+    def _maybe_finish(self, req: Request, tok: int):
+        if req.done:
+            return
+        if (req.eos_token_id is not None and tok == req.eos_token_id) or \
+                len(req.output_ids) >= req.max_new_tokens:
+            req.done = True
+
+    def _evict(self, slot: int):
+        req = self.slot_req[slot]
+        # return the part of the growth reservation this request never
+        # drew (early eos); drawn blocks were decremented at allocation
+        self.reserved -= getattr(req, "_growth_left", 0)
+        req._growth_left = 0
+        for col in range(self.nb_per_seq):
+            if self.tables[slot, col]:
+                self.free_blocks.append(int(self.tables[slot, col]))
+                self.tables[slot, col] = 0
+        self.seq_lens[slot] = 0
+        self.last_tok[slot] = 0
+        self.slot_req[slot] = None
+        self.free_slots.append(slot)
+        self.finished.append(req)
+
+    def _active_slots(self):
+        return [s for s in range(self.B) if self.slot_req[s] is not None]
+
+    def step(self) -> bool:
+        """One scheduler tick: admit what fits, evict finished, run ONE
+        compiled decode step over the current mix.  Returns True while
+        work remains."""
+        while self._try_admit():
+            pass
+        for slot in list(range(self.B)):
+            req = self.slot_req[slot]
+            if req is not None and req.done:
+                self._evict(slot)
+        active = self._active_slots()
+        if not active:
+            return bool(self.waiting)
+        k = self._tick_size(active)
+        # ensure a physical block exists for every position this tick
+        # will write (all draws covered by the admission reservation)
+        for slot in active:
+            for pos in range(int(self.seq_lens[slot]),
+                             int(self.seq_lens[slot]) + k):
+                col = pos // self.bs
+                if pos % self.bs == 0 and self.tables[slot, col] == 0:
+                    blk = self.free_blocks.popleft()
+                    self.reserved -= 1
+                    self.slot_req[slot]._growth_left -= 1
+                    self.tables[slot, col] = blk
+        param_vals = [self._sd[k]._value for k in self._keys]
+        saved = dict((kk, self._sd[kk]._value) for kk in self._keys)
+        try:
+            if k == 1:
+                greedy, logits, self.pools = self._decode_program()(
+                    param_vals, self.pools, jnp.asarray(self.tables),
+                    jnp.asarray(self.seq_lens),
+                    jnp.asarray(self.last_tok))
+                toks = np.asarray(greedy)[:, None]
+            else:
+                logits = None
+                toks, self.pools = self._decode_multi_program(k)(
+                    param_vals, self.pools, jnp.asarray(self.tables),
+                    jnp.asarray(self.seq_lens),
+                    jnp.asarray(self.last_tok))
+                toks = np.asarray(toks)
+        finally:
+            for kk, v in saved.items():
+                self._sd[kk]._value = v
+        logits_np = None
+        self.steps += k
+        for slot in active:
+            req = self.slot_req[slot]
+            self.seq_lens[slot] += k
+            self.last_tok[slot] = int(toks[slot, -1])
+            for j in range(k):
+                if req.done:
+                    break        # post-eos tokens are discarded (the
+                                 # compiled tick keeps decoding; the cache
+                                 # rows die with the eviction)
+                if req.do_sample:
+                    if logits_np is None:
+                        logits_np = np.asarray(logits)
+                    tok = req._sample(logits_np[slot])
+                    self.last_tok[slot] = tok
+                else:
+                    tok = int(toks[slot, j])
+                req.output_ids.append(tok)
+                self.tokens_out += 1
+                self._maybe_finish(req, tok)
+        return True
+
+    def _tick_size(self, active) -> int:
+        """Steps this tick may batch: bounded by the configured tick
+        size, every active request's remaining budget (over-decoding
+        past a budget would outrun its block reservation), and k=1
+        whenever host-side sampling is in play."""
+        k = self.steps_per_tick
+        for slot in active:
+            req = self.slot_req[slot]
+            if req.do_sample:
+                return 1
+            k = min(k, req.max_new_tokens - len(req.output_ids))
+        # exactly two compiled variants: the full tick and the k=1 tail
+        # (a mid-run compile of an intermediate size costs more than the
+        # single steps it would save)
+        return k if k >= self.steps_per_tick else 1
+
+    def run(self) -> List[Request]:
+        """Drive until every queued request finishes; returns them in
+        completion order."""
+        while self.step() or self.waiting or self._active_slots():
+            pass
+        # final eviction sweep
+        for slot in list(range(self.B)):
+            if self.slot_req[slot] is not None and self.slot_req[slot].done:
+                self._evict(slot)
+        return self.finished
+
+    def stats(self) -> dict:
+        return {"steps": self.steps, "tokens_out": self.tokens_out,
+                "free_blocks": len(self.free_blocks),
+                "reserved": self.reserved,
+                "active": len(self._active_slots()),
+                "waiting": len(self.waiting)}
