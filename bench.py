@@ -60,6 +60,7 @@ _REGRESSION_KEYS = {
     "lenet_train": "jit_imgs_per_sec",
     "resnet50_train": "imgs_per_sec",
     "bert_base_mlm_train": "tokens_per_sec",
+    "gpt350m_train": "tokens_per_sec",
     "gpt124m_decode": "paged_tokens_per_sec",
 }
 
@@ -315,6 +316,67 @@ def bench_gpt124m():
          "flops_per_token": fpt, "mfu": round(mfu, 4),
          "loss": float(loss.item())})
     return tokens_per_sec, mfu
+
+
+def bench_gpt350m():
+    """Medium rung toward BASELINE config 4 (1.3B): GPT-350M
+    (hidden 1024 x 24 layers), B=8 S=1024, AMP O1 bf16, selective remat
+    (`dots_with_no_batch_dims_saveable`: matmul outputs saved, elementwise
+    recomputed — full remat measured 1.5pt MFU lower, no-remat OOMs at
+    this batch).  Same step/measurement shape as the 124M headline."""
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_350m
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if not on_tpu:
+        return
+    B, S = 8, 1024
+    paddle.seed(0)
+    cfg = gpt3_350m(use_recompute=True,
+                    recompute_policy="dots_with_no_batch_dims_saveable")
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+
+    def train_step(ids, labels):
+        with amp.auto_cast(True, level="O1", dtype="bfloat16"):
+            loss = model.compute_loss(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    t0 = time.perf_counter()
+    loss = step(ids, labels)
+    np.asarray(loss._value)
+    compile_s = time.perf_counter() - t0
+
+    def run_steps(n):
+        for _ in range(n):
+            step(ids, labels)
+
+    sync = lambda: model.gpt.ln_f.bias._value  # noqa: E731
+    dt = marginal_step_s(run_steps, sync, 3, 13, reps=3)
+    tokens_per_sec = B * S / dt
+    fpt = model.flops_per_token(S)
+    mfu = tokens_per_sec * fpt / peak_flops(dev)
+    log({"bench": "gpt350m_train", "device": str(dev.device_kind),
+         "batch": B, "seq": S, "step_ms": round(dt * 1e3, 2),
+         "compile_s": round(compile_s, 1),
+         "tokens_per_sec": round(tokens_per_sec, 1),
+         "params_m": round(model.num_params() / 1e6, 1),
+         "mfu": round(mfu, 4), "loss": float(loss.item())})
 
 
 def bench_lenet():
@@ -629,7 +691,7 @@ def bench_serving():
     mk = lambda L, n: Request(  # noqa: E731
         rng.randint(1, cfg.vocab_size, (L,)), max_new_tokens=n)
     # warm every program the timed run will hit: both prefill buckets
-    # and the tick-size ladder (8/4/2/1 decode scans)
+    # and both decode variants (the full k-step tick and the k=1 tail)
     # budgets of 34 = 1 prefill token + 4 full ticks + a k=1 tail, so
     # BOTH decode programs compile before the timed region
     eng.add_request(mk(96 if on_tpu else 24, 34))
@@ -778,6 +840,7 @@ def main():
     _run_rung("gpt124m_decode_32k_config", bench_decode_longctx, 150)
     _run_rung("resnet50_train", bench_resnet50, 380)
     _run_rung("bert_base_mlm_train", bench_bert_base, 500)
+    _run_rung("gpt350m_train", bench_gpt350m, 450)
     _run_rung("ring_attention_8k", bench_ring_attention, 120)
     _run_rung("serving_continuous_batching", bench_serving, 240)
     check_regressions()

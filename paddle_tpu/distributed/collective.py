@@ -364,6 +364,10 @@ def _store_object_exchange(obj, op_name, group, src_only=None):
     # seq counters are PER (op, group): a member and a non-member of some
     # subgroup must still agree on the sequence numbers of every group
     # they are BOTH in (a global counter would desynchronize them)
+    if src_only is not None and src_only not in ranks:
+        raise ValueError(
+            f"{op_name}: src rank {src_only} is not in the group "
+            f"{sorted(ranks)}")
     gkey = (op_name, tuple(sorted(ranks)))
     seqs = _store_state.setdefault("obj_seq", {})
     seq = seqs.get(gkey, 0)

@@ -61,11 +61,18 @@ def _is_jax_value(v) -> bool:
 
 
 def recompute(function: Callable, *args, preserve_rng_state: bool = True,
-              use_reentrant: bool = True, **kwargs) -> Any:
+              use_reentrant: bool = True, policy: str = None,
+              **kwargs) -> Any:
     """Run ``function(*args)`` with activation recomputation in backward.
 
     function: a Layer or any callable over Tensors.  Gradients flow to both
-    the Tensor arguments and the parameters/closure Tensors read inside."""
+    the Tensor arguments and the parameters/closure Tensors read inside.
+
+    policy: None = full recompute (Megatron "full" granularity); a string
+    names a `jax.checkpoint_policies` member (e.g.
+    "dots_with_no_batch_dims_saveable" — keep matmul outputs, recompute
+    only the cheap elementwise work: the reference's selective
+    recompute_granularity at a fraction of full remat's extra FLOPs)."""
     from ...nn import Layer
 
     if isinstance(function, Layer):
@@ -96,7 +103,10 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True,
                              for o in out)
             return out._value if isinstance(out, Tensor) else out
 
-        return jax.checkpoint(pure)(s_args, s_closure)
+        ckpt_kwargs = {}
+        if policy is not None:
+            ckpt_kwargs["policy"] = getattr(jax.checkpoint_policies, policy)
+        return jax.checkpoint(pure, **ckpt_kwargs)(s_args, s_closure)
 
     op = registry.OpDef("recompute_region", fwd, None, ("fused",))
     return registry.dispatch(op.name, list(args) + closure, {}, op)

@@ -117,7 +117,9 @@ class ServingEngine:
         nh = cfg.num_heads
         hd = cfg.hidden_size // nh
         self.nh, self.hd = nh, hd
-        dtype = model.state_dict()[sorted(model.state_dict())[0]]._value.dtype
+        self._sd = model.state_dict()
+        self._keys = sorted(self._sd)
+        dtype = self._sd[self._keys[0]]._value.dtype
         # physical pools per layer; block 0 is the pad/scratch block
         self.pools = [
             (jnp.zeros((nh, num_blocks + 1, block_size, hd), dtype),
@@ -135,8 +137,6 @@ class ServingEngine:
         self.finished: List[Request] = []
         self.steps = 0
         self.tokens_out = 0
-        self._sd = model.state_dict()
-        self._keys = sorted(self._sd)
         self.steps_per_tick = max(1, int(steps_per_tick))
         self._decode_fn = None
         self._decode_multi_fns = {}
@@ -145,13 +145,8 @@ class ServingEngine:
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
         from ..models.kv_cache import PagedKVCache
-        views = []
-        for k, v in pools:
-            c = PagedKVCache.__new__(PagedKVCache)
-            c.bs, c.k, c.v, c.tables, c.seq_lens = (
-                self.bs, k, v, tables, seq_lens)
-            views.append(c)
-        return views
+        return [PagedKVCache.from_parts(k, v, tables, seq_lens, self.bs)
+                for k, v in pools]
 
     def _bind(self, param_vals):
         for k, v in zip(self._keys, param_vals):

@@ -43,6 +43,13 @@ class GPTConfig:
     intermediate_size: int = 0  # 0 -> 4*hidden
     dropout: float = 0.0
     use_recompute: bool = False
+    # remat every k-th block (1 = all blocks, Megatron "full" granularity;
+    # k>1 trades activation memory back for recompute FLOPs — the
+    # reference's recompute_granularity/interval knob on GPT configs)
+    recompute_interval: int = 1
+    # jax.checkpoint_policies member name for selective remat (None =
+    # full recompute inside each checkpointed block)
+    recompute_policy: str = None
     tensor_parallel: bool = False
     # GPT-MoE: replace the MLP of every `moe_every_n_layers`-th block with
     # a mixture of experts (0 experts = dense); shard ExpertMLP weights
@@ -226,14 +233,18 @@ class GPTModel(nn.Layer):
         if self.cfg.use_recompute and self.training:
             from ..distributed.fleet import recompute
             from ..incubate.distributed.models.moe import MoELayer
-            for block in self.blocks:
+            k = max(1, self.cfg.recompute_interval)
+            for i, block in enumerate(self.blocks):
                 if isinstance(block.mlp, MoELayer):
                     # the gate's aux loss leaves the block as an attribute,
                     # which cannot cross a jax.checkpoint boundary — MoE
                     # blocks run un-checkpointed (dense blocks still remat)
                     x = block(x)
+                elif i % k == 0:
+                    x = recompute(block, x,
+                                  policy=self.cfg.recompute_policy)
                 else:
-                    x = recompute(block, x)
+                    x = block(x)
         else:
             for block in self.blocks:
                 x = block(x)

@@ -134,6 +134,15 @@ class PagedKVCache:
                        ).reshape(batch, nb)
         self.seq_lens = jnp.zeros((batch,), jnp.int32)
 
+    @classmethod
+    def from_parts(cls, k, v, tables, seq_lens, block_size):
+        """The one constructor for views over existing pools (used by the
+        pytree unflattener and the serving engine's per-call views)."""
+        c = cls.__new__(cls)
+        c.k, c.v, c.tables, c.seq_lens, c.bs = k, v, tables, seq_lens, \
+            block_size
+        return c
+
     def update_and_attend(self, q, k, v):
         """q/k/v: jnp [B, s, nh, hd] (post-RoPE).  s == 1 -> paged decode
         kernel; s > 1 -> bulk prefill write + dense causal attention
@@ -187,10 +196,7 @@ def _paged_flatten(c):
 
 
 def _paged_unflatten(bs, children):
-    c = PagedKVCache.__new__(PagedKVCache)
-    c.k, c.v, c.tables, c.seq_lens = children
-    c.bs = bs
-    return c
+    return PagedKVCache.from_parts(*children, block_size=bs)
 
 
 jax.tree_util.register_pytree_node(

@@ -221,3 +221,32 @@ def test_gpt_moe_with_recompute_trains():
     assert float(np.asarray(aux._value)) > 0
     with pytest.raises(ValueError):
         GPTConfig(moe_num_experts=2, moe_every_n_layers=0)
+
+
+def test_gpt_selective_recompute_parity():
+    """recompute_interval and recompute_policy change only memory/FLOPs,
+    never the math: identical loss + grads vs no-remat."""
+    import numpy as np
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 1024, (2, 32)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, 1024, (2, 32)).astype(np.int32))
+
+    losses, grads = [], []
+    for kw in (dict(use_recompute=False),
+               dict(use_recompute=True),
+               dict(use_recompute=True, recompute_interval=2),
+               dict(use_recompute=True,
+                    recompute_policy="dots_with_no_batch_dims_saveable")):
+        paddle.seed(7)
+        m = GPTForCausalLM(gpt3_tiny(num_layers=4, **kw))
+        m.train()
+        loss = m.compute_loss(ids, labels)
+        loss.backward()
+        losses.append(float(loss))
+        grads.append(np.asarray(m.gpt.blocks[0].attn.qkv.weight.grad._value))
+    for l in losses[1:]:
+        np.testing.assert_allclose(l, losses[0], rtol=1e-6)
+    for g in grads[1:]:
+        np.testing.assert_allclose(g, grads[0], rtol=2e-5, atol=2e-6)
