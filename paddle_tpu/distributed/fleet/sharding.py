@@ -18,10 +18,13 @@ latency-hiding scheduler's job.
 Offload (the reference's ZeRO-Offload `offload=True`): optimizer state
 LIVES in host memory between steps via jax's `memory_kind="pinned_host"`
 shardings; `step()` stages it to device for the update and back after —
-the TPU-native equivalent of the reference's CPU-side Adam.  Offload is
-an EAGER-path feature (the per-step host<->device staging is the cost
-model); under `to_static` capture use plain stage 1-3 sharding, which
-keeps state in HBM.
+the TPU-native equivalent of the reference's CPU-side Adam.  The
+host<->device staging also lowers inside `to_static` capture (see
+`_migrate_state`), but whether the post-step host pin sticks on the
+compiled program's outputs is backend-dependent: XLA:CPU ignores host
+placement annotations, TPU honors them — between compiled steps on CPU
+the state stays device-resident, so the offload cost model is the
+eager-step path.
 """
 
 from __future__ import annotations
@@ -170,11 +173,32 @@ class DygraphShardingOptimizer:
 
     def _migrate_state(self, memory_kind):
         """Move every accumulator to `memory_kind` (None = the backend's
-        default device memory), keeping its mesh layout."""
+        default device memory), keeping its mesh layout.
+
+        Works under trace too (whole-step `to_static` capture): a traced
+        accumulator's layout comes from the sharding remembered at its
+        last concrete sighting, and the move lowers to an in-program
+        memory-space transfer — host-pinned state enters the compiled
+        step, computes in device memory.  (Whether the post-step pin back
+        to host sticks is backend-dependent: XLA:CPU ignores host
+        placement annotations; on TPU the transfer is real.)"""
         target = memory_kind or jax.local_devices()[0].default_memory().kind
-        for accs in self._inner._accumulators.values():
+        shardings = getattr(self, "_acc_shardings", None)
+        if shardings is None:
+            shardings = self._acc_shardings = {}
+        for name, accs in self._inner._accumulators.items():
             for key, arr in list(accs.items()):
+                if isinstance(arr, jax.core.Tracer):
+                    sh0 = shardings.get((name, key))
+                    if sh0 is None:
+                        continue   # never seen concrete: layout unknown
+                    accs[key] = jax.device_put(
+                        arr, NamedSharding(sh0.mesh, sh0.spec,
+                                           memory_kind=target))
+                    continue
                 sh = getattr(arr, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    shardings[(name, key)] = sh
                 if sh is None or getattr(sh, "memory_kind", None) == target:
                     continue
                 if isinstance(sh, NamedSharding):
