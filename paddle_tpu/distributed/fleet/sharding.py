@@ -216,10 +216,21 @@ class DygraphShardingOptimizer:
             # the state LIVES in host memory between steps (ZeRO-Offload,
             # ref group_sharded_stage3.py offload=True): stage it into
             # device memory for the update, push it back after — the
-            # device-resident window is one step's worth of state
-            self._migrate_state(None)
-            self._inner.step()
-            self._migrate_state("pinned_host")
+            # device-resident window is one step's worth of state.
+            # Snapshot/rollback keeps an aborted TRACE (shape error,
+            # interrupt) from leaving escaped tracers in the persistent
+            # accumulator stores.
+            snap = {name: dict(store) for name, store
+                    in self._inner._accumulators.items()}
+            try:
+                self._migrate_state(None)
+                self._inner.step()
+                self._migrate_state("pinned_host")
+            except BaseException:
+                for name, store in self._inner._accumulators.items():
+                    store.clear()
+                    store.update(snap.get(name, {}))
+                raise
         else:
             self._inner.step()
 

@@ -123,6 +123,11 @@ def test_store_cross_process():
 DP_SCRIPT = r"""
 import json, os, pickle, sys
 sys.path.insert(0, os.environ["REPO_DIR"])
+# force CPU RELIABLY: the axon plugin overrides JAX_PLATFORMS=cpu from
+# the environment, and two workers racing to open the single tunneled
+# TPU can wedge in make_c_api_client when the tunnel is busy
+import jax
+jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import paddle_tpu.distributed as dist
 
@@ -309,7 +314,8 @@ def test_elastic_membership_registry_and_watch():
 
 ELASTIC_RESUME_SCRIPT = r"""
 import json, os, sys
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")   # axon overrides the env var
 import numpy as np
 import paddle_tpu as paddle
 import paddle_tpu.distributed.checkpoint as dist_cp
