@@ -391,3 +391,279 @@ def _addmm(input, x, y, beta=1.0, alpha=1.0):
 @register_decomp("lerp")
 def _lerp(x, y, weight):
     return x + weight * (y - x)
+
+
+# -------------------------------------------- round-5 corpus widening
+# Parity: the remainder of `paddle/fluid/primitive/composite/composite.h`
+# (add_n/any/flatten/index_sample/p_norm/reciprocal/square/squeeze/stack/
+# unsqueeze/...) plus the loss composites the reference decomposes for
+# higher-order AD (`fluid/primitive/rule/vjp/details.h`).  Every rule name
+# is a DISPATCHED registry op and the signature mirrors the registered
+# implementation, so `decomposition.enabled(name)` substitutes cleanly.
+
+def _reduce(out, reduction):
+    import paddle_tpu as paddle
+    if reduction == "mean":
+        return paddle.mean(out)
+    if reduction == "sum":
+        return paddle.sum(out)
+    return out
+
+
+@register_decomp("add_n")
+def _add_n(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_decomp("any")
+def _any(x, axis=None, keepdim=False):
+    import paddle_tpu as paddle
+    ints = paddle.cast(x, "int32")
+    return paddle.cast(paddle.max(ints, axis=axis, keepdim=keepdim) > 0,
+                       "bool")
+
+
+@register_decomp("all")
+def _all(x, axis=None, keepdim=False):
+    import paddle_tpu as paddle
+    ints = paddle.cast(x, "int32")
+    return paddle.cast(paddle.min(ints, axis=axis, keepdim=keepdim) > 0,
+                       "bool")
+
+
+@register_decomp("clip")
+def _clip(x, min=None, max=None):  # noqa: A002
+    import paddle_tpu as paddle
+    if min is not None:
+        x = paddle.maximum(x, paddle.full_like(x, min))
+    if max is not None:
+        x = paddle.minimum(x, paddle.full_like(x, max))
+    return x
+
+
+@register_decomp("reciprocal")
+def _reciprocal(x):
+    return 1.0 / x
+
+
+@register_decomp("square")
+def _square(x):
+    return x * x
+
+
+@register_decomp("flatten")
+def _flatten(v, shape):
+    import paddle_tpu as paddle
+    return paddle.reshape(v, shape)
+
+
+@register_decomp("squeeze")
+def _squeeze(v, axis=None):
+    import paddle_tpu as paddle
+    shape = list(v.shape)
+    if axis is None:
+        new = [s for s in shape if s != 1]
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+        axes = {a % len(shape) for a in axes}
+        new = [s for i, s in enumerate(shape) if not (i in axes and s == 1)]
+    return paddle.reshape(v, new)
+
+
+@register_decomp("unsqueeze")
+def _unsqueeze(v, axis):
+    import paddle_tpu as paddle
+    shape = list(v.shape)
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    # jnp.expand_dims semantics: every axis (incl. negatives) resolves
+    # against the FINAL output rank
+    final = len(shape) + len(axes)
+    for a in sorted(a % final for a in axes):
+        shape.insert(a, 1)
+    return paddle.reshape(v, shape)
+
+
+@register_decomp("stack")
+def _stack(vs, axis=0):
+    import paddle_tpu as paddle
+    return paddle.concat([decompose("unsqueeze", v, axis=axis)
+                          for v in vs], axis=axis)
+
+
+@register_decomp("index_sample")
+def _index_sample(x, index):
+    import paddle_tpu as paddle
+    return paddle.take_along_axis(x, index, axis=1)
+
+
+@register_decomp("p_norm")
+def _p_norm(x, p=2, axis=None, keepdim=False):
+    import paddle_tpu as paddle
+    if p == "nuc":
+        # nuclear norm = sum of singular values (mirrors the fused
+        # kernel's SVD branch)
+        _, s, _ = paddle.linalg.svd(x)
+        return paddle.sum(s, axis=-1)
+    if axis is None:
+        ndim = len(x.shape)
+        out = _p_norm(paddle.reshape(x, [-1]), p=p, axis=0, keepdim=False)
+        if keepdim:   # fused kernel keeps EVERY reduced dim as 1
+            out = paddle.reshape(out, [1] * ndim)
+        return out
+    if p == "fro" or p == 2:
+        return paddle.sqrt(paddle.sum(x * x, axis=axis, keepdim=keepdim))
+    if p == 1:
+        return paddle.sum(paddle.abs(x), axis=axis, keepdim=keepdim)
+    if p == float("inf"):
+        return paddle.max(paddle.abs(x), axis=axis, keepdim=keepdim)
+    if p == float("-inf"):
+        return paddle.min(paddle.abs(x), axis=axis, keepdim=keepdim)
+    if p == 0:
+        return paddle.sum(paddle.cast(x != 0, x.dtype), axis=axis,
+                          keepdim=keepdim)
+    return paddle.pow(paddle.sum(paddle.pow(paddle.abs(x), p), axis=axis,
+                                 keepdim=keepdim), 1.0 / p)
+
+
+@register_decomp("dist")
+def _dist(a, b, p=2):
+    return decompose("p_norm", a - b, p=p, axis=None, keepdim=False)
+
+
+@register_decomp("softsign")
+def _softsign(x):
+    import paddle_tpu as paddle
+    return x / (1.0 + paddle.abs(x))
+
+
+@register_decomp("thresholded_relu")
+def _thresholded_relu(x, threshold=1.0):
+    import paddle_tpu as paddle
+    return paddle.where(x > threshold, x, paddle.zeros_like(x))
+
+
+@register_decomp("glu")
+def _glu(x, axis=-1):
+    import paddle_tpu as paddle
+    a, b = paddle.split(x, 2, axis=axis)
+    return a * decompose("sigmoid", b)
+
+
+@register_decomp("cosine_similarity")
+def _cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    import paddle_tpu as paddle
+    dot = paddle.sum(x1 * x2, axis=axis)
+    n1 = paddle.sqrt(paddle.sum(x1 * x1, axis=axis))
+    n2 = paddle.sqrt(paddle.sum(x2 * x2, axis=axis))
+    return dot / paddle.maximum(n1 * n2, paddle.full_like(n1, eps))
+
+
+@register_decomp("label_smooth")
+def _label_smooth(label, epsilon=0.1):
+    return label * (1.0 - epsilon) + epsilon / label.shape[-1]
+
+
+# ----- loss composites (signatures mirror nn/functional/loss.py) -----
+
+@register_decomp("mse_loss")
+def _mse_loss(x, y, reduction="mean"):
+    return _reduce((x - y) * (x - y), reduction)
+
+
+@register_decomp("l1_loss")
+def _l1_loss(x, y, reduction="mean"):
+    import paddle_tpu as paddle
+    return _reduce(paddle.abs(x - y), reduction)
+
+
+@register_decomp("smooth_l1_loss")
+def _smooth_l1_loss(x, y, reduction="mean", delta=1.0):
+    import paddle_tpu as paddle
+    d = paddle.abs(x - y)
+    per = paddle.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _reduce(per, reduction)
+
+
+@register_decomp("kl_div")
+def _kl_div(x, y, reduction="mean", log_target=False):
+    import paddle_tpu as paddle
+    if log_target:
+        per = paddle.exp(y) * (y - x)
+    else:
+        per = y * (paddle.log(paddle.maximum(
+            y, paddle.full_like(y, 1e-12))) - x)
+    return _reduce(per, reduction)
+
+
+@register_decomp("log_loss")
+def _log_loss(pred, label, epsilon=1e-4):
+    import paddle_tpu as paddle
+    return (-label * paddle.log(pred + epsilon)
+            - (1.0 - label) * paddle.log(1.0 - pred + epsilon))
+
+
+@register_decomp("margin_ranking_loss")
+def _margin_ranking_loss(x1, x2, y, margin=0.0, reduction="mean"):
+    import paddle_tpu as paddle
+    per = paddle.maximum(-y * (x1 - x2) + margin,
+                         paddle.zeros_like(x1))
+    return _reduce(per, reduction)
+
+
+@register_decomp("hinge_embedding_loss")
+def _hinge_embedding_loss(x, y, margin=1.0, reduction="mean"):
+    import paddle_tpu as paddle
+    neg = paddle.maximum(margin - x, paddle.zeros_like(x))
+    per = paddle.where(y == 1, x, neg)
+    return _reduce(per, reduction)
+
+
+@register_decomp("cosine_embedding_loss")
+def _cosine_embedding_loss(x1, x2, y, margin=0.0, reduction="mean"):
+    import paddle_tpu as paddle
+    cos = decompose("cosine_similarity", x1, x2, axis=-1, eps=1e-12)
+    per = paddle.where(y == 1, 1.0 - cos,
+                       paddle.maximum(cos - margin,
+                                      paddle.zeros_like(cos)))
+    return _reduce(per, reduction)
+
+
+@register_decomp("triplet_margin_loss")
+def _triplet_margin_loss(a, p, n, margin=1.0, pnorm=2, reduction="mean"):
+    import paddle_tpu as paddle
+    dp = decompose("p_norm", a - p, p=pnorm, axis=-1, keepdim=False)
+    dn = decompose("p_norm", a - n, p=pnorm, axis=-1, keepdim=False)
+    per = paddle.maximum(dp - dn + margin, paddle.zeros_like(dp))
+    return _reduce(per, reduction)
+
+
+@register_decomp("nll_loss")
+def _nll_loss(logp, label, weight=None, ignore_index=-100,
+              reduction="mean"):
+    import paddle_tpu as paddle
+    valid = label != ignore_index
+    safe = paddle.cast(paddle.where(valid, label,
+                                    paddle.zeros_like(label)), "int32")
+    per = -paddle.take_along_axis(
+        logp, decompose("unsqueeze", safe, axis=1), axis=1)
+    per = decompose("squeeze", per, axis=1)
+    if weight is not None:
+        w = paddle.gather(weight, paddle.reshape(safe, [-1]))
+        w = paddle.reshape(w, safe.shape)
+    else:
+        w = None
+    per = paddle.where(valid, per * (w if w is not None else 1.0),
+                       paddle.zeros_like(per))
+    if reduction == "mean":
+        if w is not None:
+            denom = paddle.sum(paddle.where(
+                valid, w, paddle.zeros_like(w)))
+        else:
+            denom = paddle.maximum(
+                paddle.sum(paddle.cast(valid, per.dtype)),
+                paddle.full_like(paddle.sum(per), 1.0))
+        return paddle.sum(per) / denom
+    return _reduce(per, reduction)

@@ -16,7 +16,10 @@ import numpy as np
 from ...io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
 
 
 class MNIST(Dataset):
@@ -83,14 +86,204 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    pass
+    """100-class CIFAR; synthetic fallback mirrors the label space."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        super().__init__(data_file, mode, transform, download, backend,
+                         synthetic_size)
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        self.labels = rng.randint(0, 100, len(self.labels)).astype(np.int64)
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Sorted walk of image files under root (shared by the folder
+    datasets; one place for extension/validity policy)."""
+    extensions = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+    if is_valid_file is None:
+        is_valid_file = lambda p: p.lower().endswith(extensions)  # noqa: E731
+    found = []
+    for base, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(base, fname)
+            if is_valid_file(path):
+                found.append(path)
+    return found
+
+
+def _pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        img = Image.open(f)
+        return img.convert("RGB")
 
 
 class DatasetFolder(Dataset):
+    """Parity: `python/paddle/vision/datasets/folder.py` DatasetFolder —
+    samples arranged as root/class_x/xxx.ext; classes discovered from the
+    subdirectory names in sorted order."""
+
     def __init__(self, root, loader=None, extensions=None, transform=None,
                  is_valid_file=None):
-        raise NotImplementedError("DatasetFolder needs PIL; planned")
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
 
 
-class ImageFolder(DatasetFolder):
-    pass
+class ImageFolder(Dataset):
+    """Parity: folder.py ImageFolder — a FLAT (unlabelled) image list:
+    every image under root, no class structure, returns [img]."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        self.samples = _scan_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"no valid files under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Parity: `python/paddle/vision/datasets/flowers.py` (102-category
+    Oxford flowers).  Local-file mode reads the official scipy-format
+    label .mat + image tgz when given; the no-network fallback is a
+    deterministic synthetic set with the same shapes/label space."""
+
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.transform = transform
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(
+                f"Flowers mode must be train/valid/test, got {mode!r}")
+        if data_file and os.path.exists(data_file):
+            if not (label_file and setid_file):
+                raise ValueError(
+                    "Flowers with data_file also needs label_file "
+                    "(imagelabels.mat) and setid_file (setid.mat)")
+            self._init_from_files(data_file, label_file, setid_file, mode)
+            return
+        n = synthetic_size or (1020 if mode == "train" else 102)
+        rng = np.random.RandomState({"train": 10, "valid": 11,
+                                     "test": 12}.get(mode, 10))
+        self.labels = (np.arange(n) % self.NUM_CLASSES).astype(np.int64)
+        self.images = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+
+    def _init_from_files(self, data_file, label_file, setid_file, mode):
+        import tarfile
+
+        from scipy.io import loadmat
+        labels = loadmat(label_file)["labels"][0] - 1
+        split_key = {"train": "trnid", "valid": "valid",
+                     "test": "tstid"}[mode]
+        ids = loadmat(setid_file)[split_key][0]
+        self._tar = tarfile.open(data_file)
+        self._names = {int(m.name.split("_")[-1].split(".")[0]): m.name
+                       for m in self._tar.getmembers()
+                       if m.name.endswith(".jpg")}
+        self._ids = [int(i) for i in ids]
+        self.labels = np.asarray([labels[i - 1] for i in self._ids],
+                                 np.int64)
+        self.images = None
+
+    def __getitem__(self, idx):
+        if self.images is not None:
+            img = self.images[idx]
+        else:
+            from PIL import Image
+            f = self._tar.extractfile(self._names[self._ids[idx]])
+            img = np.asarray(Image.open(f).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """Parity: `python/paddle/vision/datasets/voc2012.py` (segmentation:
+    image + per-pixel class mask).  Local-path mode walks a VOCdevkit
+    tree (JPEGImages/ + SegmentationClass/ + ImageSets/Segmentation
+    split lists); fallback is synthetic image/mask pairs with VOC's 21
+    labels (20 classes + background) and 255 ignore borders."""
+
+    NUM_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        if data_file and os.path.isdir(data_file):
+            split = {"train": "train", "valid": "val",
+                     "test": "val"}.get(mode, "train")
+            lst = os.path.join(data_file, "ImageSets", "Segmentation",
+                               split + ".txt")
+            with open(lst) as f:
+                names = [ln.strip() for ln in f if ln.strip()]
+            self._pairs = [
+                (os.path.join(data_file, "JPEGImages", n + ".jpg"),
+                 os.path.join(data_file, "SegmentationClass", n + ".png"))
+                for n in names]
+            self.images = None
+            return
+        n = synthetic_size or (120 if mode == "train" else 30)
+        rng = np.random.RandomState(20 if mode == "train" else 21)
+        self.images = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+        self.masks = rng.randint(0, self.NUM_CLASSES,
+                                 (n, 64, 64)).astype(np.uint8)
+        self.masks[:, 0, :] = 255          # VOC ignore-border label
+        self._pairs = None
+
+    def __getitem__(self, idx):
+        if self.images is not None:
+            img, mask = self.images[idx], self.masks[idx]
+        else:
+            from PIL import Image
+            ip, mp = self._pairs[idx]
+            img = np.asarray(Image.open(ip).convert("RGB"))
+            mask = np.asarray(Image.open(mp))
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, mask.astype(np.int64)
+
+    def __len__(self):
+        return len(self._pairs) if self._pairs is not None \
+            else len(self.images)

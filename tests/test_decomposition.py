@@ -1,0 +1,125 @@
+"""Decomposition corpus: eager-vs-decomposed parity for every round-5
+rule, driven through `decomposition.enabled` (the dispatch-seam
+substitution of the reference's decompose pass,
+`paddle/fluid/primitive/composite/composite.h` +
+`python/paddle/decomposition/decomp.py:177`)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import decomposition
+from paddle_tpu.nn import functional as F
+
+
+def t(shape, seed=0, scale=1.0, positive=False):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*shape).astype(np.float32) * scale
+    if positive:
+        a = np.abs(a) + 0.1
+    return paddle.to_tensor(a)
+
+
+# (rule name, callable) — callable runs the PUBLIC api whose dispatch the
+# rule substitutes; parity: enabled(name) == fused
+CASES = {
+    "add_n": lambda: paddle.add_n([t((3, 4)), t((3, 4), 1), t((3, 4), 2)]),
+    "any": lambda: paddle.any(t((3, 4)) > 0, axis=1),
+    "all": lambda: paddle.all(t((3, 4)) > -2, axis=1, keepdim=True),
+    "clip": lambda: paddle.clip(t((3, 4)), -0.5, 0.5),
+    "reciprocal": lambda: paddle.reciprocal(t((3, 4), positive=True)),
+    "square": lambda: paddle.square(t((3, 4))),
+    "flatten": lambda: paddle.flatten(t((2, 3, 4)), 1, 2),
+    "squeeze": lambda: paddle.squeeze(t((2, 1, 4)), 1),
+    "unsqueeze": lambda: paddle.unsqueeze(t((2, 4)), [0, 2]),
+    "stack": lambda: paddle.stack([t((2, 3)), t((2, 3), 1)], axis=1),
+    "index_sample": lambda: paddle.index_sample(
+        t((3, 5)), paddle.to_tensor(
+            np.array([[0, 2], [1, 1], [4, 3]], np.int64))),
+    "p_norm": lambda: paddle.norm(t((3, 4)), p=3, axis=1),
+    "dist": lambda: paddle.dist(t((3, 4)), t((3, 4), 1), p=2),
+    "softsign": lambda: F.softsign(t((3, 4))),
+    "thresholded_relu": lambda: F.thresholded_relu(t((3, 4)), 0.3),
+    "glu": lambda: F.glu(t((3, 8)), axis=-1),
+    "cosine_similarity": lambda: F.cosine_similarity(
+        t((3, 4)), t((3, 4), 1), axis=1),
+    "label_smooth": lambda: F.label_smooth(
+        t((3, 4), positive=True), epsilon=0.1),
+    "mse_loss": lambda: F.mse_loss(t((3, 4)), t((3, 4), 1)),
+    "l1_loss": lambda: F.l1_loss(t((3, 4)), t((3, 4), 1),
+                                 reduction="sum"),
+    "smooth_l1_loss": lambda: F.smooth_l1_loss(t((3, 4)), t((3, 4), 1),
+                                               delta=0.7),
+    "kl_div": lambda: F.kl_div(t((3, 4)), t((3, 4), 1, positive=True),
+                               reduction="sum"),
+    "log_loss": lambda: F.log_loss(
+        paddle.to_tensor(np.random.RandomState(2).rand(3, 1)
+                         .astype(np.float32)),
+        paddle.to_tensor(np.random.RandomState(3).randint(0, 2, (3, 1))
+                         .astype(np.float32))),
+    "margin_ranking_loss": lambda: F.margin_ranking_loss(
+        t((4,)), t((4,), 1),
+        paddle.to_tensor(np.array([1, -1, 1, -1], np.float32)),
+        margin=0.2),
+    "hinge_embedding_loss": lambda: F.hinge_embedding_loss(
+        t((4,), positive=True),
+        paddle.to_tensor(np.array([1, -1, 1, -1], np.float32))),
+    "cosine_embedding_loss": lambda: F.cosine_embedding_loss(
+        t((3, 4)), t((3, 4), 1),
+        paddle.to_tensor(np.array([1, -1, 1], np.float32)),
+        margin=0.1),
+    "triplet_margin_loss": lambda: F.triplet_margin_loss(
+        t((3, 4)), t((3, 4), 1), t((3, 4), 2)),
+    "nll_loss": lambda: F.nll_loss(
+        F.log_softmax(t((4, 5)), axis=1),
+        paddle.to_tensor(np.array([0, 2, 4, 1], np.int64))),
+    "nll_loss_weighted": lambda: F.nll_loss(
+        F.log_softmax(t((4, 5)), axis=1),
+        paddle.to_tensor(np.array([0, 2, 4, 1], np.int64)),
+        weight=t((5,), positive=True)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_decomposed_matches_fused(name):
+    rule = name.split("_weighted")[0]
+    want = CASES[name]()
+    with decomposition.enabled(rule):
+        got = CASES[name]()
+    np.testing.assert_allclose(np.asarray(got._value),
+                               np.asarray(want._value),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_corpus_size():
+    """VERDICT r4 #9: corpus must reach >= 60 wired rules."""
+    assert len(decomposition.list_decomps()) >= 60
+
+
+def test_decomposed_rules_differentiate():
+    """Decomposed composites must keep the eager tape flowing (the
+    higher-order-AD motivation for decomposition)."""
+    x = t((3, 4))
+    x.stop_gradient = False
+    with decomposition.enabled("smooth_l1_loss", "p_norm"):
+        loss = F.smooth_l1_loss(x, t((3, 4), 1)) + paddle.norm(x, p=3)
+    loss.backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+@pytest.mark.parametrize("case", [
+    lambda: paddle.norm(t((3, 4)), p=2, keepdim=True),      # axis=None+keepdim
+    lambda: paddle.unsqueeze(t((2, 4)), [0, -1]),           # mixed-sign axes
+])
+def test_decomp_shape_edge_cases(case):
+    """Fused-vs-decomposed SHAPE parity on the edges review caught:
+    p_norm(axis=None, keepdim=True) and unsqueeze with negative axes."""
+    want = case()
+    name = "p_norm" if want.ndim == 2 and want.shape[0] == 1 else "unsqueeze"
+    with decomposition.enabled("p_norm", "unsqueeze"):
+        got = case()
+    assert tuple(got.shape) == tuple(want.shape), name
+    np.testing.assert_allclose(np.asarray(got._value),
+                               np.asarray(want._value), rtol=2e-5,
+                               atol=2e-6)

@@ -139,3 +139,91 @@ def test_transforms():
     out = t(img)
     assert out.shape == [1, 28, 28]
     assert float(out.numpy().min()) >= -1.001
+
+
+# ------------------------------------------ round-5 dataset families
+
+def test_dataset_folder_discovers_classes(tmp_path):
+    """DatasetFolder (ref folder.py): root/class_x/*.png with sorted
+    class discovery and PIL loading."""
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import DatasetFolder
+    for cls, color in (("cats", (255, 0, 0)), ("dogs", (0, 255, 0))):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.new("RGB", (8, 8), color).save(d / f"{i}.png")
+        (d / "notes.txt").write_text("not an image")
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cats", "dogs"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert label == 0 and np.asarray(img).shape == (8, 8, 3)
+    img, label = ds[5]
+    assert label == 1
+
+
+def test_image_folder_flat_listing(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import ImageFolder
+    for i in range(4):
+        Image.new("RGB", (4, 4), (i * 50, 0, 0)).save(
+            tmp_path / f"im{i}.png")
+    ds = ImageFolder(str(tmp_path),
+                     transform=lambda im: np.asarray(im).mean())
+    assert len(ds) == 4
+    out = ds[3]
+    assert isinstance(out, list) and len(out) == 1
+
+
+def test_flowers_synthetic_and_loader():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.vision.datasets import Flowers
+    ds = Flowers(mode="train", synthetic_size=40)
+    assert len(ds) == 40
+    img, label = ds[7]
+    assert img.shape == (3, 64, 64) and 0 <= label < 102
+    batch = next(iter(DataLoader(ds, batch_size=8)))
+    assert tuple(batch[0].shape) == (8, 3, 64, 64)
+
+
+def test_voc2012_synthetic_masks():
+    from paddle_tpu.vision.datasets import VOC2012
+    ds = VOC2012(mode="train", synthetic_size=12)
+    img, mask = ds[0]
+    assert img.shape == (3, 64, 64)
+    assert mask.shape == (64, 64) and mask.dtype == np.int64
+    labels = set(np.unique(mask).tolist())
+    assert labels <= set(range(21)) | {255}
+    assert 255 in labels            # ignore border present
+
+
+def test_voc2012_local_tree(tmp_path):
+    """Local VOCdevkit layout: split lists + image/mask pairs."""
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import VOC2012
+    (tmp_path / "JPEGImages").mkdir()
+    (tmp_path / "SegmentationClass").mkdir()
+    (tmp_path / "ImageSets" / "Segmentation").mkdir(parents=True)
+    names = ["a1", "a2"]
+    for n in names:
+        Image.new("RGB", (6, 6), (10, 20, 30)).save(
+            tmp_path / "JPEGImages" / f"{n}.jpg")
+        Image.fromarray(np.full((6, 6), 5, np.uint8)).save(
+            tmp_path / "SegmentationClass" / f"{n}.png")
+    (tmp_path / "ImageSets" / "Segmentation" / "train.txt").write_text(
+        "\n".join(names))
+    ds = VOC2012(data_file=str(tmp_path), mode="train")
+    assert len(ds) == 2
+    img, mask = ds[1]
+    assert img.shape == (3, 6, 6) and (np.asarray(mask) == 5).all()
+
+
+def test_cifar100_label_space():
+    from paddle_tpu.vision.datasets import Cifar100
+    ds = Cifar100(mode="train", synthetic_size=300)
+    labels = {ds[i][1] for i in range(300)}
+    assert max(labels) > 10      # actually 100-way, not 10-way

@@ -42,12 +42,24 @@ def test_detect_from_megascale_coordinator():
     assert len(pod["hosts"]) == 4 and pod["rank"] == 2
 
 
-def test_explicit_single_node_wins_on_pod_host():
-    """`--nnodes 1` pins a single-node debug run even on a pod host."""
-    pod = {"hosts": ["h0", "h1"], "rank": 1}
-    args = parse_args(["--nnodes", "1", "train.py"])
-    apply_tpu_pod(args, pod)
-    assert args.nnodes == "1"
+def test_explicit_single_node_wins_on_pod_host(monkeypatch):
+    """`--nnodes 1` pins a single-node debug run even on a pod host: NO
+    pod wiring at all (rank/master untouched), via launch()'s gate."""
+    import paddle_tpu.distributed.launch.main as m
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    calls = []
+    monkeypatch.setattr(m, "detect_tpu_pod",
+                        lambda *a, **k: calls.append(1) or None)
+
+    class _Stop(Exception):
+        pass
+
+    monkeypatch.setattr(m.CollectiveController, "run",
+                        lambda self: (_ for _ in ()).throw(_Stop()))
+    with pytest.raises(_Stop):
+        m.launch(["--nnodes", "1", "train.py"])
+    assert not calls            # detection never even probed
 
 
 def test_detect_from_metadata_server():
