@@ -21,25 +21,26 @@ from ..ops import registry as _registry
 __all__ = ["auto_cast", "amp_guard", "decorate", "FP16_WHITE_LIST",
            "FP16_BLACK_LIST"]
 
-# O1 white list: MXU-bound ops where low precision wins (ref amp_lists.py
-# white_list: conv2d, matmul, mul, ...)
-FP16_WHITE_LIST: Set[str] = {
-    "matmul", "bmm", "mv", "mm", "linear", "conv_nd", "conv_transpose_nd",
-    "einsum", "addmm", "multi_dot", "sdpa", "lstm_cell", "gru_cell",
-    "rnn_scan",
-}
+# O1 white/black lists are DERIVED from the op-spec YAMLs (the single
+# metadata source, ref amp_lists.py white_list/black_list carried in the
+# phi YAML corpus): every entry's `amp: white|black` field feeds these —
+# edit ops/specs/*.yaml, not this module (tests/test_codegen_ops.py
+# enforces the derivation).  Loaded LAZILY via module __getattr__ so
+# `import paddle_tpu` doesn't pay the YAML parse (~0.2s on 1 core);
+# consumers read the lists at first auto_cast/decorate use.
 
-# O1 black list: precision-sensitive ops kept in fp32 (ref black_list:
-# exp, log, softmax, cross_entropy, layer_norm-ish reductions ...)
-FP16_BLACK_LIST: Set[str] = {
-    "exp", "expm1", "log", "log2", "log10", "log1p", "pow", "square",
-    "reciprocal", "rsqrt", "softmax", "log_softmax", "cross_entropy",
-    "bce", "bce_with_logits", "nll_loss", "kl_div", "cumsum", "cumprod",
-    "logsumexp", "p_norm", "layer_norm", "rms_norm", "group_norm",
-    "instance_norm", "batch_norm_apply", "mse_loss", "l1_loss",
-    "sigmoid_focal_loss", "softmax_with_cross_entropy", "erfinv", "cosh",
-    "sinh", "atanh", "acosh", "asinh", "tan", "sum", "mean", "std", "var",
-}
+
+def _load_lists():
+    from ..ops import spec_meta
+    globals()["FP16_WHITE_LIST"] = spec_meta.amp_white()
+    globals()["FP16_BLACK_LIST"] = spec_meta.amp_black()
+
+
+def __getattr__(name):
+    if name in ("FP16_WHITE_LIST", "FP16_BLACK_LIST"):
+        _load_lists()
+        return globals()[name]
+    raise AttributeError(name)
 
 
 class _AmpState(threading.local):
@@ -47,8 +48,8 @@ class _AmpState(threading.local):
         self.enabled = False
         self.level = "O1"
         self.dtype = jnp.bfloat16
-        self.white = FP16_WHITE_LIST
-        self.black = FP16_BLACK_LIST
+        self.white = None   # lazily bound to the YAML-derived lists
+        self.black = None
 
 
 _state = _AmpState()
@@ -81,6 +82,8 @@ class auto_cast:
         self._dtype = _dtypes.convert_dtype(dtype)
         if self._dtype not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16)):
             raise ValueError("amp dtype must be float16 or bfloat16")
+        if "FP16_WHITE_LIST" not in globals():
+            _load_lists()
         self._white = set(FP16_WHITE_LIST)
         self._black = set(FP16_BLACK_LIST)
         if custom_white_list:

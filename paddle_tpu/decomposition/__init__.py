@@ -421,17 +421,17 @@ def _add_n(xs):
 @register_decomp("any")
 def _any(x, axis=None, keepdim=False):
     import paddle_tpu as paddle
-    ints = paddle.cast(x, "int32")
-    return paddle.cast(paddle.max(ints, axis=axis, keepdim=keepdim) > 0,
-                       "bool")
+    nz = paddle.cast(x != 0, "int32")    # truthiness = nonzero, matching
+    return paddle.cast(                  # jnp.any for negatives/floats
+        paddle.max(nz, axis=axis, keepdim=keepdim) > 0, "bool")
 
 
 @register_decomp("all")
 def _all(x, axis=None, keepdim=False):
     import paddle_tpu as paddle
-    ints = paddle.cast(x, "int32")
-    return paddle.cast(paddle.min(ints, axis=axis, keepdim=keepdim) > 0,
-                       "bool")
+    nz = paddle.cast(x != 0, "int32")
+    return paddle.cast(
+        paddle.min(nz, axis=axis, keepdim=keepdim) > 0, "bool")
 
 
 @register_decomp("clip")

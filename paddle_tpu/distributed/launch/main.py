@@ -325,11 +325,12 @@ class CollectiveController:
 
 def launch(argv=None) -> int:
     args = parse_args(argv)
-    # pod detection only runs when the node count is UNSET: an explicit
-    # --nnodes (including --nnodes 1, the single-node debug escape hatch
-    # on a pod host) opts out of ALL pod wiring, and fully explicit
-    # topology also skips the 2s metadata HTTP probe
-    if args.nnodes is None:
+    # pod wiring runs when the node count is unset, or when a multi-node
+    # count still needs its master auto-filled; --nnodes 1 (the
+    # single-node debug escape hatch on a pod host) opts out of ALL pod
+    # wiring, and fully explicit topology skips the metadata probe
+    if args.nnodes is None or (args.master is None
+                               and str(args.nnodes) != "1"):
         pod = detect_tpu_pod()
         if pod is not None:
             apply_tpu_pod(args, pod)

@@ -14,146 +14,10 @@ silently widening the gap.
 
 from __future__ import annotations
 
-# reference op name -> dotted path (relative to paddle_tpu) of the seat.
-# Only ops whose REGISTRY name differs or that live as API functions.
-ALIASES = {
-    "arange": "ops.creation.arange",
-    "batch_norm": "nn.functional.batch_norm",       # batch_norm_apply op
-    "bce_loss": "nn.functional.binary_cross_entropy",
-    "bernoulli": "ops.random_ops.bernoulli",
-    "bicubic_interp": "nn.functional.interpolate",
-    "bilinear_interp": "nn.functional.interpolate",
-    "bincount": "ops.search.bincount",
-    "broadcast_tensors": "ops.manipulation.broadcast_tensors",
-    "channel_shuffle": "nn.functional.channel_shuffle",
-    "check_numerics": "amp.debugging.check_numerics",
-    "conv2d": "nn.functional.conv2d",
-    "conv2d_transpose": "nn.functional.conv2d_transpose",
-    "conv3d": "nn.functional.conv3d",
-    "conv3d_transpose": "nn.functional.conv3d_transpose",
-    "crop": "ops.manipulation.crop",
-    "cross_entropy_with_softmax": "nn.functional.cross_entropy",
-    "cummax": "ops.math.cummax",
-    "cummin": "ops.math.cummin",
-    "decode_jpeg": "vision.ops.decode_jpeg",
-    "depthwise_conv2d": "nn.functional.conv2d",     # groups=C path
-    "distribute_fpn_proposals": "vision.ops.distribute_fpn_proposals",
-    "dropout": "nn.functional.dropout",
-    "eig": "ops.linalg.eig",
-    "eigvals": "ops.linalg.eigvals",
-    "elementwise_pow": "ops.math.pow",
-    "embedding": "nn.functional.embedding",
-    "empty": "ops.creation.empty",
-    "empty_like": "ops.creation.empty_like",
-    "expand": "ops.manipulation.expand",
-    "expand_as": "ops.manipulation.expand_as",
-    "eye": "ops.creation.eye",
-    "fft_c2c": "fft.fft",
-    "fft_c2r": "fft.irfft",
-    "fft_r2c": "fft.rfft",
-    "fill": "ops.creation.full",
-    "flash_attn": "nn.functional.flash_attention",
-    "flash_attn_with_sparse_mask": "nn.functional.scaled_dot_product_attention",
-    "full": "ops.creation.full",
-    "full_like": "ops.creation.full_like",
-    "full_int_array": "ops.creation.full",
-    "full_with_tensor": "ops.creation.full",
-    "flash_attn_unpadded": "nn.functional.scaled_dot_product_attention",
-    # varlen = key-padding-mask path of the flash kernel (pallas_flash)
-    "gaussian": "ops.random_ops.randn",
-    "logspace": "ops.generated_ops.logspace",
-    "merge_selected_rows": "framework.tensor_variants.SelectedRows.merge",
-    "generate_proposals": "vision.ops.generate_proposals",
-    "graph_sample_neighbors": "geometric.sample_neighbors",
-    "gumbel_softmax": "nn.functional.gumbel_softmax",
-    "histogram": "ops.search.histogramdd",
-    "index_add": "ops.manipulation.index_add",
-    "index_select": "ops.manipulation.index_select",
-    "is_empty": "ops.logic.is_empty",
-    "kldiv_loss": "nn.functional.kl_div",
-    "kthvalue": "ops.search.kthvalue",
-    "linear_interp": "nn.functional.interpolate",
-    "linspace": "ops.creation.linspace",
-    "llm_int8_linear": "nn.quant.llm_int8_linear",
-    "logsigmoid": "nn.functional.log_sigmoid",
-    "lstsq": "ops.linalg.lstsq",
-    "lu": "ops.linalg.lu",
-    "masked_select": "ops.search.masked_select",
-    "matrix_nms": "vision.ops.matrix_nms",
-    "matrix_rank": "ops.linalg.matrix_rank",
-    "matrix_rank_tol": "ops.linalg.matrix_rank",
-    "max_pool2d_v2": "nn.functional.max_pool2d",
-    "max_pool3d_with_index": "nn.functional.max_pool3d",
-    "memory_efficient_attention": "nn.functional.scaled_dot_product_attention",
-    "meshgrid": "ops.creation.meshgrid",
-    "multiclass_nms3": "vision.ops.multiclass_nms",
-    "multihead_matmul": "nn.functional.scaled_dot_product_attention",
-    "multinomial": "ops.random_ops.multinomial",
-    "nearest_interp": "nn.functional.interpolate",
-    "nms": "vision.ops.nms",
-    "nonzero": "ops.search.nonzero",
-    "norm": "ops.linalg.norm",
-    "numel": "ops.manipulation.numel",
-    "ones": "ops.creation.ones",
-    "pad": "ops.manipulation.pad",
-    "pixel_shuffle": "nn.functional.pixel_shuffle",
-    "pixel_unshuffle": "nn.functional.pixel_unshuffle",
-    "poisson": "ops.random_ops.poisson",
-    "pool2d": "nn.functional.avg_pool2d",
-    "pool3d": "nn.functional.avg_pool3d",
-    "prelu": "nn.functional.prelu",
-    "randint": "ops.random_ops.randint",
-    "randperm": "ops.random_ops.randperm",
-    "reindex_graph": "geometric.reindex_graph",
-    "remainder": "ops.math.mod",
-    "repeat_interleave_with_tensor_index": "ops.manipulation.repeat_interleave",
-    "rnn": "nn.layer.rnn.RNN",
-    "segment_pool": "geometric.segment_sum",
-    "set_value": "framework.tensor.Tensor.set_value",
-    "set_value_with_tensor": "framework.tensor.Tensor.set_value",
-    "shape": "framework.tensor.Tensor.shape",
-    "slice": "ops.manipulation.slice",
-    "strided_slice": "ops.manipulation.strided_slice",
-    "tanh_shrink": "nn.functional.tanhshrink",
-    "tril_indices": "ops.creation.tril_indices",
-    "trilinear_interp": "nn.functional.interpolate",
-    "triu_indices": "ops.creation.triu_indices",
-    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
-    "unbind": "ops.manipulation.unbind",
-    "uniform": "ops.random_ops.uniform",
-    "unique": "ops.search.unique",
-    "unpool": "ops.generated_ops.max_unpool2d",
-    "unpool3d": "ops.generated_ops.max_unpool3d",
-    "unstack": "ops.manipulation.unstack",
-    "view_dtype": "ops.manipulation.view",
-    "view_shape": "ops.manipulation.view",
-    "warpctc": "nn.functional.ctc_loss",
-    "weighted_sample_neighbors": "geometric.weighted_sample_neighbors",
-    "zeros": "ops.creation.zeros",
-}
+# The manifest DATA lives in specs/parity_manifest.yaml (generated-file
+# discipline: one data source, no hand-maintained python dicts); this
+# module exposes it under the original names.
+from .spec_meta import parity_manifest as _pm
 
-# reference ops with no seat by DESIGN, with the reason.
-SKIPPED = {
-    "apply_per_channel_scale": "folded into weight_only_linear's scale arg",
-    "coalesce_tensor": "buffer fusion is XLA buffer assignment's job",
-    "conv2d_transpose_bias": "bias fusion is an XLA epilogue fusion",
-    "depthwise_conv2d_transpose": "conv2d_transpose(groups=C) covers it",
-    "disable_check_model_nan_inf": "NaN hooks toggle via flags registry",
-    "enable_check_model_nan_inf": "NaN hooks toggle via flags registry",
-    "embedding_grad_dense": "grad kernel split: jax vjp produces it",
-    "fc": "legacy fused mul+add: XLA fuses linear automatically",
-    "full_batch_size_like": "legacy static-graph shape plumbing",
-    "gaussian_inplace": "functional arrays: out-of-place randn + assign",
-    "graph_khop_sampler": "compose sample_neighbors per hop",
-    "index_select_strided": "index_select over a strided view covers it",
-    "npu_identity": "NPU-specific layout copy",
-    "read_file": "host IO: python open() + decode_jpeg",
-    "self_dp_attention": "CPU-specific fused attention variant",
-    "skip_layernorm": "XLA fuses residual+layernorm",
-    "squeeze_excitation_block": "XPU-specific fused block",
-    "trans_layout": "XLA owns device layouts",
-    "uniform_inplace": "functional arrays: out-of-place uniform + assign",
-    "variable_length_memory_efficient_attention":
-        "varlen seat: flash kernel kv_mask path",
-    "warprnnt": "RNN-T loss: niche; CTC seat covered via optax",
-}
+ALIASES = dict(_pm()["aliases"])
+SKIPPED = dict(_pm()["skips"])

@@ -1,0 +1,90 @@
+"""Single-source op metadata: the loader over both spec YAMLs.
+
+Parity: the reference's `paddle/phi/api/yaml/*` corpus is the one place
+an op's kernel declaration, datatype (AMP) class, and SPMD rule binding
+live; 11 generators fan it out.  Here the same single-sourcing is two
+files under `ops/specs/`:
+
+* `ops.yaml`             — codegen-lowered ops (`ops/codegen.py` emits
+                           registration + public wrapper from each entry);
+* `registered_ops.yaml`  — hand-implemented ops (complex signatures,
+                           custom VJPs, Pallas kernels): the entry declares
+                           the metadata, the named module owns the lowering.
+
+DERIVED from these files (nothing else defines them):
+* the AMP O1 white/black lists (`amp_white()` / `amp_black()` — consumed
+  by `amp/auto_cast.py`);
+* the SPMD-rule binding set (`spmd_ops()` — `tests/test_codegen_ops.py`
+  asserts it equals the rules actually registered);
+* registry coverage (every dispatched op must be declared in exactly one
+  file; stale declarations fail the same test).
+
+Entries with `module: (amp-alias)` are AMP list names that are not
+registry ops (user-facing aliases honored by custom_white/black_list).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, Set
+
+import yaml
+
+_DIR = os.path.join(os.path.dirname(__file__), "specs")
+GENERATED_SPEC = os.path.join(_DIR, "ops.yaml")
+REGISTERED_SPEC = os.path.join(_DIR, "registered_ops.yaml")
+PARITY_SPEC = os.path.join(_DIR, "parity_manifest.yaml")
+
+AMP_ALIAS_MODULE = "(amp-alias)"
+
+
+@functools.lru_cache(maxsize=None)
+def generated_entries() -> tuple:
+    with open(GENERATED_SPEC) as f:
+        return tuple(yaml.safe_load(f) or ())
+
+
+@functools.lru_cache(maxsize=None)
+def declared_entries() -> tuple:
+    with open(REGISTERED_SPEC) as f:
+        return tuple(yaml.safe_load(f) or ())
+
+
+def generated_ops() -> Dict[str, dict]:
+    return {e["op"]: e for e in generated_entries()}
+
+
+def declared_ops() -> Dict[str, dict]:
+    """Hand-implemented op declarations (excluding AMP aliases)."""
+    return {e["op"]: e for e in declared_entries()
+            if e.get("module") != AMP_ALIAS_MODULE}
+
+
+def all_entries() -> List[dict]:
+    return list(generated_entries()) + list(declared_entries())
+
+
+def _amp(cls: str) -> Set[str]:
+    return {e["op"] for e in all_entries() if e.get("amp") == cls}
+
+
+def amp_white() -> Set[str]:
+    return _amp("white")
+
+
+def amp_black() -> Set[str]:
+    return _amp("black")
+
+
+def spmd_bindings() -> Dict[str, str]:
+    """op -> SPMD rule name, from the `spmd:` fields of both specs."""
+    return {e["op"]: e["spmd"] for e in all_entries() if e.get("spmd")}
+
+
+@functools.lru_cache(maxsize=None)
+def parity_manifest() -> dict:
+    """{'aliases': {ref_op: seat}, 'skips': {ref_op: reason}} — the
+    reference-op parity manifest data (`ops/parity.py` consumes it)."""
+    with open(PARITY_SPEC) as f:
+        return yaml.safe_load(f)

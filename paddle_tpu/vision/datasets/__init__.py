@@ -211,10 +211,12 @@ class Flowers(Dataset):
         split_key = {"train": "trnid", "valid": "valid",
                      "test": "tstid"}[mode]
         ids = loadmat(setid_file)[split_key][0]
-        self._tar = tarfile.open(data_file)
-        self._names = {int(m.name.split("_")[-1].split(".")[0]): m.name
-                       for m in self._tar.getmembers()
-                       if m.name.endswith(".jpg")}
+        self._tar_path = data_file
+        self._tar = None     # opened lazily PER PROCESS: an open TarFile
+        with tarfile.open(data_file) as tf:   # can't pickle into workers
+            self._names = {int(m.name.split("_")[-1].split(".")[0]): m.name
+                           for m in tf.getmembers()
+                           if m.name.endswith(".jpg")}
         self._ids = [int(i) for i in ids]
         self.labels = np.asarray([labels[i - 1] for i in self._ids],
                                  np.int64)
@@ -224,7 +226,11 @@ class Flowers(Dataset):
         if self.images is not None:
             img = self.images[idx]
         else:
+            import tarfile
+
             from PIL import Image
+            if self._tar is None:
+                self._tar = tarfile.open(self._tar_path)
             f = self._tar.extractfile(self._names[self._ids[idx]])
             img = np.asarray(Image.open(f).convert("RGB"))
         if self.transform is not None:
@@ -249,9 +255,14 @@ class VOC2012(Dataset):
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None, synthetic_size=None):
         self.transform = transform
+        if mode == "val":
+            mode = "valid"       # torchvision spelling, accepted
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(
+                f"VOC2012 mode must be train/valid/test, got {mode!r}")
         if data_file and os.path.isdir(data_file):
             split = {"train": "train", "valid": "val",
-                     "test": "val"}.get(mode, "train")
+                     "test": "val"}[mode]
             lst = os.path.join(data_file, "ImageSets", "Segmentation",
                                split + ".txt")
             with open(lst) as f:

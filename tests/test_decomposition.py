@@ -123,3 +123,15 @@ def test_decomp_shape_edge_cases(case):
     np.testing.assert_allclose(np.asarray(got._value),
                                np.asarray(want._value), rtol=2e-5,
                                atol=2e-6)
+
+
+def test_any_all_truthiness_on_numerics():
+    """any/all decomps must treat NONZERO as true (negatives, sub-1
+    floats), exactly like the fused jnp.any/jnp.all."""
+    x = paddle.to_tensor(np.array([[-1.0, -2.0], [0.5, 0.0]], np.float32))
+    for name, fn in (("any", paddle.any), ("all", paddle.all)):
+        want = fn(x, axis=1)
+        with decomposition.enabled(name):
+            got = fn(x, axis=1)
+        np.testing.assert_array_equal(np.asarray(got._value),
+                                      np.asarray(want._value))
