@@ -336,6 +336,8 @@ def bench_tuner_memory_validation():
     from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m
 
     if jax.devices()[0].platform != "tpu":
+        log({"bench": "tuner_memory_validation", "skipped": "platform",
+             "platform": jax.devices()[0].platform})
         return
     B, S = 4, 1024
     paddle.seed(0)
@@ -939,14 +941,16 @@ def main():
     _run_rung("dispatch_overhead", bench_dispatch, 15, release=False)
     _run_rung("dispatch_overhead_cpu", bench_dispatch_cpu, 60,
               release=False)
+    # BEFORE the larger rungs: PJRT's peak_bytes_in_use is monotonic per
+    # process, so the 124M-step measurement must precede resnet/bert/350M
+    _run_rung("tuner_memory_validation", bench_tuner_memory_validation,
+              200)
     _run_rung("lenet_train", bench_lenet, 60)
     _run_rung("gpt124m_decode", bench_decode, 200)
     _run_rung("gpt124m_decode_32k_config", bench_decode_longctx, 150)
     _run_rung("resnet50_train", bench_resnet50, 380)
     _run_rung("bert_base_mlm_train", bench_bert_base, 500)
     _run_rung("gpt350m_train", bench_gpt350m, 450)
-    _run_rung("tuner_memory_validation", bench_tuner_memory_validation,
-              200)
     _run_rung("ring_attention_8k", bench_ring_attention, 120)
     _run_rung("serving_continuous_batching", bench_serving, 240)
     check_regressions()

@@ -1,0 +1,285 @@
+"""Analysis-pass pipeline over saved inference artifacts.
+
+Role of the reference's analysis pipeline
+(`paddle/fluid/inference/api/analysis_predictor.h:100`,
+`inference/analysis/analyzer.cc` + `ir_passes/`): an ordered, named,
+configurable sequence of program passes between load and execution.
+
+TPU-native split: the ~90k LoC of graph-rewrite passes (fusion,
+constant folding, layout) are XLA's job when the StableHLO artifact
+compiles — re-rewriting the module by hand would fight the compiler.
+What the pipeline owns here is everything PADDLE-VISIBLE about the
+artifact: weight precision (bf16/fp16/int8 conversion), artifact
+statistics (op histogram over the StableHLO text — the observability
+`analyzer.cc` logs per pass), and any user-registered custom pass.
+The seam is the same as the reference's: `Config.pass_builder()`
+lists/edits the pipeline, `create_predictor` runs it before compile.
+
+    config = Config(prefix)
+    pb = config.pass_builder()
+    pb.turn_on("weight_bf16_pass")
+    pb.delete_pass("program_stats_pass")
+    predictor = create_predictor(config)
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["AnalysisPass", "PassPipeline", "register_pass", "list_passes"]
+
+
+class Artifact:
+    """A loaded `jit.save` artifact the passes transform: metadata dict,
+    raw param arrays, and the StableHLO module text (read-only for
+    analysis passes)."""
+
+    def __init__(self, prefix: str):
+        import numpy as np
+        self.prefix = prefix
+        with open(prefix + ".pdmeta.json") as f:
+            self.meta = json.load(f)
+        with np.load(prefix + ".pdiparams.npz") as z:
+            self.params = [np.asarray(z[str(i)])
+                           for i in range(len(z.files))]
+        with open(prefix + ".pdmodel", "rb") as f:
+            self.module_bytes = f.read()
+        self.reports: Dict[str, dict] = {}   # pass name -> findings
+        self.dirty = False   # set by any pass that MUTATES the artifact
+                             # (drives whether the predictor reloads a
+                             # transformed copy)
+
+    def module_text(self) -> str:
+        """StableHLO MLIR text of the serialized program (deserialized
+        through jax.export; empty string if undecodable)."""
+        try:
+            import jax
+            return jax.export.deserialize(
+                bytearray(self.module_bytes)).mlir_module()
+        except Exception:  # pragma: no cover - foreign/corrupt artifact
+            return self.module_bytes.decode("utf-8", errors="replace")
+
+    def save(self, prefix: str):
+        import numpy as np
+        np.savez(prefix + ".pdiparams.npz",
+                 **{str(i): v for i, v in enumerate(self.params)})
+        with open(prefix + ".pdmeta.json", "w") as f:
+            json.dump(self.meta, f)
+        with open(prefix + ".pdmodel", "wb") as f:
+            f.write(self.module_bytes)
+
+
+class AnalysisPass:
+    """One named pass.  Subclass and implement run(artifact) (mutate in
+    place or record into artifact.reports[self.name])."""
+
+    name = "analysis_pass"
+
+    def run(self, artifact: Artifact) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, Callable[[], AnalysisPass]] = {}
+
+
+def register_pass(name: str):
+    """Register a pass factory under `name` (the reference's
+    REGISTER_PASS macro seam — custom passes slot into pipelines by
+    name)."""
+    def deco(factory):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def list_passes() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class PassPipeline:
+    """Ordered pass list with the PassStrategy editing surface
+    (`paddle/fluid/inference/api/paddle_pass_builder.h`:
+    AppendPass/DeletePass/TurnOn)."""
+
+    # default pipeline is EMPTY: merely obtaining a pass_builder must
+    # not add artifact re-reads/deserializes to predictor creation —
+    # stats are opt-in (turn_on("program_stats_pass"))
+    DEFAULT: List[str] = []
+
+    def __init__(self, names: Optional[List[str]] = None):
+        self._names = list(self.DEFAULT if names is None else names)
+
+    def all_passes(self) -> List[str]:
+        return list(self._names)
+
+    def append_pass(self, name: str):
+        self._check(name)
+        self._names.append(name)
+        return self
+
+    def turn_on(self, name: str):
+        """Idempotent enable (reference PassStrategy TurnOn semantics —
+        double enabling must not run a transform twice)."""
+        self._check(name)
+        if name not in self._names:
+            self._names.append(name)
+        return self
+
+    def insert_pass(self, idx: int, name: str):
+        self._check(name)
+        self._names.insert(idx, name)
+        return self
+
+    def delete_pass(self, name: str):
+        self._names = [n for n in self._names if n != name]
+        return self
+
+    def _check(self, name: str):
+        if name not in _REGISTRY:
+            raise KeyError(
+                f"unknown pass {name!r}; registered: {list_passes()}")
+
+    def run(self, src_prefix: str, dst_prefix: Optional[str] = None
+            ) -> Artifact:
+        art = Artifact(src_prefix)
+        for name in self._names:
+            _REGISTRY[name]().run(art)
+        if dst_prefix is not None:
+            art.save(dst_prefix)
+        return art
+
+
+# ------------------------------------------------------- built-in passes
+
+@register_pass("program_stats_pass")
+class ProgramStatsPass(AnalysisPass):
+    """Op histogram + constant/param accounting over the StableHLO text
+    — the per-pass observability `analyzer.cc` logs.  Pure analysis."""
+
+    name = "program_stats_pass"
+
+    def run(self, art: Artifact) -> None:
+        text = art.module_text()
+        ops = collections.Counter(
+            m.group(1) for m in re.finditer(
+                r"=\s*\"?(stablehlo\.[a-z_]+|mhlo\.[a-z_]+|"
+                r"func\.call|call)", text))
+        art.reports[self.name] = {
+            "op_histogram": dict(ops.most_common()),
+            "n_params": len(art.params),
+            "param_bytes": int(sum(v.nbytes for v in art.params)),
+            "module_bytes": len(art.module_bytes),
+        }
+
+
+def convert_weights_mixed(meta: dict, params: list, precision: str,
+                          black_list=None) -> int:
+    """THE weight-precision conversion (one implementation shared by the
+    analysis passes and the offline `passes.py` converters; the
+    weight_precision/param_converted metadata contract is decoded by
+    TranslatedLayer at load).  Mutates meta/params; returns the count."""
+    import jax.numpy as jnp
+    import numpy as np
+    if meta.get("weight_precision"):
+        raise ValueError(
+            "artifact already precision-converted "
+            f"({meta['weight_precision']!r}); convert from the original "
+            "full-precision artifact")
+    black_list = list(black_list or [])
+    keys = meta.get("param_keys") or [""] * len(params)
+    flags, converted = [], 0
+    for i, (key, v) in enumerate(zip(keys, params)):
+        skip = any(b in key for b in black_list)
+        if not skip and v.dtype == np.float32:
+            c = np.asarray(jnp.asarray(v).astype(getattr(jnp, precision)))
+            if precision == "bfloat16":
+                # numpy has no bfloat16: store the uint16 bit pattern
+                c = c.view(np.uint16)
+            params[i] = c
+            flags.append(True)
+            converted += 1
+        else:
+            flags.append(False)
+    meta["weight_precision"] = precision
+    meta["weight_precision_converted"] = converted
+    meta["param_converted"] = flags
+    return converted
+
+
+def convert_weights_int8(meta: dict, params: list,
+                         black_list=None) -> int:
+    """THE int8 weight quantization (shared with `passes.py`): symmetric
+    absmax per-tensor scales, dequantized by TranslatedLayer at load."""
+    import numpy as np
+    if meta.get("weight_precision"):
+        raise ValueError(
+            "artifact already precision-converted "
+            f"({meta['weight_precision']!r}); convert from the original "
+            "full-precision artifact")
+    black_list = list(black_list or [])
+    keys = meta.get("param_keys") or [""] * len(params)
+    flags, scales = [], []
+    for i, (key, v) in enumerate(zip(keys, params)):
+        skip = any(b in key for b in black_list)
+        if not skip and v.dtype == np.float32 and v.size > 0:
+            scale = float(np.abs(v).max()) or 1e-8
+            params[i] = np.clip(
+                np.round(v / scale * 127.0), -127, 127).astype(np.int8)
+            flags.append(True)
+            scales.append(scale)
+        else:
+            flags.append(False)
+            scales.append(None)
+    meta["weight_precision"] = "int8"
+    meta["weight_precision_converted"] = sum(flags)
+    meta["param_converted"] = flags
+    meta["int8_scales"] = scales
+    return sum(flags)
+
+
+class _WeightPrecisionPass(AnalysisPass):
+    precision = "bfloat16"
+
+    def __init__(self, black_list=None):
+        self.black_list = black_list
+
+    def run(self, art: Artifact) -> None:
+        converted = convert_weights_mixed(art.meta, art.params,
+                                          self.precision, self.black_list)
+        art.dirty = True
+        art.reports[self.name] = {"converted": converted}
+
+
+@register_pass("weight_bf16_pass")
+class WeightBf16Pass(_WeightPrecisionPass):
+    """Weight side of `auto_mixed_precision_pass.cc`: params stored
+    bf16, cast at the call boundary by TranslatedLayer."""
+
+    name = "weight_bf16_pass"
+    precision = "bfloat16"
+
+
+@register_pass("weight_fp16_pass")
+class WeightFp16Pass(_WeightPrecisionPass):
+    name = "weight_fp16_pass"
+    precision = "float16"
+
+
+@register_pass("weight_int8_pass")
+class WeightInt8Pass(AnalysisPass):
+    """Weight side of the int8 quantization passes: symmetric absmax
+    per-tensor scales, dequantized at load."""
+
+    name = "weight_int8_pass"
+
+    def __init__(self, black_list=None):
+        self.black_list = black_list
+
+    def run(self, art: Artifact) -> None:
+        converted = convert_weights_int8(art.meta, art.params,
+                                         self.black_list)
+        art.dirty = True
+        art.reports[self.name] = {"converted": converted}

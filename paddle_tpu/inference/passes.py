@@ -42,48 +42,16 @@ def convert_to_mixed_precision(src_prefix: str, dst_prefix: str,
     src_params, dst_model, dst_params, precision, backend, keep_io_types,
     black_list)` — collapsed to prefix paths (our artifacts derive from
     one prefix).  `black_list`: parameter-name substrings kept at fp32
-    (e.g. norm scales)."""
-    dtype = _DTYPES[mixed_precision]
-    black_list = list(black_list or [])
-    with open(src_prefix + ".pdmeta.json") as f:
-        meta = json.load(f)
-    if meta.get("weight_precision"):
-        raise ValueError(
-            f"artifact {src_prefix!r} is already precision-converted "
-            f"(weight_precision={meta['weight_precision']!r}); convert "
-            "from the original full-precision artifact")
-    keys = meta["param_keys"]
-    with np.load(src_prefix + ".pdiparams.npz") as z:
-        vals = [np.asarray(z[str(i)]) for i in range(len(z.files))]
-    out = []
-    converted_flags = []
-    converted = 0
-    for key, v in zip(keys, vals):
-        skip = any(b in key for b in black_list)
-        if not skip and np.issubdtype(v.dtype, np.floating) \
-                and v.dtype == np.float32:
-            c = np.asarray(jnp.asarray(v).astype(dtype))
-            if mixed_precision == "bfloat16":
-                # numpy has no bfloat16: store the uint16 bit pattern,
-                # TranslatedLayer bitcasts back at load
-                c = c.view(np.uint16)
-            out.append(c)
-            converted_flags.append(True)
-            converted += 1
-        else:
-            out.append(v)
-            converted_flags.append(False)
-    np.savez(dst_prefix + ".pdiparams.npz",
-             **{str(i): v for i, v in enumerate(out)})
-    meta["weight_precision"] = mixed_precision
-    meta["weight_precision_converted"] = converted
-    # explicit per-param flags: a param whose ORIGINAL dtype happens to
-    # equal the target precision must not be confused with a converted one
-    meta["param_converted"] = converted_flags
-    with open(dst_prefix + ".pdmeta.json", "w") as f:
-        json.dump(meta, f)
-    if src_prefix != dst_prefix:
-        shutil.copyfile(src_prefix + ".pdmodel", dst_prefix + ".pdmodel")
+    (e.g. norm scales).  Delegates to the ONE conversion implementation
+    shared with the analysis passes (`analysis.convert_weights_mixed`).
+    """
+    if mixed_precision not in _DTYPES:
+        raise KeyError(mixed_precision)
+    from .analysis import Artifact, convert_weights_mixed
+    art = Artifact(src_prefix)
+    convert_weights_mixed(art.meta, art.params, mixed_precision,
+                          black_list)
+    art.save(dst_prefix)
 
 
 def convert_to_int8(src_prefix: str, dst_prefix: str,
@@ -93,43 +61,9 @@ def convert_to_int8(src_prefix: str, dst_prefix: str,
     Parity: the weight half of the reference's static quantization
     export (`python/paddle/static/quantization/quant2_int8_onednn_pass.py`
     semantics: int8 storage + per-tensor scale, dequantized at the call
-    boundary).  Each converted float32 param is stored as int8 with its
-    absmax scale in the metadata; `TranslatedLayer` dequantizes
-    (v * scale / 127) at load — weights occupy a quarter of the HBM.
-    `black_list`: parameter-name substrings kept at fp32 (norm scales,
-    biases are good candidates)."""
-    black_list = list(black_list or [])
-    with open(src_prefix + ".pdmeta.json") as f:
-        meta = json.load(f)
-    if meta.get("weight_precision"):
-        raise ValueError(
-            f"artifact {src_prefix!r} is already precision-converted "
-            f"(weight_precision={meta['weight_precision']!r}); convert "
-            "from the original full-precision artifact")
-    keys = meta["param_keys"]
-    with np.load(src_prefix + ".pdiparams.npz") as z:
-        vals = [np.asarray(z[str(i)]) for i in range(len(z.files))]
-    out, flags, scales = [], [], []
-    for key, v in zip(keys, vals):
-        skip = any(b in key for b in black_list)
-        if not skip and v.dtype == np.float32 and v.size > 0:
-            scale = float(np.abs(v).max()) or 1e-8
-            q = np.clip(np.round(v / scale * 127.0), -127, 127) \
-                .astype(np.int8)
-            out.append(q)
-            flags.append(True)
-            scales.append(scale)
-        else:
-            out.append(v)
-            flags.append(False)
-            scales.append(None)
-    np.savez(dst_prefix + ".pdiparams.npz",
-             **{str(i): v for i, v in enumerate(out)})
-    meta["weight_precision"] = "int8"
-    meta["weight_precision_converted"] = sum(flags)
-    meta["param_converted"] = flags
-    meta["int8_scales"] = scales
-    with open(dst_prefix + ".pdmeta.json", "w") as f:
-        json.dump(meta, f)
-    if src_prefix != dst_prefix:
-        shutil.copyfile(src_prefix + ".pdmodel", dst_prefix + ".pdmodel")
+    boundary).  Delegates to `analysis.convert_weights_int8` (one
+    implementation, also behind the `weight_int8_pass`)."""
+    from .analysis import Artifact, convert_weights_int8
+    art = Artifact(src_prefix)
+    convert_weights_int8(art.meta, art.params, black_list)
+    art.save(dst_prefix)

@@ -38,6 +38,7 @@ class Config:
         self._profile = False
         self._ir_optim = True
         self._threads = 1
+        self._pass_pipeline = None   # created on first pass_builder()
 
     def set_prog_file(self, path: str):
         self.model_prefix = path[:-len(".pdmodel")] \
@@ -66,6 +67,16 @@ class Config:
             raise ValueError(f"unsupported precision {precision!r}")
         self._mixed_precision = precision
         self._cast_inputs = cast_inputs
+
+    def pass_builder(self):
+        """The analysis-pass pipeline applied between artifact load and
+        compile (reference `Config::pass_builder()` /
+        paddle_pass_builder.h).  Edit with append_pass/delete_pass/
+        insert_pass; passes run when the Predictor is created."""
+        if self._pass_pipeline is None:
+            from .analysis import PassPipeline
+            self._pass_pipeline = PassPipeline()
+        return self._pass_pipeline
 
     def exp_disable_mixed_precision_ops(self, *a, **k):
         pass  # op-level black list: XLA decides per-fusion
@@ -141,7 +152,24 @@ class Predictor:
         if not config.model_prefix:
             raise ValueError("Config needs the jit.save path prefix")
         self._config = config
-        self._layer = TranslatedLayer(config.model_prefix)
+        prefix = config.model_prefix
+        pipeline = config._pass_pipeline
+        self._analysis = None
+        self._analysis_dir = None
+        if pipeline is not None and pipeline.all_passes():
+            # run the analysis pipeline between load and compile
+            # (reference analyzer.cc sequencing); whether the predictor
+            # serves a transformed copy is decided by the artifact's
+            # dirty flag — ANY pass that mutated it counts, custom
+            # passes included
+            self._analysis = pipeline.run(prefix)
+            if self._analysis.dirty:
+                import tempfile
+                self._analysis_dir = tempfile.TemporaryDirectory(
+                    prefix="pd_analysis_")   # cleaned up with the
+                prefix = self._analysis_dir.name + "/model"  # predictor
+                self._analysis.save(prefix)
+        self._layer = TranslatedLayer(prefix)
         n_in = len(self._layer.input_specs)
         self._inputs = {f"input_{i}": PredictHandle(f"input_{i}")
                         for i in range(n_in)}

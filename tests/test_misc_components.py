@@ -327,3 +327,93 @@ def test_decomposition_norm_and_loss_rules_substitute():
     with decomposition.enabled("log_sigmoid"):
         out = np.asarray(F.log_sigmoid(xe)._value)
     np.testing.assert_allclose(out, [-100.0, 0.0], atol=1e-4)
+
+
+# ---------------------------------------------- inference analysis passes
+def test_analysis_pass_pipeline(tmp_path):
+    """The analysis-pass pipeline (ref analysis_predictor.h:100 +
+    paddle_pass_builder.h): named registry, PassStrategy editing, stats
+    pass reporting, weight-precision transform feeding the Predictor."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.inference.analysis import (PassPipeline, list_passes,
+                                               register_pass, AnalysisPass)
+    from paddle_tpu.jit import save as jit_save
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 8)
+                         .astype(np.float32))
+    want = np.asarray(net(x)._value)
+    prefix = str(tmp_path / "m")
+    jit_save(net, prefix, input_spec=[x])
+
+    assert {"program_stats_pass", "weight_bf16_pass",
+            "weight_int8_pass"} <= set(list_passes())
+
+    # analysis-only run: stats report, artifact untouched
+    art = PassPipeline(["program_stats_pass"]).run(prefix)
+    rep = art.reports["program_stats_pass"]
+    assert rep["n_params"] == 4 and rep["param_bytes"] > 0
+    assert rep["op_histogram"], rep
+
+    # custom pass registration (REGISTER_PASS seam)
+    seen = []
+
+    @register_pass("probe_pass")
+    class Probe(AnalysisPass):
+        name = "probe_pass"
+
+        def run(self, a):
+            seen.append(len(a.params))
+
+    pipe = PassPipeline(["program_stats_pass"])
+    pipe.append_pass("probe_pass")
+    pipe.delete_pass("program_stats_pass")
+    assert pipe.all_passes() == ["probe_pass"]
+    pipe.run(prefix)
+    assert seen == [4]
+
+    # Config.pass_builder -> transform before compile: bf16 weights
+    cfg = Config(prefix)
+    cfg.pass_builder().turn_on("weight_bf16_pass")
+    pred = create_predictor(cfg)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(np.asarray(x._value))
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, want, rtol=2e-2, atol=2e-2)
+    assert pred._analysis.meta["weight_precision"] == "bfloat16"
+
+    with pytest.raises(KeyError, match="unknown pass"):
+        PassPipeline().append_pass("no_such_pass")
+
+    # turn_on is idempotent (double enable must not run a transform twice)
+    pb = PassPipeline()
+    pb.turn_on("weight_bf16_pass")
+    pb.turn_on("weight_bf16_pass")
+    assert pb.all_passes() == ["weight_bf16_pass"]
+
+    # a CUSTOM pass that mutates the artifact marks it dirty, and the
+    # predictor serves the mutated copy (not the original file)
+    @register_pass("zero_last_param_pass")
+    class ZeroLast(AnalysisPass):
+        name = "zero_last_param_pass"
+
+        def run(self, a):
+            a.params[-1] = np.zeros_like(a.params[-1])
+            a.dirty = True
+
+    cfg2 = Config(prefix)
+    cfg2.pass_builder().turn_on("zero_last_param_pass")
+    pred2 = create_predictor(cfg2)
+    h2 = pred2.get_input_handle(pred2.get_input_names()[0])
+    h2.copy_from_cpu(np.asarray(x._value))
+    pred2.run()
+    out2 = pred2.get_output_handle(
+        pred2.get_output_names()[0]).copy_to_cpu()
+    # last param is the output bias: zeroing it shifts every output
+    assert not np.allclose(out2, want)
