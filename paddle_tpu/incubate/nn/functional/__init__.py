@@ -141,10 +141,122 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     return F.linear(x, weight, bias)
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "fused_multi_head_attention: use nn.MultiHeadAttention (SDPA/Pallas "
-        "path) — kept for API discovery")
+def _fused_mha_impl(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+                    pre_ln_scale, pre_ln_bias, ln_scale, ln_bias,
+                    attn_mask, *, pre_layer_norm, pre_ln_epsilon,
+                    ln_epsilon, dropout_rate, attn_dropout_rate,
+                    training, add_residual, num_heads, transpose_qkv_wb,
+                    mode, seed):
+    B, S, H = x.shape
+    residual = x
+
+    def _ln(v, scale, bias, eps):
+        mu = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(v - mu), axis=-1, keepdims=True)
+        out = (v - mu) * jax.lax.rsqrt(var + eps)
+        if scale is not None:
+            out = out * scale
+        if bias is not None:
+            out = out + bias
+        return out
+
+    def _drop(v, rate, tag):
+        if rate <= 0.0:
+            return v
+        if not training:
+            # downscale_in_infer applies the keep probability at infer
+            # time instead of upscaling at train time
+            return v * (1.0 - rate) if mode == "downscale_in_infer" else v
+        keep = jax.random.bernoulli(
+            jax.random.fold_in(seed, tag), 1.0 - rate, v.shape)
+        kept = jnp.where(keep, v, 0.0)
+        return kept if mode == "downscale_in_infer" else kept / (1.0 - rate)
+
+    h = _ln(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon) \
+        if pre_layer_norm else x
+    if transpose_qkv_wb:
+        nh = num_heads
+        qkv = h @ qkv_weight                       # [B, S, 3H]
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        qkv = qkv.reshape(B, S, 3, nh, H // nh)
+    else:
+        # qkv_weight [3, nh, hd, H]
+        _, nh, hd, _ = qkv_weight.shape
+        qkv = jnp.einsum("bsh,cndh->bscnd", h, qkv_weight)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias[None, None]       # bias [3, nh, hd]
+    q = qkv[:, :, 0].transpose(0, 2, 1, 3)         # [B, nh, S, hd]
+    k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+    hd = q.shape[-1]
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                   preferred_element_type=jnp.float32) \
+        * (hd ** -0.5)
+    if attn_mask is not None:
+        s = s + attn_mask
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    p = _drop(p, attn_dropout_rate, 1)
+    out = jnp.einsum("bnqk,bnkd->bnqd", p, v)
+    out = out.transpose(0, 2, 1, 3).reshape(B, S, -1)
+    out = out @ linear_weight
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = _drop(out, dropout_rate, 2)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = _ln(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+register_op("fused_multi_head_attention", _fused_mha_impl,
+            tags=("mxu", "fused"))
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """paddle.incubate.nn.functional.fused_multi_head_attention parity
+    (ref `fused_transformer.py:502` / `fused_attention_op.cu`): the
+    fused pre/post-LN self-attention block — on TPU one traced
+    expression XLA fuses end to end.  `cache_kv` decoding uses
+    nn.MultiHeadAttention's cache path or the paged serving engine."""
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use "
+            "nn.MultiHeadAttention's cache or inference.ServingEngine")
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"unknown dropout mode {mode!r}")
+    # draw a key ONLY when dropout actually fires (the sdpa convention:
+    # a key in the statics would defeat the cached-program fast path and
+    # advance the global stream during eval)
+    seed = None
+    if training and (dropout_rate > 0 or attn_dropout_rate > 0):
+        from ....framework import random as _random
+        seed = _random.next_key()
+    return _d("fused_multi_head_attention",
+              (x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+               pre_ln_scale, pre_ln_bias, ln_scale, ln_bias, attn_mask),
+              {"pre_layer_norm": bool(pre_layer_norm),
+               "pre_ln_epsilon": float(pre_ln_epsilon),
+               "ln_epsilon": float(ln_epsilon),
+               "dropout_rate": float(dropout_rate),
+               "attn_dropout_rate": float(attn_dropout_rate),
+               "training": bool(training),
+               "add_residual": bool(add_residual),
+               "num_heads": int(num_heads),
+               "transpose_qkv_wb": bool(transpose_qkv_wb),
+               "mode": mode,
+               "seed": seed})
 
 
 def block_multihead_attention(q, k_cache, v_cache, block_tables, seq_lens,

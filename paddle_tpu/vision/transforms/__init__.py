@@ -10,7 +10,11 @@ from ...framework.tensor import Tensor
 
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
-           "Transpose", "Pad", "to_tensor", "normalize"]
+           "Transpose", "Pad", "to_tensor", "normalize",
+           "BaseTransform", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter",
+           "Grayscale", "RandomResizedCrop", "RandomRotation",
+           "RandomAffine", "RandomPerspective", "RandomErasing"]
 
 
 class Compose:
@@ -156,3 +160,367 @@ class Pad:
         else:
             pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
         return np.pad(arr, pads)
+
+
+# --------------------------------------------- round-5 transform families
+# Parity: the remainder of `python/paddle/vision/transforms/transforms.py`
+# — photometric jitter, geometric warps (scipy.ndimage backed), erasing.
+# All host-side numpy HWC (the module convention); device-side resizing
+# belongs to F.interpolate.
+
+class BaseTransform:
+    """Parity: transforms.py BaseTransform — the param/apply split
+    subclasses override (`_get_params` once per call, `_apply_image`)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+        self.params = None
+
+    def _get_params(self, inputs):
+        return None
+
+    def _apply_image(self, image):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        self.params = self._get_params(inputs)
+        return self._apply_image(np.asarray(inputs))
+
+
+def _as_float(arr):
+    """uint8 -> float32 [0, 255] kept on the same scale; remembers how
+    to convert back."""
+    if arr.dtype == np.uint8:
+        return arr.astype(np.float32), True
+    return arr.astype(np.float32), False
+
+
+def _restore(arr, was_uint8):
+    if was_uint8:
+        return np.clip(np.round(arr), 0, 255).astype(np.uint8)
+    return arr
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, u8 = _as_float(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return _restore(arr * f, u8)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, u8 = _as_float(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return _restore(mean + (arr - mean) * f, u8)
+
+
+def _to_gray(arr):
+    if arr.ndim == 3 and arr.shape[-1] >= 3:
+        return (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                + 0.114 * arr[..., 2])
+    return arr.reshape(arr.shape[0], arr.shape[1])
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, u8 = _as_float(img)
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        gray = _to_gray(arr)[..., None]
+        return _restore(gray + (arr - gray) * f, u8)
+
+
+def _rgb_to_hsv(arr):
+    """Vectorized RGB->HSV on [0,1] floats (matplotlib-style formulas)."""
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr.max(-1)
+    minc = arr.min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta > 0, (h / 6.0) % 1.0, 0.0)
+    return np.stack([h, s, v], axis=-1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0], hsv[..., 1], hsv[..., 2]
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(int) % 6
+    conds = [i == k for k in range(6)]
+    r = np.select(conds, [v, q, p, p, t, v])
+    g = np.select(conds, [t, v, v, q, p, p])
+    b = np.select(conds, [p, p, t, v, v, q])
+    return np.stack([r, g, b], axis=-1)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, u8 = _as_float(img)
+        scale = 255.0 if u8 else 1.0
+        shift = np.random.uniform(-self.value, self.value)
+        hsv = _rgb_to_hsv(arr / scale)
+        hsv[..., 0] = (hsv[..., 0] + shift) % 1.0
+        return _restore(_hsv_to_rgb(hsv) * scale, u8)
+
+
+class ColorJitter(BaseTransform):
+    """Parity: transforms.py ColorJitter — the four photometric jitters
+    applied in a random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = int(num_output_channels)
+
+    def _apply_image(self, img):
+        arr, u8 = _as_float(img)
+        gray = _to_gray(arr)
+        out = np.repeat(gray[..., None], self.n, axis=-1)
+        return _restore(out, u8)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop resized to `size` (transforms.py
+    RandomResizedCrop; scipy bilinear zoom)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        from scipy import ndimage
+        arr = np.asarray(img)
+        ih, iw = arr.shape[0], arr.shape[1]
+        area = ih * iw
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < h <= ih and 0 < w <= iw:
+                top = np.random.randint(0, ih - h + 1)
+                left = np.random.randint(0, iw - w + 1)
+                crop = arr[top:top + h, left:left + w]
+                break
+        else:
+            crop = arr      # fallback: whole image
+            h, w = ih, iw
+        zoom = [self.size[0] / crop.shape[0], self.size[1] / crop.shape[1]]
+        if crop.ndim == 3:
+            zoom.append(1.0)
+        out = ndimage.zoom(crop.astype(np.float32), zoom, order=1)
+        # zoom rounding can be off by one: pad/crop to the exact size
+        out = out[:self.size[0], :self.size[1]]
+        return _restore(out, arr.dtype == np.uint8)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.expand = expand
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from scipy import ndimage
+        arr, u8 = _as_float(img)
+        angle = np.random.uniform(*self.degrees)
+        axes = (0, 1)
+        out = ndimage.rotate(arr, angle, axes=axes, reshape=self.expand,
+                             order=1, cval=self.fill)
+        return _restore(out, u8)
+
+
+class RandomAffine(BaseTransform):
+    """Parity: transforms.py RandomAffine — rotation + translation +
+    scale + shear as one inverse-map affine (scipy affine_transform)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_rng = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from scipy import ndimage
+        arr, u8 = _as_float(img)
+        ih, iw = arr.shape[0], arr.shape[1]
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * iw
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * ih
+        s = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        if isinstance(self.shear, numbers.Number):
+            shx = np.deg2rad(np.random.uniform(-self.shear, self.shear))
+        elif self.shear:       # paddle's 2/4-element sequence form
+            shx = np.deg2rad(np.random.uniform(self.shear[0],
+                                               self.shear[1]))
+        else:
+            shx = 0.0
+        c, si = np.cos(angle), np.sin(angle)
+        # rotation*scale with the shear composed into the column term
+        m = np.array([[c * s, -si * s + np.tan(shx)],
+                      [si * s, c * s]])
+        center = np.array([(ih - 1) / 2, (iw - 1) / 2])
+        inv = np.linalg.inv(m)
+        offset = center - inv @ (center + np.array([ty, tx]))
+        if arr.ndim == 2:
+            out = ndimage.affine_transform(arr, inv, offset=offset,
+                                           order=1, cval=self.fill)
+        else:
+            out = np.stack([
+                ndimage.affine_transform(arr[..., ch], inv, offset=offset,
+                                         order=1, cval=self.fill)
+                for ch in range(arr.shape[-1])], axis=-1)
+        return _restore(out, u8)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.d = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from scipy import ndimage
+        arr, u8 = _as_float(img)
+        if np.random.rand() >= self.prob:
+            return _restore(arr, u8)
+        ih, iw = arr.shape[0], arr.shape[1]
+        dh, dw = self.d * ih / 2, self.d * iw / 2
+        src = np.float32([[0, 0], [0, iw - 1], [ih - 1, 0],
+                          [ih - 1, iw - 1]])
+        dst = src + np.random.uniform(
+            -1, 1, (4, 2)).astype(np.float32) * [dh, dw]
+        # fit homography dst -> src (inverse map) by least squares
+        A, b = [], []
+        for (ys, xs), (yd, xd) in zip(src, dst):
+            A.append([yd, xd, 1, 0, 0, 0, -ys * yd, -ys * xd])
+            b.append(ys)
+            A.append([0, 0, 0, yd, xd, 1, -xs * yd, -xs * xd])
+            b.append(xs)
+        hvec = np.linalg.lstsq(np.array(A), np.array(b), rcond=None)[0]
+        H = np.append(hvec, 1.0).reshape(3, 3)
+        yy, xx = np.meshgrid(np.arange(ih), np.arange(iw), indexing="ij")
+        ones = np.ones_like(yy)
+        pts = np.stack([yy, xx, ones]).reshape(3, -1).astype(np.float32)
+        mapped = np.linalg.inv(H) @ pts
+        mapped = mapped[:2] / np.maximum(mapped[2:], 1e-8)
+        coords = mapped.reshape(2, ih, iw)
+
+        def warp(ch):
+            return ndimage.map_coordinates(ch, coords, order=1,
+                                           cval=self.fill)
+        if arr.ndim == 2:
+            out = warp(arr)
+        else:
+            out = np.stack([warp(arr[..., c])
+                            for c in range(arr.shape[-1])], axis=-1)
+        return _restore(out, u8)
+
+
+class RandomErasing(BaseTransform):
+    """Parity: transforms.py RandomErasing — zero (or fill) a random
+    rectangle; operates on CHW arrays/Tensors (paddle applies it after
+    ToTensor)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        t_in = isinstance(img, Tensor)
+        arr = np.array(img._value if t_in else img)
+        if np.random.rand() >= self.prob:
+            return Tensor(arr) if t_in else arr
+        c, ih, iw = arr.shape if arr.ndim == 3 else (1,) + arr.shape
+        area = ih * iw
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < ih and w < iw:
+                top = np.random.randint(0, ih - h + 1)
+                left = np.random.randint(0, iw - w + 1)
+                if self.value == "random":
+                    fill = np.random.randn(
+                        *((c, h, w) if arr.ndim == 3 else (h, w)))
+                else:
+                    fill = self.value
+                if arr.ndim == 3:
+                    arr[:, top:top + h, left:left + w] = fill
+                else:
+                    arr[top:top + h, left:left + w] = fill
+                break
+        return Tensor(arr) if t_in else arr

@@ -227,3 +227,41 @@ def test_cifar100_label_space():
     ds = Cifar100(mode="train", synthetic_size=300)
     labels = {ds[i][1] for i in range(300)}
     assert max(labels) > 10      # actually 100-way, not 10-way
+
+
+def test_round5_transform_families():
+    """transforms.py parity tail: photometric jitters, geometric warps,
+    erasing — shape/dtype preserved, randomness seeded by np.random."""
+    from paddle_tpu.vision import transforms as T
+
+    np.random.seed(7)
+    img = (np.random.rand(24, 30, 3) * 255).astype(np.uint8)
+    cases = [T.BrightnessTransform(0.4), T.ContrastTransform(0.4),
+             T.SaturationTransform(0.4), T.HueTransform(0.25),
+             T.ColorJitter(0.4, 0.4, 0.4, 0.2), T.Grayscale(3),
+             T.RandomRotation(25),
+             T.RandomAffine(15, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                            shear=5),
+             T.RandomPerspective(prob=1.0, distortion_scale=0.3)]
+    for t in cases:
+        out = t(img)
+        assert out.shape[:2] == (24, 30) and out.dtype == np.uint8, t
+    assert T.Grayscale(1)(img).shape == (24, 30, 1)
+    assert T.RandomResizedCrop(16)(img).shape == (16, 16, 3)
+
+    chw = img.transpose(2, 0, 1).astype(np.float32)
+    erased = T.RandomErasing(prob=1.0)(chw)
+    assert (erased == 0).any() and erased.shape == chw.shape
+
+    # identity-parameter jitters are exact no-ops
+    np.testing.assert_array_equal(T.BrightnessTransform(0.0)(img), img)
+    # hsv round trip is exact
+    x = np.random.rand(6, 6, 3).astype(np.float32)
+    np.testing.assert_allclose(T._hsv_to_rgb(T._rgb_to_hsv(x)), x,
+                               atol=1e-5)
+    # seeded determinism
+    np.random.seed(3)
+    a = T.ColorJitter(0.3, 0.3, 0.3, 0.1)(img)
+    np.random.seed(3)
+    b = T.ColorJitter(0.3, 0.3, 0.3, 0.1)(img)
+    np.testing.assert_array_equal(a, b)

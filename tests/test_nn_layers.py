@@ -274,3 +274,62 @@ def test_initializers():
     I.Orthogonal()(p)
     q = p.numpy()
     np.testing.assert_allclose(q @ q.T, np.eye(100), atol=1e-4)
+
+
+def test_fused_multi_head_attention_parity():
+    """paddle.incubate.nn.functional.fused_multi_head_attention (ref
+    fused_transformer.py:502): pre/post-LN fused self-attention block vs
+    a manual composition; grads flow."""
+    import numpy as np
+
+    from paddle_tpu.incubate.nn import functional as IF
+
+    rng = np.random.RandomState(0)
+    B, S, H, nh = 2, 6, 16, 4
+    hd = H // nh
+    x = paddle.to_tensor(rng.randn(B, S, H).astype(np.float32))
+    qkvw = paddle.to_tensor(rng.randn(3, nh, hd, H).astype(np.float32) * 0.2)
+    qkvb = paddle.to_tensor(rng.randn(3, nh, hd).astype(np.float32) * 0.1)
+    lw = paddle.to_tensor(rng.randn(H, H).astype(np.float32) * 0.2)
+    lb = paddle.to_tensor(rng.randn(H).astype(np.float32) * 0.1)
+    lns = paddle.to_tensor(np.ones(H, np.float32))
+    lnb = paddle.to_tensor(np.zeros(H, np.float32))
+
+    out = IF.fused_multi_head_attention(
+        x, qkvw, lw, pre_layer_norm=False, ln_scale=lns, ln_bias=lnb,
+        qkv_bias=qkvb, linear_bias=lb, dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False)
+
+    xn, qw, qb = (np.asarray(t._value) for t in (x, qkvw, qkvb))
+    qkv = np.einsum("bsh,cndh->bscnd", xn, qw) + qb[None, None]
+    q = qkv[:, :, 0].transpose(0, 2, 1, 3)
+    k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+    s = np.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(hd)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bnqk,bnkd->bnqd", p, v).transpose(0, 2, 1, 3) \
+        .reshape(B, S, H)
+    o = o @ np.asarray(lw._value) + np.asarray(lb._value)
+    o = xn + o
+    mu = o.mean(-1, keepdims=True)
+    var = o.var(-1, keepdims=True)
+    want = (o - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(out._value), want,
+                               rtol=2e-4, atol=2e-5)
+
+    # transpose_qkv_wb layout + attn_mask + grads
+    qkvw2 = paddle.to_tensor(
+        np.einsum("cndh->hcnd", qw).reshape(H, 3 * H).astype(np.float32))
+    mask = paddle.to_tensor(
+        np.where(np.tril(np.ones((1, 1, S, S))) > 0, 0.0, -1e9)
+        .astype(np.float32))
+    x2 = paddle.to_tensor(rng.randn(B, S, H).astype(np.float32))
+    x2.stop_gradient = False
+    out2 = IF.fused_multi_head_attention(
+        x2, qkvw2, lw, pre_layer_norm=True, pre_ln_scale=lns,
+        pre_ln_bias=lnb, attn_mask=mask, dropout_rate=0.0,
+        attn_dropout_rate=0.0, num_heads=nh, transpose_qkv_wb=True)
+    paddle.sum(out2 * out2).backward()
+    assert x2.grad is not None
+    assert np.isfinite(np.asarray(x2.grad._value)).all()
