@@ -25,6 +25,8 @@ __all__ = ["ring_attention", "ring_attention_local",
            "fused_rotary_position_embedding", "rope", "swiglu",
            "fused_rms_norm", "fused_layer_norm", "fused_bias_act",
            "fused_linear", "fused_multi_head_attention",
+           "fused_feedforward", "fused_dropout_add",
+           "fused_bias_dropout_residual_layer_norm",
            "block_multihead_attention", "BlockKVCache"]
 
 
@@ -141,6 +143,54 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
     return F.linear(x, weight, bias)
 
 
+def _fused_ln(v, scale, bias, eps):
+    """LayerNorm helper shared by the fused blocks."""
+    mu = jnp.mean(v, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(v - mu), axis=-1, keepdims=True)
+    out = (v - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _fused_drop(v, rate, tag, *, training, mode, seed):
+    """Mode-aware dropout shared by the fused blocks.  p=1.0 drops
+    everything (no 0/0); upscale_in_train scales kept values at train
+    time, downscale_in_infer scales by keep prob at infer time."""
+    if rate <= 0.0:
+        return v
+    if not training:
+        return v * (1.0 - rate) if mode == "downscale_in_infer" else v
+    keep = jax.random.bernoulli(jax.random.fold_in(seed, tag),
+                                1.0 - rate, v.shape)
+    kept = jnp.where(keep, v, 0.0)
+    if mode == "downscale_in_infer":
+        return kept
+    return kept / max(1.0 - rate, 1e-12)
+
+
+# fused activations follow the REPO's op semantics (erf gelu by default,
+# matching nn.functional.gelu / the reference), not jax.nn defaults
+_FUSED_ACTS = {
+    "relu": jax.nn.relu,
+    "gelu": lambda v: jax.nn.gelu(v, approximate=False),
+    "silu": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+}
+
+
+def _check_dropout_args(mode, *rates):
+    if mode not in ("upscale_in_train", "downscale_in_infer"):
+        raise ValueError(f"unknown dropout mode {mode!r}")
+    for r in rates:
+        if not 0.0 <= r <= 1.0:
+            raise ValueError(f"dropout rate {r} outside [0, 1]")
+
+
+
 def _fused_mha_impl(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
                     pre_ln_scale, pre_ln_bias, ln_scale, ln_bias,
                     attn_mask, *, pre_layer_norm, pre_ln_epsilon,
@@ -149,28 +199,11 @@ def _fused_mha_impl(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
                     mode, seed):
     B, S, H = x.shape
     residual = x
-
-    def _ln(v, scale, bias, eps):
-        mu = jnp.mean(v, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(v - mu), axis=-1, keepdims=True)
-        out = (v - mu) * jax.lax.rsqrt(var + eps)
-        if scale is not None:
-            out = out * scale
-        if bias is not None:
-            out = out + bias
-        return out
+    _ln = _fused_ln
 
     def _drop(v, rate, tag):
-        if rate <= 0.0:
-            return v
-        if not training:
-            # downscale_in_infer applies the keep probability at infer
-            # time instead of upscaling at train time
-            return v * (1.0 - rate) if mode == "downscale_in_infer" else v
-        keep = jax.random.bernoulli(
-            jax.random.fold_in(seed, tag), 1.0 - rate, v.shape)
-        kept = jnp.where(keep, v, 0.0)
-        return kept if mode == "downscale_in_infer" else kept / (1.0 - rate)
+        return _fused_drop(v, rate, tag, training=training, mode=mode,
+                           seed=seed)
 
     h = _ln(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon) \
         if pre_layer_norm else x
@@ -234,8 +267,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         raise NotImplementedError(
             "fused_multi_head_attention cache_kv: use "
             "nn.MultiHeadAttention's cache or inference.ServingEngine")
-    if mode not in ("upscale_in_train", "downscale_in_infer"):
-        raise ValueError(f"unknown dropout mode {mode!r}")
+    _check_dropout_args(mode, dropout_rate, attn_dropout_rate)
     # draw a key ONLY when dropout actually fires (the sdpa convention:
     # a key in the statics would defeat the cached-program fast path and
     # advance the global stream during eval)
@@ -278,3 +310,116 @@ def block_multihead_attention(q, k_cache, v_cache, block_tables, seq_lens,
 from ....framework.tensor import Tensor as _Tensor  # noqa: E402
 from ....ops.pallas_paged import (  # noqa: E402,F401
     BlockKVCache, paged_attention as _paged_attention)
+
+
+def _fused_ffn_impl(x, w1, b1, w2, b2, ln1_s, ln1_b, ln2_s, ln2_b, *,
+                    pre_layer_norm, ln1_epsilon, ln2_epsilon,
+                    dropout1_rate, dropout2_rate, activation, training,
+                    add_residual, mode, seed):
+    residual = x
+    _ln = _fused_ln
+
+    def _drop(v, rate, tag):
+        return _fused_drop(v, rate, tag, training=training, mode=mode,
+                           seed=seed)
+
+    h = _ln(x, ln1_s, ln1_b, ln1_epsilon) if pre_layer_norm else x
+    h = h @ w1
+    if b1 is not None:
+        h = h + b1
+    h = _FUSED_ACTS.get(activation, getattr(jax.nn, activation))(h)
+    h = _drop(h, dropout1_rate, 1)
+    h = h @ w2
+    if b2 is not None:
+        h = h + b2
+    h = _drop(h, dropout2_rate, 2)
+    out = residual + h if add_residual else h
+    if not pre_layer_norm:
+        out = _ln(out, ln2_s, ln2_b, ln2_epsilon)
+    return out
+
+
+register_op("fused_feedforward", _fused_ffn_impl, tags=("mxu", "fused"))
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """paddle.incubate.nn.functional.fused_feedforward parity (ref
+    fused_transformer.py:36): the fused pre/post-LN MLP block —
+    linear2(dropout1(act(linear1(ln?(x))))) + residual + (post-)LN."""
+    _check_dropout_args(mode, dropout1_rate, dropout2_rate)
+    seed = None
+    if training and (dropout1_rate > 0 or dropout2_rate > 0):
+        from ....framework import random as _random
+        seed = _random.next_key()
+    return _d("fused_feedforward",
+              (x, linear1_weight, linear1_bias, linear2_weight,
+               linear2_bias, ln1_scale, ln1_bias, ln2_scale, ln2_bias),
+              {"pre_layer_norm": bool(pre_layer_norm),
+               "ln1_epsilon": float(ln1_epsilon),
+               "ln2_epsilon": float(ln2_epsilon),
+               "dropout1_rate": float(dropout1_rate),
+               "dropout2_rate": float(dropout2_rate),
+               "activation": activation, "training": bool(training),
+               "add_residual": bool(add_residual), "mode": mode,
+               "seed": seed})
+
+
+def _fused_dropout_add_impl(x, y, *, p, training, mode, seed):
+    return _fused_drop(x, p, 0, training=training, mode=mode,
+                       seed=seed) + y
+
+
+register_op("fused_dropout_add", _fused_dropout_add_impl, tags=("fused",))
+
+
+def fused_dropout_add(x, y, p=0.5, training=True,
+                      mode="upscale_in_train", name=None):
+    """paddle.incubate.nn.functional.fused_dropout_add parity
+    (ref `incubate/nn/functional/fused_dropout_add.py`):
+    dropout(x) + y as one fused expression."""
+    _check_dropout_args(mode, p)
+    seed = None
+    if training and p > 0:
+        from ....framework import random as _random
+        seed = _random.next_key()
+    return _d("fused_dropout_add", (x, y),
+              {"p": float(p), "training": bool(training), "mode": mode,
+               "seed": seed})
+
+
+def _fused_bdrln_impl(x, residual, bias, ln_scale, ln_bias, *,
+                      dropout_rate, ln_epsilon, training, mode, seed):
+    h = x if bias is None else x + bias
+    out = residual + _fused_drop(h, dropout_rate, 0, training=training,
+                                 mode=mode, seed=seed)
+    return _fused_ln(out, ln_scale, ln_bias, ln_epsilon)
+
+
+register_op("fused_bias_dropout_residual_layer_norm", _fused_bdrln_impl,
+            tags=("fused",))
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """paddle.incubate.nn.functional.fused_bias_dropout_residual_layer_norm
+    parity (ref fused_transformer.py): ln(residual + dropout(x + bias)),
+    one dispatched op (AMP/NaN/profiler hooks apply under its name)."""
+    _check_dropout_args(mode, dropout_rate)
+    seed = None
+    if training and dropout_rate > 0:
+        from ....framework import random as _random
+        seed = _random.next_key()
+    return _d("fused_bias_dropout_residual_layer_norm",
+              (x, residual, bias, ln_scale, ln_bias),
+              {"dropout_rate": float(dropout_rate),
+               "ln_epsilon": float(ln_epsilon),
+               "training": bool(training), "mode": mode, "seed": seed})

@@ -1,0 +1,204 @@
+"""Fused incubate layers.
+
+Parity: `python/paddle/incubate/nn/layer/fused_transformer.py`
+(FusedMultiHeadAttention `:30`, FusedFeedForward, FusedTransformer-
+EncoderLayer) + `fused_dropout_add.py`, `fused_linear.py` — thin Layer
+wrappers holding the fused blocks' parameters and calling the
+functionals, which trace to single XLA-fused expressions on TPU.
+"""
+
+from __future__ import annotations
+
+from ...nn import Layer
+from . import functional as F
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y (ref `fused_dropout_add.py` FusedDropoutAdd)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p,
+                                   training=self.training, mode=self.mode)
+
+
+class FusedLinear(Layer):
+    """Linear over the fused epilogue path (ref `fused_linear.py`)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """ln(residual + dropout(x + bias)) (ref fused_transformer.py)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = None if bias_attr is False else \
+            self.create_parameter([embed_dim], attr=bias_attr,
+                                  is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr, default_initializer=_ones())
+        self.ln_bias = None if bias_attr is False else \
+            self.create_parameter([embed_dim], attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+def _ones():
+    from ...nn import initializer as I
+    return I.Constant(1.0)
+
+
+class FusedMultiHeadAttention(Layer):
+    """Fused self-attention block (ref fused_transformer.py:30):
+    holds qkv/linear weights in the [3, nh, hd, H] fused layout and
+    calls `functional.fused_multi_head_attention`."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        hd = embed_dim // num_heads
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, hd, embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = None if qkv_bias_attr is False else \
+            self.create_parameter([3, num_heads, hd],
+                                  attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = None if linear_bias_attr is False else \
+            self.create_parameter([embed_dim], attr=linear_bias_attr,
+                                  is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=_ones())
+        self.pre_ln_bias = self.create_parameter(
+            [embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=ln_scale_attr, default_initializer=_ones())
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=ln_bias_attr, is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    """Fused MLP block (ref fused_transformer.py FusedFeedForward)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = None if linear1_bias_attr is False else \
+            self.create_parameter([dim_feedforward],
+                                  attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = None if linear2_bias_attr is False else \
+            self.create_parameter([d_model], attr=linear2_bias_attr,
+                                  is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            [d_model], attr=ln1_scale_attr, default_initializer=_ones())
+        self.ln1_bias = self.create_parameter(
+            [d_model], attr=ln1_bias_attr, is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], attr=ln2_scale_attr, default_initializer=_ones())
+        self.ln2_bias = self.create_parameter(
+            [d_model], attr=ln2_bias_attr, is_bias=True)
+
+    def forward(self, src, cache=None):
+        return F.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, ln1_epsilon=self.epsilon,
+            ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before,
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """Fused encoder layer = FusedMultiHeadAttention + FusedFeedForward
+    (ref fused_transformer.py FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -333,3 +333,63 @@ def test_fused_multi_head_attention_parity():
     paddle.sum(out2 * out2).backward()
     assert x2.grad is not None
     assert np.isfinite(np.asarray(x2.grad._value)).all()
+
+
+def test_incubate_fused_layers():
+    """incubate.nn fused layer classes (ref incubate/nn/layer/
+    fused_transformer.py + fused_dropout_add.py + fused_linear.py):
+    shapes, training, dropout-mode semantics, ffn parity vs manual."""
+    import numpy as np
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.incubate import nn as inn
+    from paddle_tpu.incubate.nn import functional as IF
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+
+    enc = inn.FusedTransformerEncoderLayer(
+        d_model=16, nhead=4, dim_feedforward=32, dropout_rate=0.0,
+        normalize_before=True)
+    assert tuple(enc(x).shape) == (2, 6, 16)
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=enc.parameters())
+    tgt = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32) * 0.1)
+    l0 = None
+    for _ in range(6):
+        loss = paddle.mean((enc(x) - tgt) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = float(loss) if l0 is None else l0
+    assert float(loss) < l0
+
+    # fused_feedforward post-LN parity vs a manual composition
+    w1 = paddle.to_tensor(rng.randn(16, 32).astype(np.float32) * 0.2)
+    w2 = paddle.to_tensor(rng.randn(32, 16).astype(np.float32) * 0.2)
+    lns = paddle.to_tensor(np.ones(16, np.float32))
+    lnb = paddle.to_tensor(np.zeros(16, np.float32))
+    got = IF.fused_feedforward(x, w1, w2, ln2_scale=lns, ln2_bias=lnb,
+                               dropout1_rate=0.0, dropout2_rate=0.0,
+                               training=False)
+    xn = np.asarray(x._value)
+    o = xn + np.maximum(xn @ np.asarray(w1._value), 0) \
+        @ np.asarray(w2._value)
+    mu = o.mean(-1, keepdims=True)
+    want = (o - mu) / np.sqrt(o.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(got._value), want,
+                               rtol=2e-4, atol=2e-5)
+
+    # dropout-mode semantics at inference
+    a = paddle.to_tensor(np.ones((4, 4), np.float32))
+    b = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    r = IF.fused_dropout_add(a, b, p=0.25, training=False,
+                             mode="downscale_in_infer")
+    np.testing.assert_allclose(np.asarray(r._value), 0.75)
+    np.testing.assert_allclose(
+        np.asarray(IF.fused_dropout_add(a, b, p=0.25,
+                                        training=False)._value), 1.0)
+
+    assert tuple(inn.FusedLinear(16, 8)(x).shape) == (2, 6, 8)
+    assert tuple(inn.FusedBiasDropoutResidualLayerNorm(
+        16, dropout_rate=0.0)(x, x).shape) == (2, 6, 16)
