@@ -1,8 +1,10 @@
 from . import functional  # noqa: F401
 from .layer import (  # noqa: F401
     FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd, FusedFeedForward,
-    FusedLinear, FusedMultiHeadAttention, FusedTransformerEncoderLayer)
+    FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer,
+    FusedTransformerEncoderLayer)
 
 __all__ = ["functional", "FusedDropoutAdd", "FusedLinear",
            "FusedBiasDropoutResidualLayerNorm", "FusedMultiHeadAttention",
-           "FusedFeedForward", "FusedTransformerEncoderLayer"]
+           "FusedFeedForward", "FusedTransformerEncoderLayer",
+           "FusedMultiTransformer"]

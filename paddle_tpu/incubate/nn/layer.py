@@ -202,3 +202,170 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask)
         return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """Stacked fused decoder layers with optional static KV caches (ref
+    fused_transformer.py:994 / `fused_multi_transformer_op.cu`).  Each
+    layer: pre/post-LN attention (qkv in the [3, nh, hd, H] fused
+    layout) + residual, then pre/post-LN FFN + residual.  `caches` are
+    per-layer [2, B, nh, max_seq, hd] buffers; with `time_step` set the
+    call is one decode step (q of length 1 attending the cache through
+    `time_step`), functional-style: updated caches are returned.
+
+    The production serving seat (paged blocks, continuous batching) is
+    `inference.ServingEngine`; this class is the API-parity dense-cache
+    form."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, num_layers=-1,
+                 nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        if num_layers == -1:
+            num_layers = len(qkv_weight_attrs) \
+                if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        hd = embed_dim // num_heads
+
+        def attr(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        def plist(name, shape, attrs, is_bias=False, ones=False):
+            out = []
+            for i in range(num_layers):
+                p = self.create_parameter(
+                    shape, attr=attr(attrs, i), is_bias=is_bias,
+                    default_initializer=_ones() if ones else None)
+                self.add_parameter(f"{name}_{i}", p)
+                out.append(p)
+            return out
+
+        self.ln_scales = plist("ln_scale", [embed_dim], ln_scale_attrs,
+                               ones=True)
+        self.ln_biases = plist("ln_bias", [embed_dim], ln_bias_attrs,
+                               is_bias=True)
+        self.qkv_weights = plist("qkv_weight",
+                                 [3, num_heads, hd, embed_dim],
+                                 qkv_weight_attrs)
+        self.qkv_biases = plist("qkv_bias", [3, num_heads, hd],
+                                qkv_bias_attrs, is_bias=True)
+        self.linear_weights = plist("linear_weight",
+                                    [embed_dim, embed_dim],
+                                    linear_weight_attrs)
+        self.linear_biases = plist("linear_bias", [embed_dim],
+                                   linear_bias_attrs, is_bias=True)
+        self.ffn_ln_scales = plist("ffn_ln_scale", [embed_dim],
+                                   ffn_ln_scale_attrs, ones=True)
+        self.ffn_ln_biases = plist("ffn_ln_bias", [embed_dim],
+                                   ffn_ln_bias_attrs, is_bias=True)
+        self.ffn1_weights = plist("ffn1_weight",
+                                  [embed_dim, dim_feedforward],
+                                  ffn1_weight_attrs)
+        self.ffn1_biases = plist("ffn1_bias", [dim_feedforward],
+                                 ffn1_bias_attrs, is_bias=True)
+        self.ffn2_weights = plist("ffn2_weight",
+                                  [dim_feedforward, embed_dim],
+                                  ffn2_weight_attrs)
+        self.ffn2_biases = plist("ffn2_bias", [embed_dim],
+                                 ffn2_bias_attrs, is_bias=True)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+        from ...framework.tensor import Tensor
+
+        x = src
+        new_caches = [] if caches is not None else None
+        for i in range(self.num_layers):
+            residual = x
+            h = paddle.nn.functional.layer_norm(
+                x, x.shape[-1:], weight=self.ln_scales[i],
+                bias=self.ln_biases[i], epsilon=self.epsilon) \
+                if self.normalize_before else x
+            qkv = paddle.einsum("bsh,cndh->bscnd", h,
+                                self.qkv_weights[i])
+            qkv = qkv + paddle.unsqueeze(
+                paddle.unsqueeze(self.qkv_biases[i], 0), 0)
+            q = paddle.transpose(qkv[:, :, 0], [0, 2, 1, 3])
+            k = paddle.transpose(qkv[:, :, 1], [0, 2, 1, 3])
+            v = paddle.transpose(qkv[:, :, 2], [0, 2, 1, 3])
+            if caches is not None and time_step is not None:
+                # one decode step against the dense cache (the cache-KV
+                # branch of fused_multi_transformer_op.cu.h)
+                cache = caches[i]._value if isinstance(caches[i], Tensor) \
+                    else caches[i]
+                t = int(time_step)
+                cache = cache.at[0, :, :, t].set(k._value[:, :, 0])
+                cache = cache.at[1, :, :, t].set(v._value[:, :, 0])
+                k = Tensor._wrap(cache[0, :, :, :t + 1])
+                v = Tensor._wrap(cache[1, :, :, :t + 1])
+                new_caches.append(Tensor._wrap(cache))
+                causal = False
+            elif caches is not None:
+                cache = caches[i]._value if isinstance(caches[i], Tensor) \
+                    else caches[i]
+                S = q.shape[2]
+                cache = cache.at[0, :, :, :S].set(k._value)
+                cache = cache.at[1, :, :, :S].set(v._value)
+                new_caches.append(Tensor._wrap(cache))
+                causal = True
+            else:
+                causal = True
+            hd = q.shape[-1]
+            s = paddle.matmul(q, k, transpose_y=True) * (hd ** -0.5)
+            if attn_mask is not None and time_step is None:
+                s = s + attn_mask
+            elif causal and attn_mask is None:
+                S = q.shape[2]
+                m = jnp.where(jnp.tril(jnp.ones((S, S), bool)), 0.0,
+                              -1e9).astype(s._value.dtype)
+                s = s + Tensor._wrap(m)
+            p = paddle.nn.functional.softmax(s, axis=-1)
+            o = paddle.matmul(p, v)
+            B, S = o.shape[0], o.shape[2]
+            o = paddle.reshape(paddle.transpose(o, [0, 2, 1, 3]),
+                               [B, S, -1])
+            o = paddle.matmul(o, self.linear_weights[i]) \
+                + self.linear_biases[i]
+            x = residual + o
+            if not self.normalize_before:
+                x = paddle.nn.functional.layer_norm(
+                    x, x.shape[-1:], weight=self.ln_scales[i],
+                    bias=self.ln_biases[i], epsilon=self.epsilon)
+            residual = x
+            h = paddle.nn.functional.layer_norm(
+                x, x.shape[-1:], weight=self.ffn_ln_scales[i],
+                bias=self.ffn_ln_biases[i], epsilon=self.epsilon) \
+                if self.normalize_before else x
+            from .functional import _FUSED_ACTS
+            act = _FUSED_ACTS.get(self.activation)
+            h = paddle.matmul(h, self.ffn1_weights[i]) \
+                + self.ffn1_biases[i]
+            h = Tensor._wrap(act(h._value))
+            h = paddle.matmul(h, self.ffn2_weights[i]) \
+                + self.ffn2_biases[i]
+            x = residual + h
+            if not self.normalize_before:
+                x = paddle.nn.functional.layer_norm(
+                    x, x.shape[-1:], weight=self.ffn_ln_scales[i],
+                    bias=self.ffn_ln_biases[i], epsilon=self.epsilon)
+        if new_caches is not None:
+            return x, new_caches
+        return x

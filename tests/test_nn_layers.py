@@ -393,3 +393,34 @@ def test_incubate_fused_layers():
     assert tuple(inn.FusedLinear(16, 8)(x).shape) == (2, 6, 8)
     assert tuple(inn.FusedBiasDropoutResidualLayerNorm(
         16, dropout_rate=0.0)(x, x).shape) == (2, 6, 16)
+
+
+def test_fused_multi_transformer_decode_parity():
+    """FusedMultiTransformer (ref fused_transformer.py:994): stacked
+    fused decoder with dense KV caches — one cached decode step equals
+    the last position of the whole-sequence forward."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.incubate import nn as inn
+
+    paddle.seed(0)
+    B, S, H, nh, L = 2, 5, 16, 4, 2
+    mt = inn.FusedMultiTransformer(H, nh, 32, num_layers=L,
+                                   normalize_before=True)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(B, S, H)
+                         .astype(np.float32))
+    assert tuple(mt(x).shape) == (B, S, H)
+    assert len(mt.parameters()) == 12 * L
+
+    hd = H // nh
+    caches = [jnp.zeros((2, B, nh, 16, hd), jnp.float32)
+              for _ in range(L)]
+    _, caches = mt(x, caches=caches)
+    tok = paddle.to_tensor(np.random.RandomState(1).randn(B, 1, H)
+                           .astype(np.float32))
+    out_d, caches = mt(tok, caches=caches, time_step=S)
+    want = mt(paddle.concat([x, tok], axis=1))
+    np.testing.assert_allclose(np.asarray(out_d._value),
+                               np.asarray(want._value)[:, -1:],
+                               rtol=2e-4, atol=2e-5)
