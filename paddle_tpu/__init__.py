@@ -72,6 +72,7 @@ from .framework.tensor_array import (TensorArray, array_length,  # noqa: F401,E4
 from .framework.tensor_variants import SelectedRows, StringTensor  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import observability  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402

@@ -1,0 +1,51 @@
+"""Version compatibility for the moving jax API surface.
+
+The repo targets the modern spelling (`jax.shard_map`, `jax.lax.pvary`);
+older jaxlibs (this container ships 0.4.x) keep the same machinery under
+`jax.experimental.shard_map` with `check_rep` instead of `check_vma` and
+have no replication-typing ops at all.  Routing every internal use
+through this module keeps the subsystems (ring attention, reshard,
+hybrid/pipeline steps) importable and runnable on both generations.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "pvary", "axis_size"]
+
+
+def axis_size(axis_name):
+    """`jax.lax.axis_size` when present; else the classic `psum(1, axis)`
+    idiom (constant-folded to the static mesh-axis size under tracing)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+    """`jax.shard_map` when present, else the experimental spelling with
+    `check_vma` mapped onto `check_rep`."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma, **kw)
+
+
+def pvary(x, axes):
+    """Mark a (pytree of) rank-invariant value(s) as varying over `axes`.
+
+    New jax tracks varying-mesh-axes types and needs the cast for scan
+    carries whose updates are rank-dependent; pre-vma jax doesn't type
+    replication, so the identity is correct there."""
+    lax = jax.lax
+    if hasattr(lax, "pcast"):
+        cast = lambda v: lax.pcast(v, axes, to="varying")  # noqa: E731
+    elif hasattr(lax, "pvary"):
+        cast = lambda v: lax.pvary(v, axes)  # noqa: E731
+    else:
+        return x
+    return jax.tree_util.tree_map(cast, x)

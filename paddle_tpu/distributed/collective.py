@@ -25,8 +25,35 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from ..core.jax_compat import axis_size as _axis_size
+from ..observability import metrics as _metrics
 from ..ops.registry import dispatch as _d, register_op
 from . import mesh as _mesh
+
+_M_COLL_CALLS = _metrics.counter(
+    "collective.calls", "collective API invocations per op")
+_M_COLL_BYTES = _metrics.counter(
+    "collective.bytes", "payload bytes entering each collective (per "
+    "invocation; inside jit capture this counts per trace, not per run)")
+
+
+def _instrument(op_name: str, *tensors) -> None:
+    """Count one collective call + its input payload bytes."""
+    if not _metrics.enabled():
+        return
+    nbytes = 0
+    for t in tensors:
+        try:
+            v = t._value if isinstance(t, Tensor) else t
+            n = 1
+            for d in v.shape:
+                n *= int(d)
+            nbytes += n * jnp.dtype(v.dtype).itemsize
+        except Exception:  # noqa: BLE001 - sizing is best-effort (tracers)
+            pass
+    _M_COLL_CALLS.inc(op=op_name)
+    if nbytes:
+        _M_COLL_BYTES.inc(nbytes, op=op_name)
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "is_initialized",
            "all_reduce", "all_gather", "all_gather_object", "reduce",
@@ -189,9 +216,9 @@ def _reducescatter_impl(x, op, axis):
         return jax.lax.psum_scatter(x, axis, tiled=True)
     if op == ReduceOp.AVG:
         return jax.lax.psum_scatter(x, axis, tiled=True) / \
-            jax.lax.axis_size(axis)
+            _axis_size(axis)
     # MAX/MIN/PROD: full reduce then slice out this rank's tile
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     if x.shape[0] % n != 0:
         raise ValueError(
             f"reduce_scatter: dim0 {x.shape[0]} not divisible by group "
@@ -267,6 +294,7 @@ def _eager_multiproc(group) -> bool:
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
     """In-place all-reduce (paddle semantics: mutates `tensor`)."""
+    _instrument("all_reduce", tensor)
     _maybe_static_check("all_reduce", tensor, group)
     axis = current_axis_for(group)
     if axis is not None:
@@ -295,6 +323,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list: List[Tensor], tensor: Tensor,
                group: Optional[Group] = None, sync_op: bool = True):
+    _instrument("all_gather", tensor)
     _maybe_static_check("all_gather", tensor, group)
     axis = current_axis_for(group)
     group = group or _get_default_group()
@@ -322,6 +351,7 @@ def all_gather(tensor_list: List[Tensor], tensor: Tensor,
 
 def all_gather_into_tensor(out: Tensor, tensor: Tensor, group=None,
                            sync_op=True):
+    _instrument("all_gather", tensor)
     axis = current_axis_for(group)
     if axis is not None:
         res = _d("c_allgather", (tensor,), {"axis": axis, "tiled": True})
@@ -415,6 +445,8 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
                    op=ReduceOp.SUM, group=None, sync_op=True):
     axis = current_axis_for(group)
     src = tensor_or_tensor_list
+    _instrument("reduce_scatter", *(src if isinstance(src, (list, tuple))
+                                    else (src,)))
     if isinstance(src, (list, tuple)):
         from ..ops.manipulation import concat
         src = concat(list(src), axis=0)
@@ -438,6 +470,7 @@ def reduce_scatter(tensor: Tensor, tensor_or_tensor_list,
 
 def alltoall(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
              group=None, sync_op=True):
+    _instrument("alltoall", *in_tensor_list)
     axis = current_axis_for(group)
     from ..ops.manipulation import split, squeeze, stack
     if axis is not None:
@@ -467,6 +500,7 @@ def alltoall(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
 def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
                     in_split_sizes=None, out_split_sizes=None, group=None,
                     sync_op=True):
+    _instrument("alltoall", in_tensor)
     axis = current_axis_for(group)
     if axis is not None:
         out = _d("c_alltoall", (in_tensor,), {"axis": axis, "split_axis": 0,
@@ -489,6 +523,7 @@ def alltoall_single(out_tensor: Tensor, in_tensor: Tensor,
 
 
 def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    _instrument("broadcast", tensor)
     axis = current_axis_for(group)
     if axis is not None:
         group = group or _get_default_group()
@@ -521,6 +556,7 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 
 def scatter(tensor: Tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _instrument("scatter", tensor)
     axis = current_axis_for(group)
     if axis is not None:
         from ..ops.manipulation import stack
@@ -632,6 +668,7 @@ def _host_p2p(tensor, peer, is_send, group):
 
 def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
     """Point-to-point over a pipeline axis = ppermute (see fleet pp_utils)."""
+    _instrument("send", tensor)
     axis = current_axis_for(group)
     if axis is None:
         if _single_rank(group):
@@ -649,6 +686,7 @@ def send(tensor: Tensor, dst: int = 0, group=None, sync_op=True):
 
 
 def recv(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    _instrument("recv", tensor)
     axis = current_axis_for(group)
     if axis is None:
         if _single_rank(group):
@@ -673,6 +711,7 @@ def barrier(group=None):
     compiler orders collectives.  Multi-process: synchronizes through the
     launcher's TCPStore (reference: ProcessGroup::Barrier).
     """
+    _instrument("barrier")
     store = _host_store()
     if store is None:
         return None

@@ -48,6 +48,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ...core.jax_compat import axis_size as _axis_size, \
+    shard_map as _compat_shard_map
+
 __all__ = [
     "HybridConfig", "init_gpt_params", "stack_for_pipeline",
     "hybrid_param_specs", "init_zero_state", "zero_state_specs",
@@ -374,7 +377,7 @@ def _moe_ffn_dist(blocks, x, lidx, cfg, dp_axis="dp"):
     residual path passes through.  The sort/scatter indices are integer
     (non-differentiable); gradients ride the gathered values and the gate
     prob, and the all_to_all transposes to the reverse all_to_all."""
-    DP = jax.lax.axis_size(dp_axis)
+    DP = _axis_size(dp_axis)
     E = cfg.moe_num_experts
     El = E // DP
     B, S, H = x.shape
@@ -771,12 +774,31 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     # psum'd over dp before the update and shards all-gathered after), but
     # the static varying-axes analysis can't prove it through all_gather
     ids_spec = P(None, "dp", "cp") if CP > 1 else P(None, "dp", None)
-    mapped = jax.shard_map(
+    mapped = _compat_shard_map(
         device_fn, mesh=mesh,
         in_specs=(specs, opt_specs, opt_specs, P(), ids_spec),
         out_specs=(P(), specs, opt_specs, opt_specs),
         check_vma=False)
-    return jax.jit(mapped)
+    jitted = jax.jit(mapped)
+
+    import time as _time
+
+    from ...observability import metrics as _metrics
+    _hist = _metrics.histogram(
+        "train.step_seconds",
+        "host wall time to dispatch one train step (labels: mode); on "
+        "async accelerators this is enqueue time unless the caller syncs "
+        "inside the step — the first sample includes XLA compile")
+
+    def timed_step(*args, **kwargs):
+        t0 = _time.perf_counter()
+        out = jitted(*args, **kwargs)
+        _hist.observe(_time.perf_counter() - t0, mode="hybrid")
+        return out
+
+    timed_step.lower = jitted.lower          # AOT/debug paths still work
+    timed_step._jitted = jitted
+    return timed_step
 
 
 # ---------------------------------------------------------------------------

@@ -183,6 +183,21 @@ class DygraphShardingOptimizer:
         to host sticks is backend-dependent: XLA:CPU ignores host
         placement annotations; on TPU the transfer is real.)"""
         target = memory_kind or jax.local_devices()[0].default_memory().kind
+        # older CPU PJRT backends expose only 'unpinned_host'; a missing
+        # pinned space degrades the offload to whatever host kind exists
+        # (or a no-op when the device can't address host memory at all)
+        try:
+            kinds = {m.kind for m in
+                     jax.local_devices()[0].addressable_memories()}
+        except Exception:  # noqa: BLE001
+            kinds = None
+        if kinds is not None and target not in kinds:
+            fallback = [k for k in kinds if "host" in k] \
+                if "host" in target else []
+            if fallback:
+                target = fallback[0]
+            else:
+                return
         shardings = getattr(self, "_acc_shardings", None)
         if shardings is None:
             shardings = self._acc_shardings = {}

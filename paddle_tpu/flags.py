@@ -145,3 +145,14 @@ define_flag("comm_static_check", False,
 define_flag("tpu_fast_rng", True,
             "use the fast 'rbg' PRNG for framework keys on TPU (an order "
             "of magnitude cheaper dropout masks); 0 = threefry everywhere")
+
+
+def _metrics_flag_changed(enabled):
+    from .observability import metrics as _metrics
+    _metrics._sync_enabled(enabled)
+
+
+define_flag("enable_metrics", True,
+            "runtime metrics registry (observability.metrics); 0 makes "
+            "every instrument a single-boolean-check no-op",
+            on_change=_metrics_flag_changed)

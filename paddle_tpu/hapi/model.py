@@ -24,7 +24,14 @@ from ..framework import io as framework_io
 from ..framework.tensor import Tensor
 from ..metric import Metric
 from ..nn.layer.layers import Layer
+from ..observability import metrics as _obs_metrics
 from .callbacks import config_callbacks
+
+_M_STEP_S = _obs_metrics.histogram(
+    "train.step_seconds",
+    "host wall time to dispatch one train step (labels: mode); on "
+    "async accelerators this is enqueue time unless the caller syncs "
+    "inside the step — the first sample includes XLA compile")
 
 __all__ = ["Model"]
 
@@ -182,7 +189,11 @@ class Model:
             fn = self._mode_fn(mode)
         if mode in ("train", "accumulate"):
             self._pending_accum = mode == "accumulate"
-        return fn(*(inputs + labels)), labels
+        import time
+        t0 = time.perf_counter()
+        out = fn(*(inputs + labels))
+        _M_STEP_S.observe(time.perf_counter() - t0, mode=mode)
+        return out, labels
 
     def train_batch(self, inputs, labels=None, update=True):
         """One optimizer step (update=False: accumulate grads only);

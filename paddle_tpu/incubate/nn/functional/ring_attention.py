@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ....core.jax_compat import axis_size as _axis_size, \
+    pvary as _compat_pvary, shard_map as _compat_shard_map
 from ....ops import pallas_flash
 
 __all__ = ["ring_attention_local", "ring_attention",
@@ -58,6 +60,27 @@ def _register():
     from ....ops.registry import register_op
     register_op("ring_attention", _ring_attention_val)
     register_op("ulysses_attention", _ulysses_attention_val)
+
+
+def _check_gqa(nh: int, nkv: int) -> None:
+    if nkv == 0 or nh % nkv:
+        raise ValueError(
+            f"GQA: num_heads ({nh}) must be a multiple of kv heads "
+            f"({nkv})")
+
+
+def _expand_kv_heads(q, k, v):
+    """GQA support for the jnp/dense fallback paths: the Pallas kernels
+    broadcast nkv < nh natively, but the fallbacks' 'bhqd,bhkd' einsums
+    need matching head axes — repeat each kv head nh/nkv times (BHSD
+    layout, head axis 1).  ADVICE r5 #3: without this, GQA inputs outside
+    the kernel envelope crashed on einsum shapes instead of computing."""
+    nh, nkv = q.shape[1], k.shape[1]
+    if nkv == nh:
+        return k, v
+    _check_gqa(nh, nkv)
+    r = nh // nkv
+    return jnp.repeat(k, r, axis=1), jnp.repeat(v, r, axis=1)
 
 
 def _block_update(q, k, v, acc, m, l, q_off, k_off, causal, scale):
@@ -174,12 +197,9 @@ def _causal_hop_idx(src, rank):
 
 def _pvary(*xs, axis_name):
     """Mark rank-invariant scan carries as varying over the manual axis so
-    carry types match the rank-dependent updates."""
-    if hasattr(jax.lax, "pcast"):
-        return tuple(jax.lax.pcast(x, (axis_name,), to="varying") for x in xs)
-    if hasattr(jax.lax, "pvary"):
-        return tuple(jax.lax.pvary(x, (axis_name,)) for x in xs)
-    return xs
+    carry types match the rank-dependent updates (jax_compat dispatches
+    the pcast/pvary spelling and no-ops on pre-vma jax)."""
+    return tuple(_compat_pvary(x, (axis_name,)) for x in xs)
 
 
 # ----------------------------------------------------- multi-device ring
@@ -195,7 +215,7 @@ def _ring_fwd(q, k, v, axis_name, causal, interpret):
     lse-merge between hops, K/V rotating via ppermute (uniform rotation so
     XLA pipelines hop i+1's permute under hop i's compute; n hops return
     the buffers home)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     B, S, nh, hd = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -229,7 +249,7 @@ def _ring_core_bwd(axis_name, causal, interpret, res, g):
     alongside, so each chunk collects its gradient contributions from every
     rank and arrives home after the full rotation."""
     q, k, v, out, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     lse_b = _lse128(lse)
@@ -262,8 +282,13 @@ _ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def _ring_local_jnp(q, k, v, axis_name, causal, scale):
-    """jnp fallback (exact online softmax) for unsupported shapes."""
-    n = jax.lax.axis_size(axis_name)
+    """jnp fallback (exact online softmax) for unsupported shapes.
+
+    GQA kv heads rotate around the ring UNEXPANDED (nkv payloads) and are
+    repeated per hop right before the block update — the ppermute traffic
+    stays 1/(nh/nkv) of the expanded size."""
+    _check_gqa(q.shape[1], k.shape[1])
+    n = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     B, H, S, D = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -276,7 +301,8 @@ def _ring_local_jnp(q, k, v, axis_name, causal, scale):
     def hop(carry, i):
         acc, m, l, k_cur, v_cur = carry
         src = (rank - i) % n
-        acc, m, l = _block_update(q, k_cur, v_cur, acc, m, l,
+        ke, ve = _expand_kv_heads(q, k_cur, v_cur)
+        acc, m, l = _block_update(q, ke, ve, acc, m, l,
                                   q_off=rank * S, k_off=src * S,
                                   causal=causal, scale=scale)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -320,7 +346,7 @@ def _ring_attention_val(q, k, v, mesh=None, axis_name="sp", causal=False,
     spec = P(None, None, axis_name, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _compat_shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         # pallas_call outputs can't declare their varying mesh axes; skip
         # the vma check (the ring math is manifestly rank-varying)
@@ -428,6 +454,7 @@ _chunk_core.defvjp(_chunk_core_fwd, _chunk_core_bwd)
 
 def _chunked_jnp(q, k, v, n_chunks, causal, scale, q_off):
     """jnp fallback: the original exact online-softmax member program."""
+    k, v = _expand_kv_heads(q, k, v)
     B, H, Sq, D = q.shape
     C = k.shape[2] // n_chunks
     kc = k.reshape(B, H, n_chunks, C, D)
@@ -489,6 +516,7 @@ def ring_attention_chunked(q, k, v, n_chunks: int, causal: bool = False,
 
 def _dense_attention(q, k, v, causal, scale):
     """Dense BHSD attention for shapes outside the Pallas envelope."""
+    k, v = _expand_kv_heads(q, k, v)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
@@ -515,7 +543,7 @@ def ulysses_attention_local(q, k, v, axis_name: str, causal: bool = False,
     Returns (B, H, S_local, D).  Differentiable (all_to_all is its own
     transpose).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     B, H, Sl, D = q.shape
     if H % n or k.shape[1] % n:
         raise ValueError(
@@ -544,7 +572,7 @@ def _ulysses_attention_val(q, k, v, mesh=None, axis_name="sep",
     spec = P(None, None, axis_name, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        _compat_shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     def run(q, k, v):
         return ulysses_attention_local(q, k, v, axis_name, causal, scale)

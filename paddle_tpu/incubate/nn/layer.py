@@ -204,6 +204,24 @@ class FusedTransformerEncoderLayer(Layer):
         return self.ffn(out)
 
 
+def _act_fns():
+    """Registry-dispatched activations (autograd-tracked), matching the
+    `_FUSED_ACTS` name set (erf gelu, like nn.functional.gelu)."""
+    import paddle_tpu as paddle
+    PF = paddle.nn.functional
+    return {"relu": PF.relu, "gelu": PF.gelu, "silu": PF.silu,
+            "sigmoid": PF.sigmoid, "tanh": paddle.tanh}
+
+
+class _LazyActs(dict):
+    def __missing__(self, key):
+        self.update(_act_fns())
+        return dict.__getitem__(self, key)
+
+
+_ACT_FNS = _LazyActs()
+
+
 class FusedMultiTransformer(Layer):
     """Stacked fused decoder layers with optional static KV caches (ref
     fused_transformer.py:994 / `fused_multi_transformer_op.cu`).  Each
@@ -354,11 +372,12 @@ class FusedMultiTransformer(Layer):
                 x, x.shape[-1:], weight=self.ffn_ln_scales[i],
                 bias=self.ffn_ln_biases[i], epsilon=self.epsilon) \
                 if self.normalize_before else x
-            from .functional import _FUSED_ACTS
-            act = _FUSED_ACTS.get(self.activation)
             h = paddle.matmul(h, self.ffn1_weights[i]) \
                 + self.ffn1_biases[i]
-            h = Tensor._wrap(act(h._value))
+            # dispatch the activation through the op registry so the tape
+            # records it — a raw jax call here detached the graph and
+            # silently dropped grads for qkv/ln/ffn1 (ADVICE r5 #2)
+            h = _ACT_FNS[self.activation](h)
             h = paddle.matmul(h, self.ffn2_weights[i]) \
                 + self.ffn2_biases[i]
             x = residual + h

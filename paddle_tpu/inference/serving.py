@@ -33,13 +33,34 @@ import math
 from collections import deque
 from typing import List, Optional
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..observability import metrics as _metrics
 
 __all__ = ["Request", "ServingEngine"]
+
+_M_ADMISSIONS = _metrics.counter(
+    "serving.admissions", "requests admitted into a decode slot")
+_M_REJECTIONS = _metrics.counter(
+    "serving.rejections", "requests rejected (kind=too_long|pool|error)")
+_M_TICKS = _metrics.counter(
+    "serving.ticks", "scheduler ticks that ran a compiled decode step")
+_M_TOKENS = _metrics.counter(
+    "serving.tokens_out", "tokens emitted to requests")
+_M_TICK_S = _metrics.histogram(
+    "serving.tick_seconds", "wall time of one decode tick (k compiled "
+    "steps + host scheduling)")
+_M_POOL = _metrics.gauge(
+    "serving.pool_occupancy", "fraction of physical KV blocks in use")
+_M_SLOTS = _metrics.gauge(
+    "serving.slot_occupancy", "fraction of batch slots holding a request")
+_M_TPS = _metrics.gauge(
+    "serving.tokens_per_sec", "decode tokens/sec over the last tick")
 
 
 class Request:
@@ -231,18 +252,32 @@ class ServingEngine:
         return fn
 
     # ----------------------------------------------------------- scheduler
+    def _pad_bucket(self, L: int) -> int:
+        """Prompt pad length: power-of-two bucket (bounds the number of
+        compiled prefill programs) CLAMPED to the block-table capacity.
+        Without the clamp a non-power-of-two max_context (e.g. 96 with
+        block_size 16, prompt 70 -> bucket 128) makes need_now exceed
+        nb_per_seq and admission crashes mid-flight leaking blocks
+        (ADVICE r5 #1/#4).  Both bounds are block multiples, so the min
+        is too."""
+        return min(_bucket(L, self.bs), self.nb_per_seq * self.bs)
+
     def add_request(self, req: Request):
         L = len(req.prompt_ids)
         if L + req.max_new_tokens > self.max_context:
+            _M_REJECTIONS.inc(kind="too_long")
             raise ValueError(
                 f"request needs {L + req.max_new_tokens}"
                 f" tokens > max_context {self.max_context}")
         # worst-case block need must fit the POOL outright, or admission
-        # can never succeed and run() would spin on the waiting queue
-        worst = self._blocks_for(_bucket(L, self.bs)) + max(
+        # can never succeed and run() would spin on the waiting queue.
+        # Uses the SAME clamped pad formula as _try_admit, so a request
+        # accepted here can never out-size the block table at admission.
+        worst = self._blocks_for(self._pad_bucket(L)) + max(
             0, self._blocks_for(L + req.max_new_tokens)
             - self._blocks_for(L))
         if worst > self.num_blocks:
+            _M_REJECTIONS.inc(kind="pool")
             raise ValueError(
                 f"request needs {worst} blocks worst-case but the pool "
                 f"has {self.num_blocks}; raise num_blocks or lower "
@@ -258,8 +293,8 @@ class ServingEngine:
             return False
         req = self.waiting[0]
         L = len(req.prompt_ids)
-        L_pad = _bucket(L, self.bs)
-        need_now = self._blocks_for(L_pad)
+        L_pad = self._pad_bucket(L)
+        need_now = self._blocks_for(L_pad)      # <= nb_per_seq by clamp
         # full reservation: prompt blocks now + growth to the worst case
         total_need = self._blocks_for(L + req.max_new_tokens)
         growth = max(0, total_need - self._blocks_for(L))
@@ -278,13 +313,26 @@ class ServingEngine:
         prompt[0, :L] = req.prompt_ids
         saved = dict((k, self._sd[k]._value) for k in self._keys)
         try:
-            row, self.pools = self._prefill_program(L_pad)(
-                param_vals, self.pools,
-                jnp.asarray(self.tables[slot:slot + 1]),
-                jnp.asarray(prompt), jnp.int32(L))
-        finally:
-            for k, v in saved.items():
-                self._sd[k]._value = v
+            try:
+                row, self.pools = self._prefill_program(L_pad)(
+                    param_vals, self.pools,
+                    jnp.asarray(self.tables[slot:slot + 1]),
+                    jnp.asarray(prompt), jnp.int32(L))
+            finally:
+                for k, v in saved.items():
+                    self._sd[k]._value = v
+        except BaseException:
+            # admission failed mid-flight: undo every host-side draw so
+            # nothing leaks (blocks back to the pool, slot freed, growth
+            # reservation returned); the request is dropped from the
+            # queue and the error propagates to the caller
+            self.tables[slot, :] = 0
+            self.free_blocks.extend(blocks)
+            self.free_slots.appendleft(slot)
+            self.reserved -= growth
+            req._growth_left = 0
+            _M_REJECTIONS.inc(kind="error")
+            raise
         # release pad-bucket blocks beyond the prompt's real span (their
         # stale contents are masked by seq_lens and overwritten by any
         # future owner before becoming visible)
@@ -292,6 +340,7 @@ class ServingEngine:
         for col in range(keep, need_now):
             self.free_blocks.append(int(self.tables[slot, col]))
             self.tables[slot, col] = 0
+        _M_ADMISSIONS.inc()
         first = req._sample(np.asarray(row))
         req.output_ids.append(first)
         req.slot = slot
@@ -299,8 +348,15 @@ class ServingEngine:
         self.seq_lens[slot] = L
         self.last_tok[slot] = first
         self.tokens_out += 1
+        _M_TOKENS.inc()
+        self._update_occupancy()
         self._maybe_finish(req, first)
         return True
+
+    def _update_occupancy(self):
+        _M_POOL.set(round(1.0 - len(self.free_blocks)
+                          / max(self.num_blocks, 1), 4))
+        _M_SLOTS.set(round(1.0 - len(self.free_slots) / max(self.B, 1), 4))
 
     def _maybe_finish(self, req: Request, tok: int):
         if req.done:
@@ -324,6 +380,7 @@ class ServingEngine:
         self.slot_req[slot] = None
         self.free_slots.append(slot)
         self.finished.append(req)
+        self._update_occupancy()
 
     def _active_slots(self):
         return [s for s in range(self.B) if self.slot_req[s] is not None]
@@ -341,6 +398,8 @@ class ServingEngine:
         active = self._active_slots()
         if not active:
             return bool(self.waiting)
+        t_tick0 = time.perf_counter()
+        toks_before = self.tokens_out
         k = self._tick_size(active)
         # ensure a physical block exists for every position this tick
         # will write (all draws covered by the admission reservation)
@@ -393,6 +452,14 @@ class ServingEngine:
                 req.output_ids.append(tok)
                 self.tokens_out += 1
                 self._maybe_finish(req, tok)
+        dt = time.perf_counter() - t_tick0
+        harvested = self.tokens_out - toks_before
+        _M_TICKS.inc()
+        _M_TICK_S.observe(dt)
+        _M_TOKENS.inc(harvested)
+        if dt > 0:
+            _M_TPS.set(round(harvested / dt, 1))
+        self._update_occupancy()
         return True
 
     def _tick_size(self, active) -> int:

@@ -40,8 +40,21 @@ import numpy as np
 
 from ..framework import random as _random
 from ..framework.tensor import Tensor
+from ..observability import metrics as _metrics
 from ..ops import registry as _registry
 from . import sot as _sot
+
+_M_JIT_TRACES = _metrics.counter(
+    "jit.traces", "to_static capture builds (record + trace passes)")
+_M_JIT_COMPILE_S = _metrics.histogram(
+    "jit.compile_seconds",
+    "capture cost per program, by stage label: stage=trace is the _build "
+    "pass (eager state-discovery run + jaxpr capture), stage=compile is "
+    "the first call (XLA compile + run)")
+_M_SOT_GUARD = _metrics.counter(
+    "jit.sot_guards", "SOT guarded-dispatch outcomes (kind=hit|miss)")
+_M_GRAPH_BREAKS = _metrics.counter(
+    "jit.graph_breaks", "signatures that fell back to eager execution")
 
 __all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module"]
 
@@ -268,6 +281,8 @@ class StaticFunction:
         return slots, changed, burned
 
     def _build(self, args, kwargs, sot=False):
+        import time as _time
+        _t_build0 = _time.perf_counter()
         slots, changed, burned = self._discover_state(args, kwargs,
                                                       sot_record=sot)
         mutable_idx = [i for i, c in enumerate(changed) if c]
@@ -342,9 +357,12 @@ class StaticFunction:
             jax.default_backend() != "cpu" else ()
         jitted = jax.jit(functional, donate_argnums=donate)
         self._stats["signatures"] += 1
+        _M_JIT_TRACES.inc(fn=self.__name__)
+        _M_JIT_COMPILE_S.observe(_time.perf_counter() - _t_build0,
+                                 fn=self.__name__, stage="trace")
         return {"slots": slots, "mutable_idx": mutable_idx,
                 "readonly_idx": readonly_idx, "jitted": jitted,
-                "spec": spec,
+                "spec": spec, "fresh": True,
                 "burned": tuple(burned) if burned is not None else None}
 
     # errors that mean "this function cannot trace as one graph" (value-
@@ -395,6 +413,7 @@ class StaticFunction:
         import warnings
         reason = (f"{type(first_err).__name__} -> SOT: "
                   f"{type(sot_err).__name__}: {sot_err}")
+        _M_GRAPH_BREAKS.inc(fn=self.__name__)
         self._stats["graph_breaks"].append(
             {"signature": repr(key[1])[:120], "reason": reason[:300]})
         self._stats["eager_calls"] += 1
@@ -431,9 +450,11 @@ class StaticFunction:
             try:
                 out = self._run_prog(prog, args, kwargs)
                 entry["last"] = burned
+                _M_SOT_GUARD.inc(kind="hit")
                 return out
             except _sot.GuardMiss as miss:
                 self._stats["guard_misses"] += 1
+                _M_SOT_GUARD.inc(kind="miss")
                 tried.add(burned)
                 nxt = _sot.match_prefix(
                     [b for b in entry["specs"] if b not in tried],
@@ -494,6 +515,13 @@ class StaticFunction:
         saved = [(s, s.get()) for s in slots]
         saved_grads = [(s, s.ref()._grad) for s in slots
                        if isinstance(s, _TensorSlot) and s.ref() is not None]
+        # cleared only after a successful observe, so a first call that
+        # raises (GuardMiss, trace fallback) still gets its compile-stage
+        # sample on the retry
+        first_call = prog.get("fresh", False)
+        if first_call:
+            import time as _time
+            _t_exec0 = _time.perf_counter()
         try:
             (out_vals, new_mutable, grad_outs, arg_grad_outs,
              guard_vals) = prog["jitted"](
@@ -505,6 +533,10 @@ class StaticFunction:
                 t = s.ref()
                 if t is not None:
                     t._grad = g
+        if first_call:
+            prog.pop("fresh", None)
+            _M_JIT_COMPILE_S.observe(_time.perf_counter() - _t_exec0,
+                                     fn=self.__name__, stage="compile")
         if prog.get("burned"):
             # guard check BEFORE any state commit: a miss discards this
             # run (inputs were not donated) and re-dispatches

@@ -1,0 +1,90 @@
+"""Runtime observability: metrics registry, trace spans, perf-evidence
+harness.
+
+Three parts (ISSUE 1 tentpole):
+
+* :mod:`.metrics` — process-wide Counter / Gauge / Histogram registry
+  with labels; ``snapshot()`` / ``export_json()`` for readout, flag-gated
+  (``FLAGS_enable_metrics``) so disabled instruments cost one boolean
+  check.
+* :func:`span` — user-labelled timing span.  Always observed into the
+  ``spans.seconds`` histogram; when a :class:`paddle_tpu.profiler.Profiler`
+  is recording, the span also lands on the host timeline (the existing
+  ``_HostTracer``), so spans show up in exported chrome traces next to
+  per-op dispatch events.
+* :mod:`.harness` — registered benchmark rungs with backend probing and
+  degradation: every rung always emits a schema-stable JSON record
+  ``{rung, ok, value|error, device, elapsed_s}`` instead of a run-killing
+  stack trace (`bench.py` drives it).
+
+Usage::
+
+    from paddle_tpu import observability as obs
+
+    with obs.span("train_step"):
+        loss = step(x, y)
+
+    obs.metrics.snapshot()                  # dict of every live metric
+    obs.metrics.export_json("metrics.json")
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from . import metrics  # noqa: F401
+from .metrics import (  # noqa: F401
+    counter, gauge, histogram, snapshot, reset, export_json,
+)
+
+__all__ = ["metrics", "harness", "span",
+           "counter", "gauge", "histogram", "snapshot", "reset",
+           "export_json"]
+
+_SPAN_SECONDS = metrics.histogram(
+    "spans.seconds", "wall time of observability.span regions")
+
+
+class span:
+    """Timing span: context manager (or begin()/end()) that records wall
+    time into the ``spans.seconds`` histogram (labelled by name) and, when
+    a Profiler is recording, onto the host chrome-trace timeline."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0: Optional[float] = None
+
+    def begin(self) -> "span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def end(self) -> Optional[float]:
+        if self._t0 is None:
+            return None
+        t0, self._t0 = self._t0, None
+        t1 = time.perf_counter()
+        _SPAN_SECONDS.observe(t1 - t0, name=self.name)
+        from ..profiler import profiler as _prof
+        tracer = _prof.active_tracer()
+        if tracer is not None:
+            tracer.add(self.name, t0, t1, category="span")
+        return t1 - t0
+
+    def __enter__(self) -> "span":
+        return self.begin()
+
+    def __exit__(self, *exc) -> bool:
+        self.end()
+        return False
+
+
+def __getattr__(name):
+    # harness is a leaf module only bench/test flows need; keep it lazy so
+    # `import paddle_tpu` never pays for it
+    if name == "harness":
+        import importlib
+        return importlib.import_module(".harness", __name__)
+    raise AttributeError(name)

@@ -32,6 +32,17 @@ from .. import flags as _flags
 from ..framework import autograd_engine as _engine
 from ..framework.dygraph import is_grad_enabled
 from ..framework.tensor import Tensor
+from ..observability import metrics as _metrics
+
+_M_DISPATCH_OPS = _metrics.counter(
+    "dispatch.ops", "eager dispatches per op name")
+_M_DISPATCH_FASTPATH = _metrics.counter(
+    "dispatch.fastpath", "per-op jitted-program cache hits/misses")
+# hot-loop instruments use pre-frozen label keys (no kwargs, no sort);
+# the explicit _ENABLED read keeps the disabled cost to one global load
+_DISPATCH_KEYS: Dict[str, tuple] = {}
+_FP_HIT_KEY = (("kind", "hit"),)
+_FP_MISS_KEY = (("kind", "miss"),)
 
 __all__ = ["OpDef", "register_op", "get_op", "dispatch", "set_autocast_hook",
            "list_ops"]
@@ -279,12 +290,15 @@ def _fast_programs(name: str, treedef, skey, fn_flat):
     key = (name, treedef, skey)
     fwd = _fast_fwd.get(key)
     if fwd is None:
+        _M_DISPATCH_FASTPATH.inc_key(_FP_MISS_KEY)
         fwd = jax.jit(fn_flat)
         _fast_fwd[key] = fwd
 
         def bwd(primals, cot):
             return jax.vjp(fn_flat, *primals)[1](cot)
         _fast_bwd[key] = jax.jit(bwd)
+    elif _metrics._ENABLED:
+        _M_DISPATCH_FASTPATH.inc_key(_FP_HIT_KEY)
     return fwd, _fast_bwd[key]
 
 
@@ -333,6 +347,11 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
                    static: Dict[str, Any], op: Optional[OpDef] = None):
     if op is None:
         op = _OPS[name]
+    if _metrics._ENABLED:
+        k = _DISPATCH_KEYS.get(name)
+        if k is None:
+            k = _DISPATCH_KEYS[name] = (("op", name),)
+        _M_DISPATCH_OPS.inc_key(k)
     if _op_stats_sink is not None:
         _op_stats_sink[name] = _op_stats_sink.get(name, 0) + 1
     vals, leaves, treedef = _flatten_inputs(diff_inputs)

@@ -191,6 +191,12 @@ class _HostTracer:
 _active_tracer: Optional[_HostTracer] = None
 
 
+def active_tracer() -> Optional[_HostTracer]:
+    """The recording host tracer, if a Profiler is currently in a RECORD
+    state (observability.span uses this to land spans on the timeline)."""
+    return _active_tracer
+
+
 class RecordEvent:
     """User-labelled span on the host timeline (`profiler/utils.py`
     RecordEvent).  Usable as context manager or begin()/end()."""

@@ -19,6 +19,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy tests excluded from the tier-1 fast run "
+        "(`-m 'not slow'`); a plain pytest invocation runs everything")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything():
     import paddle_tpu as paddle

@@ -2,6 +2,8 @@
 backend" strategy from SURVEY.md §4: real XLA collectives, no TPU pod)."""
 
 import jax
+
+from paddle_tpu.core.jax_compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -103,7 +105,9 @@ def test_zero1_sharded_optimizer_state(hybrid_env):
     net.bias.grad = paddle.randn([2])
     hopt.step()
     m1 = opt._accumulators["moment1"][id(net.weight)]
-    assert m1.sharding.spec == P("sharding")
+    # older jax keeps trailing Nones on PartitionSpec; compare normalized
+    assert tuple(s for s in m1.sharding.spec if s is not None) == \
+        ("sharding",)
     # bias (size 2, not divisible by shard degree 2? it is) — just exists
     assert id(net.bias) in opt._accumulators["moment1"]
 
@@ -187,7 +191,7 @@ def test_collectives_inside_shard_map(hybrid_env):
             dist.all_reduce(t, group=g)
             return t._value
 
-    y = jax.jit(jax.shard_map(worker, mesh=m, in_specs=P("mp"),
+    y = jax.jit(compat_shard_map(worker, mesh=m, in_specs=P("mp"),
                               out_specs=P("mp")))(
         jnp.arange(8, dtype=jnp.float32))
     np.testing.assert_allclose(np.asarray(y), [4, 6, 8, 10, 4, 6, 8, 10])
@@ -206,7 +210,7 @@ def test_allgather_reducescatter_inside_shard_map(hybrid_env):
             return summed._value
 
     x = jnp.arange(8, dtype=jnp.float32)
-    y = jax.jit(jax.shard_map(worker, mesh=m, in_specs=P("dp"),
+    y = jax.jit(compat_shard_map(worker, mesh=m, in_specs=P("dp"),
                               out_specs=P("dp")))(x)
     np.testing.assert_allclose(np.asarray(y), [4, 6, 8, 10, 4, 6, 8, 10])
 
@@ -230,7 +234,7 @@ def test_spmd_pipeline_matches_serial():
         local = jax.tree_util.tree_map(lambda a: a[0], params)
         return pipeline_forward(stage_fn, local, inputs, n_microbatches=M)
 
-    out = jax.jit(jax.shard_map(pipe, mesh=mesh, in_specs=(P("pp"), P()),
+    out = jax.jit(compat_shard_map(pipe, mesh=mesh, in_specs=(P("pp"), P()),
                                 out_specs=P()))(stacked, jnp.asarray(x))
     ref = x.copy()
     for W in Ws:
@@ -340,6 +344,10 @@ def test_stage2_validates_params(hybrid_mesh):
 def test_stage2_offload_places_state_in_host_memory(hybrid_mesh):
     """ZeRO-Offload: optimizer state lives in pinned host memory (the
     jax memory_kind equivalent of the reference's CPU-side Adam)."""
+    kinds = {m.kind for m in jax.local_devices()[0].addressable_memories()}
+    if "pinned_host" not in kinds:
+        pytest.skip("backend exposes no pinned_host memory space "
+                    f"(has {sorted(kinds)}); offload degrades to default")
     import paddle_tpu as paddle
     from paddle_tpu.distributed.fleet.sharding import (
         GroupShardedOptimizerStage2)

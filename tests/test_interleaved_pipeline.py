@@ -10,6 +10,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from paddle_tpu.core.jax_compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -55,7 +57,7 @@ def test_interleaved_forward_matches_serial(pp, vpp, M):
     mesh = _mesh(pp)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        compat_shard_map, mesh=mesh,
         in_specs=(jax.tree_util.tree_map(lambda _: P(None, "pp"),
                                          chunk_stack),
                   P()),
@@ -88,7 +90,7 @@ def test_interleaved_training_matches_serial():
     pspec = jax.tree_util.tree_map(lambda _: P(None, "pp"), chunk_stack)
 
     def loss_pipeline(params_vp, inp, tgt):
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(compat_shard_map, mesh=mesh,
                            in_specs=(pspec, P(), P()), out_specs=P())
         def run(pl, i, t):
             local = jax.tree_util.tree_map(lambda l: l[:, 0], pl)
@@ -139,7 +141,7 @@ def test_gpipe_and_interleaved_agree():
     mesh4 = _mesh(4)
     stack4 = stack_stage_params(stages)
 
-    @functools.partial(jax.shard_map, mesh=mesh4,
+    @functools.partial(compat_shard_map, mesh=mesh4,
                        in_specs=(jax.tree_util.tree_map(
                            lambda _: P("pp"), stack4), P()),
                        out_specs=P())
@@ -155,7 +157,7 @@ def test_gpipe_and_interleaved_agree():
         [stack_stage_params([stages[v * pp + r] for r in range(pp)])
          for v in range(vpp)])
 
-    @functools.partial(jax.shard_map, mesh=mesh2,
+    @functools.partial(compat_shard_map, mesh=mesh2,
                        in_specs=(jax.tree_util.tree_map(
                            lambda _: P(None, "pp"), chunk_stack), P()),
                        out_specs=P())

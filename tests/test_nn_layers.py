@@ -424,3 +424,30 @@ def test_fused_multi_transformer_decode_parity():
     np.testing.assert_allclose(np.asarray(out_d._value),
                                np.asarray(want._value)[:, -1:],
                                rtol=2e-4, atol=2e-5)
+
+
+def test_fused_multi_transformer_grad_flow():
+    """Regression (ADVICE r5 #2): the FFN activation used to run as a raw
+    jax call wrapped back into a Tensor, detaching the tape — every
+    parameter upstream of the activation (qkv/ln/ffn1) silently got no
+    gradient while ffn2 still did.  All parameter groups must now
+    receive nonzero grads through a training step."""
+    import numpy as np
+
+    from paddle_tpu.incubate import nn as inn
+
+    paddle.seed(0)
+    mt = inn.FusedMultiTransformer(16, 2, 32, num_layers=1,
+                                   activation="gelu",
+                                   normalize_before=True)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4, 16).astype(np.float32))
+    loss = paddle.sum(mt(x) * mt(x))
+    loss.backward()
+    sd = dict(mt.named_parameters())
+    for name in ("qkv_weight_0", "ln_scale_0", "ffn1_weight_0",
+                 "ffn2_weight_0", "ffn_ln_scale_0", "linear_weight_0"):
+        g = sd[name].grad
+        assert g is not None, f"{name} got no gradient"
+        assert float(np.abs(np.asarray(g._value)).max()) > 0, \
+            f"{name} gradient is all-zero"

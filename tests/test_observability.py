@@ -1,0 +1,416 @@
+"""Observability subsystem: metrics registry semantics, instrumentation
+hooks in the hot layers, the span API, and the perf-evidence harness's
+degradation guarantees (ISSUE 1)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import harness, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    metrics.reset()
+    yield
+    paddle.set_flags({"enable_metrics": True})
+    metrics.reset()
+
+
+# ------------------------------------------------------------------- core
+
+def test_counter_semantics():
+    c = metrics.counter("t.counter", "help text")
+    c.inc()
+    c.inc(2)
+    c.inc(op="add")
+    c.inc(3, op="add")
+    c.inc(op="mul")
+    assert c.value() == 3
+    assert c.value(op="add") == 4
+    assert c.value(op="mul") == 1
+    assert c.total() == 8
+    snap = metrics.snapshot()["t.counter"]
+    assert snap["type"] == "counter" and snap["help"] == "help text"
+    assert {"labels": {"op": "mul"}, "value": 1} in snap["series"]
+
+
+def test_counter_get_or_create_idempotent():
+    a = metrics.counter("t.same")
+    b = metrics.counter("t.same")
+    assert a is b
+    with pytest.raises(ValueError):
+        metrics.gauge("t.same")
+
+
+def test_gauge_semantics():
+    g = metrics.gauge("t.gauge")
+    assert g.value() is None
+    g.set(0.5)
+    g.set(0.75)
+    assert g.value() == 0.75
+    g.inc(0.25)
+    g.dec(0.5)
+    assert abs(g.value() - 0.5) < 1e-9
+    g.set(3, slot="a")
+    assert g.value(slot="a") == 3
+
+
+def test_histogram_semantics():
+    h = metrics.histogram("t.hist", buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert abs(h.sum() - 55.55) < 1e-9
+    val = metrics.snapshot()["t.hist"]["series"][0]["value"]
+    assert val["count"] == 4
+    assert val["min"] == 0.05 and val["max"] == 50.0
+    assert val["buckets"] == {"0.1": 1, "1.0": 1, "10.0": 1, "+inf": 1}
+    assert abs(val["mean"] - 55.55 / 4) < 1e-9
+
+
+def test_label_cardinality_overflow():
+    c = metrics.counter("t.cardinality")
+    limit = type(c).MAX_SERIES
+    for i in range(limit + 10):
+        c.inc(rid=i)
+    snap = metrics.snapshot()["t.cardinality"]["series"]
+    assert len(snap) == limit + 1          # capped + one overflow series
+    overflow = [s for s in snap if s["labels"] == {"__overflow__": "true"}]
+    assert overflow and overflow[0]["value"] == 10
+
+
+def test_disabled_mode_is_noop():
+    c = metrics.counter("t.disabled")
+    h = metrics.histogram("t.disabled_h")
+    paddle.set_flags({"enable_metrics": False})
+    assert not metrics.enabled()
+    c.inc()
+    c.inc_key((("op", "x"),))
+    h.observe(1.0)
+    metrics.gauge("t.disabled_g").set(5)
+    assert metrics.snapshot() == {}
+    paddle.set_flags({"enable_metrics": True})
+    assert metrics.enabled()
+    c.inc()
+    assert c.total() == 1
+
+
+def test_reset_keeps_definitions():
+    c = metrics.counter("t.reset")
+    c.inc(5)
+    metrics.reset()
+    assert metrics.counter("t.reset") is c
+    assert c.total() == 0
+    assert "t.reset" not in metrics.snapshot()  # no data -> omitted
+
+
+def test_export_json(tmp_path):
+    metrics.counter("t.export").inc(7, kind="x")
+    path = tmp_path / "metrics.json"
+    text = metrics.export_json(str(path))
+    doc = json.loads(path.read_text())
+    assert json.loads(text) == doc
+    assert doc["schema"] == "paddle_tpu.metrics/v1"
+    assert doc["metrics"]["t.export"]["series"][0]["value"] == 7
+
+
+def test_span_histogram_and_chrome_trace(tmp_path):
+    from paddle_tpu.profiler import Profiler
+    with obs.span("outside_profiler"):
+        pass
+    h = metrics.get("spans.seconds")
+    assert h.count(name="outside_profiler") == 1
+    # inside a recording profiler the span lands on the host timeline
+    with Profiler() as p:
+        with obs.span("inside_profiler"):
+            sum(range(100))
+        path = p.export(str(tmp_path / "trace.json"))
+    events = json.load(open(path))["traceEvents"]
+    assert any(e["name"] == "inside_profiler" and e["cat"] == "span"
+               for e in events)
+
+
+# -------------------------------------------------------- instrumentation
+
+def test_dispatch_instrumentation():
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    y = paddle.add(paddle.multiply(x, x), x)
+    del y
+    ops = metrics.get("dispatch.ops")
+    assert ops.value(op="add") >= 1
+    assert ops.value(op="multiply") >= 1
+    fp = metrics.get("dispatch.fastpath")
+    assert fp.total() >= 1  # hits and/or misses were recorded
+
+
+def test_jit_compile_metrics():
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(a):
+        return a * 2 + 1
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    f(x)
+    f(x)  # cache hit: no new trace
+    traces = metrics.get("jit.traces")
+    assert traces.value(fn="f") == 1
+    comp = metrics.get("jit.compile_seconds")
+    assert comp.count(fn="f", stage="trace") == 1
+    assert comp.count(fn="f", stage="compile") == 1
+
+
+def test_collective_instrumentation():
+    from paddle_tpu import distributed as dist
+    x = paddle.to_tensor(np.ones((8, 4), np.float32))
+    dist.all_reduce(x)          # single-rank no-op, still counted
+    dist.broadcast(x, src=0)
+    calls = metrics.get("collective.calls")
+    assert calls.value(op="all_reduce") == 1
+    assert calls.value(op="broadcast") == 1
+    nbytes = metrics.get("collective.bytes")
+    assert nbytes.value(op="all_reduce") == 8 * 4 * 4
+
+
+def test_serving_instrumentation_and_export(tmp_path):
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt3_tiny())
+    model.eval()
+    eng = ServingEngine(model, max_batch=2, max_context=64, block_size=16)
+    rng = np.random.RandomState(0)
+    eng.add_request(Request(rng.randint(1, 100, (8,)), max_new_tokens=4))
+    eng.run()
+    snap = metrics.snapshot()
+    assert snap["serving.admissions"]["series"][0]["value"] == 1
+    assert snap["serving.tokens_out"]["series"][0]["value"] >= 4
+    assert snap["serving.ticks"]["series"][0]["value"] >= 1
+    assert "serving.pool_occupancy" in snap
+    assert "serving.tokens_per_sec" in snap
+    # exportable as JSON (acceptance: non-empty snapshot -> artifact)
+    doc = json.loads(metrics.export_json(str(tmp_path / "m.json")))
+    assert doc["metrics"]["serving.tokens_out"]["series"][0]["value"] >= 4
+
+
+def test_serving_rejection_metrics():
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt3_tiny())
+    model.eval()
+    eng = ServingEngine(model, max_batch=1, max_context=32, block_size=16)
+    with pytest.raises(ValueError):
+        eng.add_request(Request(np.arange(1, 30), max_new_tokens=16))
+    rej = metrics.get("serving.rejections")
+    assert rej.value(kind="too_long") == 1
+
+
+def test_train_step_latency_histogram():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.hapi import Model
+
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m = Model(net)
+    m.prepare(optimizer=optimizer.SGD(learning_rate=0.1,
+                                      parameters=net.parameters()),
+              loss=nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    y = np.array([0, 1, 0, 1], np.int64)
+    m.train_batch([x], [y])
+    m.train_batch([x], [y])
+    h = metrics.get("train.step_seconds")
+    assert h.count(mode="train") == 2
+
+
+# ----------------------------------------------------------------- harness
+
+def _fail_devices(monkeypatch):
+    import jax
+
+    def boom():
+        raise RuntimeError("no backend: simulated tunnel outage")
+    monkeypatch.setattr(jax, "devices", boom)
+
+
+def test_probe_backend_survives_raising_devices(monkeypatch):
+    _fail_devices(monkeypatch)
+    probe = harness.probe_backend()
+    assert probe["ok"] is False
+    assert "simulated tunnel outage" in probe["error"]
+
+
+def test_harness_degradation(monkeypatch):
+    """Backend gone: TPU rungs degrade to backend_unavailable, CPU rungs
+    still run and emit real measurements, a raising rung emits an error
+    record — every record schema-valid, nothing raises."""
+    _fail_devices(monkeypatch)
+
+    @harness.register_rung("_t_tpu_only", requires="tpu")
+    def tpu_rung(ctx):
+        raise AssertionError("must not run")
+
+    @harness.register_rung("_t_cpu_ok")
+    def cpu_rung(ctx):
+        assert ctx.on_tpu is False
+        return {"answer": 42}
+
+    @harness.register_rung("_t_cpu_boom")
+    def cpu_boom(ctx):
+        raise ValueError("inner rung failure")
+
+    try:
+        recs = harness.run(["_t_tpu_only", "_t_cpu_ok", "_t_cpu_boom"])
+    finally:
+        for n in ("_t_tpu_only", "_t_cpu_ok", "_t_cpu_boom"):
+            harness._REGISTRY.pop(n, None)
+    by = {r["rung"]: r for r in recs}
+    assert by["_t_tpu_only"]["ok"] is False
+    assert by["_t_tpu_only"]["reason"] == "backend_unavailable"
+    assert by["_t_cpu_ok"]["ok"] is True
+    assert by["_t_cpu_ok"]["value"] == {"answer": 42}
+    assert by["_t_cpu_boom"]["ok"] is False
+    assert "inner rung failure" in by["_t_cpu_boom"]["error"]
+    for r in recs:
+        assert harness.validate_record(r) is None, harness.validate_record(r)
+
+
+def test_harness_budget_and_smoke_gates():
+    @harness.register_rung("_t_costly", est_cold_s=1000)
+    def costly(ctx):
+        return {}
+
+    @harness.register_rung("_t_smokeless")
+    def smokeless(ctx):
+        return {}
+
+    try:
+        rec = harness.run_rung(harness.get_rung("_t_costly"),
+                               budget_left=lambda: 5.0)
+        assert rec["ok"] is False and rec["reason"] == "budget"
+        rec = harness.run_rung(harness.get_rung("_t_smokeless"), smoke=True)
+        assert rec["ok"] is False and rec["reason"] == "skipped_smoke"
+    finally:
+        harness._REGISTRY.pop("_t_costly", None)
+        harness._REGISTRY.pop("_t_smokeless", None)
+
+
+def test_validate_record_rejects_malformed():
+    assert harness.validate_record("nope") is not None
+    assert harness.validate_record({}) is not None
+    assert harness.validate_record(
+        {"rung": "x", "ok": True, "device": "cpu",
+         "elapsed_s": 0.1}) is not None      # ok without value
+    assert harness.validate_record(
+        {"rung": "x", "ok": False, "device": "cpu",
+         "elapsed_s": 0.1}) is not None      # degraded without reason
+    assert harness.validate_record(
+        {"rung": "x", "ok": True, "device": "cpu", "elapsed_s": 0.1,
+         "value": {"a": 1}}) is None
+
+
+def test_regression_check_reads_both_artifact_generations(tmp_path):
+    prev = tmp_path / "BENCH_r99.json"
+    prev.write_text(json.dumps({
+        "tail": "\n".join([
+            json.dumps({"bench": "gpt124m_train", "tokens_per_sec": 100.0}),
+            json.dumps({"rung": "lenet_train", "ok": True, "device": "x",
+                        "elapsed_s": 1.0,
+                        "value": {"jit_imgs_per_sec": 200.0}}),
+        ])}))
+    current = [
+        {"rung": "gpt124m_train", "ok": True, "device": "x",
+         "elapsed_s": 1.0, "value": {"tokens_per_sec": 50.0}},
+        {"rung": "lenet_train", "ok": True, "device": "x",
+         "elapsed_s": 1.0, "value": {"jit_imgs_per_sec": 220.0}},
+    ]
+    out = harness.regression_check(
+        current, previous=str(prev),
+        keys={"gpt124m_train": "tokens_per_sec",
+              "lenet_train": "jit_imgs_per_sec"})
+    assert out["rel_delta"]["gpt124m_train"] == -0.5
+    assert out["rel_delta"]["lenet_train"] == 0.1
+    assert out["regressed"] == ["gpt124m_train"]
+
+
+# ------------------------------------------------------------ bench driver
+
+def _import_bench():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_backend_unavailable_exits_zero(monkeypatch, tmp_path,
+                                              capsys):
+    """Acceptance: with `jax.devices` raising, bench.py exits 0 and the
+    artifact holds ok:false backend_unavailable records for TPU rungs and
+    real measurements for the CPU rungs."""
+    bench = _import_bench()
+    _fail_devices(monkeypatch)
+    art = tmp_path / "artifact.json"
+    rc = bench.main(["--rungs", "all", "--smoke", "--out", str(art)])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    headline = json.loads(out[-1])
+    assert headline["metric"] == "gpt124m_train_tokens_per_sec"
+    doc = json.loads(art.read_text())
+    assert doc["backend"]["ok"] is False
+    recs = {r["rung"]: r for r in doc["records"]}
+    for r in doc["records"]:
+        assert harness.validate_record(r) is None, harness.validate_record(r)
+    # every TPU-only rung degraded, none crashed the run
+    for name in ("tuner_memory_validation", "gpt124m_decode_32k_config",
+                 "gpt350m_train"):
+        assert recs[name]["ok"] is False
+        assert recs[name]["reason"] == "backend_unavailable"
+    # the CPU-salvageable smoke rungs produced real measurements
+    for name in ("dispatch_overhead", "serving_continuous_batching",
+                 "ring_attention_8k", "metrics_overhead"):
+        assert recs[name]["ok"] is True, recs[name]
+        assert recs[name]["value"], name
+
+
+def test_bench_cpu_smoke_subprocess(tmp_path):
+    """CI/tooling satellite: `python bench.py --rungs cpu --smoke` runs in
+    seconds on CPU, exits 0, and every rung emits schema-valid JSON."""
+    art = tmp_path / "smoke.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_BUDGET_S="400")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--rungs", "cpu", "--smoke", "--out", str(art)],
+        capture_output=True, text=True, timeout=390, cwd=REPO, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    headline = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert headline["metric"] == "gpt124m_train_tokens_per_sec"
+    doc = json.loads(art.read_text())
+    assert doc["schema"] == harness.SCHEMA
+    names = set()
+    ok_names = set()
+    for rec in doc["records"]:
+        assert harness.validate_record(rec) is None, \
+            (rec, harness.validate_record(rec))
+        names.add(rec["rung"])
+        if rec["ok"]:
+            ok_names.add(rec["rung"])
+    # the named CPU rungs really measured (ISSUE acceptance)
+    assert {"dispatch_overhead", "serving_continuous_batching",
+            "ring_attention_8k"} <= ok_names
+    # stderr carried one JSON line per rung
+    stderr_rungs = {json.loads(line)["rung"]
+                    for line in proc.stderr.splitlines()
+                    if line.startswith("{")}
+    assert names <= stderr_rungs

@@ -9,6 +9,8 @@ import numpy as np
 import pytest
 
 import jax
+
+from paddle_tpu.core.jax_compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -246,7 +248,7 @@ def test_partial_to_replicate_matches_full_matmul():
 
     import functools
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(compat_shard_map, mesh=mesh,
                        in_specs=(P(None, "mp"), P("mp", None)),
                        out_specs=P("mp"))
     def partial_mm(xl, wl):
@@ -267,7 +269,7 @@ def test_partial_to_shard_reduce_scatter():
 
     import functools
 
-    @functools.partial(jax.shard_map, mesh=mesh,
+    @functools.partial(compat_shard_map, mesh=mesh,
                        in_specs=(P(None, "mp"), P("mp", None)),
                        out_specs=P("mp"))
     def partial_mm(xl, wl):
