@@ -60,7 +60,11 @@ def enable_compile_cache():
         pass
 
 
+from paddle_tpu.observability import flight_recorder as _flight  # noqa: E402
 from paddle_tpu.observability import harness  # noqa: E402
+# the ONE FLOPs/MFU accounting helper — bench, the models'
+# flops_per_token and the auto-tuner cost model all read the same table
+from paddle_tpu.observability.flops import peak_flops  # noqa: E402,F401
 
 # metric keys to diff against the previous round, per rung (higher=better)
 _REGRESSION_KEYS = {
@@ -70,27 +74,10 @@ _REGRESSION_KEYS = {
     "bert_base_mlm_train": "tokens_per_sec",
     "gpt350m_train": "tokens_per_sec",
     "gpt124m_decode": "paged_tokens_per_sec",
+    "telemetry_train": "tokens_per_sec",
 }
 
 _ENV_PROBE = {}
-
-
-def peak_flops(device_kind: str) -> float:
-    """bf16 peak FLOP/s per chip by device kind (public spec sheets)."""
-    kind = (device_kind or "").lower()
-    table = {
-        "tpu v5 lite": 197e12,   # v5e
-        "tpu v5e": 197e12,
-        "tpu v5": 459e12,        # v5p
-        "tpu v5p": 459e12,
-        "tpu v4": 275e12,
-        "tpu v6 lite": 918e12,   # v6e (Trillium)
-        "tpu v6e": 918e12,
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 197e12 if "tpu" in kind else 2e12  # conservative default / CPU
 
 
 def _timeit(fn):
@@ -203,6 +190,55 @@ def bench_gpt124m(ctx):
             "tokens_per_sec": round(tokens_per_sec, 1),
             "flops_per_token": fpt, "mfu": round(mfu, 4),
             "loss": float(loss.item())}
+
+
+@harness.register_rung("telemetry_train", est_cold_s=120, smoke=True)
+def bench_telemetry_train(ctx):
+    """ISSUE 2 acceptance rung: a short compiled GPT train loop driven
+    step-by-step under a StepTimeline, so the record carries per-step
+    evidence — compute/comm/host fractions, tokens/sec and MFU from the
+    shared FLOPs helper — instead of a bare throughput claim.  Each
+    step syncs the loss to the host inside the bracket (the timeline
+    measures completed steps, not enqueue time)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m, gpt3_tiny
+    from paddle_tpu.observability import telemetry
+
+    on_tpu = ctx.on_tpu
+    paddle.seed(0)
+    cfg = gpt3_124m() if on_tpu else gpt3_tiny()
+    B, S, steps = (4, 1024, 8) if on_tpu else (2, 64, 4)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def train_step(ids, labels):
+        loss = model.compute_loss(ids, labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    step = to_static(train_step)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32))
+
+    tl = telemetry.StepTimeline(name="bench.telemetry_train",
+                                flops_per_token=model.flops_per_token(S),
+                                device_kind=ctx.device_kind)
+    for _ in range(steps):
+        with tl.step(tokens=B * S) as st:
+            loss = step(ids, labels)
+            st.annotate(loss=float(np.asarray(loss._value)), synced=True)
+    summ = tl.summary()
+    return {"batch": B, "seq": S, "steps": steps,
+            "tokens_per_sec": summ["tokens_per_sec"],
+            "mfu": summ.get("mfu"), "timeline": summ}
 
 
 @harness.register_rung("env_probe", est_cold_s=30, smoke=True)
@@ -924,6 +960,19 @@ def main(argv=None) -> int:
 
     def emit(rec):
         nonlocal headline_done
+        if not rec.get("ok") and rec.get("error"):
+            # rung died: drop a flight-recorder dump next to the JSON
+            # record so an rc!=0-style artifact (BENCH_r05) still carries
+            # the last-K steps/events/metrics of what ran before it
+            base = os.path.splitext(args.out)[0] if args.out \
+                else "BENCH_failed"
+            dump_path = f"{base}.flight.{rec['rung']}.json"
+            try:
+                _flight.default_recorder().dump(
+                    dump_path, reason=f"rung_failure:{rec['rung']}")
+                rec["flight_dump"] = dump_path
+            except Exception:  # noqa: BLE001 - evidence is best-effort
+                pass
         _emit(rec)
         # headline goes out the moment its rung lands — if the driver
         # caps wall time, the stdout metric line is already committed
@@ -934,7 +983,8 @@ def main(argv=None) -> int:
 
     records = harness.run(args.rungs, smoke=args.smoke,
                           budget_left=remaining_s, emit=emit, probe=probe,
-                          release=_release_device_memory)
+                          release=_release_device_memory,
+                          collect_metrics=True)
     if not headline_done:
         _headline(None)
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List
 
+from ...observability.flops import training_flops_per_token
 from .tuner import Trial
 
 __all__ = ["ModelSpec", "Hardware", "estimate_params", "estimate_memory",
@@ -81,7 +82,12 @@ def estimate_step_time(trial: Trial, spec: ModelSpec,
     tokens = spec.global_batch_size * spec.seq_len
     data_ways = trial.dp * trial.sharding
     model_ways = trial.mp * trial.pp
-    flops_dev = 6.0 * p * tokens / (data_ways * model_ways)
+    # per-token train FLOPs from the ONE shared accounting helper
+    # (observability.flops) — the same 6N + 12LHS the models and bench
+    # report MFU against, so tuner rankings and measured MFU agree
+    fpt = training_flops_per_token(p, spec.num_layers, spec.hidden_size,
+                                   spec.seq_len)
+    flops_dev = fpt * tokens / (data_ways * model_ways)
     compute = flops_dev / (hw.peak_flops * hw.mfu_ceiling)
 
     # DP gradient all-reduce: ring 2(n-1)/n of the local grad bytes

@@ -783,18 +783,62 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
 
     import time as _time
 
+    from ... import flags as _pt_flags
+    from ...observability import flight_recorder as _flight
     from ...observability import metrics as _metrics
+    from ...observability import telemetry as _telemetry
     _hist = _metrics.histogram(
         "train.step_seconds",
         "host wall time to dispatch one train step (labels: mode); on "
         "async accelerators this is enqueue time unless the caller syncs "
         "inside the step — the first sample includes XLA compile")
 
+    # per-token FLOPs of THIS config for the telemetry MFU line, via the
+    # shared accounting helper (params estimated from the config shape).
+    # The timeline is per-factory — a second config in the same process
+    # gets its own FLOPs binding instead of inheriting the first's —
+    # and its records still reach the process flight-recorder ring.
+    from ...observability.flops import training_flops_per_token
+    n_params = (cfg.num_layers * (4 * cfg.hidden_size ** 2
+                                  + 2 * cfg.hidden_size
+                                  * cfg.intermediate_size)
+                + 2 * cfg.vocab_size * cfg.hidden_size
+                + cfg.seq_len * cfg.hidden_size)
+    tl = _telemetry.StepTimeline(
+        name="train",
+        flops_per_token=training_flops_per_token(
+            n_params, cfg.num_layers, cfg.hidden_size, cfg.seq_len),
+        device_kind=str(getattr(mesh.devices.flat[0], "device_kind",
+                                "cpu")))
+    _step_count = [0]
+
     def timed_step(*args, **kwargs):
+        _step_count[0] += 1
+        ids = args[4] if len(args) > 4 else kwargs.get("ids")
+        tokens = int(ids.size) if ids is not None else 0
+        # periodic watchdog probe: materializing the (tiny, scalar) loss
+        # is a host sync, so it runs INSIDE the bracket — on probe steps
+        # wall_s is completed-step time (record marked synced), on the
+        # others it is enqueue time.  The probe itself is independent of
+        # the metrics gate (the annotation no-ops when the registry is
+        # off, the check never does).
+        probe = _flight.enabled() and _step_count[0] % max(
+            int(_pt_flags.get_flag("nan_watchdog_interval")), 1) == 0
+        loss = None
         t0 = _time.perf_counter()
-        out = jitted(*args, **kwargs)
+        with _flight.guard("hybrid.train_step"), \
+                tl.step(tokens=tokens, mode="hybrid") as st:
+            out = jitted(*args, **kwargs)
+            if probe:
+                loss = float(np.asarray(out[0]))
+                st.annotate(loss=loss, synced=True)
         _hist.observe(_time.perf_counter() - t0, mode="hybrid")
+        if probe:
+            _flight.check_finite(loss, site="hybrid.train_step.loss",
+                                 step=_step_count[0])
         return out
+
+    timed_step.timeline = tl                 # readout for callers/tests
 
     timed_step.lower = jitted.lower          # AOT/debug paths still work
     timed_step._jitted = jitted

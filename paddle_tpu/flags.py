@@ -156,3 +156,34 @@ define_flag("enable_metrics", True,
             "runtime metrics registry (observability.metrics); 0 makes "
             "every instrument a single-boolean-check no-op",
             on_change=_metrics_flag_changed)
+
+
+def _nan_watchdog_flag_changed(enabled):
+    from .observability import flight_recorder as _fr
+    _fr._sync_enabled(enabled)
+
+
+define_flag("enable_nan_watchdog", False,
+            "NaN/Inf watchdog on instrumented train-loop losses "
+            "(observability.flight_recorder.check_finite) + automatic "
+            "flight-recorder dumps on unhandled train-step exceptions; "
+            "off (the default) = a single-boolean-check no-op that never "
+            "touches the probed value",
+            on_change=_nan_watchdog_flag_changed)
+define_flag("nan_watchdog_interval", 1,
+            "train steps between watchdog loss checks on async paths "
+            "(each check materializes the loss on the host; hapi already "
+            "syncs the loss every step, so this gates the hybrid step)")
+def _flight_capacity_changed(value):
+    from .observability import flight_recorder as _fr
+    _fr._sync_capacity(value)
+
+
+define_flag("flight_recorder_steps", 64,
+            "ring capacity of the flight recorder (last-K step records "
+            "and events kept for post-mortem dumps); resizes the "
+            "default recorder at runtime",
+            on_change=_flight_capacity_changed)
+define_flag("flight_dump_dir", "",
+            "directory automatic flight-recorder dumps are written to "
+            "(empty = current working directory)")

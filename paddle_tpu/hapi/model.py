@@ -24,7 +24,9 @@ from ..framework import io as framework_io
 from ..framework.tensor import Tensor
 from ..metric import Metric
 from ..nn.layer.layers import Layer
+from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs_metrics
+from ..observability import telemetry as _telemetry
 from .callbacks import config_callbacks
 
 _M_STEP_S = _obs_metrics.histogram(
@@ -32,6 +34,23 @@ _M_STEP_S = _obs_metrics.histogram(
     "host wall time to dispatch one train step (labels: mode); on "
     "async accelerators this is enqueue time unless the caller syncs "
     "inside the step — the first sample includes XLA compile")
+
+
+def _batch_tokens(inputs) -> int:
+    """Telemetry token heuristic: 2-D integer batches are [B, S] token
+    ids and count B*S; anything else (images, dense features) counts
+    batch rows."""
+    if not inputs:
+        return 0
+    x = inputs[0]
+    shape = getattr(x, "shape", None) or ()
+    if not shape:
+        return 1
+    try:
+        is_ids = len(shape) == 2 and np.dtype(x.dtype).kind in "iu"
+    except Exception:  # noqa: BLE001 - exotic dtype: fall back to rows
+        is_ids = False
+    return int(shape[0]) * int(shape[1]) if is_ids else int(shape[0])
 
 __all__ = ["Model"]
 
@@ -191,7 +210,10 @@ class Model:
             self._pending_accum = mode == "accumulate"
         import time
         t0 = time.perf_counter()
-        out = fn(*(inputs + labels))
+        # the guard turns an unhandled train-step exception into a
+        # flight-recorder dump (watchdog flag on) before it propagates
+        with _flight.guard(f"hapi.{mode}_step"):
+            out = fn(*(inputs + labels))
         _M_STEP_S.observe(time.perf_counter() - t0, mode=mode)
         return out, labels
 
@@ -200,11 +222,26 @@ class Model:
         returns (loss_numpy, [metric results])."""
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer=..., loss=...) first")
-        res, labs = self._run_step("train" if update else "accumulate",
-                                   inputs, labels)
-        loss, outputs = res[0], res[1:]
+        # the telemetry bracket spans dispatch AND the loss host read, so
+        # wall_s is completed-step time even on async backends (the
+        # record is marked synced)
+        st = _telemetry.default_timeline().step(
+            tokens=_batch_tokens(to_list(inputs)),
+            mode="train" if update else "accumulate")
+        with st:
+            res, labs = self._run_step("train" if update else "accumulate",
+                                       inputs, labels)
+            loss = res[0]
+            loss_np = np.asarray(loss._value)
+            st.annotate(loss=float(loss_np.reshape(-1)[0]), synced=True)
+        outputs = res[1:]
         metrics = self._update_metrics(outputs, labs)
-        return np.asarray(loss._value), metrics
+        # NaN/Inf watchdog probe — gated ONLY by its own flag, so it
+        # fires even with the metrics registry (and the timeline) off
+        _flight.check_finite(float(loss_np.reshape(-1)[0]),
+                             site="hapi.train.loss",
+                             step=st.index if st.index >= 0 else None)
+        return loss_np, metrics
 
     def eval_batch(self, inputs, labels=None):
         res, labs = self._run_step("eval", inputs, labels)

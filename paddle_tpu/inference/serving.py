@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
 
 __all__ = ["Request", "ServingEngine"]
@@ -47,7 +48,12 @@ __all__ = ["Request", "ServingEngine"]
 _M_ADMISSIONS = _metrics.counter(
     "serving.admissions", "requests admitted into a decode slot")
 _M_REJECTIONS = _metrics.counter(
-    "serving.rejections", "requests rejected (kind=too_long|pool|error)")
+    "serving.rejections",
+    "requests rejected or stalled, by reason: over_context (prompt + "
+    "budget exceed max_context), capacity (worst-case blocks exceed the "
+    "whole pool — can never fit), pool_exhausted (admission deferred "
+    "because the pool is currently drained; counted once per request), "
+    "error (admission failed mid-flight)")
 _M_TICKS = _metrics.counter(
     "serving.ticks", "scheduler ticks that ran a compiled decode step")
 _M_TOKENS = _metrics.counter(
@@ -265,7 +271,7 @@ class ServingEngine:
     def add_request(self, req: Request):
         L = len(req.prompt_ids)
         if L + req.max_new_tokens > self.max_context:
-            _M_REJECTIONS.inc(kind="too_long")
+            _M_REJECTIONS.inc(reason="over_context")
             raise ValueError(
                 f"request needs {L + req.max_new_tokens}"
                 f" tokens > max_context {self.max_context}")
@@ -277,7 +283,7 @@ class ServingEngine:
             0, self._blocks_for(L + req.max_new_tokens)
             - self._blocks_for(L))
         if worst > self.num_blocks:
-            _M_REJECTIONS.inc(kind="pool")
+            _M_REJECTIONS.inc(reason="capacity")
             raise ValueError(
                 f"request needs {worst} blocks worst-case but the pool "
                 f"has {self.num_blocks}; raise num_blocks or lower "
@@ -299,6 +305,13 @@ class ServingEngine:
         total_need = self._blocks_for(L + req.max_new_tokens)
         growth = max(0, total_need - self._blocks_for(L))
         if len(self.free_blocks) - self.reserved < need_now + growth:
+            # admission deferred on a drained pool: counted ONCE per
+            # request so rejected/stalled traffic is diagnosable from the
+            # metrics snapshot alone (the request stays queued and admits
+            # when evictions return blocks)
+            if not getattr(req, "_deferral_counted", False):
+                req._deferral_counted = True
+                _M_REJECTIONS.inc(reason="pool_exhausted")
             return False
         self.waiting.popleft()
         slot = self.free_slots.popleft()
@@ -331,7 +344,7 @@ class ServingEngine:
             self.free_slots.appendleft(slot)
             self.reserved -= growth
             req._growth_left = 0
-            _M_REJECTIONS.inc(kind="error")
+            _M_REJECTIONS.inc(reason="error")
             raise
         # release pad-bucket blocks beyond the prompt's real span (their
         # stale contents are masked by seq_lens and overwritten by any
@@ -415,19 +428,20 @@ class ServingEngine:
         param_vals = [self._sd[k]._value for k in self._keys]
         saved = dict((kk, self._sd[kk]._value) for kk in self._keys)
         try:
-            if k == 1:
-                greedy, logits, self.pools = self._decode_program()(
-                    param_vals, self.pools, jnp.asarray(self.tables),
-                    jnp.asarray(self.seq_lens),
-                    jnp.asarray(self.last_tok))
-                toks = np.asarray(greedy)[:, None]
-            else:
-                logits = None
-                toks, self.pools = self._decode_multi_program(k)(
-                    param_vals, self.pools, jnp.asarray(self.tables),
-                    jnp.asarray(self.seq_lens),
-                    jnp.asarray(self.last_tok))
-                toks = np.asarray(toks)
+            with _flight.guard("serving.tick"):
+                if k == 1:
+                    greedy, logits, self.pools = self._decode_program()(
+                        param_vals, self.pools, jnp.asarray(self.tables),
+                        jnp.asarray(self.seq_lens),
+                        jnp.asarray(self.last_tok))
+                    toks = np.asarray(greedy)[:, None]
+                else:
+                    logits = None
+                    toks, self.pools = self._decode_multi_program(k)(
+                        param_vals, self.pools, jnp.asarray(self.tables),
+                        jnp.asarray(self.seq_lens),
+                        jnp.asarray(self.last_tok))
+                    toks = np.asarray(toks)
         finally:
             for kk, v in saved.items():
                 self._sd[kk]._value = v
@@ -460,6 +474,16 @@ class ServingEngine:
         if dt > 0:
             _M_TPS.set(round(harvested / dt, 1))
         self._update_occupancy()
+        if _metrics.enabled():
+            # the flight ring keeps the last-K ticks, so a post-mortem
+            # dump of a wedged/crashed engine shows what was in flight
+            _flight.default_recorder().record_step({
+                "timeline": "serving", "step": self.steps,
+                "wall_s": round(dt, 6), "decode_steps": k,
+                "tokens": harvested,
+                "tokens_per_sec": round(harvested / dt, 1) if dt else 0.0,
+                "active": len(active), "waiting": len(self.waiting),
+                "free_blocks": len(self.free_blocks)})
         return True
 
     def _tick_size(self, active) -> int:

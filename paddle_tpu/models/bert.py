@@ -193,9 +193,10 @@ class BertForMaskedLM(nn.Layer):
         return sum(p.size for p in self.parameters())
 
     def flops_per_token(self, seq_len) -> float:
-        n = self.num_params()
-        attn = 12 * self.cfg.num_layers * self.cfg.hidden_size * seq_len
-        return 6.0 * n + attn
+        from ..observability.flops import training_flops_per_token
+        return training_flops_per_token(
+            self.num_params(), self.cfg.num_layers, self.cfg.hidden_size,
+            seq_len)
 
 
 class BertForSequenceClassification(nn.Layer):

@@ -322,11 +322,12 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
         return sum(p.size for p in self.parameters())
 
     def flops_per_token(self, seq_len=None) -> float:
-        """~6N + attention flops per token (fwd+bwd), standard MFU accounting."""
-        n = self.num_params()
-        s = seq_len or self.cfg.max_seq_len
-        attn = 12 * self.cfg.num_layers * self.cfg.hidden_size * s
-        return 6.0 * n + attn
+        """Train-step FLOPs/token via the shared MFU accounting helper
+        (`observability.flops`: 6N + 12*L*H*S)."""
+        from ..observability.flops import training_flops_per_token
+        return training_flops_per_token(
+            self.num_params(), self.cfg.num_layers, self.cfg.hidden_size,
+            seq_len or self.cfg.max_seq_len)
 
 
 def gpt3_tiny(**kw):

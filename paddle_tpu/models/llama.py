@@ -294,10 +294,10 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         return sum(p.size for p in self.parameters())
 
     def flops_per_token(self, seq_len=None) -> float:
-        n = self.num_params()
-        s = seq_len or self.cfg.max_seq_len
-        attn = 12 * self.cfg.num_layers * self.cfg.hidden_size * s
-        return 6.0 * n + attn
+        from ..observability.flops import training_flops_per_token
+        return training_flops_per_token(
+            self.num_params(), self.cfg.num_layers, self.cfg.hidden_size,
+            seq_len or self.cfg.max_seq_len)
 
 
 def llama_tiny(**kw):

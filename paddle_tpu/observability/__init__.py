@@ -1,7 +1,7 @@
-"""Runtime observability: metrics registry, trace spans, perf-evidence
-harness.
+"""Runtime observability: metrics registry, trace spans, step telemetry,
+flight recorder, perf-evidence harness.
 
-Three parts (ISSUE 1 tentpole):
+Parts (ISSUE 1 + ISSUE 2 tentpoles):
 
 * :mod:`.metrics` — process-wide Counter / Gauge / Histogram registry
   with labels; ``snapshot()`` / ``export_json()`` for readout, flag-gated
@@ -12,6 +12,16 @@ Three parts (ISSUE 1 tentpole):
   is recording, the span also lands on the host timeline (the existing
   ``_HostTracer``), so spans show up in exported chrome traces next to
   per-op dispatch events.
+* :mod:`.telemetry` — per-training-step :class:`~.telemetry.StepTimeline`
+  records (wall/compile/comm split, compute/comm/host fractions,
+  tokens/sec, MFU via the shared :mod:`.flops` helper).
+* :mod:`.flight_recorder` — bounded ring of the last K step records +
+  events, dumped to JSON on demand, on an unhandled train-step
+  exception, or when the NaN/Inf watchdog
+  (``FLAGS_enable_nan_watchdog``) trips.  CLI:
+  ``python -m paddle_tpu.observability.dump``.
+* :mod:`.flops` — the ONE FLOPs/MFU accounting helper (models, the
+  auto-tuner cost model, bench and telemetry all use it).
 * :mod:`.harness` — registered benchmark rungs with backend probing and
   degradation: every rung always emits a schema-stable JSON record
   ``{rung, ok, value|error, device, elapsed_s}`` instead of a run-killing
@@ -21,8 +31,12 @@ Usage::
 
     from paddle_tpu import observability as obs
 
-    with obs.span("train_step"):
+    tl = obs.telemetry.StepTimeline(flops_per_token=fpt,
+                                    device_kind="tpu v5e")
+    with tl.step(tokens=B * S) as st:
         loss = step(x, y)
+    st.annotate(loss=float(loss))
+    tl.summary()                            # fractions, tokens/s, MFU
 
     obs.metrics.snapshot()                  # dict of every live metric
     obs.metrics.export_json("metrics.json")
@@ -34,11 +48,15 @@ import time
 from typing import Optional
 
 from . import metrics  # noqa: F401
+from . import flops  # noqa: F401
+from . import flight_recorder  # noqa: F401
+from . import telemetry  # noqa: F401
 from .metrics import (  # noqa: F401
     counter, gauge, histogram, snapshot, reset, export_json,
 )
 
-__all__ = ["metrics", "harness", "span",
+__all__ = ["metrics", "harness", "span", "telemetry", "flight_recorder",
+           "flops",
            "counter", "gauge", "histogram", "snapshot", "reset",
            "export_json"]
 

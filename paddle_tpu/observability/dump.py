@@ -1,0 +1,75 @@
+"""Observability dump CLI.
+
+    python -m paddle_tpu.observability.dump             # newest flight dump
+    python -m paddle_tpu.observability.dump --dir prof/ # search there
+    python -m paddle_tpu.observability.dump --registry  # live registry
+
+Prints ONE JSON document on stdout.  Default mode locates the newest
+``flight_*.json`` written by the flight recorder (automatic NaN/hang/
+exception dumps or ``bench.py`` failure artifacts) in ``--dir`` (falls
+back to ``FLAGS_flight_dump_dir``, then the cwd) and echoes it;
+``--registry`` instead snapshots THIS process's metrics registry — which
+for a fresh CLI process shows the instruments import-time wiring creates,
+so it doubles as a smoke check that the registry imports cleanly.
+
+Exit codes: 0 = document printed, 1 = no dump found (the reason goes to
+stderr so stdout stays machine-readable).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Optional
+
+
+def find_latest_dump(directory: str) -> Optional[str]:
+    """Newest flight_*.json by mtime (dump counters are per-process, so
+    name order is not time order across runs)."""
+    paths = glob.glob(os.path.join(directory, "flight_*.json"))
+    paths += glob.glob(os.path.join(directory, "*.flight.*.json"))
+    if not paths:
+        return None
+    return max(paths, key=lambda p: (os.path.getmtime(p), p))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=None,
+                   help="directory to search for flight dumps "
+                        "(default: FLAGS_flight_dump_dir, then cwd)")
+    p.add_argument("--registry", action="store_true",
+                   help="print this process's metrics registry snapshot "
+                        "instead of a flight dump")
+    p.add_argument("--path", default=None,
+                   help="print this exact dump file (skips the search)")
+    args = p.parse_args(argv)
+
+    if args.registry:
+        from . import metrics
+        print(metrics.export_json())
+        return 0
+
+    path = args.path
+    if path is None:
+        directory = args.dir
+        if directory is None:
+            from .. import flags as _flags
+            directory = str(_flags.get_flag("flight_dump_dir")) or "."
+        path = find_latest_dump(directory)
+        if path is None:
+            print(f"no flight_*.json dump found in {directory!r}",
+                  file=sys.stderr)
+            return 1
+    with open(path) as f:
+        doc = json.load(f)
+    print(json.dumps(doc, indent=1))
+    print(f"(from {path})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

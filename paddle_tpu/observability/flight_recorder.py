@@ -1,0 +1,257 @@
+"""Flight recorder: a bounded ring of recent step records + a NaN/Inf
+watchdog that turns a dying run into a JSON post-mortem.
+
+The reference stack treats this as a first-class subsystem — the comm
+task manager's hang traces (`comm_task_manager.h:37`, mirrored by
+`distributed/watchdog.py`) and the `FLAGS_check_nan_inf` op scanner.
+This module is the training-loop-level counterpart: the last K
+StepTimeline records, recent named events, and the metrics registry are
+kept in memory (cheap deque appends) and dumped to a schema-stable JSON
+document
+
+* on demand (``default_recorder().dump(path)`` / the
+  ``python -m paddle_tpu.observability.dump`` CLI),
+* on an unhandled exception inside an instrumented train step / serving
+  tick (the :class:`guard` context manager), or
+* when the NaN/Inf watchdog trips — :func:`check_finite` records WHICH
+  instrumented site first went non-finite and at which step.
+
+Cost model mirrors ``FLAGS_enable_metrics``: the watchdog is gated by
+``FLAGS_enable_nan_watchdog`` (default OFF), and the gated paths
+(:func:`check_finite`, :class:`guard` dump-on-exception) are a single
+module-global boolean check when disabled — in particular
+:func:`check_finite` never touches its value argument when off, so
+passing a device array costs nothing and forces no sync.  When on, each
+check materializes the value on the host (that is the point); callers
+space checks with ``FLAGS_nan_watchdog_interval``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["FlightRecorder", "default_recorder", "check_finite", "guard",
+           "enabled", "last_dump_path", "FLIGHT_SCHEMA"]
+
+FLIGHT_SCHEMA = "paddle_tpu.flight/v1"
+
+# Synced from FLAGS_enable_nan_watchdog (flags.py installs the hook).
+_ENABLED = False
+
+
+def _sync_enabled(value: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _init_from_flag() -> None:
+    try:
+        from .. import flags as _flags
+        _sync_enabled(_flags.get_flag("enable_nan_watchdog"))
+    except Exception:  # noqa: BLE001 - flag not registered yet (early import)
+        pass
+
+
+def _flag(name: str, default):
+    try:
+        from .. import flags as _flags
+        return _flags.get_flag(name)
+    except Exception:  # noqa: BLE001
+        return default
+
+
+class FlightRecorder:
+    """Bounded in-memory evidence buffer; ``dump()`` is the readout.
+
+    ``record_step`` keeps the dict by REFERENCE (no copy): StepTimeline
+    annotates its last record (loss arrives after the step returns) and
+    the annotation must be visible in a later dump.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(_flag("flight_recorder_steps", 64))
+        self._lock = threading.Lock()
+        self.first_nonfinite: Optional[Dict[str, Any]] = None
+        self.dump_count = 0
+        self._steps: deque = deque(maxlen=1)
+        self._events: deque = deque(maxlen=1)
+        self.resize(capacity)
+
+    def resize(self, capacity: int) -> None:
+        """Re-bound the ring (keeps the newest entries).  Wired to
+        FLAGS_flight_recorder_steps changes for the default recorder."""
+        capacity = max(int(capacity), 1)
+        with self._lock:
+            self.capacity = capacity
+            self._steps = deque(self._steps, maxlen=capacity)
+            self._events = deque(self._events, maxlen=capacity)
+
+    # ------------------------------------------------------------ recording
+    def record_step(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._steps.append(record)
+
+    def record_event(self, kind: str, **info) -> None:
+        with self._lock:
+            self._events.append(dict(info, kind=kind,
+                                     unix_time=round(time.time(), 3)))
+
+    def note_nonfinite(self, site: str, step: Optional[int] = None,
+                       value: Optional[float] = None) -> bool:
+        """Record a non-finite observation; only the FIRST one per run is
+        kept as `first_nonfinite` (that is the one that names the bug).
+        Returns True when this call was the first."""
+        with self._lock:
+            first = self.first_nonfinite is None
+            if first:
+                self.first_nonfinite = {
+                    "site": site, "step": step,
+                    "value": repr(value),
+                    "unix_time": round(time.time(), 3)}
+        self.record_event("nonfinite", site=site, step=step,
+                          value=repr(value))
+        return first
+
+    def steps(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._steps)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._steps.clear()
+            self._events.clear()
+            self.first_nonfinite = None
+
+    # -------------------------------------------------------------- readout
+    def snapshot(self, reason: str = "manual") -> Dict[str, Any]:
+        """The full post-mortem document: last-K step records, recent
+        events, the first non-finite site, and the metrics registry."""
+        return {"schema": FLIGHT_SCHEMA,
+                "unix_time": round(time.time(), 3),
+                "pid": os.getpid(),
+                "reason": reason,
+                "capacity": self.capacity,
+                "first_nonfinite": self.first_nonfinite,
+                "steps": self.steps(),
+                "events": self.events(),
+                "metrics": _metrics.snapshot()}
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> Dict[str, Any]:
+        """Write the snapshot as JSON (when `path` given) and return it."""
+        doc = self.snapshot(reason)
+        if path is not None:
+            dirname = os.path.dirname(path)
+            if dirname:
+                os.makedirs(dirname, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=1, default=repr)
+            global _LAST_DUMP_PATH
+            _LAST_DUMP_PATH = path
+        return doc
+
+
+_default: Optional[FlightRecorder] = None
+_default_lock = threading.Lock()
+_LAST_DUMP_PATH: Optional[str] = None
+
+
+def default_recorder() -> FlightRecorder:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = FlightRecorder()
+    return _default
+
+
+def _sync_capacity(value) -> None:
+    """FLAGS_flight_recorder_steps on_change hook: resize the default
+    recorder (if it exists yet) so runtime set_flags works like the
+    other observability flags."""
+    if _default is not None:
+        _default.resize(int(value))
+
+
+def last_dump_path() -> Optional[str]:
+    return _LAST_DUMP_PATH
+
+
+def _auto_dump(rec: FlightRecorder, reason: str) -> Optional[str]:
+    """Unattended dump (watchdog trip / unhandled exception): writes into
+    FLAGS_flight_dump_dir (cwd when empty), never raises."""
+    directory = str(_flag("flight_dump_dir", "")) or "."
+    rec.dump_count += 1
+    tag = "".join(c if c.isalnum() or c in "-_" else "_"
+                  for c in reason)[:48]
+    path = os.path.join(
+        directory, f"flight_{tag}_{os.getpid()}_{rec.dump_count}.json")
+    try:
+        rec.dump(path, reason)
+        return path
+    except Exception:  # noqa: BLE001 - evidence is best-effort by design
+        return None
+
+
+def check_finite(value, site: str, step: Optional[int] = None,
+                 recorder: Optional[FlightRecorder] = None) -> bool:
+    """NaN/Inf watchdog probe.  Flag off: returns True without touching
+    `value` (no host sync, no float conversion — the verified no-op
+    path).  Flag on: materializes `value` as a float; on NaN/Inf records
+    the site/step and, for the first trip, writes an automatic dump."""
+    if not _ENABLED:
+        return True
+    try:
+        v = float(value)
+    except (TypeError, ValueError):  # non-scalar probe: not checkable
+        return True
+    if math.isfinite(v):
+        return True
+    rec = recorder if recorder is not None else default_recorder()
+    if rec.note_nonfinite(site, step, v):
+        _auto_dump(rec, reason=f"nonfinite_{site}")
+    return False
+
+
+class guard:
+    """Context manager: on an unhandled exception inside an instrumented
+    region (train step, serving tick, bench rung) record the error into
+    the flight ring and — watchdog flag on — write an automatic dump
+    before the exception propagates."""
+
+    __slots__ = ("site",)
+
+    def __init__(self, site: str):
+        self.site = site
+
+    def __enter__(self) -> "guard":
+        return self
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        if exc is not None and _ENABLED and not isinstance(
+                exc, (KeyboardInterrupt, SystemExit, GeneratorExit)):
+            rec = default_recorder()
+            rec.record_event("exception", site=self.site,
+                             error=f"{type(exc).__name__}: {exc}"[:300])
+            _auto_dump(rec, reason=f"exception_{self.site}")
+        return False
+
+
+_init_from_flag()

@@ -31,6 +31,9 @@ from dataclasses import dataclass
 from types import SimpleNamespace
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from . import flight_recorder as _flight
+from . import metrics as _metrics
+
 __all__ = ["Rung", "register_rung", "rung_names", "get_rung",
            "probe_backend", "run_rung", "run", "select",
            "validate_record", "regression_check", "SCHEMA"]
@@ -108,9 +111,16 @@ def _ctx(probe: Dict[str, Any], smoke: bool) -> SimpleNamespace:
 
 def run_rung(rung: Rung, probe: Optional[Dict[str, Any]] = None,
              smoke: bool = False,
-             budget_left: Optional[Callable[[], float]] = None
-             ) -> Dict[str, Any]:
-    """Run one rung in isolation; always returns a schema-valid record."""
+             budget_left: Optional[Callable[[], float]] = None,
+             collect_metrics: bool = False) -> Dict[str, Any]:
+    """Run one rung in isolation; always returns a schema-valid record.
+
+    With ``collect_metrics`` the registry is reset before the rung and
+    snapshotted after, so the record carries the rung's OWN metric
+    deltas under a ``metrics`` key — every BENCH artifact then
+    self-evidences what actually ran (ISSUE 2): a tokens/sec claim sits
+    next to the dispatch/collective/serving counters it produced.
+    """
     if probe is None:
         probe = probe_backend()
     ctx = _ctx(probe, smoke)
@@ -123,6 +133,9 @@ def run_rung(rung: Rung, probe: Optional[Dict[str, Any]] = None,
         return dict(base, ok=False, reason="budget",
                     remaining_s=round(budget_left(), 1),
                     est_cold_s=rung.est_cold_s)
+    if collect_metrics:
+        _metrics.reset()
+    _flight.default_recorder().record_event("rung_begin", rung=rung.name)
     t0 = time.perf_counter()
     try:
         value = rung.fn(ctx)
@@ -134,7 +147,11 @@ def run_rung(rung: Rung, probe: Optional[Dict[str, Any]] = None,
     except BaseException as e:  # noqa: BLE001 - a rung must never kill a run
         rec = dict(base, ok=False,
                    error=f"{type(e).__name__}: {e}"[:500])
+        _flight.default_recorder().record_event(
+            "rung_error", rung=rung.name, error=rec["error"][:300])
     rec["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    if collect_metrics:
+        rec["metrics"] = _metrics.snapshot()
     return rec
 
 
@@ -160,19 +177,25 @@ def run(names: Optional[Sequence[str] | str] = None, smoke: bool = False,
         budget_left: Optional[Callable[[], float]] = None,
         emit: Optional[Callable[[Dict[str, Any]], None]] = None,
         probe: Optional[Dict[str, Any]] = None,
-        release: Optional[Callable[[], None]] = None) -> List[Dict[str, Any]]:
+        release: Optional[Callable[[], None]] = None,
+        collect_metrics: bool = False) -> List[Dict[str, Any]]:
     """Run a selection of rungs; returns their records in order.  ``emit``
     is called per record as it lands (streaming JSON lines); ``release``
-    runs between rungs (device-memory cleanup)."""
+    runs between rungs (device-memory cleanup); ``collect_metrics``
+    attaches each rung's own registry delta to its record."""
     if probe is None:
         probe = probe_backend()
     records = []
     for rung in select(names):
-        rec = run_rung(rung, probe, smoke, budget_left)
+        rec = run_rung(rung, probe, smoke, budget_left,
+                       collect_metrics=collect_metrics)
         records.append(rec)
         if emit is not None:
             emit(rec)
-        if release is not None and rec.get("ok"):
+        # release after every rung that actually RAN — including failed
+        # ones (an OOM'd rung leaving its buffers pinned would cascade
+        # into every later rung); gate-skipped records did no device work
+        if release is not None and (rec.get("ok") or "error" in rec):
             try:
                 release()
             except Exception:  # noqa: BLE001 - cleanup is best-effort

@@ -216,6 +216,17 @@ class Histogram(_Metric):
             s = self._series.get(tuple(sorted(labels.items())))
             return s[1] if s else 0.0
 
+    def total_count(self) -> int:
+        """Observation count over every label series (telemetry diffs
+        this across a step bracket)."""
+        with self._lock:
+            return sum(s[0] for s in self._series.values())
+
+    def total_sum(self) -> float:
+        """Sum of observed values over every label series."""
+        with self._lock:
+            return sum(s[1] for s in self._series.values())
+
     def _snapshot_value(self, raw):
         count, total, mn, mx, bucket_counts = raw
         return {"count": count, "sum": total,

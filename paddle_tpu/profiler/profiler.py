@@ -316,6 +316,16 @@ class Profiler:
         elif recording_old and not recording_new:
             self._teardown_tracer()
 
+    def _record_flight_event(self, state: str):
+        # profiler transitions land in the flight-recorder ring so a
+        # post-mortem dump shows whether a trace was recording (and at
+        # which step) when the run died
+        from ..observability import flight_recorder as _fr
+        from ..observability import metrics as _metrics
+        if _metrics.enabled():
+            _fr.default_recorder().record_event(
+                "profiler", state=state, step=self.step_num)
+
     def _setup_tracer(self):
         global _active_tracer
         if self.timer_only:
@@ -323,6 +333,7 @@ class Profiler:
         self._tracer = _HostTracer()
         self._reported = False
         _active_tracer = self._tracer
+        self._record_flight_event("record_start")
         from ..ops import registry
         registry.set_op_timer(self._tracer.op_timer)
         if self._device_trace_dir:
@@ -339,6 +350,7 @@ class Profiler:
         registry.set_op_timer(None)
         if _active_tracer is self._tracer:
             _active_tracer = None
+            self._record_flight_event("record_stop")
         if self._tracer is not None:
             self._tracer.close()  # drain native buffers while still owner
         if self._device_tracing:

@@ -212,7 +212,13 @@ def test_serving_rejection_metrics():
     with pytest.raises(ValueError):
         eng.add_request(Request(np.arange(1, 30), max_new_tokens=16))
     rej = metrics.get("serving.rejections")
-    assert rej.value(kind="too_long") == 1
+    assert rej.value(reason="over_context") == 1
+    # worst-case block need beyond the WHOLE pool: a capacity rejection
+    eng2 = ServingEngine(model, max_batch=1, max_context=64, block_size=16,
+                         num_blocks=2)
+    with pytest.raises(ValueError):
+        eng2.add_request(Request(np.arange(1, 17), max_new_tokens=40))
+    assert rej.value(reason="capacity") == 1
 
 
 def test_train_step_latency_histogram():
@@ -378,9 +384,18 @@ def test_bench_backend_unavailable_exits_zero(monkeypatch, tmp_path,
         assert recs[name]["reason"] == "backend_unavailable"
     # the CPU-salvageable smoke rungs produced real measurements
     for name in ("dispatch_overhead", "serving_continuous_batching",
-                 "ring_attention_8k", "metrics_overhead"):
+                 "ring_attention_8k", "metrics_overhead",
+                 "telemetry_train"):
         assert recs[name]["ok"] is True, recs[name]
         assert recs[name]["value"], name
+        # ISSUE 2: every bench rung record self-evidences with its own
+        # metrics delta
+        assert isinstance(recs[name].get("metrics"), dict), name
+    # the telemetry rung embeds a StepTimeline summary with fractions +
+    # MFU from the shared FLOPs helper
+    summ = recs["telemetry_train"]["value"]["timeline"]
+    assert set(summ["fractions"]) == {"compute", "comm", "host"}
+    assert "mfu" in summ and summ["steps"] >= 1
 
 
 def test_bench_cpu_smoke_subprocess(tmp_path):
@@ -408,7 +423,17 @@ def test_bench_cpu_smoke_subprocess(tmp_path):
             ok_names.add(rec["rung"])
     # the named CPU rungs really measured (ISSUE acceptance)
     assert {"dispatch_overhead", "serving_continuous_batching",
-            "ring_attention_8k"} <= ok_names
+            "ring_attention_8k", "telemetry_train"} <= ok_names
+    # ISSUE 2 acceptance: per-rung records carry a metrics snapshot and
+    # the telemetry rung a StepTimeline summary (fractions + MFU)
+    recs = {r["rung"]: r for r in doc["records"]}
+    for name in ok_names:
+        assert isinstance(recs[name].get("metrics"), dict), name
+    summ = recs["telemetry_train"]["value"]["timeline"]
+    assert set(summ["fractions"]) == {"compute", "comm", "host"}
+    assert abs(sum(summ["fractions"].values()) - 1.0) < 0.02
+    assert isinstance(summ.get("mfu"), float)
+    assert summ["flops_per_token"] > 0 and summ["peak_flops"] > 0
     # stderr carried one JSON line per rung
     stderr_rungs = {json.loads(line)["rung"]
                     for line in proc.stderr.splitlines()
