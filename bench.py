@@ -844,6 +844,52 @@ def bench_ring_attention(ctx):
             "ring_member_temp_mb": round(res["ring"][1] / 2**20, 1)}
 
 
+def _sampled_decode_sweep(model, cfg, on_tpu):
+    """Sampled-decode throughput at steps_per_tick in {1, 4} with the
+    double-buffered tick overlap off and on (the round-6 serving fast
+    path): a mixed greedy+sampled batch runs to completion per cell.
+    On-device sampling keeps sampled requests on the full k-step tick,
+    so the k=4 cells measure exactly the RTT amortization the old
+    host-side sampler forfeited."""
+    from paddle_tpu.flags import flag_guard
+    from paddle_tpu.inference.serving import Request, ServingEngine
+
+    rng = np.random.RandomState(7)
+    plen = 64 if on_tpu else 12
+    budget = 64 if on_tpu else 11
+    out = {}
+
+    def mk(seed=None):
+        ids = rng.randint(1, cfg.vocab_size, (plen,))
+        if seed is None:
+            return Request(ids, max_new_tokens=budget)
+        return Request(ids, max_new_tokens=budget, do_sample=True,
+                       temperature=0.9, top_k=40, seed=seed)
+
+    for k in (1, 4):
+        for overlap in (False, True):
+            with flag_guard(serving_overlap=overlap):
+                eng = ServingEngine(model, max_batch=4,
+                                    max_context=1024 if on_tpu else 128,
+                                    steps_per_tick=k)
+                # warm run compiles the prefill bucket and BOTH decode
+                # variants (budget spans full ticks + a k=1 tail)
+                eng.add_request(mk(seed=1))
+                eng.add_request(mk())
+                eng.run()
+                eng.finished.clear()
+                for r in (mk(seed=2), mk(seed=3), mk()):
+                    eng.add_request(r)
+                t0 = time.perf_counter()
+                toks0 = eng.tokens_out
+                eng.run()
+                dt = time.perf_counter() - t0
+                cell = f"k{k}_{'overlap' if overlap else 'sync'}"
+                out[cell + "_tokens_per_sec"] = round(
+                    (eng.tokens_out - toks0) / dt, 1)
+    return out
+
+
 @harness.register_rung("serving_continuous_batching", est_cold_s=240,
                        smoke=True)
 def bench_serving(ctx):
@@ -877,6 +923,8 @@ def bench_serving(ctx):
                 "tokens_out": eng.tokens_out,
                 "tokens_per_sec": round(eng.tokens_out / dt, 1),
                 "ms_per_step": round(dt / max(eng.steps, 1) * 1e3, 3),
+                "sampled_decode": _sampled_decode_sweep(model, cfg,
+                                                        on_tpu),
                 "smoke": True}
     # warm every program the timed run will hit: both prefill buckets
     # and both decode variants (the full k-step tick and the k=1 tail)
@@ -910,7 +958,8 @@ def bench_serving(ctx):
     steps = eng.steps - steps0
     return {"requests": n_requests, "decode_steps": steps,
             "tokens_out": toks, "tokens_per_sec": round(toks / dt, 1),
-            "ms_per_step": round(dt / max(steps, 1) * 1e3, 3)}
+            "ms_per_step": round(dt / max(steps, 1) * 1e3, 3),
+            "sampled_decode": _sampled_decode_sweep(model, cfg, on_tpu)}
 
 
 # ====================================================================== main

@@ -12,7 +12,20 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["shard_map", "pvary", "axis_size"]
+__all__ = ["shard_map", "pvary", "axis_size", "distributed_is_initialized"]
+
+
+def distributed_is_initialized() -> bool:
+    """`jax.distributed.is_initialized()` when present (jax >= 0.4.34-ish);
+    older jaxlibs expose the same fact as the private global state's
+    client handle."""
+    if hasattr(jax.distributed, "is_initialized"):
+        return bool(jax.distributed.is_initialized())
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:  # pragma: no cover - exotic jax builds
+        return False
 
 
 def axis_size(axis_name):

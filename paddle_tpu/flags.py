@@ -187,3 +187,17 @@ define_flag("flight_recorder_steps", 64,
 define_flag("flight_dump_dir", "",
             "directory automatic flight-recorder dumps are written to "
             "(empty = current working directory)")
+
+# Serving decode fast path (inference/serving.py).
+define_flag("serving_device_sampling", True,
+            "sample temperature/top-k/top-p INSIDE the compiled decode "
+            "step (per-slot params + PRNG keys as device inputs), so "
+            "sampling requests ride the full k-step tick; 0 restores the "
+            "host-side per-row sampler, which demotes every tick with a "
+            "sampling request to k=1")
+define_flag("serving_overlap",  True,
+            "double-buffer the serving tick loop: dispatch tick t+1's "
+            "compiled step (feeding tick t's on-device last-token handle "
+            "forward) BEFORE harvesting/detokenizing tick t, overlapping "
+            "device compute with host admission/harvest work; 0 keeps "
+            "the synchronous dispatch-then-harvest loop")

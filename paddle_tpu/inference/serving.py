@@ -17,9 +17,22 @@ attention_kernel.cu`) driven by a request scheduler behind
 * Free slots ride through the decode program as seq_len-0 rows: their
   writes land in the reserved pad block 0 and their attention output is
   ignored, so occupancy changes cost nothing.
-* Sampling happens host-side on the returned last-token logits (the
-  engine reads one [B] token vector per step anyway), so per-request
-  sampling parameters never enter the compiled program.
+* Sampling happens ON DEVICE inside the compiled k-step tick (the seat
+  of the reference's fused top-p path in
+  `fused_multi_transformer_op.cu.h`): per-slot temperature/top-k/top-p/
+  do_sample masks and PRNG seeds are device INPUTS, so changing the
+  sampling mix never recompiles anything and sampled requests amortize
+  the host round trip over the same k steps greedy ones do.  The
+  host-side per-row sampler survives behind
+  ``FLAGS_serving_device_sampling=0`` (it demotes ticks to k=1).
+* The tick loop double-buffers (``FLAGS_serving_overlap``): tick t+1's
+  compiled step is dispatched — feeding tick t's on-device last-token
+  handle straight back in — BEFORE tick t is harvested, so device
+  compute overlaps host detokenize/bookkeeping.  JAX async dispatch
+  makes this a reordering plus one in-flight handle, not a thread; an
+  EOS discovered at harvest simply wastes the already-dispatched step
+  (the block-budget clamp keeps the overrun inside the admission
+  reservation).
 
 Block accounting reserves the worst case (prompt + max_new_tokens) at
 admission, so a running sequence can never hit pool exhaustion
@@ -39,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import flags as _flags
 from ..framework.tensor import Tensor
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
@@ -67,6 +81,12 @@ _M_SLOTS = _metrics.gauge(
     "serving.slot_occupancy", "fraction of batch slots holding a request")
 _M_TPS = _metrics.gauge(
     "serving.tokens_per_sec", "decode tokens/sec over the last tick")
+_M_SAMPLED = _metrics.counter(
+    "serving.sampled_tokens", "tokens drawn by the sampler (device or "
+    "host path) rather than argmax")
+_M_OVERLAP = _metrics.counter(
+    "serving.overlap_dispatches", "ticks dispatched before the previous "
+    "tick was harvested (double-buffered fast path)")
 
 
 class Request:
@@ -88,8 +108,13 @@ class Request:
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
-        self._rng = np.random.RandomState(seed if seed is not None
-                                          else self.rid)
+        # one integer seed drives BOTH samplers: the host RandomState
+        # (prefill's first token + the FLAGS_serving_device_sampling=0
+        # fallback) and the per-slot device PRNG key (decode tokens are
+        # drawn from fold_in(key(seed), token_position), so a rerun with
+        # the same seed reproduces the stream regardless of tick sizes)
+        self.seed = int(seed) if seed is not None else self.rid
+        self._rng = np.random.RandomState(self.seed)
         self.output_ids: List[int] = []
         self.done = False
         self.slot: Optional[int] = None
@@ -104,6 +129,27 @@ class Request:
         p = np.exp(filtered - filtered.max())
         p = p / p.sum()
         return int(self._rng.choice(len(p), p=p))
+
+
+class _PendingTick:
+    """One compiled decode tick in flight.  `toks` ([B, k] int32) is a
+    device handle the host has not blocked on — harvest materializes it;
+    a second dispatch may slice its last column first (overlap)."""
+
+    __slots__ = ("active", "k", "toks", "logits", "reqs", "t0",
+                 "device_sampling", "overlapped", "step_no")
+
+    def __init__(self, active, k, toks, logits, reqs, t0,
+                 device_sampling, step_no):
+        self.active = active
+        self.k = k
+        self.toks = toks
+        self.logits = logits
+        self.reqs = reqs
+        self.t0 = t0
+        self.device_sampling = device_sampling
+        self.overlapped = False
+        self.step_no = step_no
 
 
 def _bucket(n: int, minimum: int) -> int:
@@ -130,8 +176,9 @@ class ServingEngine:
         # host round trip harvests k tokens per slot (the tunnel's RTT
         # otherwise caps serving at ~1/RTT steps); admissions join at
         # tick boundaries — the standard iteration-level scheduling
-        # granularity tradeoff.  Sampling requests force k=1 ticks (their
-        # sampling happens host-side).
+        # granularity tradeoff.  Sampling runs on device inside the same
+        # scan (per-slot params + PRNG seeds are inputs), so sampled
+        # requests keep the full k too.
         self.model = model
         cfg = model.cfg
         self.B = max_batch
@@ -156,6 +203,17 @@ class ServingEngine:
         self.tables = np.zeros((max_batch, self.nb_per_seq), np.int32)
         self.seq_lens = np.zeros((max_batch,), np.int32)
         self.last_tok = np.zeros((max_batch,), np.int32)
+        # per-slot sampling params — device INPUTS of the decode tick
+        # (free slots carry the identity: greedy, t=1, no filters)
+        self.samp_do = np.zeros((max_batch,), bool)
+        self.samp_temp = np.ones((max_batch,), np.float32)
+        self.samp_topk = np.zeros((max_batch,), np.int32)
+        self.samp_topp = np.ones((max_batch,), np.float32)
+        self.samp_seed = np.zeros((max_batch,), np.uint32)
+        # tokens DISPATCHED per slot (appended + in-flight): the PRNG
+        # stream position and the budget clamp both count these, so an
+        # overlapped tick in flight is already accounted for
+        self.tok_pos = np.zeros((max_batch,), np.int32)
         self.free_blocks = deque(range(1, num_blocks + 1))
         self.free_slots = deque(range(max_batch))
         self.reserved = 0                      # growth blocks promised
@@ -163,11 +221,13 @@ class ServingEngine:
         self.waiting: deque = deque()
         self.finished: List[Request] = []
         self.steps = 0
+        self.ticks = 0
         self.tokens_out = 0
         self.steps_per_tick = max(1, int(steps_per_tick))
         self._decode_fn = None
-        self._decode_multi_fns = {}
+        self._tick_fns = {}
         self._prefill_fns = {}
+        self._last_harvest_t = None
 
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
@@ -200,24 +260,49 @@ class ServingEngine:
         self._decode_fn = jax.jit(step, donate_argnums=donate)
         return self._decode_fn
 
-    def _decode_multi_program(self, k: int):
-        fn = self._decode_multi_fns.get(k)
+    def _tick_program(self, k: int):
+        """The fast-path k-step tick with ON-DEVICE sampling.
+
+        Per-slot `do_sample`/`temperature`/`top_k`/`top_p`/`seed` ride
+        in as arrays, so one compiled program serves every batch mix
+        (the reference samples inside its decode megakernel for the
+        same reason).  Each step's token for a sampling row is drawn
+        from ``fold_in(key(seed), token_position)`` — the stream is a
+        pure function of (seed, position), independent of tick
+        boundaries, overlap, or slot placement."""
+        fn = self._tick_fns.get(k)
         if fn is not None:
             return fn
         from ..framework.dygraph import no_grad
+        from ..models.generation import _process_logits_rows
 
-        def tick(param_vals, pools, tables, seq_lens, last_tok):
+        def tick(param_vals, pools, tables, seq_lens, last_tok,
+                 do_sample, temperature, top_k, top_p, seeds, tok_pos):
             self._bind(param_vals)
 
-            def body(carry, _):
+            def body(carry, j):
                 pools, lens, last = carry
                 views = self._views(pools, tables, lens)
                 with no_grad():
                     logits_t, new_views = self.model.forward_with_cache(
                         Tensor._wrap(last[:, None]), views,
                         pos_offset=Tensor._wrap(lens[:, None]))
-                nxt = jnp.argmax(
-                    logits_t._value[:, -1, :], axis=-1).astype(jnp.int32)
+                logits = logits_t._value[:, -1, :]
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+                def drawn():
+                    filtered = _process_logits_rows(
+                        logits.astype(jnp.float32), temperature,
+                        top_k, top_p)
+                    keys = jax.vmap(lambda s, p: jax.random.fold_in(
+                        jax.random.key(s), p + j))(seeds, tok_pos)
+                    samp = jax.vmap(jax.random.categorical)(
+                        keys, filtered).astype(jnp.int32)
+                    return jnp.where(do_sample, samp, greedy)
+
+                # an all-greedy mix skips the [B, V] sort at run time
+                nxt = jax.lax.cond(jnp.any(do_sample),
+                                   drawn, lambda: greedy)
                 active = lens > 0
                 nxt = jnp.where(active, nxt, 0)
                 lens = jnp.where(active, lens + 1, 0)
@@ -225,12 +310,11 @@ class ServingEngine:
                 return (new_pools, lens, nxt), nxt
 
             (pools, _, _), toks = jax.lax.scan(
-                body, (pools, seq_lens, last_tok), None, length=k)
+                body, (pools, seq_lens, last_tok), jnp.arange(k))
             return jnp.transpose(toks), pools        # [B, k]
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = self._decode_multi_fns[k] = jax.jit(
-            tick, donate_argnums=donate)
+        fn = self._tick_fns[k] = jax.jit(tick, donate_argnums=donate)
         return fn
 
     def _prefill_program(self, L_pad: int):
@@ -360,6 +444,12 @@ class ServingEngine:
         self.slot_req[slot] = req
         self.seq_lens[slot] = L
         self.last_tok[slot] = first
+        self.samp_do[slot] = req.do_sample
+        self.samp_temp[slot] = req.temperature
+        self.samp_topk[slot] = max(0, int(req.top_k))
+        self.samp_topp[slot] = req.top_p
+        self.samp_seed[slot] = np.uint32(req.seed & 0xFFFFFFFF)
+        self.tok_pos[slot] = len(req.output_ids)
         self.tokens_out += 1
         _M_TOKENS.inc()
         self._update_occupancy()
@@ -390,6 +480,12 @@ class ServingEngine:
                 self.tables[slot, col] = 0
         self.seq_lens[slot] = 0
         self.last_tok[slot] = 0
+        self.samp_do[slot] = False
+        self.samp_temp[slot] = 1.0
+        self.samp_topk[slot] = 0
+        self.samp_topp[slot] = 1.0
+        self.samp_seed[slot] = 0
+        self.tok_pos[slot] = 0
         self.slot_req[slot] = None
         self.free_slots.append(slot)
         self.finished.append(req)
@@ -399,20 +495,36 @@ class ServingEngine:
         return [s for s in range(self.B) if self.slot_req[s] is not None]
 
     def step(self) -> bool:
-        """One scheduler tick: admit what fits, evict finished, run ONE
-        compiled decode step over the current mix.  Returns True while
-        work remains."""
-        while self._try_admit():
-            pass
-        for slot in list(range(self.B)):
-            req = self.slot_req[slot]
-            if req is not None and req.done:
-                self._evict(slot)
+        """One SYNCHRONOUS scheduler tick: admit what fits, evict
+        finished, run one compiled decode tick over the current mix and
+        harvest it.  Returns True while work remains."""
+        pend = self._dispatch_tick(boundary=True)
+        if pend is None:
+            return bool(self.waiting)
+        self._harvest_tick(pend)
+        return True
+
+    def _dispatch_tick(self, boundary: bool = True, last_tok_dev=None):
+        """Launch one compiled decode tick and return it IN FLIGHT.
+
+        At a tick ``boundary`` the scheduler work runs first (admit
+        what fits, evict finished).  ``last_tok_dev`` feeds a previous
+        tick's on-device last-token column straight back in (the
+        overlap path) instead of the host `last_tok` array.  JAX async
+        dispatch means the returned `_PendingTick.toks` is a device
+        handle nothing has blocked on; host seq_lens/tok_pos advance
+        NOW so a second dispatch sees the in-flight state."""
+        if boundary:
+            while self._try_admit():
+                pass
+            for slot in list(range(self.B)):
+                req = self.slot_req[slot]
+                if req is not None and req.done:
+                    self._evict(slot)
         active = self._active_slots()
         if not active:
-            return bool(self.waiting)
-        t_tick0 = time.perf_counter()
-        toks_before = self.tokens_out
+            return None
+        t0 = time.perf_counter()
         k = self._tick_size(active)
         # ensure a physical block exists for every position this tick
         # will write (all draws covered by the admission reservation)
@@ -425,52 +537,102 @@ class ServingEngine:
                     self.reserved -= 1
                     self.slot_req[slot]._growth_left -= 1
                     self.tables[slot, col] = blk
-        param_vals = [self._sd[k]._value for k in self._keys]
+        param_vals = [self._sd[kk]._value for kk in self._keys]
         saved = dict((kk, self._sd[kk]._value) for kk in self._keys)
+        device_sampling = _flags.get_flag("serving_device_sampling")
+        # device inputs get PRIVATE host copies: async dispatch returns
+        # before the program consumes them, and jax device_put may alias
+        # numpy memory zero-copy — without the copy, this tick's own
+        # post-dispatch bookkeeping (and any overlapped next tick's
+        # block draws) would race the in-flight program's reads
+        dev = lambda a: jnp.asarray(a.copy())              # noqa: E731
+        last = last_tok_dev if last_tok_dev is not None \
+            else dev(self.last_tok)
+        logits = None
         try:
             with _flight.guard("serving.tick"):
-                if k == 1:
+                if not device_sampling and k == 1:
+                    # host-sampling fallback: the k=1 program returns the
+                    # logits the per-row host sampler needs
                     greedy, logits, self.pools = self._decode_program()(
-                        param_vals, self.pools, jnp.asarray(self.tables),
-                        jnp.asarray(self.seq_lens),
-                        jnp.asarray(self.last_tok))
-                    toks = np.asarray(greedy)[:, None]
+                        param_vals, self.pools, dev(self.tables),
+                        dev(self.seq_lens), last)
+                    toks = greedy[:, None]
                 else:
-                    logits = None
-                    toks, self.pools = self._decode_multi_program(k)(
-                        param_vals, self.pools, jnp.asarray(self.tables),
-                        jnp.asarray(self.seq_lens),
-                        jnp.asarray(self.last_tok))
-                    toks = np.asarray(toks)
+                    # the one k-step tick program; with sampling off the
+                    # demotion guarantees no sampled row is active, the
+                    # all-False mask takes the greedy cond branch
+                    toks, self.pools = self._tick_program(k)(
+                        param_vals, self.pools, dev(self.tables),
+                        dev(self.seq_lens), last,
+                        dev(self.samp_do), dev(self.samp_temp),
+                        dev(self.samp_topk), dev(self.samp_topp),
+                        dev(self.samp_seed), dev(self.tok_pos))
         finally:
             for kk, v in saved.items():
                 self._sd[kk]._value = v
-        logits_np = None
         self.steps += k
         for slot in active:
-            req = self.slot_req[slot]
             self.seq_lens[slot] += k
+            self.tok_pos[slot] += k
+        return _PendingTick(active=active, k=k, toks=toks, logits=logits,
+                            reqs=list(self.slot_req), t0=t0,
+                            device_sampling=device_sampling,
+                            step_no=self.steps)
+
+    def _harvest_tick(self, pend) -> None:
+        """Block on the tick's device tokens and feed the requests:
+        append, EOS/budget-check, host-sample (fallback path only).
+        `pend.reqs` is the slot->request snapshot from dispatch time —
+        under overlap a request may have finished (EOS) while its next
+        tick was already in flight; its overrun rows are discarded."""
+        k = pend.k
+        with _flight.guard("serving.tick"):
+            # first host block on the async result: a decode-execution
+            # error (OOM, XlaRuntimeError) surfaces HERE, not at the
+            # guarded dispatch — keep the post-mortem dump coverage
+            toks = np.asarray(pend.toks)
+        logits_np = None
+        toks_before = self.tokens_out
+        sampled = 0
+        for slot in pend.active:
+            req = pend.reqs[slot]
+            if req.done:
+                continue         # whole row is EOS overrun
             self.last_tok[slot] = int(toks[slot, -1])
             for j in range(k):
                 if req.done:
                     break        # post-eos tokens are discarded (the
                                  # compiled tick keeps decoding; the cache
                                  # rows die with the eviction)
-                if req.do_sample:
+                if req.do_sample and not pend.device_sampling:
                     if logits_np is None:
-                        logits_np = np.asarray(logits)
+                        logits_np = np.asarray(pend.logits)
                     tok = req._sample(logits_np[slot])
                     self.last_tok[slot] = tok
                 else:
                     tok = int(toks[slot, j])
+                if req.do_sample:
+                    sampled += 1
                 req.output_ids.append(tok)
                 self.tokens_out += 1
                 self._maybe_finish(req, tok)
-        dt = time.perf_counter() - t_tick0
+        # wall time ATTRIBUTABLE to this tick: an overlapped tick was
+        # dispatched before the previous harvest finished, so clock it
+        # from that harvest, not from its own dispatch — tick_seconds
+        # then sum to real elapsed wall and tokens/sec stays honest
+        t_done = time.perf_counter()
+        t_from = pend.t0 if self._last_harvest_t is None \
+            else max(pend.t0, self._last_harvest_t)
+        self._last_harvest_t = t_done
+        dt = t_done - t_from
         harvested = self.tokens_out - toks_before
+        self.ticks += 1
         _M_TICKS.inc()
         _M_TICK_S.observe(dt)
         _M_TOKENS.inc(harvested)
+        if sampled:
+            _M_SAMPLED.inc(sampled)
         if dt > 0:
             _M_TPS.set(round(harvested / dt, 1))
         self._update_occupancy()
@@ -478,35 +640,80 @@ class ServingEngine:
             # the flight ring keeps the last-K ticks, so a post-mortem
             # dump of a wedged/crashed engine shows what was in flight
             _flight.default_recorder().record_step({
-                "timeline": "serving", "step": self.steps,
+                "timeline": "serving", "step": pend.step_no,
                 "wall_s": round(dt, 6), "decode_steps": k,
-                "tokens": harvested,
+                "tokens": harvested, "overlap": pend.overlapped,
                 "tokens_per_sec": round(harvested / dt, 1) if dt else 0.0,
-                "active": len(active), "waiting": len(self.waiting),
+                "active": len(pend.active), "waiting": len(self.waiting),
                 "free_blocks": len(self.free_blocks)})
-        return True
 
     def _tick_size(self, active) -> int:
         """Steps this tick may batch: bounded by the configured tick
-        size, every active request's remaining budget (over-decoding
-        past a budget would outrun its block reservation), and k=1
-        whenever host-side sampling is in play."""
+        size and every active request's remaining budget (over-decoding
+        past a budget would outrun its block reservation).  Budgets
+        count DISPATCHED tokens (`tok_pos`), so an overlapped in-flight
+        tick is already accounted for.  With on-device sampling,
+        sampled and greedy rows share the full k-step tick; the
+        host-sampling fallback (FLAGS_serving_device_sampling=0)
+        demotes any tick with a sampling request to k=1."""
         k = self.steps_per_tick
+        device_sampling = _flags.get_flag("serving_device_sampling")
         for slot in active:
             req = self.slot_req[slot]
-            if req.do_sample:
+            if req.do_sample and not device_sampling:
                 return 1
-            k = min(k, req.max_new_tokens - len(req.output_ids))
+            k = min(k, req.max_new_tokens - int(self.tok_pos[slot]))
         # exactly two compiled variants: the full tick and the k=1 tail
         # (a mid-run compile of an intermediate size costs more than the
         # single steps it would save)
         return k if k >= self.steps_per_tick else 1
 
+    def _can_overlap(self, pend) -> bool:
+        """May tick t+1 dispatch before tick t (`pend`) is harvested?
+        Requires the overlap flag, next-token choice living on device
+        (host sampling owns it otherwise), no admissions pending (they
+        join at a REAL boundary: their prefill must not race the
+        in-flight tick's pool writes), and at least one budgeted token
+        per active request beyond the in-flight tick (the block-budget
+        clamp that keeps EOS overrun inside the reservation)."""
+        if not _flags.get_flag("serving_overlap"):
+            return False
+        if not pend.device_sampling and any(
+                pend.reqs[s].do_sample for s in pend.active):
+            return False
+        if self.waiting:
+            return False
+        for slot in pend.active:
+            req = self.slot_req[slot]
+            if req is None or req.done:
+                return False     # eviction boundary needed first
+            if req.max_new_tokens - int(self.tok_pos[slot]) < 1:
+                return False     # in-flight tick exhausts the budget
+        return True
+
     def run(self) -> List[Request]:
         """Drive until every queued request finishes; returns them in
-        completion order."""
-        while self.step() or self.waiting or self._active_slots():
-            pass
+        completion order.  With ``FLAGS_serving_overlap`` the loop keeps
+        one tick in flight: dispatch t+1 (chaining t's device last-token
+        column), THEN harvest t — device compute and host harvest/
+        detokenize overlap instead of strictly alternating."""
+        pend = None
+        while True:
+            if pend is None:
+                if not (self.waiting or self._active_slots()):
+                    break
+                pend = self._dispatch_tick(boundary=True)
+                if pend is None:
+                    continue     # waiting on evictions, as before
+            nxt = None
+            if self._can_overlap(pend):
+                nxt = self._dispatch_tick(boundary=False,
+                                          last_tok_dev=pend.toks[:, -1])
+                if nxt is not None:
+                    nxt.overlapped = True
+                    _M_OVERLAP.inc()
+            self._harvest_tick(pend)
+            pend = nxt
         # final eviction sweep
         for slot in list(range(self.B)):
             if self.slot_req[slot] is not None and self.slot_req[slot].done:
@@ -514,7 +721,8 @@ class ServingEngine:
         return self.finished
 
     def stats(self) -> dict:
-        return {"steps": self.steps, "tokens_out": self.tokens_out,
+        return {"steps": self.steps, "ticks": self.ticks,
+                "tokens_out": self.tokens_out,
                 "free_blocks": len(self.free_blocks),
                 "reserved": self.reserved,
                 "active": len(self._active_slots()),

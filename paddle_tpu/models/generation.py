@@ -19,6 +19,38 @@ from ..framework.tensor import Tensor
 __all__ = ["GenerationMixin"]
 
 
+def _process_logits_rows(logits, temperature, top_k, top_p):
+    """Row-wise `_process_logits`: every sampling parameter is a [B]
+    array, so one compiled program can filter a batch whose rows carry
+    DIFFERENT temperature/top-k/top-p (the serving engine's per-slot
+    sampling inputs).  Rows with ``top_k <= 0`` / ``top_p >= 1`` skip
+    that filter, matching the scalar version's Python branches, and the
+    top-p cutoff is computed on the already top-k-filtered logits in the
+    same order the scalar version applies them.
+
+    logits: jnp (B, V) float; temperature/top_p float [B]; top_k int [B].
+    """
+    V = logits.shape[-1]
+    logits = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k: threshold at the k-th largest (ascending index V - k)
+    asc = jnp.sort(logits, axis=-1)
+    kth = jnp.take_along_axis(
+        asc, jnp.clip(V - top_k, 0, V - 1)[:, None], axis=-1)
+    logits = jnp.where((top_k > 0)[:, None] & (logits < kth),
+                       -jnp.inf, logits)
+    # top-p: smallest set with cumulative prob >= top_p, over the
+    # top-k-filtered distribution (exp(-inf) rows contribute 0)
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jnp.exp(sorted_l - jnp.max(sorted_l, axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.clip(jnp.sum(cum < top_p[:, None], axis=-1), 0, V - 1)
+    pth = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+    logits = jnp.where((top_p < 1.0)[:, None] & (logits < pth),
+                       -jnp.inf, logits)
+    return logits
+
+
 def _process_logits(logits, temperature, top_k, top_p):
     """logits: jnp (B, V) -> filtered logits ready for sampling."""
     if temperature != 1.0:

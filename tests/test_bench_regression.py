@@ -1,0 +1,95 @@
+"""Self-test for the bench regression classifier (VERDICT r5 #7).
+
+`harness.regression_check` separates CODE regressions from tunnel-window
+artifacts (env_suspect).  Until now its first real firing would have
+been its first run ever; these tests synthesize a prior BENCH artifact
+plus degraded/healthy env probes on CPU and pin the split it must make.
+"""
+
+import json
+
+import numpy as np  # noqa: F401  (suite convention)
+
+from paddle_tpu.observability import harness
+
+
+def _artifact(tmp_path, values, env=None):
+    """Write a prior-round artifact in the harness `records` schema."""
+    records = [{"rung": name, "ok": True, "device": "cpu",
+                "elapsed_s": 1.0, "value": val}
+               for name, val in values.items()]
+    if env is not None:
+        records.append({"rung": "env_probe", "ok": True, "device": "cpu",
+                        "elapsed_s": 0.1, "value": env})
+    path = tmp_path / "BENCH_r98.json"
+    path.write_text(json.dumps({
+        "schema": harness.SCHEMA, "records": records}))
+    return str(path)
+
+
+def _records(values):
+    return [{"rung": name, "ok": True, "device": "cpu",
+             "elapsed_s": 1.0, "value": val}
+            for name, val in values.items()]
+
+
+KEYS = {"gpt124m_train": "tokens_per_sec",
+        "serving_decode": "tokens_per_sec"}
+
+
+def test_healthy_env_drop_is_a_regression(tmp_path):
+    """Same dispatch floor and chip throughput, -20% on a rung: that is
+    CODE, and the classifier must say so."""
+    env = {"dispatch_floor_ms": 1.5, "matmul_tflops": 10.0}
+    prev = _artifact(tmp_path, {
+        "gpt124m_train": {"tokens_per_sec": 1000.0},
+        "serving_decode": {"tokens_per_sec": 500.0, "latency_bound": True},
+    }, env=env)
+    cur = _records({
+        "gpt124m_train": {"tokens_per_sec": 800.0},
+        "serving_decode": {"tokens_per_sec": 495.0, "latency_bound": True},
+    })
+    out = harness.regression_check(cur, previous=prev, keys=KEYS,
+                                   env_probe=env)
+    assert out["regressed"] == ["gpt124m_train"]
+    assert out["env_suspect"] == {}
+    # the -1% serving drift is noise, not a finding
+    assert "serving_decode" not in out["regressed"]
+
+
+def test_degraded_dispatch_floor_marks_latency_bound_env_suspect(tmp_path):
+    """A latency-bound rung whose drop tracks a worsened dispatch floor
+    is a tunnel artifact, not a regression (the round-4/5 lesson)."""
+    prev = _artifact(tmp_path, {
+        "serving_decode": {"tokens_per_sec": 500.0, "latency_bound": True},
+    }, env={"dispatch_floor_ms": 1.5, "matmul_tflops": 10.0})
+    cur = _records({
+        "serving_decode": {"tokens_per_sec": 330.0, "latency_bound": True},
+    })
+    out = harness.regression_check(
+        cur, previous=prev, keys=KEYS,
+        env_probe={"dispatch_floor_ms": 6.0, "matmul_tflops": 10.0})
+    assert out["regressed"] == []
+    assert "serving_decode" in out["env_suspect"]
+    assert "latency-bound" in out["env_suspect"]["serving_decode"]
+
+
+def test_degraded_chip_window_marks_compute_rung_env_suspect(tmp_path):
+    """A compute rung dropping while the probe shows the chip window
+    itself degraded (<85% of the prior matmul TFLOP/s) is env-suspect."""
+    prev = _artifact(tmp_path, {
+        "gpt124m_train": {"tokens_per_sec": 1000.0},
+    }, env={"dispatch_floor_ms": 1.5, "matmul_tflops": 10.0})
+    cur = _records({"gpt124m_train": {"tokens_per_sec": 700.0}})
+    out = harness.regression_check(
+        cur, previous=prev, keys=KEYS,
+        env_probe={"dispatch_floor_ms": 1.5, "matmul_tflops": 6.0})
+    assert out["regressed"] == []
+    assert "chip window degraded" in out["env_suspect"]["gpt124m_train"]
+
+
+def test_no_prior_artifact_returns_none(tmp_path):
+    out = harness.regression_check(
+        _records({"gpt124m_train": {"tokens_per_sec": 1.0}}),
+        previous=str(tmp_path / "missing.json"), keys=KEYS)
+    assert out is None

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.flags import flag_guard
 from paddle_tpu.inference.serving import Request, ServingEngine
 from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
 
@@ -44,7 +45,12 @@ def test_three_staggered_requests_one_program(model):
     done = eng.run()                             # all three to completion
 
     assert {r.rid for r in done} == {r1.rid, r2.rid, r3.rid}
-    assert eng._decode_fn is not None            # single decode program
+    # exactly ONE decode program compiled for the whole run (the k=1
+    # device-sampling tick; `_decode_fn` is the host-sampling fallback's
+    # cache and must stay empty so the two variants never cross-talk)
+    progs = ([eng._decode_fn] if eng._decode_fn is not None else []) \
+        + list(eng._tick_fns.values())
+    assert len(progs) == 1
     for req, prompt in ((r1, p1), (r2, p2), (r3, p3)):
         assert len(req.output_ids) == req.max_new_tokens
         ref = model.generate(
@@ -107,7 +113,7 @@ def test_admission_respects_capacity(model):
 
 
 def test_sampling_requests_mix_with_greedy(model):
-    """Per-request sampling params stay host-side: a sampling request and
+    """Per-slot sampling params are device inputs: a sampling request and
     a greedy request share the same compiled step."""
     eng = ServingEngine(model, max_batch=2, max_context=64, block_size=16)
     p1, p2, _ = prompts()
@@ -121,6 +127,152 @@ def test_sampling_requests_mix_with_greedy(model):
     np.testing.assert_array_equal(
         g.output_ids, np.asarray(ref._value)[0, 8:])
     assert len(s.output_ids) == 6
+
+
+def test_mixed_ticks_no_demotion_and_reproducible(model):
+    """On-device sampling keeps a mixed greedy+sampled batch on the FULL
+    k-step tick (no k=1 demotion), the sampled stream is reproducible
+    from the request seed, and — because each token is drawn from
+    fold_in(key(seed), position) — the stream is INDEPENDENT of the tick
+    size."""
+    p1, p2, _ = prompts()
+
+    def serve(steps_per_tick):
+        eng = ServingEngine(model, max_batch=2, max_context=64,
+                            block_size=16, steps_per_tick=steps_per_tick)
+        g = eng.add_request(Request(p1[:8], max_new_tokens=9))
+        s = eng.add_request(Request(p2[:8], max_new_tokens=9,
+                                    do_sample=True, temperature=0.9,
+                                    top_k=40, seed=1234))
+        eng.run()
+        return eng, g, s
+
+    eng4, g4, s4 = serve(4)
+    # budget 9 = 1 prefill token + 8 decode steps = two FULL k=4 ticks;
+    # the old host-side sampler demoted this to eight k=1 ticks
+    assert eng4.steps == 8 and eng4.stats()["ticks"] == 2
+    assert len(s4.output_ids) == 9
+    # greedy row unaffected by its sampling neighbour
+    ref = model.generate(
+        paddle.to_tensor(np.asarray(p1[:8], np.int32)[None]),
+        max_new_tokens=9, cache_impl="paged")
+    np.testing.assert_array_equal(g4.output_ids,
+                                  np.asarray(ref._value)[0, 8:])
+    # same seeds -> same stream; k=1 ticks -> same stream too
+    _, _, s4b = serve(4)
+    assert s4b.output_ids == s4.output_ids
+    _, _, s1 = serve(1)
+    assert s1.output_ids == s4.output_ids
+
+
+def test_device_filter_matches_host_filter():
+    """`_process_logits_rows` (per-row params, the decode tick's filter)
+    equals the scalar host `_process_logits` row by row on a fixed-logits
+    case, across greedy-ish/temperature/top-k/top-p mixes."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.generation import (_process_logits,
+                                              _process_logits_rows)
+    rng = np.random.RandomState(3)
+    V = 50
+    params = [(1.0, 0, 1.0), (0.7, 0, 1.0), (1.0, 10, 1.0),
+              (1.0, 0, 0.9), (0.8, 12, 0.85), (1.3, 3, 0.5)]
+    logits = rng.randn(len(params), V).astype(np.float32) * 3
+    rows = _process_logits_rows(
+        jnp.asarray(logits),
+        jnp.asarray([t for t, _, _ in params], jnp.float32),
+        jnp.asarray([k for _, k, _ in params], jnp.int32),
+        jnp.asarray([p for _, _, p in params], jnp.float32))
+    for i, (t, k, p) in enumerate(params):
+        want = _process_logits(jnp.asarray(logits[i:i + 1]), t, k, p)
+        np.testing.assert_allclose(np.asarray(rows)[i], np.asarray(want)[0],
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_device_sampler_matches_host_distribution():
+    """Tokens drawn the way the decode tick draws them (per-slot
+    fold_in(key(seed), position) + categorical over the filtered logits)
+    follow the host sampler's distribution on a fixed-logits case:
+    same support (filtered-out tokens never drawn) and matching
+    frequencies."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.generation import (_process_logits,
+                                              _process_logits_rows)
+    rng = np.random.RandomState(5)
+    V = 24
+    logits = (rng.randn(V) * 2).astype(np.float32)
+    t, k, p = 0.8, 12, 0.9
+    # host distribution (the Request._sample construction)
+    filtered = np.asarray(_process_logits(
+        jnp.asarray(logits)[None], t, k, p))[0]
+    probs = np.exp(filtered - filtered.max())
+    probs = probs / probs.sum()
+    # device draws: one per position, as the tick program folds the key
+    N = 4000
+    frows = _process_logits_rows(
+        jnp.asarray(np.tile(logits, (N, 1))),
+        jnp.full((N,), t, jnp.float32), jnp.full((N,), k, jnp.int32),
+        jnp.full((N,), p, jnp.float32))
+    keys = jax.vmap(lambda pos: jax.random.fold_in(
+        jax.random.key(jnp.uint32(77)), pos))(jnp.arange(N))
+    draws = np.asarray(jax.vmap(jax.random.categorical)(keys, frows))
+    counts = np.bincount(draws, minlength=V) / N
+    assert counts[probs == 0].sum() == 0          # support respected
+    np.testing.assert_allclose(counts, probs, atol=0.05)
+
+
+def test_overlap_matches_synchronous(model):
+    """The double-buffered tick loop (FLAGS_serving_overlap) produces
+    token-for-token the same streams as the synchronous loop, greedy and
+    sampled alike, and releases every block/reservation."""
+    p1, p2, p3 = prompts()
+
+    def serve():
+        eng = ServingEngine(model, max_batch=3, max_context=128,
+                            block_size=16, steps_per_tick=2)
+        reqs = [eng.add_request(Request(p1, max_new_tokens=10)),
+                eng.add_request(Request(p2, max_new_tokens=7,
+                                        do_sample=True, top_k=25,
+                                        seed=42)),
+                eng.add_request(Request(p3, max_new_tokens=12))]
+        eng.run()
+        return eng, [list(r.output_ids) for r in reqs]
+
+    with flag_guard(serving_overlap=False):
+        _, sync = serve()
+    from paddle_tpu.observability import metrics as _metrics
+    _metrics.reset()
+    with flag_guard(serving_overlap=True):
+        eng, ov = serve()
+    assert ov == sync
+    assert eng.stats()["free_blocks"] == eng.num_blocks
+    assert eng.stats()["reserved"] == 0
+    snap = _metrics.snapshot()
+    assert snap["serving.overlap_dispatches"]["series"][0]["value"] > 0
+    assert snap["serving.sampled_tokens"]["series"][0]["value"] >= 6
+
+
+def test_overlap_eos_overrun_reclaims_everything(model):
+    """A request that hits EOS while the NEXT tick is already in flight
+    (overlap's EOS overrun) discards the overrun tokens, truncates at
+    the first EOS, and still returns every block and reservation."""
+    p = np.asarray([5, 6, 7], np.int32)
+    probe_eng = ServingEngine(model, max_batch=2, max_context=64,
+                              block_size=16)
+    probe = probe_eng.add_request(Request(p, max_new_tokens=8))
+    probe_eng.run()
+    eos = probe.output_ids[-1]
+    stop_at = probe.output_ids.index(eos)         # first occurrence
+    with flag_guard(serving_overlap=True):
+        eng = ServingEngine(model, max_batch=2, max_context=64,
+                            block_size=16, steps_per_tick=4)
+        r = eng.add_request(Request(p, max_new_tokens=30,
+                                    eos_token_id=eos))
+        eng.run()
+    assert r.done
+    assert r.output_ids == probe.output_ids[:stop_at + 1]
+    assert eng.stats()["free_blocks"] == eng.num_blocks
+    assert eng.stats()["reserved"] == 0
 
 
 def test_llama_family_serves_at_parity():
