@@ -75,6 +75,7 @@ _REGRESSION_KEYS = {
     "gpt350m_train": "tokens_per_sec",
     "gpt124m_decode": "paged_tokens_per_sec",
     "telemetry_train": "tokens_per_sec",
+    "fused_optimizer": "speedup",
 }
 
 _ENV_PROBE = {}
@@ -239,6 +240,77 @@ def bench_telemetry_train(ctx):
     return {"batch": B, "seq": S, "steps": steps,
             "tokens_per_sec": summ["tokens_per_sec"],
             "mfu": summ.get("mfu"), "timeline": summ}
+
+
+@harness.register_rung("fused_optimizer", est_cold_s=120, smoke=True)
+def bench_fused_optimizer(ctx):
+    """Round-7 tentpole rung: one Adam step with global-norm clip over a
+    param-count ladder, FLAGS_fused_optimizer off vs on.  Each cell
+    records the marginal per-step wall time and the optimizer-layer
+    program dispatches per step (the `dispatch.ops` delta over
+    optimizer.fused_step / optimizer.leaf_update / clip.tree / amp.unscale
+    — the count the fused path collapses from ~3N+1 to 1)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.flags import flag_guard
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    _OPT_OPS = ("optimizer.fused_step", "optimizer.leaf_update",
+                "clip.tree", "amp.unscale")
+
+    def opt_dispatches():
+        c = obs_metrics.get("dispatch.ops")
+        return sum(c.value(op=k) for k in _OPT_OPS) if c else 0
+
+    ladder = (8, 64) if ctx.smoke else (8, 64, 256)
+    leaf_size = 256 if ctx.smoke else 1024
+    rows = []
+    for n_leaves in ladder:
+        row = {"leaves": n_leaves, "leaf_size": leaf_size}
+        rng = np.random.RandomState(0)
+        grads_np = [rng.rand(leaf_size).astype(np.float32) * 0.1
+                    for _ in range(n_leaves)]
+        for fused in (False, True):
+            with flag_guard(fused_optimizer=fused):
+                paddle.seed(0)
+                params = [paddle.Parameter(np.ones(leaf_size, np.float32))
+                          for _ in range(n_leaves)]
+                grads = [paddle.to_tensor(g) for g in grads_np]
+                opt = optimizer.Adam(
+                    learning_rate=1e-3, parameters=params,
+                    grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+                def one_step():
+                    for p, g in zip(params, grads):
+                        p.grad = g
+                    opt.step()
+
+                one_step()  # compile/warm the per-tree programs
+                base = opt_dispatches()
+                one_step()
+                dispatches = opt_dispatches() - base
+                np.asarray(params[0]._value)
+                steps = 3 if ctx.smoke else 20
+                best = float("inf")
+                for _ in range(2 if ctx.smoke else 3):
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        one_step()
+                    np.asarray(params[0]._value)
+                    best = min(best, (time.perf_counter() - t0) / steps)
+                row["fused" if fused else "per_param"] = {
+                    "step_ms": round(best * 1e3, 3),
+                    "dispatches_per_step": int(dispatches)}
+        row["speedup"] = round(
+            row["per_param"]["step_ms"] / max(row["fused"]["step_ms"], 1e-9),
+            2)
+        rows.append(row)
+    return {"ladder": rows,
+            "speedup": rows[-1]["speedup"],
+            "fused_dispatches_per_step":
+                rows[-1]["fused"]["dispatches_per_step"],
+            "per_param_dispatches_per_step":
+                rows[-1]["per_param"]["dispatches_per_step"]}
 
 
 @harness.register_rung("env_probe", est_cold_s=30, smoke=True)

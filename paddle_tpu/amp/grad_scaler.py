@@ -2,16 +2,37 @@
 GradScaler with found_inf plumbing.
 
 On TPU bf16 training rarely needs scaling (exponent range == fp32), so
-`enable=False` is the common path; the full fp16 machinery is provided for
-parity and for fp16 models."""
+`enable=False` is the common path; a disabled scaler is a STRICT
+passthrough — no device work, no found_inf probe, not even a counter.
+
+Enabled, `step()` first tries the fused whole-pytree program
+(`optimizer/fused.py`): unscale, the found_inf reduction, clipping, the
+update (skipped via `lax.cond` on overflow) and the dynamic scale
+bookkeeping all run inside ONE executable, with found_inf and the
+scale/good/bad counters kept ON DEVICE — `_sync_fused_state()` is the
+single flag-spaced host read (hapi calls it at the loss-sync interval).
+The legacy path (`unscale_()` recipe, irregular pytrees, flag off)
+unscales with one jitted per-tree program and host-syncs `bool(found)`
+per step, exactly as before.
+"""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from ..observability import metrics as _metrics
 
 __all__ = ["GradScaler", "AmpScaler"]
+
+# per-step scaler outcome (outcome=ok|skipped).  Eager steps count as
+# they happen; fused steps keep found_inf on device and are accounted in
+# bulk at the next _sync_fused_state() host read.
+_M_FOUND_INF = _metrics.counter(
+    "amp.found_inf", "GradScaler step outcomes (outcome=ok|skipped)")
+_M_DISPATCH = _metrics.counter("dispatch.ops", "eager dispatches per op name")
+_K_UNSCALE = (("op", "amp.unscale"),)
 
 
 class GradScaler:
@@ -21,6 +42,14 @@ class GradScaler:
                  decr_every_n_nan_or_inf: int = 1, use_dynamic_loss_scaling:
                  bool = True):
         self._enable = enable
+        # fused-path device state: (scale, good, bad, skips-since-sync)
+        # f32/i32 scalars updated inside the fused program; None = the
+        # host fields below are authoritative.  Reading any host field
+        # (the _scale/_good_steps/... properties) IS the sync point.
+        self._dev_state = None
+        self._found_inf_dev = None
+        self._steps_since_sync = 0
+        self._unscale_programs = {}
         self._scale = float(init_loss_scaling)
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
@@ -32,6 +61,27 @@ class GradScaler:
         self._found_inf = False
         self._already_unscaled = False
 
+    # host-visible scaler state: plain attributes backed by storage
+    # fields, except that a READ first materializes any pending fused
+    # device state — so `scaler._scale` is always current without the
+    # fused step path ever blocking on the host
+    def _lazy(name):  # noqa: N805 - descriptor factory, not a method
+        store = name + "_h"
+
+        def get(self):
+            self._sync_fused_state()
+            return getattr(self, store)
+
+        def set(self, v):  # noqa: A001
+            setattr(self, store, v)
+        return property(get, set)
+
+    _scale = _lazy("_scale")
+    _good_steps = _lazy("_good_steps")
+    _bad_steps = _lazy("_bad_steps")
+    _found_inf = _lazy("_found_inf")
+    del _lazy
+
     def is_enable(self) -> bool:
         return self._enable
 
@@ -40,33 +90,102 @@ class GradScaler:
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
             return var
+        if self._dev_state is not None:
+            # fused steps keep the live scale ON DEVICE; multiplying by it
+            # directly (dtype-preserving) avoids a per-step host sync
+            return var * Tensor._wrap(
+                self._dev_state[0].astype(var._value.dtype))
         from ..ops.math import scale as _scale_op
         return _scale_op(var, scale=self._scale)
 
+    # ------------------------------------------------- fused device state
+    def _fused_state(self):
+        """Seed (or reuse) the on-device scale/good/bad/skip scalars the
+        fused program threads through."""
+        if self._dev_state is None:
+            self._dev_state = (jnp.asarray(self._scale, jnp.float32),
+                               jnp.asarray(self._good_steps, jnp.int32),
+                               jnp.asarray(self._bad_steps, jnp.int32),
+                               jnp.zeros((), jnp.int32))
+        return self._dev_state
+
+    def _fused_commit(self, found, scale, good, bad, nskip):
+        self._dev_state = (scale, good, bad, nskip)
+        self._found_inf_dev = found
+        self._steps_since_sync += 1
+
+    def _sync_fused_state(self):
+        """The flag-spaced host read: materialize the device scaler state
+        back into the host floats (and account the per-step outcomes on
+        the amp.found_inf counter).  No-op when the fused path hasn't
+        run since the last sync."""
+        if self._dev_state is None:
+            return None
+        scale, good, bad, nskip = jax.device_get(self._dev_state)
+        self._scale = float(scale)
+        self._good_steps = int(good)
+        self._bad_steps = int(bad)
+        found = bool(jax.device_get(self._found_inf_dev)) \
+            if self._found_inf_dev is not None else False
+        self._found_inf = found
+        skipped = int(nskip)
+        ok = self._steps_since_sync - skipped
+        if ok > 0:
+            _M_FOUND_INF.inc(ok, outcome="ok")
+        if skipped > 0:
+            _M_FOUND_INF.inc(skipped, outcome="skipped")
+        self._steps_since_sync = 0
+        self._dev_state = None
+        self._found_inf_dev = None
+        return found
+
+    # --------------------------------------------------------- step paths
     def _unscale_and_check(self, optimizer):
-        """Divide grads by scale; detect nan/inf (found_inf plumbing)."""
-        found = jnp.zeros((), jnp.bool_)
-        params = optimizer._parameter_list
-        inv = 1.0 / self._scale
-        for p in params:
-            if p.grad is None:
-                continue
-            g = p.grad._value * inv
-            found = found | jnp.any(~jnp.isfinite(g))
+        """Divide grads by scale; detect nan/inf (found_inf plumbing).
+        One jitted program per grad-tree structure — not one any(isfinite)
+        reduction per parameter — then a single host bool sync."""
+        self._sync_fused_state()
+        with_grads = [p for p in optimizer._parameter_list
+                      if p.grad is not None]
+        if not with_grads:
+            self._found_inf = False
+            return False
+        vals = [p.grad._value for p in with_grads]
+        from ..nn.clip import _struct_key
+        key = _struct_key(vals)
+        prog = self._unscale_programs.get(key)
+        if prog is None:
+            def run(vs, inv):
+                out = [g * inv.astype(g.dtype) for g in vs]
+                found = jnp.zeros((), jnp.bool_)
+                for g in out:
+                    found = found | jnp.any(~jnp.isfinite(g))
+                return out, found
+            prog = self._unscale_programs[key] = jax.jit(run)
+        if _metrics._ENABLED:
+            _M_DISPATCH.inc_key(_K_UNSCALE)
+        outs, found = prog(vals, jnp.asarray(1.0 / self._scale, jnp.float32))
+        for p, g in zip(with_grads, outs):
             p.grad._value = g
         self._found_inf = bool(found)
         return self._found_inf
 
     def step(self, optimizer):
         if not self._enable:
+            # strict passthrough: no unscale, no found probe, no device
+            # work beyond the update itself
             optimizer.step()
             return
         # don't unscale twice when the user already called unscale_()
         # (the unscale_ -> clip -> step recipe)
         if not self._already_unscaled:
+            from ..optimizer import fused as _fused
+            if _fused.scaler_step(self, optimizer):
+                return  # found_inf stayed on device; sync is flag-spaced
             self._unscale_and_check(optimizer)
         if not self._found_inf:
             optimizer.step()
+        _M_FOUND_INF.inc(outcome="skipped" if self._found_inf else "ok")
         self._already_unscaled = False
         self.update()
 
@@ -83,6 +202,7 @@ class GradScaler:
     def update(self):
         if not (self._enable and self._dynamic):
             return
+        self._sync_fused_state()
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
@@ -97,12 +217,15 @@ class GradScaler:
                 self._good_steps = 0
 
     def get_loss_scaling(self):
+        self._sync_fused_state()
         return Tensor(jnp.asarray(self._scale, jnp.float32))
 
     def set_init_loss_scaling(self, v):
+        self._sync_fused_state()
         self._scale = float(v)
 
     def state_dict(self):
+        self._sync_fused_state()
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
                 "incr_every_n_steps": self._incr_every,
@@ -110,6 +233,7 @@ class GradScaler:
                 "good_steps": self._good_steps, "bad_steps": self._bad_steps}
 
     def load_state_dict(self, state):
+        self._sync_fused_state()
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)

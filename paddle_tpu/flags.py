@@ -188,6 +188,27 @@ define_flag("flight_dump_dir", "",
             "directory automatic flight-recorder dumps are written to "
             "(empty = current working directory)")
 
+# Training-step fast path (optimizer/fused.py, hapi/model.py, io).
+define_flag("fused_optimizer", True,
+            "route Optimizer.step through ONE donated jitted XLA program "
+            "over the whole param/grad/state pytree (AMP unscale, on-device "
+            "found_inf, global-norm clip and the update fused per "
+            "(optimizer, tree structure, clip/scaler config)); 0 restores "
+            "the per-parameter program-per-leaf path.  Irregular cases "
+            "(L1 decay, custom clip classes) fall back per step either "
+            "way — see the optimizer.fused hit/miss/fallback counter")
+define_flag("loss_sync_interval", 1,
+            "train steps between host materializations of the hapi loss "
+            "(fit/train_batch): K>1 leaves the loss on device and reads "
+            "it back every K-th step, so step dispatch overlaps the "
+            "previous step's compute; the NaN watchdog and the telemetry "
+            "loss/synced annotations ride the synced steps only")
+define_flag("dataloader_device_prefetch", True,
+            "io.DataLoader double-buffers batch fetch + collate + "
+            "jax.device_put on a background thread, so H2D transfer of "
+            "batch t+1 overlaps step t's compute; 0 fetches batches "
+            "inline on the consuming thread")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

@@ -19,6 +19,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from .. import flags as _flags
 from .. import io as paddle_io
 from ..framework import io as framework_io
 from ..framework.tensor import Tensor
@@ -34,6 +35,10 @@ _M_STEP_S = _obs_metrics.histogram(
     "host wall time to dispatch one train step (labels: mode); on "
     "async accelerators this is enqueue time unless the caller syncs "
     "inside the step — the first sample includes XLA compile")
+_M_LOSS_SYNC = _obs_metrics.counter(
+    "train.loss_syncs",
+    "host materializations of the hapi train loss; with "
+    "FLAGS_loss_sync_interval=K, fit performs ceil(steps/K) of these")
 
 
 def _batch_tokens(inputs) -> int:
@@ -85,6 +90,8 @@ class Model:
         self._save_dir = None
         self.mode = "train"
         self._pending_accum = False
+        self._train_steps = 0       # paces the flag-spaced loss sync
+        self._last_synced_step = -1  # for flushed_steps attribution
 
     # ------------------------------------------------------------------ prep
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -219,12 +226,20 @@ class Model:
 
     def train_batch(self, inputs, labels=None, update=True):
         """One optimizer step (update=False: accumulate grads only);
-        returns (loss_numpy, [metric results])."""
+        returns (loss, [metric results]).  The loss is a numpy array on
+        synced steps; with FLAGS_loss_sync_interval=K every other step
+        leaves it as the raw device array (no host round trip — the step
+        dispatch returns while the device still computes)."""
         if self._optimizer is None or self._loss is None:
             raise RuntimeError("call prepare(optimizer=..., loss=...) first")
-        # the telemetry bracket spans dispatch AND the loss host read, so
-        # wall_s is completed-step time even on async backends (the
-        # record is marked synced)
+        interval = max(int(_flags.get_flag("loss_sync_interval")), 1)
+        step_idx = self._train_steps
+        sync = step_idx % interval == 0
+        self._train_steps += 1
+        # the telemetry bracket spans dispatch AND (on synced steps) the
+        # loss host read, so a synced record's wall_s is completed-step
+        # time even on async backends; unsynced records are enqueue time
+        # and stay marked synced=False
         st = _telemetry.default_timeline().step(
             tokens=_batch_tokens(to_list(inputs)),
             mode="train" if update else "accumulate")
@@ -232,12 +247,26 @@ class Model:
             res, labs = self._run_step("train" if update else "accumulate",
                                        inputs, labels)
             loss = res[0]
-            loss_np = np.asarray(loss._value)
-            st.annotate(loss=float(loss_np.reshape(-1)[0]), synced=True)
+            if sync:
+                loss_np = np.asarray(loss._value)
+                _M_LOSS_SYNC.inc()
+                st.annotate(loss=float(loss_np.reshape(-1)[0]), synced=True)
+                flushed = step_idx - self._last_synced_step
+                self._last_synced_step = step_idx
+                if flushed > 1:
+                    # the synced wall includes the drained dispatch queue
+                    # of the flushed-1 unsynced steps before it
+                    st.annotate(flushed_steps=flushed)
+                if self._scaler is not None:
+                    # the flag-spaced found_inf/scale read-back
+                    self._scaler._sync_fused_state()
         outputs = res[1:]
         metrics = self._update_metrics(outputs, labs)
+        if not sync:
+            return loss._value, metrics
         # NaN/Inf watchdog probe — gated ONLY by its own flag, so it
-        # fires even with the metrics registry (and the timeline) off
+        # fires even with the metrics registry (and the timeline) off;
+        # probes ride the synced steps only
         _flight.check_finite(float(loss_np.reshape(-1)[0]),
                              site="hapi.train.loss",
                              step=st.index if st.index >= 0 else None)
@@ -309,6 +338,11 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None):
         assert train_data is not None, "train_data must be given"
+        # restart the loss-sync phase: each fit performs exactly
+        # ceil(steps/K) host reads and step 0 always syncs (so logs
+        # carry a 'loss' from the first callback on)
+        self._train_steps = 0
+        self._last_synced_step = -1
         train_loader = self._make_loader(train_data, batch_size, shuffle,
                                          drop_last, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False, False,
@@ -365,7 +399,10 @@ class Model:
             getattr(cbks, f"on_{mode}_batch_begin")(step, logs)
             if mode == "train":
                 loss, metrics = self.train_batch(inputs, labels)
-                logs["loss"] = float(np.asarray(loss).reshape(-1)[0])
+                # an unsynced step returns the raw device array — leave it
+                # on device (logs keep the last synced loss)
+                if isinstance(loss, np.ndarray):
+                    logs["loss"] = float(loss.reshape(-1)[0])
             else:
                 loss, metrics = self.eval_batch(inputs, labels)
                 if loss is not None:

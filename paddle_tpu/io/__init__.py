@@ -446,7 +446,91 @@ class DataLoader:
             return {k: DataLoader._to_tensors(v) for k, v in obj.items()}
         return obj
 
+    # ----------------------------------------------- device-side prefetch
+    @staticmethod
+    def _to_device(obj):
+        """Force every batch leaf onto the device (jax.device_put for any
+        numpy stragglers; collate output is usually already device-backed
+        Tensors).  Runs on the prefetch thread so the H2D DMA of batch
+        t+1 overlaps step t's compute."""
+        import jax
+        if isinstance(obj, Tensor):
+            if isinstance(obj._value, np.ndarray):
+                obj._value = jax.device_put(obj._value)
+            return obj
+        if isinstance(obj, np.ndarray):
+            return jax.device_put(obj)
+        if isinstance(obj, tuple):
+            return tuple(DataLoader._to_device(x) for x in obj)
+        if isinstance(obj, list):
+            return [DataLoader._to_device(x) for x in obj]
+        if isinstance(obj, dict):
+            return {k: DataLoader._to_device(v) for k, v in obj.items()}
+        return obj
+
+    def _iter_device_prefetch(self, inner):
+        """Double-buffered background fetch: batch fetch + collate +
+        device transfer run one batch ahead on a daemon thread (bounded
+        queue of 2 = the classic double buffer).  Abandoning the iterator
+        mid-epoch stops the thread, closes the inner iterator (so
+        multiprocess workers terminate) and drains the queue."""
+        q: "queue.Queue" = queue.Queue(maxsize=2)
+        sentinel = object()
+        stop = threading.Event()
+        error: List[BaseException] = []
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in inner:
+                    if not put(self._to_device(batch)):
+                        return  # consumer gone
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                error.append(e)
+            finally:
+                if hasattr(inner, "close"):
+                    try:
+                        inner.close()  # same-thread close: worker cleanup
+                    except BaseException:  # noqa: BLE001
+                        pass
+                put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="paddle-tpu-device-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+            if error:
+                raise error[0]
+        finally:
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
     def __iter__(self):
+        inner = self._iter_inner()
+        from .. import flags as _flags
+        if _flags.get_flag("dataloader_device_prefetch"):
+            return self._iter_device_prefetch(inner)
+        return inner
+
+    def _iter_inner(self):
         if self._iterable_mode:
             yield from self._iter_iterable()
             return

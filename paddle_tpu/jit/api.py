@@ -205,6 +205,9 @@ class StaticFunction:
         opt_snapshots = [(o, {n: dict(s) for n, s in o._accumulators.items()},
                           o._global_step) for o in opts]
         rng_state = _random.get_rng_state()
+        # the registry fires these only from THIS thread — concurrent op
+        # dispatch (the dataloader's device-prefetch producer fetching
+        # the next batch) cannot leak into the recorded state
         _registry.set_trace_recorder(rec.on_inputs)
         _registry.set_trace_out_recorder(rec.on_outputs)
         burned = None

@@ -1,20 +1,72 @@
 """Gradient clipping. Parity: `python/paddle/nn/clip.py`
 (ClipGradByGlobalNorm is what HybridParallelOptimizer composes across mesh
-axes — see distributed/fleet)."""
+axes — see distributed/fleet).
+
+TPU-native detail: each clip class compiles ONE jitted program over the
+whole applicable grad list (cached per tree structure + clip bounds), so
+even the non-fused optimizer fallback stops emitting one
+``sqrt(sum(square))`` program per parameter per step.  When the fleet
+cross-mesh ``_global_norm_reduce_fn`` hook is installed the global-norm
+pass splits into two programs around the eager hook call (squared-norm
+reduction → hook → scale) so any host-side reduction composes.  The
+fully-fused optimizer path (`optimizer/fused.py`) re-traces the same
+math inside its single whole-pytree program instead of calling these.
+"""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.tensor import Tensor
+from ..observability import metrics as _metrics
 
 __all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
            "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+# per-tree clip program dispatches ride the shared dispatch.ops counter
+# (see optimizer/fused.py) so a step's total program count is one delta
+_M_DISPATCH = _metrics.counter("dispatch.ops", "eager dispatches per op name")
+_K_CLIP_TREE = (("op", "clip.tree"),)
+
+
+def _aval_key(v):
+    """(shape, dtype) cache-key atom shared by every per-tree program
+    cache in the training fast path (clip, GradScaler unscale, the fused
+    optimizer update) — one definition so the caches key identically."""
+    return (tuple(v.shape), str(v.dtype))
+
+
+def _struct_key(vals):
+    return tuple(_aval_key(v) for v in vals)
 
 
 class ClipGradBase:
     def __call__(self, params_grads):
         raise NotImplementedError
+
+    # ------------------------------------------------- per-tree jit cache
+    def _split(self, params_grads):
+        """Indices of the leaves this clip applies to (grad present and
+        the param opted in via need_clip)."""
+        return [i for i, (p, g) in enumerate(params_grads)
+                if g is not None and getattr(p, "need_clip", True)]
+
+    def _program(self, key, build):
+        cache = self.__dict__.setdefault("_tree_programs", {})
+        prog = cache.get(key)
+        if prog is None:
+            prog = cache[key] = jax.jit(build())
+        if _metrics._ENABLED:
+            _M_DISPATCH.inc_key(_K_CLIP_TREE)
+        return prog
+
+    @staticmethod
+    def _merge(params_grads, idx, new_vals):
+        out = list(params_grads)
+        for i, v in zip(idx, new_vals):
+            out[i] = (params_grads[i][0], Tensor._wrap(v))
+        return out
 
 
 class ClipGradByValue(ClipGradBase):
@@ -23,13 +75,16 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -self.max
 
     def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-                continue
-            out.append((p, Tensor._wrap(jnp.clip(g._value, self.min, self.max))))
-        return out
+        idx = self._split(params_grads)
+        if not idx:
+            return list(params_grads)
+        vals = [params_grads[i][1]._value for i in idx]
+        lo, hi = self.min, self.max
+
+        def build():
+            return lambda vs: [jnp.clip(v, lo, hi) for v in vs]
+        prog = self._program(("value", lo, hi, _struct_key(vals)), build)
+        return self._merge(params_grads, idx, prog(vals))
 
 
 class ClipGradByNorm(ClipGradBase):
@@ -37,16 +92,21 @@ class ClipGradByNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def __call__(self, params_grads):
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-                continue
-            norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
-            scale = jnp.where(norm > self.clip_norm, self.clip_norm /
-                              jnp.maximum(norm, 1e-12), 1.0)
-            out.append((p, Tensor._wrap(g._value * scale)))
-        return out
+        idx = self._split(params_grads)
+        if not idx:
+            return list(params_grads)
+        vals = [params_grads[i][1]._value for i in idx]
+        cn = self.clip_norm
+
+        def build():
+            def clip_one(g):
+                norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+                scale = jnp.where(norm > cn, cn / jnp.maximum(norm, 1e-12),
+                                  1.0)
+                return g * scale
+            return lambda vs: [clip_one(v) for v in vs]
+        prog = self._program(("norm", cn, _struct_key(vals)), build)
+        return self._merge(params_grads, idx, prog(vals))
 
 
 class ClipGradByGlobalNorm(ClipGradBase):
@@ -68,21 +128,46 @@ class ClipGradByGlobalNorm(ClipGradBase):
         return sq
 
     def __call__(self, params_grads):
-        sq = self._compute_global_sq_norm(params_grads)
-        if sq is None:
-            return params_grads
-        if self._global_norm_reduce_fn is not None:
-            sq = self._global_norm_reduce_fn(sq)
-        global_norm = jnp.sqrt(sq)
-        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
-        out = []
-        for p, g in params_grads:
-            if g is None or not getattr(p, "need_clip", True):
-                out.append((p, g))
-                continue
-            out.append((p, Tensor._wrap((g._value.astype(jnp.float32) * scale)
-                                        .astype(g._value.dtype))))
-        return out
+        idx = self._split(params_grads)
+        if not idx:
+            return list(params_grads)
+        vals = [params_grads[i][1]._value for i in idx]
+        cn = self.clip_norm
+        skey = _struct_key(vals)
+        if self._global_norm_reduce_fn is None:
+            # one program: left-fold squared-norm reduction + scale
+            def build():
+                def run(vs):
+                    sq = None
+                    for v in vs:
+                        s = jnp.sum(jnp.square(v.astype(jnp.float32)))
+                        sq = s if sq is None else sq + s
+                    scale = cn / jnp.maximum(jnp.sqrt(sq), cn)
+                    return [(v.astype(jnp.float32) * scale).astype(v.dtype)
+                            for v in vs]
+                return run
+            prog = self._program(("global", cn, skey), build)
+            return self._merge(params_grads, idx, prog(vals))
+        # hook installed: split around the eager cross-mesh reduction
+        def build_sq():
+            def run(vs):
+                sq = None
+                for v in vs:
+                    s = jnp.sum(jnp.square(v.astype(jnp.float32)))
+                    sq = s if sq is None else sq + s
+                return sq
+            return run
+        sq = self._program(("global_sq", skey), build_sq)(vals)
+        sq = self._global_norm_reduce_fn(sq)
+
+        def build_scale():
+            def run(vs, sq):
+                scale = cn / jnp.maximum(jnp.sqrt(sq), cn)
+                return [(v.astype(jnp.float32) * scale).astype(v.dtype)
+                        for v in vs]
+            return run
+        prog = self._program(("global_scale", cn, skey), build_scale)
+        return self._merge(params_grads, idx, prog(vals, sq))
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,

@@ -306,7 +306,8 @@ class StepTimeline:
             # schema-stable zeros: a metrics-off run (the timeline is a
             # no-op) must not KeyError consumers reading the summary
             return {"schema": TELEMETRY_SCHEMA, "timeline": self.name,
-                    "steps": 0, "steady_steps": 0, "wall_s": 0.0,
+                    "steps": 0, "steady_steps": 0, "synced_steps": 0,
+                    "wall_s": 0.0,
                     "compile_s": 0.0, "comm_bytes": 0, "tokens": 0,
                     "tokens_per_sec": 0.0,
                     "step_seconds": {"mean": 0.0, "min": 0.0, "max": 0.0,
@@ -329,6 +330,11 @@ class StepTimeline:
             "timeline": self.name,
             "steps": len(recs),
             "steady_steps": n,
+            # async-step attribution: an unsynced record's wall_s is
+            # ENQUEUE time (flag-spaced loss sync leaves the loss on
+            # device), so tokens/sec from a mostly-unsynced timeline is
+            # an upper bound — this count is the caveat's denominator
+            "synced_steps": sum(1 for r in recs if r.get("synced")),
             "wall_s": round(sum(r["wall_s"] for r in recs), 6),
             "compile_s": round(sum(r["compile_s"] for r in recs), 6),
             "step_seconds": {"mean": round(wall_steady / n, 6),

@@ -23,6 +23,7 @@ tracers.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -77,18 +78,30 @@ def set_autocast_hook(fn: Optional[Callable]) -> None:
 
 _trace_out_recorder: Optional[Callable] = None
 
+# The recorder hooks are process-global but a capture (to_static state
+# discovery, recompute saved-tensor recording) is a single-thread affair:
+# ops dispatched concurrently by OTHER threads — the dataloader's
+# device-prefetch producer fetching the next batch — must not leak into
+# the recording.  Each hook remembers its installer's thread id and
+# dispatch only fires it from that thread.
+_trace_recorder_tid: Optional[int] = None
+_trace_out_recorder_tid: Optional[int] = None
+
 # Sink dict for per-op call counting (amp.debugging.collect_operator_stats).
 _op_stats_sink: Optional[Dict[str, int]] = None
 
 
 def set_trace_recorder(fn: Optional[Callable]) -> None:
-    global _trace_recorder
+    global _trace_recorder, _trace_recorder_tid
     _trace_recorder = fn
+    _trace_recorder_tid = threading.get_ident() if fn is not None else None
 
 
 def set_trace_out_recorder(fn: Optional[Callable]) -> None:
-    global _trace_out_recorder
+    global _trace_out_recorder, _trace_out_recorder_tid
     _trace_out_recorder = fn
+    _trace_out_recorder_tid = threading.get_ident() if fn is not None \
+        else None
 
 
 def set_op_stats_sink(sink: Optional[Dict[str, int]]) -> None:
@@ -356,7 +369,8 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
         _op_stats_sink[name] = _op_stats_sink.get(name, 0) + 1
     vals, leaves, treedef = _flatten_inputs(diff_inputs)
     if _trace_recorder is not None:
-        _trace_recorder(leaves)
+        if threading.get_ident() == _trace_recorder_tid:
+            _trace_recorder(leaves)
     vals, _ = _autocast_vals(name, vals)
 
     requires_grad = is_grad_enabled() and any(
@@ -393,7 +407,8 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
             _check_nan_inf(name, outs_t)
         wrapped = tuple(Tensor._wrap(o, stop_gradient=True) for o in outs_t)
         if _trace_out_recorder is not None:
-            _trace_out_recorder(wrapped)
+            if threading.get_ident() == _trace_out_recorder_tid:
+                _trace_out_recorder(wrapped)
         return wrapped if multi else wrapped[0]
 
     if op.custom_vjp is not None:
@@ -457,7 +472,8 @@ def _dispatch_impl(name: str, diff_inputs: Sequence[Any],
         w._output_slot = i
         wrapped.append(w)
     if _trace_out_recorder is not None:
-        _trace_out_recorder(wrapped)
+        if threading.get_ident() == _trace_out_recorder_tid:
+            _trace_out_recorder(wrapped)
     return tuple(wrapped) if multi else wrapped[0]
 
 

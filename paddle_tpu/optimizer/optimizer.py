@@ -19,7 +19,14 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Parameter, Tensor
 from ..nn.clip import ClipGradBase
+from ..observability import metrics as _metrics
 from .lr import LRScheduler
+
+# per-leaf jitted-program dispatches ride the same instrument as the
+# eager op dispatcher, so one metrics delta covers a whole train step
+# (the fused path counts ONE optimizer.fused_step instead — fused.py)
+_M_DISPATCH = _metrics.counter("dispatch.ops", "eager dispatches per op name")
+_K_LEAF_UPDATE = (("op", "optimizer.leaf_update"),)
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
            "RMSProp", "Adadelta", "Adamax", "Lamb"]
@@ -60,6 +67,8 @@ def _instance_update(opt, rule, value, grad, master, states, lr, wd, step):
         jitted = cache[donate] = jax.jit(
             apply, static_argnames=("wd",),
             donate_argnums=(0, 2, 3) if donate else ())
+    if _metrics._ENABLED:
+        _M_DISPATCH.inc_key(_K_LEAF_UPDATE)
     return jitted(value, grad, master, states,
                   jnp.asarray(lr, jnp.float32), wd,
                   jnp.asarray(step, jnp.float32))
@@ -151,12 +160,12 @@ class Optimizer:
         self._accumulators[name][id(p)] = value
 
     # ------------------------------------------------------------ step
-    @jax.named_scope("optimizer_step")
-    def step(self):
-        self._global_step += 1
-        # collect across ALL groups first so ClipGradByGlobalNorm sees the
-        # true global norm (paddle clips the whole parameter list at once)
-        work = []  # (param, grad, lr, wd, l1)
+    def _collect_work(self):
+        """Collect across ALL groups first so ClipGradByGlobalNorm sees
+        the true global norm (paddle clips the whole parameter list at
+        once).  Returns (work, all_pg): work items are mutable
+        [param, grad, lr, wd, l1] lists."""
+        work = []
         all_pg = []
         for group in self._param_groups:
             lr = group.get("learning_rate", 1.0) * self.get_lr() \
@@ -170,6 +179,21 @@ class Optimizer:
                     continue
                 work.append([p, p.grad, lr, wd, l1])
                 all_pg.append((p, p.grad))
+        return work, all_pg
+
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        from . import fused as _fused
+        self._global_step += 1
+        work, all_pg = self._collect_work()
+        if not _fused.try_step(self, work):
+            self._apply_per_leaf(work, all_pg)
+        for hook in self._aux_hooks:
+            hook(self)
+
+    def _apply_per_leaf(self, work, all_pg):
+        """The legacy one-program-per-parameter path (FLAGS_fused_optimizer
+        off, or an irregular step the fused plan declined)."""
         if self._grad_clip is not None:
             clipped = self._grad_clip(all_pg)
             for item, (_, g) in zip(work, clipped):
@@ -180,8 +204,6 @@ class Optimizer:
             self._apply_one(p, g._value if isinstance(g, Tensor) else g,
                             lr * p.optimize_attr.get("learning_rate", 1.0),
                             wd, l1)
-        for hook in self._aux_hooks:
-            hook(self)
 
     def _apply_one(self, p: Parameter, grad, lr: float, wd: float,
                    l1: float = 0.0):
@@ -205,6 +227,8 @@ class Optimizer:
         param/state buffers in place in HBM except during jit state-discovery
         (the recorder holds references for rollback)."""
         rule = type(self)._jitted_rule(donate=_donation_safe())
+        if _metrics._ENABLED:
+            _M_DISPATCH.inc_key(_K_LEAF_UPDATE)
         lr = jnp.asarray(lr, jnp.float32)
         step = jnp.asarray(step, jnp.float32)
         return rule(value, grad, master, states, lr, wd, step)
@@ -256,9 +280,17 @@ class Optimizer:
         # (`python/paddle/optimizer/optimizer.py` keys accumulators by the
         # parameter's name) so checkpoints survive parameter reordering
         import warnings
+        gs = self._global_step
+        if not isinstance(gs, int):
+            # fused scaler steps keep the applied-step count on device
+            # (it is found_inf-dependent); checkpointing materializes it
+            try:
+                gs = int(gs)
+            except TypeError:  # tracer during capture: keep as-is
+                pass
         out = {"LR_Scheduler": self._lr.state_dict()
                if isinstance(self._lr, LRScheduler) else {},
-               "global_step": self._global_step}
+               "global_step": gs}
         for name, store in self._accumulators.items():
             for p in self._parameter_list:
                 if id(p) in store:

@@ -93,3 +93,38 @@ def test_no_prior_artifact_returns_none(tmp_path):
         _records({"gpt124m_train": {"tokens_per_sec": 1.0}}),
         previous=str(tmp_path / "missing.json"), keys=KEYS)
     assert out is None
+
+
+def test_fused_optimizer_rung_schema():
+    """Pin the round-7 `fused_optimizer` rung's record schema: the
+    regression key (`speedup`) and the per-cell dispatch/wall fields the
+    acceptance criteria read.  Runs the rung at smoke scale on CPU."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_fused_optimizer(ctx)
+    rec = {"rung": "fused_optimizer", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    # the regression key harness diffs across rounds
+    assert harness.get_rung("fused_optimizer").smoke
+    assert bench._REGRESSION_KEYS["fused_optimizer"] == "speedup"
+    assert isinstance(val["speedup"], float)
+    assert val["ladder"], "param-count ladder must not be empty"
+    for row in val["ladder"]:
+        for cell in ("fused", "per_param"):
+            assert set(row[cell]) == {"step_ms", "dispatches_per_step"}
+            assert row[cell]["step_ms"] > 0
+        assert row["per_param"]["dispatches_per_step"] >= row["leaves"]
+        # the tentpole claim: ONE program dispatch per fused step
+        assert row["fused"]["dispatches_per_step"] <= 3
+    assert val["fused_dispatches_per_step"] <= 3

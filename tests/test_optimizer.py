@@ -161,3 +161,316 @@ def test_grad_clip_in_optimizer():
                         grad_clip=nn.ClipGradByGlobalNorm(5.0))
     opt.step()
     np.testing.assert_allclose(p.numpy(), [-3.0, -4.0], rtol=1e-5)
+
+
+# ================================================== fused-vs-per-param parity
+#
+# The round-7 tentpole: Optimizer.step routed through ONE donated jitted
+# program over the whole pytree (FLAGS_fused_optimizer) must agree with
+# the per-leaf path to exact bits for fp32 (allclose <= 1e-6 for mixed
+# precision with master weights), including clipping, the GradScaler
+# skip step, and a state_dict round trip across a fused<->per-param
+# switch mid-training.
+
+from paddle_tpu.flags import flag_guard  # noqa: E402
+from paddle_tpu import amp  # noqa: E402
+
+_SHAPES = [(7,), (3, 5), (2, 3, 4), (11,), (1,)]
+
+
+def _make_params(dtype="float32", seed=0):
+    rng = np.random.RandomState(seed)
+    params = []
+    for shape in _SHAPES:
+        p = paddle.Parameter(rng.uniform(-1, 1, shape).astype(np.float32))
+        if dtype != "float32":
+            p._value = p._value.astype(dtype)
+        params.append(p)
+    return params
+
+
+def _grads_for_step(step, seed=0, inf_at=None):
+    rng = np.random.RandomState(seed * 1000 + step)
+    grads = [rng.uniform(-1, 1, s).astype(np.float32) for s in _SHAPES]
+    if inf_at is not None and step == inf_at:
+        grads[2] = grads[2].copy()
+        grads[2].flat[0] = np.inf
+    return grads
+
+
+def _run_training(opt_cls, kw, fused, steps=4, dtype="float32",
+                  multi_precision=False, clip=None, scaler_kw=None,
+                  inf_at=None, switch_at=None):
+    """Run `steps` deterministic optimizer steps; returns a dict of
+    final param / master / accumulator arrays (as fp32 numpy) plus the
+    scaler scale.  `switch_at`: step index at which FLAGS_fused_optimizer
+    flips (for the mid-training switch test)."""
+    with flag_guard(fused_optimizer=fused):
+        params = _make_params(dtype=dtype)
+        opt = opt_cls(parameters=params, multi_precision=multi_precision,
+                      grad_clip=clip() if clip else None, **kw)
+        scaler = amp.GradScaler(**scaler_kw) if scaler_kw else None
+        for s in range(steps):
+            if switch_at is not None and s == switch_at:
+                paddle.set_flags({"fused_optimizer": not fused})
+            for p, g in zip(params, _grads_for_step(s, inf_at=inf_at)):
+                scale = scaler._scale if scaler else 1.0
+                p.grad = paddle.to_tensor(g * scale)
+            if scaler is not None:
+                scaler.step(opt)
+            else:
+                opt.step()
+            opt.clear_grad()
+        out = {"params": [np.asarray(p._value, np.float32) for p in params]}
+        for name, store in opt._accumulators.items():
+            out[name] = [np.asarray(store[id(p)], np.float32)
+                         for p in params if id(p) in store]
+        if scaler is not None:
+            out["scale"] = scaler._scale
+            out["found_inf"] = scaler._found_inf
+        return out
+
+
+def _assert_runs_match(a, b, exact=True):
+    assert set(a) == set(b)
+    for key in a:
+        if key in ("scale", "found_inf"):
+            assert a[key] == b[key], f"{key}: {a[key]} != {b[key]}"
+            continue
+        for i, (x, y) in enumerate(zip(a[key], b[key])):
+            if exact:
+                np.testing.assert_array_equal(
+                    x, y, err_msg=f"{key}[{i}] diverged")
+            else:
+                np.testing.assert_allclose(
+                    x, y, atol=1e-6, rtol=0, err_msg=f"{key}[{i}]")
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (optimizer.Adam, dict(learning_rate=0.05)),
+    (optimizer.AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+])
+@pytest.mark.parametrize("clip", [None, lambda: nn.ClipGradByGlobalNorm(1.0)])
+def test_fused_matches_per_param_fp32_exact(cls, kw, clip):
+    ref = _run_training(cls, kw, fused=False, clip=clip)
+    fus = _run_training(cls, kw, fused=True, clip=clip)
+    _assert_runs_match(ref, fus, exact=True)
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Adam, dict(learning_rate=0.05)),
+    (optimizer.AdamW, dict(learning_rate=0.05, weight_decay=0.01)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+])
+def test_fused_matches_per_param_bf16_master(cls, kw):
+    ref = _run_training(cls, kw, fused=False, dtype="bfloat16",
+                        multi_precision=True)
+    fus = _run_training(cls, kw, fused=True, dtype="bfloat16",
+                        multi_precision=True)
+    _assert_runs_match(ref, fus, exact=False)
+
+
+@pytest.mark.parametrize("clip", [None, lambda: nn.ClipGradByGlobalNorm(1.0)])
+def test_fused_matches_per_param_scaler_skip_step(clip):
+    """An inf grad at step 1 must skip the update and halve the scale on
+    both paths; later steps use the decreased scale identically."""
+    kw = dict(learning_rate=0.05)
+    sk = dict(init_loss_scaling=16.0, incr_every_n_steps=3)
+    ref = _run_training(optimizer.Adam, kw, fused=False, clip=clip,
+                        scaler_kw=sk, inf_at=1)
+    fus = _run_training(optimizer.Adam, kw, fused=True, clip=clip,
+                        scaler_kw=sk, inf_at=1)
+    assert ref["scale"] == 8.0
+    _assert_runs_match(ref, fus, exact=True)
+
+
+def test_fused_clip_by_norm_and_value_parity():
+    for clip in (lambda: nn.ClipGradByNorm(0.7),
+                 lambda: nn.ClipGradByValue(0.3)):
+        ref = _run_training(optimizer.Momentum,
+                            dict(learning_rate=0.1, momentum=0.9),
+                            fused=False, clip=clip)
+        fus = _run_training(optimizer.Momentum,
+                            dict(learning_rate=0.1, momentum=0.9),
+                            fused=True, clip=clip)
+        _assert_runs_match(ref, fus, exact=True)
+
+
+def test_fused_need_clip_false_subset_stays_fused():
+    with flag_guard(fused_optimizer=True):
+        from paddle_tpu.observability import metrics as obs
+        params = _make_params()
+        params[1].need_clip = False
+        opt = optimizer.SGD(learning_rate=0.5, parameters=params,
+                            grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        before = obs.get("optimizer.fused").value(kind="fallback")
+        for p, g in zip(params, _grads_for_step(0)):
+            p.grad = paddle.to_tensor(g)
+        opt.step()
+        assert obs.get("optimizer.fused").value(kind="fallback") == before
+    # parity against the per-leaf path with the same static mask
+    def run(fused):
+        with flag_guard(fused_optimizer=fused):
+            ps = _make_params()
+            ps[1].need_clip = False
+            o = optimizer.SGD(learning_rate=0.5, parameters=ps,
+                              grad_clip=nn.ClipGradByGlobalNorm(1.0))
+            for p, g in zip(ps, _grads_for_step(0)):
+                p.grad = paddle.to_tensor(g)
+            o.step()
+            return [np.asarray(p._value) for p in ps]
+    for x, y in zip(run(False), run(True)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_fused_l1_decay_falls_back():
+    from paddle_tpu.observability import metrics as obs
+    from paddle_tpu.regularizer import L1Decay
+    with flag_guard(fused_optimizer=True):
+        params = _make_params()
+        opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=params,
+                                 weight_decay=L1Decay(0.01))
+        before = obs.get("optimizer.fused").value(kind="fallback")
+        for p, g in zip(params, _grads_for_step(0)):
+            p.grad = paddle.to_tensor(g)
+        opt.step()
+        # L1's sign-term rides the per-leaf path; counted as a fallback
+        assert obs.get("optimizer.fused").value(kind="fallback") == \
+            before + 1
+
+
+def test_fused_param_groups_and_lr_scale_parity():
+    def run(fused):
+        with flag_guard(fused_optimizer=fused):
+            a = paddle.Parameter(np.ones(4, np.float32))
+            b = paddle.Parameter(np.ones(4, np.float32))
+            b.optimize_attr["learning_rate"] = 0.5
+            opt = optimizer.SGD(learning_rate=0.1, parameters=[
+                {"params": [a]},
+                {"params": [b], "learning_rate": 0.1},
+            ])
+            for s in range(3):
+                a.grad = paddle.to_tensor(np.full(4, 1.0 + s, np.float32))
+                b.grad = paddle.to_tensor(np.full(4, 2.0 + s, np.float32))
+                opt.step()
+                opt.clear_grad()
+            return np.asarray(a._value), np.asarray(b._value)
+    ra, rb = run(False)
+    fa, fb = run(True)
+    np.testing.assert_array_equal(ra, fa)
+    np.testing.assert_array_equal(rb, fb)
+
+
+def test_fused_state_dict_roundtrip_across_switch():
+    """state_dict written by a fused run restores into a per-param run
+    (and vice versa): 3 fused steps + reload + 3 per-param steps must
+    equal 6 uninterrupted per-param steps."""
+    ref = _run_training(optimizer.Adam, dict(learning_rate=0.05),
+                        fused=False, steps=6)
+
+    with flag_guard(fused_optimizer=True):
+        params = _make_params()
+        opt = optimizer.Adam(learning_rate=0.05, parameters=params)
+        for s in range(3):
+            for p, g in zip(params, _grads_for_step(s)):
+                p.grad = paddle.to_tensor(g)
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+    with flag_guard(fused_optimizer=False):
+        opt2 = optimizer.Adam(learning_rate=0.05, parameters=params)
+        opt2.set_state_dict(sd)
+        assert opt2._global_step == 3
+        for s in range(3, 6):
+            for p, g in zip(params, _grads_for_step(s)):
+                p.grad = paddle.to_tensor(g)
+            opt2.step()
+            opt2.clear_grad()
+    for x, y in zip(ref["params"],
+                    [np.asarray(p._value) for p in params]):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_fused_switch_mid_training_is_seamless():
+    ref = _run_training(optimizer.AdamW, dict(learning_rate=0.05),
+                        fused=False, steps=6)
+    mixed = _run_training(optimizer.AdamW, dict(learning_rate=0.05),
+                          fused=True, steps=6, switch_at=3)
+    _assert_runs_match(ref, mixed, exact=True)
+
+
+def test_fused_step_dispatch_count():
+    """Acceptance: a 50-leaf Adam step with global-norm clip + scaler
+    executes as <= 3 optimizer-layer XLA dispatches when fused (vs >= 50
+    per-leaf), measured on the shared dispatch.ops instrument."""
+    from paddle_tpu.observability import metrics as obs
+
+    _OPT_OPS = ("optimizer.fused_step", "optimizer.leaf_update",
+                "clip.tree", "amp.unscale")
+
+    def opt_dispatches():
+        c = obs.get("dispatch.ops")
+        return sum(c.value(op=k) for k in _OPT_OPS) if c else 0
+
+    def one_run(fused):
+        with flag_guard(fused_optimizer=fused, enable_metrics=True):
+            rng = np.random.RandomState(0)
+            params = [paddle.Parameter(rng.rand(17).astype(np.float32))
+                      for _ in range(50)]
+            opt = optimizer.Adam(learning_rate=1e-3, parameters=params,
+                                 grad_clip=nn.ClipGradByGlobalNorm(1.0))
+            scaler = amp.GradScaler(init_loss_scaling=8.0)
+
+            def step():
+                for p in params:
+                    p.grad = paddle.to_tensor(
+                        rng.rand(17).astype(np.float32))
+                scaler.step(opt)
+            step()  # warm/compile
+            before = opt_dispatches()
+            step()
+            return opt_dispatches() - before
+
+    assert one_run(fused=True) <= 3
+    assert one_run(fused=False) >= 50
+
+
+def test_fused_host_side_global_norm_hook_falls_back():
+    """A cross-mesh reduce hook that forces host concretization cannot
+    trace into the fused program — the step must FALL BACK (not crash)
+    and agree with the per-leaf path, which splits its clip around the
+    eager hook call."""
+    def run(fused):
+        with flag_guard(fused_optimizer=fused):
+            params = _make_params()
+            clip = nn.ClipGradByGlobalNorm(1.0)
+            clip._global_norm_reduce_fn = lambda sq: float(sq) * 2.0
+            opt = optimizer.SGD(learning_rate=0.5, parameters=params,
+                                grad_clip=clip)
+            for p, g in zip(params, _grads_for_step(0)):
+                p.grad = paddle.to_tensor(g)
+            opt.step()
+            return [np.asarray(p._value) for p in params]
+    for x, y in zip(run(False), run(True)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_fused_hit_miss_counter():
+    from paddle_tpu.observability import metrics as obs
+    with flag_guard(fused_optimizer=True, enable_metrics=True):
+        params = _make_params()
+        opt = optimizer.Adam(learning_rate=0.01, parameters=params)
+        c = obs.get("optimizer.fused")
+        miss0, hit0 = c.value(kind="miss"), c.value(kind="hit")
+        for s in range(3):
+            for p, g in zip(params, _grads_for_step(s)):
+                p.grad = paddle.to_tensor(g)
+            opt.step()
+            opt.clear_grad()
+        # one trace for the tree, then cache hits
+        assert c.value(kind="miss") == miss0 + 1
+        assert c.value(kind="hit") == hit0 + 2

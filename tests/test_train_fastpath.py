@@ -1,0 +1,355 @@
+"""Training-step fast path: hapi flag-spaced loss sync, dataloader
+device prefetch, and the GradScaler passthrough/counter satellites
+(round-7 tentpole acceptance tests beyond the optimizer parity suite in
+test_optimizer.py)."""
+
+import gc
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+from paddle_tpu.flags import flag_guard
+from paddle_tpu.observability import flight_recorder as flight
+from paddle_tpu.observability import metrics as obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability_state():
+    """Deterministic telemetry/counter state per test (same convention as
+    test_telemetry): the default timeline's step indices restart at 0."""
+    from paddle_tpu.observability import telemetry
+    obs.reset()
+    flight.default_recorder().clear()
+    telemetry.default_timeline().reset()
+    yield
+    paddle.set_flags({"enable_metrics": True, "enable_nan_watchdog": False,
+                      "flight_dump_dir": ""})
+    obs.reset()
+    flight.default_recorder().clear()
+    telemetry.default_timeline().reset()
+
+
+class _BlobDataset(paddle.io.Dataset):
+    def __init__(self, n=32, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.rand(n, 4).astype(np.float32)
+        self.y = self.x.sum(axis=1, keepdims=True).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _prepared_model(lr=0.01):
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(optimizer=optimizer.Adam(learning_rate=lr,
+                                           parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    return model
+
+
+# ------------------------------------------------------- loss sync interval
+def _loss_syncs():
+    c = obs.get("train.loss_syncs")
+    return c.total() if c else 0
+
+
+@pytest.mark.parametrize("interval,steps", [(1, 8), (3, 8), (4, 8), (5, 8)])
+def test_loss_sync_interval_host_read_count(interval, steps):
+    """With FLAGS_loss_sync_interval=K, fit performs exactly ceil(steps/K)
+    host reads of the loss (asserted by the train.loss_syncs counter)."""
+    with flag_guard(loss_sync_interval=interval, enable_metrics=True):
+        model = _prepared_model()
+        before = _loss_syncs()
+        model.fit(_BlobDataset(32), batch_size=4, epochs=1, verbose=0,
+                  shuffle=False)
+        reads = _loss_syncs() - before
+    assert reads == -(-steps // interval), \
+        f"K={interval}: {reads} host reads for {steps} steps"
+
+
+def test_loss_sync_interval_resets_per_fit():
+    """Each fit() restarts the sync phase: step 0 always syncs (logs
+    carry a 'loss' from the first callback) and every fit performs its
+    own ceil(steps/K) host reads — the cadence must not bleed across
+    fit() calls."""
+    with flag_guard(loss_sync_interval=4, enable_metrics=True):
+        model = _prepared_model()
+        model.fit(_BlobDataset(8), batch_size=4, epochs=1, verbose=0,
+                  shuffle=False)  # 2 steps -> 1 read, phase now mid-K
+        before = _loss_syncs()
+        logs = model.fit(_BlobDataset(8), batch_size=4, epochs=1,
+                         verbose=0, shuffle=False)
+    assert "loss" in logs
+    assert _loss_syncs() - before == 1  # ceil(2/4)
+
+
+def test_loss_sync_interval_unsynced_batch_returns_device_array():
+    import jax
+    with flag_guard(loss_sync_interval=3):
+        model = _prepared_model()
+        x = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        synced, _ = model.train_batch([x], [y])       # step 0: synced
+        deferred, _ = model.train_batch([x], [y])     # step 1: on device
+        assert isinstance(synced, np.ndarray)
+        assert not isinstance(deferred, np.ndarray)
+        assert isinstance(deferred, jax.Array)
+        # the device handle still materializes to a finite loss on demand
+        assert np.isfinite(float(np.asarray(deferred).reshape(-1)[0]))
+
+
+def test_loss_sync_records_mark_synced_steps_only():
+    from paddle_tpu.observability import telemetry
+    with flag_guard(loss_sync_interval=2, enable_metrics=True):
+        model = _prepared_model()
+        tl = telemetry.default_timeline()
+        n0 = len(tl.records)
+        x = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        for _ in range(4):
+            model.train_batch([x], [y])
+        recs = tl.records[n0:]
+    assert [r["synced"] for r in recs] == [True, False, True, False]
+    assert [r["loss"] is not None for r in recs] == \
+        [True, False, True, False]
+    # async attribution: the summary separates synced from enqueue-time
+    # steps so throughput readers see how many walls are trustworthy
+    assert tl.summary()["synced_steps"] == 2
+
+
+def test_nan_watchdog_names_synced_step_with_interval(tmp_path):
+    """Acceptance: with K-spaced syncs the flight recorder still names
+    the step whose (synced) loss went non-finite."""
+
+    class NanAfter(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 1)
+            self.calls = 0
+
+        def forward(self, x):
+            self.calls += 1
+            out = self.lin(x)
+            if self.calls > 3:
+                out = out * paddle.to_tensor(np.float32(np.nan))
+            return out
+
+    net = NanAfter()
+    model = paddle.Model(net)
+    model.prepare(optimizer=optimizer.SGD(learning_rate=0.0,
+                                          parameters=net.parameters()),
+                  loss=nn.MSELoss(), jit_compile=False)
+    x = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    y = x.sum(axis=1, keepdims=True)
+    rec = flight.default_recorder()
+    with flag_guard(loss_sync_interval=2, enable_nan_watchdog=True,
+                    enable_metrics=True, flight_dump_dir=str(tmp_path)):
+        for _ in range(6):
+            model.train_batch([x], [y])
+    assert rec.first_nonfinite is not None
+    # NaN first appears at step index 3 (unsynced); the first probed loss
+    # carrying it is synced step 4 — the recorder must name THAT step
+    assert rec.first_nonfinite["site"] == "hapi.train.loss"
+    assert rec.first_nonfinite["step"] == 4
+
+
+# --------------------------------------------------- dataloader device prefetch
+def _batch_values(loader):
+    out = []
+    for batch in loader:
+        out.append(tuple(np.asarray(b._value) for b in batch))
+    return out
+
+
+def test_device_prefetch_batch_parity():
+    """Same batch sequence and values with the flag on and off."""
+    ds = _BlobDataset(20, seed=3)
+    with flag_guard(dataloader_device_prefetch=False):
+        ref = _batch_values(paddle.io.DataLoader(ds, batch_size=3,
+                                                 shuffle=False))
+    with flag_guard(dataloader_device_prefetch=True):
+        got = _batch_values(paddle.io.DataLoader(ds, batch_size=3,
+                                                 shuffle=False))
+    assert len(ref) == len(got) == 7
+    for a, b in zip(ref, got):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_device_prefetch_batches_are_device_arrays():
+    import jax
+    with flag_guard(dataloader_device_prefetch=True):
+        loader = paddle.io.DataLoader(_BlobDataset(8), batch_size=4)
+        for batch in loader:
+            for t in batch:
+                assert isinstance(t._value, jax.Array)
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name == "paddle-tpu-device-prefetch"]
+
+
+def test_device_prefetch_abandoned_iterator_no_leaked_thread():
+    with flag_guard(dataloader_device_prefetch=True):
+        loader = paddle.io.DataLoader(_BlobDataset(32), batch_size=2)
+        it = iter(loader)
+        next(it)
+        next(it)
+        it.close()  # abandon mid-epoch
+        gc.collect()
+        deadline = 50
+        while _prefetch_threads() and deadline:
+            import time
+            time.sleep(0.05)
+            deadline -= 1
+        assert not _prefetch_threads(), "prefetch thread leaked"
+
+        # a fresh epoch over the same loader still yields every batch
+        assert len(list(loader)) == 16
+
+
+def test_device_prefetch_propagates_dataset_errors():
+    class Boom(paddle.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            if i >= 4:
+                raise RuntimeError("boom at 4")
+            return np.float32(i)
+
+    with flag_guard(dataloader_device_prefetch=True):
+        loader = paddle.io.DataLoader(Boom(), batch_size=2)
+        with pytest.raises(RuntimeError, match="boom"):
+            list(loader)
+    assert not _prefetch_threads()
+
+
+# ------------------------------------------------------------ scaler satellites
+def test_disabled_scaler_is_strict_passthrough():
+    """enable=False: no unscale, no found probe, no amp.found_inf count —
+    the step just runs."""
+    with flag_guard(enable_metrics=True):
+        c = obs.get("amp.found_inf")
+        before = c.total() if c else 0
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(enable=False)
+        p.grad = paddle.to_tensor([1.0, 1.0])
+        scaler.step(opt)
+        np.testing.assert_allclose(p.numpy(), [0.9, 0.9], rtol=1e-6)
+        c = obs.get("amp.found_inf")
+        assert (c.total() if c else 0) == before
+        assert scaler._dev_state is None  # no device bookkeeping either
+
+
+def test_found_inf_counter_outcomes_eager():
+    with flag_guard(fused_optimizer=False, enable_metrics=True):
+        c = obs.counter("amp.found_inf")
+        ok0, sk0 = c.value(outcome="ok"), c.value(outcome="skipped")
+        p = paddle.Parameter(np.ones(1, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=4.0)
+        p.grad = paddle.to_tensor([4.0])
+        scaler.step(opt)
+        p.grad = paddle.to_tensor([np.inf])
+        scaler.step(opt)
+        assert c.value(outcome="ok") == ok0 + 1
+        assert c.value(outcome="skipped") == sk0 + 1
+
+
+def test_found_inf_counter_outcomes_fused_accounted_at_sync():
+    """Fused steps keep found_inf on device; the per-step outcomes land
+    on the counter in bulk at the next host sync."""
+    with flag_guard(fused_optimizer=True, enable_metrics=True):
+        c = obs.counter("amp.found_inf")
+        ok0, sk0 = c.value(outcome="ok"), c.value(outcome="skipped")
+        p = paddle.Parameter(np.ones(3, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=4.0)
+        for g in ([4.0, 4.0, 4.0], [np.inf, 0.0, 0.0], [4.0, 4.0, 4.0]):
+            p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+            scaler.step(opt)
+        assert scaler._dev_state is not None  # still deferred
+        assert c.value(outcome="ok") == ok0
+        scaler._sync_fused_state()
+        assert c.value(outcome="ok") == ok0 + 2
+        assert c.value(outcome="skipped") == sk0 + 1
+        assert scaler._scale == 2.0  # one overflow halved 4.0
+
+
+def test_fused_scaler_step_defers_host_sync():
+    """The fused scaler path must not materialize found_inf on the host:
+    the device state stays live across steps until explicitly synced."""
+    with flag_guard(fused_optimizer=True):
+        p = paddle.Parameter(np.ones(4, np.float32))
+        opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=8.0)
+        for _ in range(3):
+            p.grad = paddle.to_tensor(np.full(4, 8.0, np.float32))
+            scaler.step(opt)
+            assert scaler._dev_state is not None
+        assert scaler._steps_since_sync == 3
+        scaler._sync_fused_state()
+        assert scaler._steps_since_sync == 0
+        assert scaler._dev_state is None
+
+
+def test_fused_scaler_step_leaves_grads_unscaled():
+    """Legacy parity: after scaler.step() the grads a user inspects are
+    UNSCALED (the _unscale_and_check contract) on both paths."""
+    def run(fused):
+        with flag_guard(fused_optimizer=fused):
+            p = paddle.Parameter(np.ones(3, np.float32))
+            opt = optimizer.SGD(learning_rate=0.0, parameters=[p])
+            scaler = amp.GradScaler(init_loss_scaling=1024.0)
+            p.grad = paddle.to_tensor(np.full(3, 1024.0, np.float32))
+            scaler.step(opt)
+            return np.asarray(p.grad._value)
+    np.testing.assert_array_equal(run(False), [1.0, 1.0, 1.0])
+    np.testing.assert_array_equal(run(True), [1.0, 1.0, 1.0])
+
+
+def test_scaler_state_dict_syncs_fused_state():
+    with flag_guard(fused_optimizer=True):
+        p = paddle.Parameter(np.ones(2, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = amp.GradScaler(init_loss_scaling=4.0,
+                                incr_every_n_steps=2)
+        for _ in range(2):
+            p.grad = paddle.to_tensor([4.0, 4.0])
+            scaler.step(opt)
+        sd = scaler.state_dict()  # forces the sync
+    assert sd["scale"] == 8.0  # two good steps -> one increase
+    assert sd["good_steps"] == 0
+
+
+def test_hapi_scaler_fit_with_loss_sync_interval_learns():
+    """End-to-end: AMP-scaled hapi fit with fused optimizer, K-spaced
+    loss sync and device prefetch all on — the loss must still go down
+    and the scaler state must stay consistent."""
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=optimizer.Adam(learning_rate=0.05,
+                                 parameters=net.parameters()),
+        loss=nn.MSELoss(),
+        amp_configs={"level": "O1", "init_loss_scaling": 256.0})
+    assert model._scaler is not None
+    with flag_guard(loss_sync_interval=3, fused_optimizer=True,
+                    dataloader_device_prefetch=True):
+        logs = model.fit(_BlobDataset(64, seed=1), batch_size=8, epochs=6,
+                         verbose=0, shuffle=False)
+    assert logs["loss"] < 0.1, logs
+    # reading the scale syncs any pending fused device state
+    assert model._scaler._scale >= 1.0
+    assert model._scaler._dev_state is None
