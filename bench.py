@@ -76,6 +76,7 @@ _REGRESSION_KEYS = {
     "gpt124m_decode": "paged_tokens_per_sec",
     "telemetry_train": "tokens_per_sec",
     "fused_optimizer": "speedup",
+    "fault_tolerance": "save_mb_per_s",
 }
 
 _ENV_PROBE = {}
@@ -311,6 +312,97 @@ def bench_fused_optimizer(ctx):
                 rows[-1]["fused"]["dispatches_per_step"],
             "per_param_dispatches_per_step":
                 rows[-1]["per_param"]["dispatches_per_step"]}
+
+
+@harness.register_rung("fault_tolerance", est_cold_s=90, smoke=True)
+def bench_fault_tolerance(ctx):
+    """Resilience rung (ISSUE 5): atomic-checkpoint save/restore latency
+    and bytes, chaos-truncation detection, and a seconds-scale
+    kill-and-resume drill on a tiny hapi model — resume from the
+    surviving version must be bit-identical to the uninterrupted run."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                                   latest_complete)
+    from paddle_tpu.testing import chaos
+
+    out = {}
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        # --- raw save/restore latency + bytes on a synthetic pytree
+        rng = np.random.RandomState(0)
+        n, w = (8, 1 << 16) if ctx.smoke else (16, 1 << 20)
+        state = {"model": {f"w{i}": rng.rand(w).astype(np.float32)
+                           for i in range(n)}}
+        mb = n * w * 4 / 1e6
+        mgr = CheckpointManager(os.path.join(root, "raw"), keep_last=2)
+        t0 = time.perf_counter()
+        mgr.save(1, state)
+        save_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loaded = mgr.load()
+        restore_s = time.perf_counter() - t0
+        roundtrip_ok = all(
+            np.array_equal(loaded["model"][k], state["model"][k])
+            for k in state["model"])
+        mgr.save(2, state)
+        # truncate the newest committed version's data file: discovery
+        # must skip it and fall back to step 1
+        data = os.path.join(mgr.step_path(2), "0_0.distcp")
+        chaos.truncate_file(data, os.path.getsize(data) // 2)
+        corrupt_skipped = latest_complete(mgr.root) == 1
+        out.update(
+            payload_mb=round(mb, 2),
+            save_s=round(save_s, 4), restore_s=round(restore_s, 4),
+            save_mb_per_s=round(mb / max(save_s, 1e-9), 2),
+            restore_mb_per_s=round(mb / max(restore_s, 1e-9), 2),
+            roundtrip_ok=bool(roundtrip_ok),
+            corrupt_skipped=bool(corrupt_skipped))
+
+        # --- tiny-model kill-and-resume drill (in-process "crash": train
+        # half the epochs, throw the model away, resume a fresh one)
+        rng = np.random.RandomState(1)
+        xs = rng.rand(32, 4).astype(np.float32)
+        ys = xs.sum(axis=1, keepdims=True).astype(np.float32)
+
+        class _DS(paddle.io.Dataset):
+            def __len__(self):
+                return len(xs)
+
+            def __getitem__(self, i):
+                return xs[i], ys[i]
+
+        def build():
+            paddle.seed(11)
+            net = nn.Linear(4, 1)
+            model = paddle.Model(net)
+            model.prepare(optimizer=optimizer.Adam(
+                learning_rate=0.05, parameters=net.parameters()),
+                loss=nn.MSELoss())
+            return model
+
+        def params_of(model):
+            return [np.asarray(p._value) for p in model.network.parameters()]
+
+        ref = build()
+        ref.fit(_DS(), batch_size=8, epochs=2, verbose=0, shuffle=False)
+
+        ck = CheckpointManager(os.path.join(root, "drill"), save_interval=4)
+        crash = build()
+        crash.fit(_DS(), batch_size=8, epochs=1, verbose=0, shuffle=False,
+                  checkpoint=ck)
+        resumed = build()
+        resumed.fit(_DS(), batch_size=8, epochs=2, verbose=0, shuffle=False,
+                    checkpoint=ck, resume=True)
+        out["resume_bitexact"] = bool(all(
+            np.array_equal(a, b)
+            for a, b in zip(params_of(ref), params_of(resumed))))
+        return out
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 @harness.register_rung("env_probe", est_cold_s=30, smoke=True)

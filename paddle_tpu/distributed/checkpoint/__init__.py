@@ -1,17 +1,26 @@
-"""Distributed (sharded) checkpoint: save/load with reshard-on-load.
+"""Distributed (sharded) checkpoint: save/load with reshard-on-load, plus
+the fault-tolerance layer (atomic versioned commits + auto-resume policy).
 
 Parity: `python/paddle/distributed/checkpoint/` — save_state_dict
 (`save_state_dict.py:104`), load_state_dict (`load_state_dict.py:377`),
-Metadata (`metadata.py:20`).
+Metadata (`metadata.py:20`).  `CheckpointManager` (manager.py) is the
+TPU-native analogue of orbax's atomic-commit CheckpointManager.
 """
 
-from .load_state_dict import load_metadata, load_state_dict
+from .load_state_dict import load_metadata, load_state_dict, read_state_dict
+from .manager import (CheckpointManager, all_steps, clear_preemption,
+                      latest_complete, preemption_requested,
+                      request_preemption, verify_version)
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
-from .save_state_dict import save_state_dict, wait_async_save
+from .save_state_dict import (plan_save, save_state_dict, wait_async_save,
+                              write_planned)
 from .utils import flatten_state_dict, unflatten_state_dict
 
 __all__ = [
     "save_state_dict", "load_state_dict", "load_metadata", "wait_async_save",
+    "read_state_dict", "plan_save", "write_planned",
+    "CheckpointManager", "latest_complete", "all_steps", "verify_version",
+    "preemption_requested", "request_preemption", "clear_preemption",
     "Metadata", "LocalTensorMetadata", "LocalTensorIndex",
     "flatten_state_dict", "unflatten_state_dict",
 ]

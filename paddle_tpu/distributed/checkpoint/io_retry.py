@@ -1,0 +1,42 @@
+"""Transient-I/O retry with exponential backoff.
+
+Shared by the checkpoint writer (``FLAGS_ckpt_io_retries`` /
+``FLAGS_ckpt_io_backoff_s``) and the DataLoader prefetch thread
+(``FLAGS_dataloader_retries`` / ``FLAGS_dataloader_retry_backoff_s``):
+transient ``OSError`` s from a networked filesystem or dataset are retried
+with doubling sleeps before surfacing; every retry is counted and recorded
+as a flight-recorder event so post-mortems show the flakiness.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def call_with_retries(fn: Callable[[], T], *, retries: int,
+                      backoff_s: float, site: str,
+                      retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+                      counter=None,
+                      sleep: Callable[[float], None] = time.sleep) -> T:
+    """Call ``fn``; on a ``retry_on`` exception retry up to ``retries``
+    times, sleeping ``backoff_s * 2**attempt`` between attempts.  The
+    final failure re-raises the last exception unchanged.  ``counter`` is
+    an observability Counter (or None) incremented once per retry."""
+    from ...observability import flight_recorder as _flight
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= max(int(retries), 0):
+                raise
+            if counter is not None:
+                counter.inc(site=site)
+            _flight.default_recorder().record_event(
+                "io_retry", site=site, attempt=attempt + 1,
+                error=f"{type(e).__name__}: {e}"[:200])
+            sleep(max(float(backoff_s), 0.0) * (2 ** attempt))
+            attempt += 1

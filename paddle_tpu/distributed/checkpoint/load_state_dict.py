@@ -25,14 +25,21 @@ from ...framework.tensor import Tensor
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .utils import copy_intersection, flatten_state_dict
 
-__all__ = ["load_state_dict", "load_metadata"]
+__all__ = ["load_state_dict", "load_metadata", "read_state_dict"]
 
 
 def load_metadata(path: str) -> Metadata:
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"checkpoint directory {path!r} does not exist")
+    if not os.path.isdir(path):
+        raise ValueError(f"checkpoint path {path!r} is not a directory")
     md = Metadata()
     files = sorted(f for f in os.listdir(path) if f.endswith(".metadata"))
     if not files:
-        raise FileNotFoundError(f"no .metadata files under {path!r}")
+        raise ValueError(
+            f"checkpoint directory {path!r} contains no .metadata files — "
+            "not a checkpoint (or an incomplete save)")
     for f in files:
         with open(os.path.join(path, f), "rb") as fh:
             md.merge(pickle.load(fh))
@@ -109,6 +116,31 @@ def load_state_dict(state_dict: Dict, path: str,
         _load_into(md, storage, state_dict, path)
     finally:
         storage.close()
+
+
+def read_state_dict(path: str) -> Dict:
+    """Assemble the WHOLE checkpoint at `path` into a nested dict of full
+    numpy arrays (no target/template needed) — the resume path for a
+    fresh process that has not built its model/optimizer state yet.
+    Nesting follows the saved structure (`flat_mapping`)."""
+    from .utils import unflatten_state_dict
+    md = load_metadata(path)
+    storage = _Storage(path)
+    flat: Dict[str, np.ndarray] = {}
+    try:
+        for key in md.state_dict_metadata:
+            shape = tuple(md.global_shape.get(key, ()))
+            pieces = _pieces_for(md, storage, key)
+            if not pieces:
+                raise ValueError(
+                    f"checkpoint at {path!r} has no stored pieces for "
+                    f"{key!r}")
+            dtype = pieces[0][1].dtype
+            flat[key] = _assemble(pieces, tuple(0 for _ in shape), shape,
+                                  dtype, key)
+    finally:
+        storage.close()
+    return unflatten_state_dict(flat, md.flat_mapping)
 
 
 def _load_into(md: Metadata, storage: _Storage, state_dict: Dict,

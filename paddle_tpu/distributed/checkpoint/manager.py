@@ -1,0 +1,574 @@
+"""Atomic, versioned, integrity-checked checkpointing + auto-resume policy.
+
+The in-place `save_state_dict` layout cannot survive a mid-write kill: a
+truncated data file next to a valid-looking metadata file merges silently.
+This module adds the orbax/torch-elastic-shaped commit protocol on top of
+the same plan/write halves:
+
+    root/
+      step_00000042.tmp/        while saving (never read by loaders)
+        0_0.distcp              rank data (save_state_dict layout)
+        0.metadata
+        extra_0.pkl             non-array leaves (coordinator rank only)
+        manifest_0.json         per-rank manifest: sha256 + bytes per file
+      step_00000042/            committed: atomic rename of the tmp dir
+        ... + COMPLETE          sentinel written after ALL manifests validate
+
+Commit order: every rank writes its files + manifest into the tmp dir; the
+coordinator waits for all ranks' manifests, re-hashes every listed file,
+atomically renames tmp → final and only then drops the `COMPLETE` sentinel.
+A reader (`latest_complete`) accepts a version only if the sentinel exists
+AND every manifest still validates — so truncation, bit flips and torn
+tails are detected, skipped and reported, never silently loaded.
+
+`CheckpointManager` owns the policy: save-every-K-steps, async save with a
+synchronous device→host snapshot (the caller may donate buffers the moment
+`save()` returns), keep-last-N rotation with keep-periodic retention,
+transient-I/O retry with exponential backoff (`FLAGS_ckpt_io_retries` /
+`FLAGS_ckpt_io_backoff_s`), and preemption handling (SIGTERM/SIGINT set a
+flag; the train loop finishes the in-flight step, takes an emergency
+checkpoint and exits cleanly).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import re
+import shutil
+import signal as _signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ... import flags as _flags
+from ...framework.tensor import Tensor
+from ...observability import flight_recorder as _flight
+from ...observability import metrics as _metrics
+from ...testing.chaos import checked_open
+from . import save_state_dict as _sd
+from .load_state_dict import load_state_dict, read_state_dict
+
+__all__ = [
+    "CheckpointManager", "latest_complete", "all_steps", "verify_version",
+    "step_dir", "COMPLETE_SENTINEL", "MANIFEST_SCHEMA",
+    "preemption_requested", "request_preemption", "clear_preemption",
+]
+
+logger = logging.getLogger("paddle_tpu.checkpoint")
+
+COMPLETE_SENTINEL = "COMPLETE"
+MANIFEST_SCHEMA = "paddle_tpu.ckpt/v1"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+_M_SAVES = _metrics.counter(
+    "ckpt.saves", "checkpoint save outcomes "
+    "(result=committed|failed|skipped_existing)")
+_M_BYTES = _metrics.counter(
+    "ckpt.bytes_written", "checkpoint payload bytes written (data files)")
+_M_RETRIES = _metrics.counter(
+    "ckpt.io_retries", "transient-I/O retries during checkpoint writes "
+    "(labels: site)")
+_M_SKIP = _metrics.counter(
+    "ckpt.skipped_corrupt", "checkpoint versions skipped by "
+    "latest_complete (reason=incomplete|corrupt)")
+_M_ROTATED = _metrics.counter(
+    "ckpt.rotated", "checkpoint versions deleted by keep-last-N rotation")
+_M_PREEMPT = _metrics.counter(
+    "preempt.signals", "SIGTERM/SIGINT preemption requests observed")
+_H_SAVE_S = _metrics.histogram(
+    "ckpt.save_seconds", "wall seconds per committed checkpoint save "
+    "(snapshot + write + validate + commit)")
+_H_RESTORE_S = _metrics.histogram(
+    "ckpt.restore_seconds", "wall seconds per checkpoint restore")
+
+
+# --------------------------------------------------------------- preemption
+
+_preempt_lock = threading.Lock()
+_preempt = {"requested": False, "signum": None}
+
+
+def preemption_requested() -> bool:
+    return _preempt["requested"]
+
+
+def request_preemption(signum: Optional[int] = None) -> None:
+    """Mark the process as preempted (signal handlers and tests)."""
+    with _preempt_lock:
+        first = not _preempt["requested"]
+        _preempt["requested"] = True
+        _preempt["signum"] = signum
+    if first:
+        _M_PREEMPT.inc()
+        _flight.default_recorder().record_event("preempt_signal",
+                                                signum=signum)
+        logger.warning("preemption requested (signal %s): will checkpoint "
+                       "after the in-flight step and exit", signum)
+
+
+def clear_preemption() -> None:
+    with _preempt_lock:
+        _preempt["requested"] = False
+        _preempt["signum"] = None
+
+
+# ----------------------------------------------------------------- layout
+
+def step_dir(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def _parse_step(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def all_steps(root: str) -> List[int]:
+    """Committed-looking version numbers under `root`, ascending
+    (no validation — `.tmp` dirs are never included)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        s = _parse_step(name)
+        if s is not None and os.path.isdir(os.path.join(root, name)):
+            out.append(s)
+    return sorted(out)
+
+
+def _sha256(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _write_manifest(path: str, rank: int, step: int,
+                    files: List[str]) -> Dict[str, Any]:
+    manifest = {"schema": MANIFEST_SCHEMA, "step": int(step),
+                "rank": int(rank),
+                "files": {name: {"sha256": _sha256(os.path.join(path, name)),
+                                 "bytes": os.path.getsize(
+                                     os.path.join(path, name))}
+                          for name in files}}
+    tmp = os.path.join(path, f"manifest_{rank}.json.part")
+    with checked_open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(path, f"manifest_{rank}.json"))
+    return manifest
+
+
+def verify_version(path: str, need_sentinel: bool = True) -> Optional[str]:
+    """Integrity-check one version directory; returns None when valid,
+    else a human-readable reason.  Every file named by every manifest must
+    exist with the recorded size and sha256."""
+    if not os.path.isdir(path):
+        return "missing directory"
+    if need_sentinel and not os.path.exists(
+            os.path.join(path, COMPLETE_SENTINEL)):
+        return "no COMPLETE sentinel (uncommitted or interrupted save)"
+    manifests = sorted(f for f in os.listdir(path)
+                       if re.match(r"^manifest_\d+\.json$", f))
+    if not manifests:
+        return "no rank manifests"
+    for mf in manifests:
+        try:
+            with open(os.path.join(path, mf)) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            return f"unreadable manifest {mf}: {type(e).__name__}"
+        for name, want in files.items():
+            fp = os.path.join(path, name)
+            if not os.path.exists(fp):
+                return f"missing file {name}"
+            if os.path.getsize(fp) != want["bytes"]:
+                return (f"size mismatch for {name}: "
+                        f"{os.path.getsize(fp)} != {want['bytes']}")
+            if _sha256(fp) != want["sha256"]:
+                return f"checksum mismatch for {name}"
+    return None
+
+
+def latest_complete(root: str,
+                    before: Optional[int] = None) -> Optional[int]:
+    """Newest step under `root` that is committed AND passes integrity
+    validation.  Partial (`.tmp`), uncommitted and corrupt versions are
+    skipped, counted (`ckpt.skipped_corrupt`) and logged — never loaded."""
+    for step in reversed(all_steps(root)):
+        if before is not None and step >= before:
+            continue
+        path = os.path.join(root, step_dir(step))
+        reason = verify_version(path)
+        if reason is None:
+            return step
+        kind = "incomplete" if "sentinel" in reason else "corrupt"
+        _M_SKIP.inc(reason=kind)
+        _flight.default_recorder().record_event(
+            "ckpt_skip_corrupt", step=step, reason=reason)
+        logger.warning("skipping checkpoint %s: %s", path, reason)
+    return None
+
+
+# ------------------------------------------------------------- tree splits
+
+def _is_array_leaf(v) -> bool:
+    import jax
+    return isinstance(v, (Tensor, jax.Array, np.ndarray, np.generic))
+
+
+def _split_tree(state: Dict) -> Tuple[Dict, Dict]:
+    """Partition a nested dict into (array leaves, everything else).
+    Arrays go through the sharded save path; the rest is pickled by the
+    coordinator (`extra_<rank>.pkl`)."""
+    arrays: Dict = {}
+    extra: Dict = {}
+    for k, v in state.items():
+        if isinstance(v, dict):
+            a, e = _split_tree(v)
+            if a:
+                arrays[k] = a
+            if e:
+                extra[k] = e
+        elif _is_array_leaf(v):
+            arrays[k] = v
+        else:
+            extra[k] = v
+    return arrays, extra
+
+
+def _deep_merge(a: Dict, b: Dict) -> Dict:
+    out = dict(a)
+    for k, v in b.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------------------ manager
+
+class CheckpointManager:
+    """Policy owner for atomic, versioned checkpoints under one root.
+
+    ``save_interval`` paces `maybe_save` (every K optimizer steps);
+    ``keep_last`` committed versions survive rotation, plus every version
+    whose step is a multiple of ``keep_period`` (0 = no periodic keeps).
+    ``async_save=True`` snapshots device state synchronously, then writes
+    + commits on a background thread; a failed async save raises on the
+    NEXT `save()`/`wait()` call.
+    """
+
+    def __init__(self, root: str, save_interval: int = 1,
+                 keep_last: int = 2, keep_period: int = 0,
+                 async_save: bool = False, coordinator_rank: int = 0):
+        if save_interval < 0:
+            raise ValueError("save_interval must be >= 0")
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.root = str(root)
+        self.save_interval = int(save_interval)
+        self.keep_last = int(keep_last)
+        self.keep_period = int(keep_period)
+        self.async_save = bool(async_save)
+        self.coordinator_rank = int(coordinator_rank)
+        os.makedirs(self.root, exist_ok=True)
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._old_handlers: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------ discovery
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.root, step_dir(step))
+
+    def all_steps(self) -> List[int]:
+        return all_steps(self.root)
+
+    def latest_complete(self) -> Optional[int]:
+        return latest_complete(self.root)
+
+    # ----------------------------------------------------------------- save
+    def wait(self) -> None:
+        """Join any in-flight async save; re-raise its failure."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                "async checkpoint save failed; the newest durable "
+                "checkpoint is older than you think") from err
+
+    def maybe_save(self, step: int, state, wait: bool = False) -> bool:
+        """Save iff `step` is on the save-interval grid.  `state` may be a
+        dict or a zero-arg callable returning one (so callers don't build
+        the state tree on the steps that won't save)."""
+        if self.save_interval <= 0 or step % self.save_interval != 0:
+            return False
+        if callable(state):
+            state = state()
+        return self.save(step, state, wait=wait)
+
+    def save(self, step: int, state: Dict, wait: bool = False) -> bool:
+        """Snapshot `state` (synchronously) and commit it as version
+        `step`.  Returns False when that version is already committed.
+        With ``async_save`` the write+commit happens on a background
+        thread unless ``wait=True``."""
+        self.wait()  # serialize vs the previous async save; surface errors
+        if os.path.exists(os.path.join(self.step_path(step),
+                                       COMPLETE_SENTINEL)):
+            _M_SAVES.inc(result="skipped_existing")
+            return False
+        t0 = time.perf_counter()
+        _flight.default_recorder().record_event("ckpt_save_start", step=step)
+        arrays, extra = _split_tree(state)
+        # device→host snapshot happens HERE, synchronously: after plan_save
+        # returns the caller may donate/overwrite every device buffer
+        plan = _sd.plan_save(arrays)
+        extra_blob = pickle.dumps(extra) \
+            if plan.rank == self.coordinator_rank else None
+
+        if self.async_save and not wait:
+            def job():
+                try:
+                    self._write_version(step, plan, extra_blob, t0)
+                except BaseException as e:  # surfaced on the next save()
+                    self._error = e
+                    _M_SAVES.inc(result="failed")
+                    _flight.default_recorder().record_event(
+                        "ckpt_save_failed", step=step,
+                        error=f"{type(e).__name__}: {e}"[:200])
+            self._thread = threading.Thread(
+                target=job, name=f"ckpt-save-{step}", daemon=True)
+            self._thread.start()
+            return True
+        try:
+            self._write_version(step, plan, extra_blob, t0)
+        except BaseException as e:
+            _M_SAVES.inc(result="failed")
+            _flight.default_recorder().record_event(
+                "ckpt_save_failed", step=step,
+                error=f"{type(e).__name__}: {e}"[:200])
+            raise
+        return True
+
+    def _write_version(self, step: int, plan: "_sd.SavePlan",
+                       extra_blob: Optional[bytes], t0: float) -> None:
+        """One rank's write + (coordinator) validate/commit/rotate, under
+        the transient-I/O retry policy."""
+        from .io_retry import call_with_retries
+        retries = int(_flags.get_flag("ckpt_io_retries"))
+        backoff = float(_flags.get_flag("ckpt_io_backoff_s"))
+        tmp = self.step_path(step) + ".tmp"
+        final = self.step_path(step)
+        rank = plan.rank
+
+        def attempt():
+            # a retry restarts this rank's files from scratch — partial
+            # output from the failed attempt must not survive into the
+            # manifest (the tmp dir itself is shared across ranks)
+            os.makedirs(tmp, exist_ok=True)
+            for name in (plan.data_file, plan.metadata_file,
+                         f"extra_{rank}.pkl", f"manifest_{rank}.json"):
+                p = os.path.join(tmp, name)
+                if os.path.exists(p):
+                    os.remove(p)
+            written = _sd.write_planned(tmp, plan)
+            if extra_blob is not None:
+                with checked_open(os.path.join(tmp, f"extra_{rank}.pkl"),
+                                  "wb") as f:
+                    f.write(extra_blob)
+                written.append(f"extra_{rank}.pkl")
+            _write_manifest(tmp, rank, step, written)
+
+        call_with_retries(attempt, retries=retries, backoff_s=backoff,
+                          site=f"ckpt.save.step_{step}", counter=_M_RETRIES)
+
+        if rank != self.coordinator_rank:
+            return
+        self._commit(step, tmp, final, retries, backoff)
+        _M_SAVES.inc(result="committed")
+        _M_BYTES.inc(plan.nbytes)
+        dt = time.perf_counter() - t0
+        _H_SAVE_S.observe(dt)
+        _flight.default_recorder().record_event(
+            "ckpt_commit", step=step, bytes=plan.nbytes,
+            seconds=round(dt, 4))
+        self.rotate(protect=step)
+
+    def _commit(self, step: int, tmp: str, final: str,
+                retries: int, backoff: float) -> None:
+        """Coordinator: wait for every rank's manifest, validate all
+        files, atomically rename, then drop the sentinel."""
+        import jax
+        from .io_retry import call_with_retries
+        n_ranks = jax.process_count()
+        deadline = time.monotonic() + float(
+            _flags.get_flag("ckpt_commit_timeout_s"))
+        while True:
+            have = [f for f in os.listdir(tmp)
+                    if re.match(r"^manifest_\d+\.json$", f)]
+            if len(have) >= n_ranks:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"checkpoint commit for step {step}: only "
+                    f"{len(have)}/{n_ranks} rank manifests appeared")
+            time.sleep(0.05)
+        reason = verify_version(tmp, need_sentinel=False)
+        if reason is not None:
+            raise ValueError(
+                f"checkpoint validation failed for step {step}: {reason}")
+
+        def do_commit():
+            if os.path.isdir(final):
+                shutil.rmtree(final)  # stale uncommitted leftover
+            os.replace(tmp, final)
+            with checked_open(os.path.join(final, COMPLETE_SENTINEL),
+                              "w") as f:
+                json.dump({"step": int(step), "ranks": int(n_ranks),
+                           "committed_unix": time.time()}, f)
+        call_with_retries(do_commit, retries=retries, backoff_s=backoff,
+                          site=f"ckpt.commit.step_{step}",
+                          counter=_M_RETRIES)
+
+    # ------------------------------------------------------------- rotation
+    def rotate(self, protect: Optional[int] = None) -> List[int]:
+        """Delete committed versions beyond ``keep_last``, retaining every
+        step that is a multiple of ``keep_period`` (and ``protect``).
+        Returns the deleted steps."""
+        steps = self.all_steps()
+        keep = set(steps[-self.keep_last:])
+        if protect is not None:
+            keep.add(protect)
+        if self.keep_period > 0:
+            keep.update(s for s in steps
+                        if s > 0 and s % self.keep_period == 0)
+        deleted = []
+        for s in steps:
+            if s in keep:
+                continue
+            for path in (self.step_path(s), self.step_path(s) + ".tmp"):
+                if os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
+            deleted.append(s)
+            _M_ROTATED.inc()
+            _flight.default_recorder().record_event("ckpt_rotate", step=s)
+        return deleted
+
+    # ----------------------------------------------------------------- load
+    def _resolve(self, step: Optional[int]) -> int:
+        if step is None:
+            found = self.latest_complete()
+            if found is None:
+                raise FileNotFoundError(
+                    f"no complete checkpoint under {self.root!r}")
+            return found
+        reason = verify_version(self.step_path(step))
+        if reason is not None:
+            raise ValueError(
+                f"checkpoint step {step} under {self.root!r} is not "
+                f"loadable: {reason}")
+        return step
+
+    def _load_extra(self, path: str) -> Dict:
+        extra: Dict = {}
+        for f in sorted(os.listdir(path)):
+            if re.match(r"^extra_\d+\.pkl$", f):
+                with open(os.path.join(path, f), "rb") as fh:
+                    extra = _deep_merge(extra, pickle.load(fh))
+        return extra
+
+    def load(self, step: Optional[int] = None) -> Dict:
+        """Template-free restore: assemble version `step` (default: the
+        newest complete one) into a nested dict — full numpy arrays for
+        array leaves, original Python values for the rest."""
+        t0 = time.perf_counter()
+        step = self._resolve(step)
+        path = self.step_path(step)
+        out = _deep_merge(read_state_dict(path), self._load_extra(path))
+        _H_RESTORE_S.observe(time.perf_counter() - t0)
+        return out
+
+    def restore_into(self, state: Dict,
+                     step: Optional[int] = None) -> Tuple[Dict, Dict]:
+        """Sharded in-place restore: every array leaf of `state` (Tensor,
+        jax.Array or numpy) is reloaded with resharding preserved (target
+        sharding wins, `load_state_dict` semantics).  Returns
+        ``(arrays, extra)`` where `arrays` mirrors the array leaves of
+        `state` with the loaded values and `extra` holds the non-array
+        leaves of the checkpoint."""
+        import jax.numpy as jnp
+        t0 = time.perf_counter()
+        step = self._resolve(step)
+        path = self.step_path(step)
+        arrays, _ = _split_tree(state)
+
+        def wrap(node):
+            if isinstance(node, dict):
+                return {k: wrap(v) for k, v in node.items()}
+            if isinstance(node, Tensor):
+                return node
+            return Tensor._wrap(jnp.asarray(node))
+        wrapped = wrap(arrays)
+        load_state_dict(wrapped, path)
+
+        def unwrap(node):
+            if isinstance(node, dict):
+                return {k: unwrap(v) for k, v in node.items()}
+            return node._value
+        out = unwrap(wrapped)
+        extra = self._load_extra(path)
+        _H_RESTORE_S.observe(time.perf_counter() - t0)
+        return out, extra
+
+    # ------------------------------------------------------------ preemption
+    @property
+    def preempted(self) -> bool:
+        return preemption_requested()
+
+    def install_signal_handlers(self, signals=(
+            _signal.SIGTERM, _signal.SIGINT)) -> None:
+        """SIGTERM/SIGINT set the preemption flag instead of killing the
+        process; the training loop checks `preempted` after each step,
+        saves, and exits cleanly.  Restore with
+        `uninstall_signal_handlers` (fit does both)."""
+        for sig in signals:
+            if sig in self._old_handlers:
+                continue
+            try:
+                self._old_handlers[sig] = _signal.signal(
+                    sig, lambda signum, frame: request_preemption(signum))
+            except ValueError:
+                # not the main thread: the caller keeps the default
+                # handlers and can still request_preemption() manually
+                logger.warning("cannot install signal handlers off the "
+                               "main thread; preemption flag only")
+                break
+
+    def uninstall_signal_handlers(self) -> None:
+        for sig, old in self._old_handlers.items():
+            _signal.signal(sig, old)
+        self._old_handlers.clear()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        try:
+            self.wait()
+        finally:
+            self.uninstall_signal_handlers()
+        return False

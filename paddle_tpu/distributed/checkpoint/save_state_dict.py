@@ -9,6 +9,20 @@ across the job), plus one metadata file (`{rank}.metadata`).  Load merges
 every metadata file it finds, so multi-host save needs no object collective —
 only the shared filesystem the reference also assumes
 (`save_state_dict.py`'s gather_object step is replaced by the merge).
+
+The save is split in two halves so `CheckpointManager` can snapshot
+synchronously and write asynchronously:
+
+* :func:`plan_save` — device→host snapshot: walks the state dict, pulls
+  every owned shard to numpy and builds the rank's metadata.  After it
+  returns, the caller may donate/mutate the device buffers.
+* :func:`write_planned` — pure host I/O: writes the rank's data + metadata
+  files from a plan.  All opens go through `testing.chaos.checked_open`,
+  the deterministic fault-injection point of the crash-safety tests.
+
+`save_state_dict` composes the two and keeps the historical in-place
+layout; the atomic, versioned, integrity-checked protocol lives in
+`manager.py` on top of the same halves.
 """
 
 from __future__ import annotations
@@ -16,12 +30,14 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+from dataclasses import dataclass
 from typing import Dict, Optional
 
 import jax
 import numpy as np
 
 from ...framework.tensor import Tensor
+from ...testing.chaos import checked_open
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 from .utils import flatten_state_dict, offset_of
 
@@ -40,6 +56,10 @@ def _data_file(rank: int) -> str:
     return f"{rank}_0.distcp"
 
 
+def _metadata_file(rank: int) -> str:
+    return f"{rank}.metadata"
+
+
 def _collect_local_pieces(key: str, val) -> list:
     """[(offset, np_array)] for the pieces this process must write."""
     if isinstance(val, jax.Array):
@@ -56,6 +76,71 @@ def _collect_local_pieces(key: str, val) -> list:
     return [(tuple(0 for _ in arr.shape), arr)]
 
 
+@dataclass
+class SavePlan:
+    """Host-side snapshot of one rank's share of a save: everything
+    `write_planned` needs, with no live device buffers referenced."""
+    rank: int
+    metadata: Metadata
+    payload: Dict[str, np.ndarray]
+
+    @property
+    def data_file(self) -> str:
+        return _data_file(self.rank)
+
+    @property
+    def metadata_file(self) -> str:
+        return _metadata_file(self.rank)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in self.payload.values())
+
+
+def plan_save(state_dict: Dict, rank: Optional[int] = None) -> SavePlan:
+    """Device→host snapshot of this rank's share of `state_dict`.
+
+    Synchronous: `np.asarray` on each owned shard blocks until the device
+    value is on the host, so after this returns the caller is free to
+    donate or overwrite the source buffers (the async-save contract)."""
+    if not isinstance(state_dict, dict):
+        raise TypeError("state_dict must be a dict, got "
+                        f"{type(state_dict).__name__}")
+    flat, mapping = flatten_state_dict(state_dict)
+    if rank is None:
+        rank = jax.process_index()
+    md = Metadata(flat_mapping=mapping)
+    file_name = _data_file(rank)
+    payload: Dict[str, np.ndarray] = {}
+    for key, v in flat.items():
+        val = _to_value(v)
+        global_shape = tuple(np.asarray(val).shape) \
+            if not isinstance(val, jax.Array) else tuple(val.shape)
+        md.global_shape[key] = global_shape
+        entries = md.state_dict_metadata.setdefault(key, [])
+        for i, (offset, arr) in enumerate(_collect_local_pieces(key, val)):
+            arr = np.ascontiguousarray(arr)
+            entries.append(LocalTensorMetadata(offset, tuple(arr.shape),
+                                               str(arr.dtype)))
+            md.storage_metadata[LocalTensorIndex(key, offset)] = file_name
+            payload[f"{key}|{i}"] = arr
+    return SavePlan(rank, md, payload)
+
+
+def write_planned(path: str, plan: SavePlan) -> list:
+    """Write one rank's data + metadata files into `path`; returns the
+    file names written (relative to `path`).  Pure host I/O."""
+    written = []
+    if plan.payload:
+        with checked_open(os.path.join(path, plan.data_file), "wb") as f:
+            np.savez(f, **plan.payload)
+        written.append(plan.data_file)
+    with checked_open(os.path.join(path, plan.metadata_file), "wb") as f:
+        pickle.dump(plan.metadata, f)
+    written.append(plan.metadata_file)
+    return written
+
+
 def save_state_dict(state_dict: Dict, path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False) -> None:
@@ -65,13 +150,15 @@ def save_state_dict(state_dict: Dict, path: str,
     written by the replica-0 owner only.  Safe to call from a single process
     over a multi-device mesh (all shards are addressable) and from each
     process of a multi-host job (shared filesystem).
+
+    NOTE: this writes IN PLACE — a crash mid-write leaves `path` partial.
+    For atomic, versioned, integrity-checked saves use
+    `CheckpointManager` (manager.py), which builds on the same plan/write
+    halves but commits via rename + COMPLETE sentinel.
     """
-    if not isinstance(state_dict, dict):
-        raise TypeError("state_dict must be a dict, got "
-                        f"{type(state_dict).__name__}")
-    flat, mapping = flatten_state_dict(state_dict)
+    plan = plan_save(state_dict)
     os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+    rank = plan.rank
     wait_async_save()  # serialize vs this process's earlier async writes
     if rank == coordinator_rank:
         # drop stale artifacts from a previous bigger job so a re-save with
@@ -84,34 +171,18 @@ def save_state_dict(state_dict: Dict, path: str,
             if f.endswith((".distcp", ".metadata")) and head.isdigit() \
                     and int(head) >= n_proc:
                 os.remove(os.path.join(path, f))
-    # our own files are rewritten below; a same-rank stale .distcp with no
-    # metadata entry is unreachable at load (reads are manifest-driven), but
-    # if this rank now has nothing to write the old data file must go
-    own_data = os.path.join(path, _data_file(rank))
-    if os.path.exists(own_data):
-        os.remove(own_data)
-
-    md = Metadata(flat_mapping=mapping)
-    file_name = _data_file(rank)
-    payload: Dict[str, np.ndarray] = {}
-    for key, v in flat.items():
-        val = _to_value(v)
-        global_shape = tuple(np.asarray(val).shape) \
-            if not isinstance(val, jax.Array) else tuple(val.shape)
-        md.global_shape[key] = global_shape
-        entries = md.state_dict_metadata.setdefault(key, [])
-        for i, (offset, arr) in enumerate(_collect_local_pieces(key, val)):
-            entries.append(LocalTensorMetadata(offset, tuple(arr.shape),
-                                               str(arr.dtype)))
-            md.storage_metadata[LocalTensorIndex(key, offset)] = file_name
-            payload[f"{key}|{i}"] = arr
+    # both of this rank's files are rewritten below; delete BOTH first so a
+    # crash between the data write and the metadata write can't leave a
+    # stale same-rank .metadata pointing into the rewritten data file (load
+    # would happily merge it) — with neither file present, a half-written
+    # save is simply invisible to load
+    for stale in (_data_file(rank), _metadata_file(rank)):
+        p = os.path.join(path, stale)
+        if os.path.exists(p):
+            os.remove(p)
 
     def write():
-        if payload:
-            with open(os.path.join(path, file_name), "wb") as f:
-                np.savez(f, **payload)
-        with open(os.path.join(path, f"{rank}.metadata"), "wb") as f:
-            pickle.dump(md, f)
+        write_planned(path, plan)
 
     if async_save:
         def guarded():

@@ -55,6 +55,7 @@ __all__ = [
     "HybridConfig", "init_gpt_params", "stack_for_pipeline",
     "hybrid_param_specs", "init_zero_state", "zero_state_specs",
     "make_hybrid_train_step",
+    "hybrid_train_state", "save_hybrid_state", "load_hybrid_state",
     "serial_train_step", "serial_forward",
 ]
 
@@ -843,6 +844,55 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     timed_step.lower = jitted.lower          # AOT/debug paths still work
     timed_step._jitted = jitted
     return timed_step
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance: versioned save / sharded resume of the hybrid train state
+# ---------------------------------------------------------------------------
+
+def hybrid_train_state(params, m, v, step_no) -> Dict[str, Any]:
+    """Checkpointable tree for `CheckpointManager.save`: the sharded
+    param/optimizer pytrees ride the sharded save path (each process
+    writes only its owned shards), the Adam step count goes into the
+    coordinator's extra blob."""
+    return {"hybrid": {"params": params, "m": m, "v": v},
+            "meta": {"step_no": float(step_no)}}
+
+
+def save_hybrid_state(manager, step: int, params, m, v, step_no,
+                      wait: bool = False) -> bool:
+    """Version the full hybrid train state as `step` (atomic commit)."""
+    return manager.save(step, hybrid_train_state(params, m, v, step_no),
+                        wait=wait)
+
+
+def _shard_tree(tree, specs, mesh: Mesh):
+    """device_put every leaf into NamedSharding(mesh, spec) — the layout
+    `make_hybrid_train_step` expects its inputs in."""
+    from jax.sharding import NamedSharding
+    leaves, spec_leaves, treedef = _flatten_with_specs(tree, specs)
+    out = [jax.device_put(x, NamedSharding(mesh, s))
+           for x, s in zip(leaves, spec_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def load_hybrid_state(manager, mesh: Mesh, cfg: HybridConfig, params, m, v,
+                      step=None):
+    """Resume: reload (params, m, v, step_no) from the newest complete
+    version (or `step`) of `manager`, laid out onto `mesh` per this
+    config's param/ZeRO specs.  The template trees supply shapes/dtypes
+    only (fresh `init_gpt_params`/`init_zero_state` output is fine) —
+    reshard-on-load means the checkpoint may have been written under a
+    DIFFERENT mesh/degree.  Returns ``(params, m, v, step_no)``."""
+    specs = hybrid_param_specs(cfg)
+    opt_specs = zero_state_specs(specs)
+    arrays, extra = manager.restore_into(
+        {"hybrid": {"params": _shard_tree(params, specs, mesh),
+                    "m": _shard_tree(m, opt_specs, mesh),
+                    "v": _shard_tree(v, opt_specs, mesh)}}, step=step)
+    h = arrays["hybrid"]
+    return (h["params"], h["m"], h["v"],
+            float(extra.get("meta", {}).get("step_no", 0.0)))
 
 
 # ---------------------------------------------------------------------------

@@ -209,6 +209,26 @@ define_flag("dataloader_device_prefetch", True,
             "batch t+1 overlaps step t's compute; 0 fetches batches "
             "inline on the consuming thread")
 
+# Fault tolerance (distributed/checkpoint/manager.py, io.DataLoader).
+define_flag("ckpt_io_retries", 3,
+            "transient-I/O retry attempts per checkpoint write/commit "
+            "step (OSError only); each retry backs off exponentially "
+            "from FLAGS_ckpt_io_backoff_s and counts on ckpt.io_retries")
+define_flag("ckpt_io_backoff_s", 0.1,
+            "base backoff seconds between checkpoint I/O retries "
+            "(doubles per attempt)")
+define_flag("ckpt_commit_timeout_s", 300.0,
+            "seconds the commit coordinator waits for every rank's "
+            "manifest to appear in the step_<N>.tmp directory before "
+            "failing the save")
+define_flag("dataloader_retries", 2,
+            "transient-OSError retries of one DataLoader batch fetch "
+            "(dataset access + collate) before the error surfaces; "
+            "retries count on dataloader.retries")
+define_flag("dataloader_retry_backoff_s", 0.05,
+            "base backoff seconds between DataLoader fetch retries "
+            "(doubles per attempt)")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

@@ -16,7 +16,9 @@ import threading
 
 import jax
 
-__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "key_source_guard"]
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key",
+           "key_source_guard", "rng_checkpoint_state",
+           "restore_rng_checkpoint_state"]
 
 
 def _key_impl():
@@ -151,6 +153,23 @@ def get_rng_state():
 
 def set_rng_state(key):
     _global_source.set_state(key)
+
+
+def rng_checkpoint_state():
+    """Host-serializable snapshot of the global key chain: the raw key
+    bits plus the PRNG impl name, so a restore re-wraps the exact key the
+    crashed process would have split next (bit-identical streams)."""
+    import numpy as np
+    key = get_rng_state()
+    return {"key_data": np.asarray(jax.random.key_data(key)),
+            "impl": str(jax.random.key_impl(key))}
+
+
+def restore_rng_checkpoint_state(state):
+    """Inverse of `rng_checkpoint_state` (accepts its dict)."""
+    import jax.numpy as jnp
+    data = jnp.asarray(state["key_data"])
+    set_rng_state(jax.random.wrap_key_data(data, impl=str(state["impl"])))
 
 
 @contextlib.contextmanager

@@ -326,6 +326,60 @@ class Model:
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
 
+    # -------------------------------------------------- fault-tolerant state
+    def _train_state(self, epoch: int, step_in_epoch: int) -> dict:
+        """Everything a resumed process needs for a bit-identical
+        continuation: params, optimizer accumulators (structured keys),
+        the fused-path device scalars materialized through the state_dict
+        sync points (`optimizer._global_step`, GradScaler
+        scale/good/bad), framework + numpy RNG, and the loop position."""
+        from ..framework import random as _random
+        meta = {
+            "epoch": int(epoch), "step_in_epoch": int(step_in_epoch),
+            "train_steps": int(self._train_steps),
+            "last_synced_step": int(self._last_synced_step),
+            "scaler": self._scaler.state_dict()
+            if self._scaler is not None else None,
+            "rng": {"framework": _random.rng_checkpoint_state(),
+                    "numpy": np.random.get_state(),
+                    "numpy_epoch_start": getattr(self, "_epoch_np_state",
+                                                 None)},
+        }
+        return {"model": self.network.state_dict(),
+                "optimizer": self._remap_opt_state(
+                    self._optimizer.state_dict(), True),
+                "meta": meta}
+
+    def _restore_train_state(self, manager, step=None):
+        """Load the newest complete version (or `step`) from `manager`
+        and restore model/optimizer/scaler/RNG + loop counters.  Returns
+        the restored meta dict, or None when the root holds no complete
+        checkpoint yet (auto-resume on a first launch starts fresh)."""
+        if self._optimizer is None:
+            raise RuntimeError("call prepare(optimizer=..., loss=...) "
+                               "before fit(resume=...)")
+        if step is None:
+            step = manager.latest_complete()
+            if step is None:
+                return None
+        state = manager.load(step)
+        self.network.set_state_dict(
+            {k: v if isinstance(v, Tensor) else Tensor(np.asarray(v))
+             for k, v in state["model"].items()})
+        self._optimizer.set_state_dict(
+            self._remap_opt_state(state["optimizer"], False))
+        meta = state.get("meta", {})
+        if self._scaler is not None and meta.get("scaler"):
+            self._scaler.load_state_dict(meta["scaler"])
+        rng = meta.get("rng") or {}
+        if rng.get("framework") is not None:
+            from ..framework import random as _random
+            _random.restore_rng_checkpoint_state(rng["framework"])
+        self._train_steps = int(meta.get("train_steps", 0))
+        self._last_synced_step = int(meta.get("last_synced_step", -1))
+        self._compiled = {}  # new weights invalidate donated buffers
+        return meta
+
     # ------------------------------------------------------------------- fit
     def _make_loader(self, data, batch_size, shuffle, drop_last, num_workers):
         if data is None or isinstance(data, paddle_io.DataLoader):
@@ -336,19 +390,48 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            checkpoint=None, resume=False):
+        """Train; with ``checkpoint`` (a `CheckpointManager` or a root
+        path) fit takes atomic versioned checkpoints every
+        ``save_interval`` optimizer steps and handles SIGTERM/SIGINT by
+        finishing the in-flight step, taking an emergency checkpoint and
+        returning cleanly.  ``resume=True`` restores the newest complete
+        version (params, optimizer + scaler state, RNG, epoch/step
+        position) before training; ``resume=<step>`` picks a version.
+        An empty checkpoint root with resume=True starts fresh, so the
+        same launch command works before and after a preemption."""
         assert train_data is not None, "train_data must be given"
         # restart the loss-sync phase: each fit performs exactly
         # ceil(steps/K) host reads and step 0 always syncs (so logs
         # carry a 'loss' from the first callback on)
         self._train_steps = 0
         self._last_synced_step = -1
+        manager = checkpoint
+        if isinstance(checkpoint, (str, os.PathLike)):
+            from ..distributed.checkpoint import CheckpointManager
+            manager = CheckpointManager(str(checkpoint))
+        start_epoch, skip_steps, resume_rng = 0, 0, None
+        if resume:
+            if manager is None:
+                raise ValueError("fit(resume=...) requires checkpoint=...")
+            meta = self._restore_train_state(
+                manager, None if resume is True else int(resume))
+            if meta is not None:
+                start_epoch = int(meta.get("epoch", 0))
+                skip_steps = int(meta.get("step_in_epoch", -1)) + 1
+                resume_rng = (meta.get("rng") or {})
         train_loader = self._make_loader(train_data, batch_size, shuffle,
                                          drop_last, num_workers)
         eval_loader = self._make_loader(eval_data, batch_size, False, False,
                                         num_workers)
         self._save_dir = save_dir
         steps = len(train_loader) if hasattr(train_loader, "__len__") else None
+        if skip_steps and steps is not None and skip_steps >= steps:
+            # the checkpoint landed on an epoch boundary: resume at the
+            # top of the next epoch instead of replaying an empty tail
+            start_epoch += 1
+            skip_steps, resume_rng = 0, None
         cbks = config_callbacks(
             callbacks, model=self, batch_size=batch_size, epochs=epochs,
             steps=steps, log_freq=log_freq, verbose=verbose,
@@ -356,19 +439,34 @@ class Model:
             metrics=self._metrics_name())
 
         self.stop_training = False
+        if manager is not None:
+            from ..distributed.checkpoint import manager as _ckpt_mgr
+            _ckpt_mgr.clear_preemption()
+            manager.install_signal_handlers()
         logs = {}
-        cbks.on_train_begin({})
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch, {})
-            logs = self._run_one_epoch(train_loader, cbks, "train")
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and epoch % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _callbacks=cbks)
-                cbks.on_eval_end(eval_logs)
-            if self.stop_training:
-                break
-        cbks.on_train_end(logs)
+        try:
+            cbks.on_train_begin({})
+            for epoch in range(start_epoch, epochs):
+                cbks.on_epoch_begin(epoch, {})
+                first = epoch == start_epoch
+                logs = self._run_one_epoch(
+                    train_loader, cbks, "train", epoch=epoch, ckpt=manager,
+                    skip_steps=skip_steps if first else 0,
+                    resume_rng=resume_rng if first else None)
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and epoch % eval_freq == 0 \
+                        and not self.stop_training:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _callbacks=cbks)
+                    cbks.on_eval_end(eval_logs)
+                if self.stop_training:
+                    break
+            cbks.on_train_end(logs)
+        finally:
+            if manager is not None:
+                manager.uninstall_signal_handlers()
+        if manager is not None:
+            manager.wait()  # surface a failed trailing async save
         return logs
 
     def _metrics_name(self):
@@ -390,11 +488,31 @@ class Model:
         n_lab = len(to_list(self._labels)) if self._labels else 1
         return batch[:-n_lab], batch[-n_lab:]
 
-    def _run_one_epoch(self, loader, cbks, mode):
+    def _run_one_epoch(self, loader, cbks, mode, epoch=0, ckpt=None,
+                       skip_steps=0, resume_rng=None):
         logs = {}
         for m in self._metrics:
             m.reset()
+        if mode == "train":
+            # replaying a resumed epoch must draw the SAME shuffle
+            # permutation the crashed run drew, so the sampler sees the
+            # epoch-start numpy state; the save-time state is restored
+            # once the skip completes (below).  Metric accumulations of
+            # the already-consumed steps are NOT restored (documented
+            # resume contract).
+            if skip_steps and resume_rng is not None and \
+                    resume_rng.get("numpy_epoch_start") is not None:
+                np.random.set_state(resume_rng["numpy_epoch_start"])
+            self._epoch_np_state = np.random.get_state()
+        skipped = 0
         for step, batch in enumerate(loader):
+            if step < skip_steps:
+                skipped += 1
+                continue
+            if skipped and resume_rng is not None and \
+                    resume_rng.get("numpy") is not None:
+                np.random.set_state(resume_rng["numpy"])
+                skipped = 0
             inputs, labels = self._split_batch(batch)
             getattr(cbks, f"on_{mode}_batch_begin")(step, logs)
             if mode == "train":
@@ -413,6 +531,19 @@ class Model:
             bs = inputs[0].shape[0] if inputs and inputs[0].shape else 1
             logs["batch_size"] = bs
             getattr(cbks, f"on_{mode}_batch_end")(step, logs)
+            if mode == "train" and ckpt is not None:
+                state_fn = (lambda e=epoch, s=step:
+                            self._train_state(e, s))
+                saved = ckpt.maybe_save(self._train_steps, state_fn)
+                if ckpt.preempted:
+                    # emergency checkpoint: the in-flight step finished
+                    # above; persist, then exit the loop cleanly
+                    if saved:
+                        ckpt.wait()
+                    else:
+                        ckpt.save(self._train_steps, state_fn(), wait=True)
+                    self.stop_training = True
+                    break
         # end-of-epoch accumulated metric values
         for m in self._metrics:
             for name, val in zip(to_list(m.name()), to_list(m.accumulate())):

@@ -251,6 +251,14 @@ def get_worker_info():
     return _worker_info
 
 
+def _dl_retry_counter():
+    """Lazy: io imports stay light until a DataLoader actually fetches."""
+    from ..observability import metrics as _metrics
+    return _metrics.counter(
+        "dataloader.retries",
+        "transient-OSError DataLoader fetch retries (labels: site)")
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
@@ -319,7 +327,17 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
-        return self.collate_fn([self.dataset[i] for i in indices])
+        # transient dataset errors (networked storage hiccup) retry with
+        # backoff before surfacing — same helper as the checkpoint writer,
+        # so a flaky epoch shows up on the dataloader.retries counter and
+        # in flight-recorder io_retry events instead of killing the run
+        from .. import flags as _flags
+        from ..distributed.checkpoint.io_retry import call_with_retries
+        return call_with_retries(
+            lambda: self.collate_fn([self.dataset[i] for i in indices]),
+            retries=int(_flags.get_flag("dataloader_retries")),
+            backoff_s=float(_flags.get_flag("dataloader_retry_backoff_s")),
+            site="dataloader.fetch", counter=_dl_retry_counter())
 
     def _iter_iterable(self):
         batch = []

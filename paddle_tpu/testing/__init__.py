@@ -1,0 +1,6 @@
+"""Test-support utilities shipped with the package (deterministic fault
+injection for the fault-tolerance suite and the bench resilience rung)."""
+
+from . import chaos  # noqa: F401
+
+__all__ = ["chaos"]

@@ -1,0 +1,267 @@
+"""Deterministic fault injection for fault-tolerance testing.
+
+The checkpoint writer (and any other crash-hardened I/O path) funnels its
+file opens through :func:`checked_open` and sprinkles :func:`inject` calls
+at named sites.  With no fault armed both are a single list/dict lookup —
+production cost is nil.  Tests arm faults through context managers:
+
+* :func:`truncate_writes` — a file opened for writing whose path contains
+  ``match`` accepts only the first ``at_byte`` bytes, then raises (the
+  on-disk file is left truncated exactly there: a process killed
+  mid-``np.savez``).
+* :func:`fail_open` — the Nth matching :func:`checked_open` call raises
+  (transient filesystem error).
+* :func:`fail_at` — the Nth :func:`inject(site)` call raises (transient
+  dataset / network error at an arbitrary instrumented site).
+* :func:`flip_bytes` / :func:`truncate_file` — immediate post-write
+  corruption of a file on disk (bit rot / torn tail).
+* :func:`run_to_step_and_kill` — spawn a subprocess and deliver a signal
+  the moment it prints ``STEP <n>`` (kill-at-step-N for resume tests).
+
+Everything is counted: each armed fault records how often it fired so a
+test can assert the injection actually happened.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import signal
+import subprocess
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = [
+    "checked_open", "inject", "active_faults",
+    "truncate_writes", "fail_open", "fail_at",
+    "flip_bytes", "truncate_file", "run_to_step_and_kill",
+]
+
+_lock = threading.Lock()
+
+
+class Fault:
+    """One armed fault.  ``fires`` counts actual injections."""
+
+    def __init__(self, kind: str, match: str = "", at_byte: int = 0,
+                 on_calls: Optional[Sequence[int]] = None,
+                 exc_factory: Optional[Callable[[], BaseException]] = None):
+        self.kind = kind                # "truncate" | "open" | "site"
+        self.match = match
+        self.at_byte = at_byte
+        # 1-based call numbers that fire; None = every matching call
+        self.on_calls = set(on_calls) if on_calls is not None else None
+        self.exc_factory = exc_factory or (
+            lambda: OSError(f"chaos: injected fault ({kind}:{match})"))
+        self.calls = 0
+        self.fires = 0
+
+    def should_fire(self) -> bool:
+        self.calls += 1
+        hit = self.on_calls is None or self.calls in self.on_calls
+        if hit:
+            self.fires += 1
+        return hit
+
+
+_open_faults: List[Fault] = []
+_site_faults: Dict[str, Fault] = {}
+
+
+def active_faults() -> int:
+    return len(_open_faults) + len(_site_faults)
+
+
+class _TruncatingFile:
+    """File wrapper that accepts ``at_byte`` bytes then raises — the write
+    that crosses the limit is cut exactly at the boundary first, so the
+    on-disk state is a mid-write crash, not a clean short file."""
+
+    def __init__(self, f, at_byte: int, exc_factory):
+        self._f = f
+        self._room = at_byte
+        self._exc_factory = exc_factory
+        self._dead = False
+
+    def write(self, data):
+        if self._dead:
+            return 0  # the crash already propagated; cleanup writes vanish
+        n = len(data)
+        if n <= self._room:
+            self._room -= n
+            return self._f.write(data)
+        if self._room > 0:
+            self._f.write(data[:self._room])
+            self._room = 0
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._dead = True
+        raise self._exc_factory()
+
+    def seek(self, *a, **kw):
+        if self._dead or self._f.closed:
+            return 0  # silence zipfile/np.savez __del__ cleanup
+        return self._f.seek(*a, **kw)
+
+    def tell(self):
+        if self._dead or self._f.closed:
+            return 0
+        return self._f.tell()
+
+    def flush(self):
+        if not (self._dead or self._f.closed):
+            self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def checked_open(path, mode: str = "rb", **kw):
+    """``open`` with armed write faults applied.  The production fast path
+    is one truthiness check on the (normally empty) fault list."""
+    if _open_faults:
+        spath = os.fspath(path)
+        with _lock:
+            for fault in list(_open_faults):
+                if fault.match not in spath:
+                    continue
+                if fault.kind == "open":
+                    if fault.should_fire():
+                        raise fault.exc_factory()
+                elif fault.kind == "truncate" and any(
+                        c in mode for c in "wxa+"):
+                    if fault.should_fire():
+                        return _TruncatingFile(
+                            builtins.open(path, mode, **kw),
+                            fault.at_byte, fault.exc_factory)
+    return builtins.open(path, mode, **kw)
+
+
+def inject(site: str) -> None:
+    """Raise at an instrumented site if a matching fault is armed."""
+    if not _site_faults:
+        return
+    with _lock:
+        fault = _site_faults.get(site)
+        fire = fault is not None and fault.should_fire()
+    if fire:
+        raise fault.exc_factory()
+
+
+@contextmanager
+def truncate_writes(match: str, at_byte: int,
+                    on_calls: Optional[Sequence[int]] = None,
+                    exc: type = OSError):
+    """Arm a mid-write truncation for files whose path contains ``match``."""
+    fault = Fault("truncate", match, at_byte, on_calls,
+                  lambda: exc(f"chaos: write truncated at byte {at_byte} "
+                              f"({match})"))
+    with _lock:
+        _open_faults.append(fault)
+    try:
+        yield fault
+    finally:
+        with _lock:
+            _open_faults.remove(fault)
+
+
+@contextmanager
+def fail_open(match: str, on_calls: Optional[Sequence[int]] = None,
+              exc: type = OSError):
+    """Arm an open-time failure for paths containing ``match`` (1-based
+    matching-call numbers in ``on_calls``; None = every call)."""
+    fault = Fault("open", match, 0, on_calls,
+                  lambda: exc(f"chaos: open failed ({match})"))
+    with _lock:
+        _open_faults.append(fault)
+    try:
+        yield fault
+    finally:
+        with _lock:
+            _open_faults.remove(fault)
+
+
+@contextmanager
+def fail_at(site: str, on_calls: Optional[Sequence[int]] = None,
+            exc: type = OSError):
+    """Arm :func:`inject(site)` to raise on the given call numbers."""
+    fault = Fault("site", site, 0, on_calls,
+                  lambda: exc(f"chaos: injected failure at {site!r}"))
+    with _lock:
+        if site in _site_faults:
+            raise RuntimeError(f"chaos: site {site!r} already armed")
+        _site_faults[site] = fault
+    try:
+        yield fault
+    finally:
+        with _lock:
+            _site_faults.pop(site, None)
+
+
+def flip_bytes(path: str, offset: int, count: int = 1,
+               xor: int = 0xFF) -> None:
+    """XOR ``count`` bytes at ``offset`` in place (post-write bit rot)."""
+    with builtins.open(path, "r+b") as f:
+        f.seek(offset)
+        data = bytearray(f.read(count))
+        if not data:
+            raise ValueError(f"{path}: offset {offset} is past EOF")
+        for i in range(len(data)):
+            data[i] ^= xor
+        f.seek(offset)
+        f.write(bytes(data))
+
+
+def truncate_file(path: str, nbytes: int) -> None:
+    """Truncate a file on disk to ``nbytes`` (torn tail)."""
+    with builtins.open(path, "r+b") as f:
+        f.truncate(nbytes)
+
+
+def run_to_step_and_kill(cmd: Sequence[str], step: int,
+                         marker: str = "STEP ",
+                         sig: int = signal.SIGKILL,
+                         timeout: float = 300.0,
+                         env: Optional[Dict[str, str]] = None,
+                         cwd: Optional[str] = None) -> "subprocess.CompletedProcess[str]":
+    """Run ``cmd``; the moment a stdout line starts with ``marker`` and
+    names a step >= ``step``, deliver ``sig``.  Returns a CompletedProcess
+    whose stdout holds everything printed (so tests can assert how far the
+    child got before dying).  The child must print ``STEP <n>`` per step
+    with line buffering (``flush=True``)."""
+    proc = subprocess.Popen(
+        list(cmd), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, bufsize=1, env=env, cwd=cwd)
+    lines: List[str] = []
+    signalled = False
+    try:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            lines.append(line)
+            s = line.strip()
+            if not signalled and s.startswith(marker):
+                try:
+                    n = int(s[len(marker):].split()[0])
+                except (ValueError, IndexError):
+                    continue
+                if n >= step:
+                    proc.send_signal(sig)
+                    signalled = True
+        rc = proc.wait(timeout=timeout)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    return subprocess.CompletedProcess(list(cmd), rc, "".join(lines), "")

@@ -95,6 +95,37 @@ def test_no_prior_artifact_returns_none(tmp_path):
     assert out is None
 
 
+def test_fault_tolerance_rung_schema(tmp_path):
+    """Pin the resilience rung's record schema (ISSUE 5): save/restore
+    latency + bytes, chaos-truncation detection and the tiny-model
+    kill-and-resume drill, run at smoke scale on CPU."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_ft", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_fault_tolerance(ctx)
+    rec = {"rung": "fault_tolerance", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("fault_tolerance").smoke
+    assert bench._REGRESSION_KEYS["fault_tolerance"] == "save_mb_per_s"
+    for key in ("payload_mb", "save_s", "restore_s", "save_mb_per_s",
+                "restore_mb_per_s"):
+        assert isinstance(val[key], float) and val[key] > 0, key
+    # the resilience claims themselves
+    assert val["roundtrip_ok"] is True
+    assert val["corrupt_skipped"] is True
+    assert val["resume_bitexact"] is True
+
+
 def test_fused_optimizer_rung_schema():
     """Pin the round-7 `fused_optimizer` rung's record schema: the
     regression key (`speedup`) and the per-cell dispatch/wall fields the

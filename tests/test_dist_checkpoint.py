@@ -102,6 +102,50 @@ def test_async_save(tmp_path):
     np.testing.assert_array_equal(np.asarray(tgt["w"]._value), 7.0)
 
 
+def test_crash_between_data_and_metadata_leaves_no_stale_merge(tmp_path):
+    """Regression (ISSUE 5 satellite): a save that dies after rewriting
+    the data file but BEFORE the metadata write used to leave the
+    previous save's same-rank .metadata pointing into the new data file —
+    load would silently merge them.  Both files are deleted up front now,
+    so the half-written save is simply invisible."""
+    from paddle_tpu.testing import chaos
+    dist.save_state_dict({"a": paddle.to_tensor(np.ones(4, np.float32))},
+                         str(tmp_path))
+    assert (tmp_path / "0.metadata").exists()
+    with chaos.fail_open(".metadata", on_calls=[1]):
+        with pytest.raises(OSError):
+            dist.save_state_dict(
+                {"a": paddle.to_tensor(np.full(4, 2.0, np.float32))},
+                str(tmp_path))
+    # the stale metadata is gone with the crashed save…
+    assert not (tmp_path / "0.metadata").exists()
+    # …so load refuses with a clear error instead of merging old+new
+    with pytest.raises(ValueError, match="no .metadata"):
+        dist.load_state_dict({"a": paddle.zeros([4])}, str(tmp_path))
+
+
+def test_load_missing_directory_clear_error(tmp_path):
+    missing = str(tmp_path / "nope")
+    with pytest.raises(FileNotFoundError, match="nope"):
+        dist.load_state_dict({"a": paddle.zeros([2])}, missing)
+
+
+def test_load_empty_directory_clear_error(tmp_path):
+    with pytest.raises(ValueError, match="no .metadata"):
+        dist.load_state_dict({"a": paddle.zeros([2])}, str(tmp_path))
+
+
+def test_read_state_dict_full_assembly(tmp_path):
+    """read_state_dict reassembles a sharded checkpoint template-free."""
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    t = paddle.Tensor._wrap(shard_value(w, mesh_1d(4), P("x", None)))
+    dist.save_state_dict({"nest": {"w": t}, "b": paddle.ones([3])},
+                         str(tmp_path))
+    out = dist.checkpoint.read_state_dict(str(tmp_path))
+    np.testing.assert_array_equal(out["nest"]["w"], w)
+    np.testing.assert_array_equal(out["b"], np.ones(3, np.float32))
+
+
 def test_training_resumes_identically_across_reshard(tmp_path):
     """Train 2 steps sharded dp2xmp2, checkpoint, resume under mp4: the
     continued trajectory must match an uninterrupted serial run."""

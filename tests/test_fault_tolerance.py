@@ -1,0 +1,468 @@
+"""Fault-tolerant training (ISSUE 5): atomic/versioned checkpointing,
+preemption-safe auto-resume, and the deterministic chaos harness.
+
+The acceptance bar: kill-at-step-N → auto-resume yields bit-identical fp32
+params vs an uninterrupted run (fused optimizer + scaler included), and a
+checkpoint truncated or bit-flipped by the chaos harness is detected,
+skipped and reported — never silently loaded.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, all_steps, latest_complete, verify_version)
+from paddle_tpu.distributed.checkpoint import manager as ckpt_manager
+from paddle_tpu.flags import flag_guard
+from paddle_tpu.observability import flight_recorder as flight
+from paddle_tpu.observability import metrics as obs
+from paddle_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    obs.reset()
+    flight.default_recorder().clear()
+    ckpt_manager.clear_preemption()
+    yield
+    ckpt_manager.clear_preemption()
+    assert chaos.active_faults() == 0
+
+
+def _state(seed=0, n=3):
+    rng = np.random.RandomState(seed)
+    return {"model": {f"w{i}": rng.rand(4, 4).astype(np.float32)
+                      for i in range(n)},
+            "meta": {"step": 7 * seed, "note": "hello",
+                     "shape": (1, 2, 3)}}
+
+
+# ------------------------------------------------------- commit protocol
+
+def test_atomic_commit_layout(tmp_path):
+    """A committed version holds COMPLETE + a validating manifest; no
+    .tmp directory survives a successful save."""
+    m = CheckpointManager(str(tmp_path))
+    assert m.save(1, _state())
+    path = m.step_path(1)
+    assert os.path.exists(os.path.join(path, "COMPLETE"))
+    manifest = json.load(open(os.path.join(path, "manifest_0.json")))
+    assert manifest["schema"] == ckpt_manager.MANIFEST_SCHEMA
+    assert set(manifest["files"]) == {"0_0.distcp", "0.metadata",
+                                      "extra_0.pkl"}
+    assert verify_version(path) is None
+    assert not any(d.endswith(".tmp") for d in os.listdir(tmp_path))
+    # idempotent: re-saving a committed step is a counted no-op
+    assert m.save(1, _state()) is False
+
+
+def test_load_round_trip_with_extras(tmp_path):
+    m = CheckpointManager(str(tmp_path))
+    st = _state(seed=3)
+    m.save(5, st)
+    out = m.load()
+    for k, want in st["model"].items():
+        np.testing.assert_array_equal(out["model"][k], want)
+    assert out["meta"]["step"] == 21
+    assert out["meta"]["note"] == "hello"
+    assert out["meta"]["shape"] == (1, 2, 3)
+
+
+def test_corrupt_checkpoint_skipped_and_reported(tmp_path):
+    """A bit-flipped committed version is detected by the manifest
+    checksums: latest_complete falls back, counts the skip and drops a
+    flight-recorder event — it is NEVER silently loaded."""
+    m = CheckpointManager(str(tmp_path), keep_last=3)
+    m.save(1, _state(1))
+    m.save(2, _state(2))
+    data = os.path.join(m.step_path(2), "0_0.distcp")
+    chaos.flip_bytes(data, os.path.getsize(data) // 2, count=2)
+    assert latest_complete(str(tmp_path)) == 1
+    assert obs.get("ckpt.skipped_corrupt").value(reason="corrupt") == 1
+    events = [e for e in flight.default_recorder().events()
+              if e.get("kind") == "ckpt_skip_corrupt"]
+    assert events and events[-1]["step"] == 2
+    # an explicitly requested corrupt step raises, clearly named
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        m.load(2)
+    # load() (no step) transparently resolves to the good version
+    out = m.load()
+    np.testing.assert_array_equal(out["model"]["w0"], _state(1)["model"]["w0"])
+
+
+def test_truncated_midwrite_save_never_commits(tmp_path):
+    """A crash mid-np.savez (simulated: writes truncate at byte 200 and
+    die) must not produce a loadable version; discovery falls back."""
+    m = CheckpointManager(str(tmp_path))
+    m.save(1, _state(1))
+    with flag_guard(ckpt_io_retries=0):
+        with chaos.truncate_writes(".distcp", at_byte=200) as fault:
+            with pytest.raises(OSError):
+                m.save(2, _state(2))
+    assert fault.fires >= 1
+    assert latest_complete(str(tmp_path)) == 1
+    assert not os.path.exists(os.path.join(m.step_path(2), "COMPLETE"))
+    assert obs.get("ckpt.saves").value(result="failed") == 1
+
+
+def test_transient_io_error_retries_with_backoff(tmp_path):
+    """One flaky open: the save retries (counted) and still commits."""
+    m = CheckpointManager(str(tmp_path))
+    with flag_guard(ckpt_io_backoff_s=0.001):
+        with chaos.fail_open(".distcp", on_calls=[1]) as fault:
+            assert m.save(1, _state())
+    assert fault.fires == 1
+    assert m.latest_complete() == 1
+    assert obs.get("ckpt.io_retries").total() == 1
+    assert any(e.get("kind") == "io_retry"
+               for e in flight.default_recorder().events())
+
+
+def test_rotation_keeps_exactly_n_plus_periodic(tmp_path):
+    m = CheckpointManager(str(tmp_path), keep_last=2, keep_period=10)
+    for s in range(5, 45, 5):
+        m.save(s, _state())
+    kept = all_steps(str(tmp_path))
+    # newest 2 = {35, 40}; periodic keeps = {10, 20, 30, 40}
+    assert kept == [10, 20, 30, 35, 40]
+    assert obs.get("ckpt.rotated").total() > 0
+    m2 = CheckpointManager(str(tmp_path), keep_last=1, keep_period=0)
+    m2.save(45, _state())
+    assert all_steps(str(tmp_path)) == [45]
+
+
+def test_async_save_failure_surfaces_on_next_save(tmp_path):
+    """An async save that dies in the background must raise on the NEXT
+    save (or wait()) — silent loss of durability is the one unforgivable
+    failure mode."""
+    m = CheckpointManager(str(tmp_path), async_save=True)
+    with flag_guard(ckpt_io_retries=0):
+        with chaos.fail_open(".metadata"):
+            assert m.save(1, _state())   # returns before the failure
+            with pytest.raises(RuntimeError, match="async checkpoint save"):
+                m.wait()
+        with chaos.fail_open(".metadata"):
+            m.save(2, _state())
+            import time
+            deadline = time.monotonic() + 5
+            while m._thread is not None and m._thread.is_alive() \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            with pytest.raises(RuntimeError):
+                m.save(3, _state())      # the surfacing point
+    # and a healthy async save commits + is waitable
+    m.save(4, _state(), wait=True)
+    assert m.latest_complete() == 4
+
+
+def test_restore_into_sharded_template(tmp_path):
+    """restore_into reloads array leaves with the TARGET sharding (the
+    reshard-on-load contract) and returns non-array leaves separately."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    m = CheckpointManager(str(tmp_path))
+    m.save(1, {"w": w, "meta": {"k": 3}})
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    tmpl = jax.device_put(jnp.zeros((8, 8), jnp.float32),
+                          NamedSharding(mesh, P("x", None)))
+    arrays, extra = m.restore_into({"w": tmpl})
+    np.testing.assert_array_equal(np.asarray(arrays["w"]), w)
+    assert arrays["w"].sharding.spec == P("x", None)
+    assert extra["meta"]["k"] == 3
+
+
+# ---------------------------------------------------- preemption handling
+
+def test_preemption_flag_emergency_checkpoint_and_clean_stop():
+    """In-process preemption: the flag set mid-epoch makes fit finish the
+    in-flight step, take an emergency checkpoint and stop cleanly; the
+    resumed run is bit-identical to an uninterrupted one (shuffle on, so
+    the numpy RNG + dataloader position restore is exercised too)."""
+    import tempfile
+    rng = np.random.RandomState(1)
+    xs = rng.rand(32, 4).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True).astype(np.float32)
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    def build():
+        paddle.seed(11)
+        np.random.seed(5)
+        net = nn.Linear(4, 1)
+        model = paddle.Model(net)
+        model.prepare(optimizer=optimizer.Adam(
+            learning_rate=0.05, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        return model
+
+    def params(m):
+        return [np.asarray(p._value) for p in m.network.parameters()]
+
+    ref = build()
+    ref.fit(DS(), batch_size=8, epochs=2, verbose=0, shuffle=True)
+
+    root = tempfile.mkdtemp()
+    crash = build()
+
+    class Preempt(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            if crash._train_steps == 6:   # mid-epoch 2
+                ckpt_manager.request_preemption(signal.SIGTERM)
+
+    crash.fit(DS(), batch_size=8, epochs=2, verbose=0, shuffle=True,
+              checkpoint=CheckpointManager(root, save_interval=100),
+              callbacks=[Preempt()])
+    assert crash.stop_training
+    assert latest_complete(root) == 6        # the emergency version
+    assert obs.get("preempt.signals").total() == 1
+
+    resumed = build()
+    resumed.fit(DS(), batch_size=8, epochs=2, verbose=0, shuffle=True,
+                checkpoint=CheckpointManager(root), resume=True)
+    for a, b in zip(params(ref), params(resumed)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_resume_on_empty_root_starts_fresh(tmp_path):
+    """Auto-resume semantics: the same launch command works on the first
+    launch (nothing to restore) and after a preemption."""
+    xs = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True).astype(np.float32)
+
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    net = nn.Linear(4, 1)
+    model = paddle.Model(net)
+    model.prepare(optimizer=optimizer.SGD(learning_rate=0.1,
+                                          parameters=net.parameters()),
+                  loss=nn.MSELoss())
+    logs = model.fit(DS(), batch_size=4, epochs=1, verbose=0, shuffle=False,
+                     checkpoint=str(tmp_path), resume=True)
+    assert "loss" in logs
+    assert latest_complete(str(tmp_path)) == 2   # 2 steps, interval 1
+
+
+# ------------------------------------------------- subprocess kill/resume
+
+_TRAIN_SCRIPT = textwrap.dedent("""
+    import os, signal, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+    root, epochs, out = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    # deterministic kill-at-step-N: SIGKILL self the moment step N's
+    # batch-end callback runs (no pipe/signal latency race)
+    kill_step = int(os.environ.get("CHAOS_SELFKILL_STEP", "0"))
+    rng = np.random.RandomState(1)
+    xs = rng.rand(24, 4).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True).astype(np.float32)
+
+    class DS(paddle.io.Dataset):
+        def __len__(self): return len(xs)
+        def __getitem__(self, i): return xs[i], ys[i]
+
+    paddle.seed(11); np.random.seed(5)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    model = paddle.Model(net)
+    # the fused-optimizer + GradScaler path: its device-side scalars
+    # (_global_step, scale/good/bad) must survive the restart
+    model.prepare(optimizer=optimizer.Adam(learning_rate=0.05,
+                                           parameters=net.parameters()),
+                  loss=nn.MSELoss(),
+                  amp_configs={"level": "O1", "init_loss_scaling": 256.0})
+
+    class Marker(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            print("STEP", model._train_steps, flush=True)
+            if kill_step and model._train_steps >= kill_step:
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    ck = None if root == "-" else CheckpointManager(root, save_interval=2)
+    model.fit(DS(), batch_size=8, epochs=epochs, verbose=0, shuffle=True,
+              checkpoint=ck, resume=ck is not None,
+              callbacks=[Marker()])
+    np.savez(out, *[np.asarray(p._value)
+                    for p in model.network.parameters()])
+    print("FINISHED", flush=True)
+""")
+
+
+def _run_child(script_path, root, epochs, out, kill_at=None,
+               sig=signal.SIGKILL, selfkill_at=None):
+    cmd = [sys.executable, script_path, root, str(epochs), out]
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    if selfkill_at is not None:
+        env["CHAOS_SELFKILL_STEP"] = str(selfkill_at)
+        return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                              timeout=300)
+    if kill_at is not None:
+        return chaos.run_to_step_and_kill(cmd, kill_at, sig=sig, env=env)
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          timeout=300)
+
+
+def _params_npz(path):
+    with np.load(path) as z:
+        return [z[k] for k in z.files]
+
+
+def test_subprocess_kill_at_step_resume_bit_exact(tmp_path):
+    """THE acceptance test: SIGKILL the child at step 3 of 6 (periodic
+    checkpoints every 2 steps), relaunch the same command with
+    resume=True — final fp32 params must be bit-identical to an
+    uninterrupted run, fused optimizer + scaler path included."""
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN_SCRIPT)
+    ref_out = str(tmp_path / "ref.npz")
+    got_out = str(tmp_path / "got.npz")
+    root = str(tmp_path / "ckpt")
+
+    ref = _run_child(str(script), "-", 2, ref_out)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    assert os.path.exists(ref_out)
+
+    killed = _run_child(str(script), root, 2, got_out, selfkill_at=3)
+    assert killed.returncode != 0          # actually died
+    assert "FINISHED" not in killed.stdout
+    assert latest_complete(root) == 2      # the last periodic version
+    assert not os.path.exists(got_out)
+
+    resumed = _run_child(str(script), root, 2, got_out)
+    assert resumed.returncode == 0, resumed.stdout
+    assert "FINISHED" in resumed.stdout
+    for a, b in zip(_params_npz(ref_out), _params_npz(got_out)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_subprocess_sigterm_takes_emergency_checkpoint(tmp_path):
+    """SIGTERM (the preemption notice): the child finishes the in-flight
+    step, writes an emergency checkpoint and exits 0; the relaunch
+    resumes it to a bit-identical end state."""
+    script = tmp_path / "train.py"
+    script.write_text(_TRAIN_SCRIPT.replace("save_interval=2",
+                                            "save_interval=100"))
+    ref_out = str(tmp_path / "ref.npz")
+    got_out = str(tmp_path / "got.npz")
+    root = str(tmp_path / "ckpt")
+
+    ref = _run_child(str(script), "-", 4, ref_out)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+
+    termed = _run_child(str(script), root, 4, got_out, kill_at=2,
+                        sig=signal.SIGTERM)
+    assert termed.returncode == 0, termed.stdout   # clean exit
+    assert "FINISHED" in termed.stdout             # fit returned normally
+    step = latest_complete(root)
+    assert step is not None and step >= 2          # emergency version
+    assert step < 12                               # ...but it did stop early
+
+    resumed = _run_child(str(script), root, 4, got_out)
+    assert resumed.returncode == 0, resumed.stdout
+    for a, b in zip(_params_npz(ref_out), _params_npz(got_out)):
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------- dataloader retries
+
+def test_dataloader_fetch_retries_transient_oserror():
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            chaos.inject("ft.dataset")
+            return np.float32([i]), np.float32([i])
+
+    with flag_guard(dataloader_retry_backoff_s=0.001):
+        with chaos.fail_at("ft.dataset", on_calls=[3]) as fault:
+            batches = list(paddle.io.DataLoader(DS(), batch_size=2))
+    assert len(batches) == 4
+    assert fault.fires == 1
+    assert obs.get("dataloader.retries").total() == 1
+
+
+def test_dataloader_fetch_exhausted_retries_surface():
+    class DS(paddle.io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            chaos.inject("ft.dataset2")
+            return np.float32([i])
+
+    with flag_guard(dataloader_retries=1, dataloader_retry_backoff_s=0.001):
+        with chaos.fail_at("ft.dataset2"):  # every call fails
+            with pytest.raises(OSError, match="chaos"):
+                list(paddle.io.DataLoader(DS(), batch_size=2))
+
+
+# --------------------------------------------------------- hybrid resume
+
+@pytest.mark.slow
+def test_hybrid_train_state_kill_resume_bit_exact(tmp_path):
+    """Sharded (pp2 x dp2 x mp2) train state: save at step 2, restore
+    into freshly-initialized sharded templates, continue — bit-identical
+    to the uninterrupted trajectory."""
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.hybrid_step import (
+        HybridConfig, init_gpt_params, stack_for_pipeline,
+        hybrid_param_specs, init_zero_state, make_hybrid_train_step,
+        save_hybrid_state, load_hybrid_state)
+    cfg = HybridConfig(num_layers=2, pp=2, dp=2, mp=2, n_microbatches=2,
+                       hidden_size=32, vocab_size=64, seq_len=16,
+                       num_heads=4)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("pp", "dp", "mp"))
+    stacked0 = stack_for_pipeline(
+        init_gpt_params(jax.random.key(42), cfg), cfg)
+    m0, v0, _ = init_zero_state(stacked0, hybrid_param_specs(cfg), mesh)
+    step = make_hybrid_train_step(mesh, cfg)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 4, 16)), jnp.int32)
+
+    p, m, v = stacked0, m0, v0
+    for i in range(4):
+        _, p, m, v = step(p, m, v, jnp.float32(i + 1), ids)
+    ref = jax.tree_util.tree_leaves(jax.tree_util.tree_map(np.asarray, p))
+
+    p, m, v = stacked0, m0, v0
+    for i in range(2):
+        _, p, m, v = step(p, m, v, jnp.float32(i + 1), ids)
+    ck = CheckpointManager(str(tmp_path))
+    save_hybrid_state(ck, 2, p, m, v, 2.0)
+
+    p2, m2, v2, step_no = load_hybrid_state(
+        CheckpointManager(str(tmp_path)), mesh, cfg, stacked0, m0, v0)
+    assert step_no == 2.0
+    for i in range(int(step_no), 4):
+        _, p2, m2, v2 = step(p2, m2, v2, jnp.float32(i + 1), ids)
+    got = jax.tree_util.tree_leaves(jax.tree_util.tree_map(np.asarray, p2))
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a, b)
