@@ -77,6 +77,7 @@ _REGRESSION_KEYS = {
     "telemetry_train": "tokens_per_sec",
     "fused_optimizer": "speedup",
     "fault_tolerance": "save_mb_per_s",
+    "request_trace": "trace_overhead_pct",
 }
 
 _ENV_PROBE = {}
@@ -1124,6 +1125,73 @@ def bench_serving(ctx):
             "tokens_out": toks, "tokens_per_sec": round(toks / dt, 1),
             "ms_per_step": round(dt / max(steps, 1) * 1e3, 3),
             "sampled_decode": _sampled_decode_sweep(model, cfg, on_tpu)}
+
+
+@harness.register_rung("request_trace", est_cold_s=120, smoke=True)
+def bench_request_trace(ctx):
+    """ISSUE 6 acceptance rung: per-request lifecycle tracing on the
+    serving engine.  Records the TTFT/TPOT percentiles the trace
+    sketches produce AND the price of producing them — the same request
+    workload driven with the metrics gate on vs off, as ticks/sec
+    (regression key `trace_overhead_pct`; the acceptance bound is <=2%
+    on-gate, exactly 0 work off-gate)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.flags import flag_guard
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m, gpt3_tiny
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    on_tpu = ctx.on_tpu
+    paddle.seed(0)
+    cfg = gpt3_124m() if on_tpu else gpt3_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = ServingEngine(model, max_batch=4,
+                        max_context=1024 if on_tpu else 128,
+                        steps_per_tick=4 if on_tpu else 2)
+    rng = np.random.RandomState(3)
+    plen = 64 if on_tpu else 12
+    budget = 48 if on_tpu else 9
+
+    def run_batch(n=4):
+        for _ in range(n):
+            eng.add_request(Request(rng.randint(1, cfg.vocab_size, (plen,)),
+                                    max_new_tokens=budget))
+        t0 = time.perf_counter()
+        ticks0 = eng.ticks
+        eng.run()
+        eng.finished.clear()
+        return (eng.ticks - ticks0) / (time.perf_counter() - t0)
+
+    run_batch()          # warm the prefill bucket + both tick variants
+
+    def rate():
+        return max(run_batch() for _ in range(2 if ctx.smoke else 5))
+
+    with flag_guard(enable_metrics=True):
+        # interleave gated/ungated windows so clock drift hits both sides
+        obs_metrics.reset()
+        on1 = rate()
+        paddle.set_flags({"enable_metrics": False})
+        off1 = rate()
+        paddle.set_flags({"enable_metrics": True})
+        on2 = rate()
+        paddle.set_flags({"enable_metrics": False})
+        off2 = rate()
+        paddle.set_flags({"enable_metrics": True})
+        ttft = obs_metrics.get("serving.ttft_seconds")
+        tpot = obs_metrics.get("serving.tpot_seconds")
+        e2e = obs_metrics.get("serving.e2e_seconds")
+        n_traced = int(e2e.count()) if e2e else 0
+    on, off = max(on1, on2), max(off1, off2)
+    q = lambda sk, p: round((sk.quantile(p) or 0.0) * 1e3, 3)  # noqa: E731
+    return {"requests_traced": n_traced,
+            "ttft_p50_ms": q(ttft, 0.5), "ttft_p99_ms": q(ttft, 0.99),
+            "tpot_p50_ms": q(tpot, 0.5), "tpot_p99_ms": q(tpot, 0.99),
+            "e2e_p50_ms": q(e2e, 0.5),
+            "ticks_per_sec_on": round(on, 1),
+            "ticks_per_sec_off": round(off, 1),
+            "trace_overhead_pct": round(max(0.0, 1 - on / off) * 100, 2)}
 
 
 # ====================================================================== main

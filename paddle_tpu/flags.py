@@ -236,6 +236,26 @@ define_flag("serving_device_sampling", True,
             "sampling requests ride the full k-step tick; 0 restores the "
             "host-side per-row sampler, which demotes every tick with a "
             "sampling request to k=1")
+# Scrape surface + request lifecycle tracing (observability/http.py,
+# observability/export.py, inference/serving.py).
+define_flag("metrics_port", 0,
+            "TCP port of the Prometheus scrape endpoint (/metrics, "
+            "/healthz, /requests), started by ServingEngine.run() and "
+            "Model.fit(); 0 (the default) = no server.  Binds "
+            "FLAGS_metrics_host (127.0.0.1 unless overridden)")
+define_flag("metrics_host", "127.0.0.1",
+            "bind address of the metrics HTTP endpoint; the loopback "
+            "default keeps operational data host-local — widening it is "
+            "an explicit operator decision")
+define_flag("serving_ttft_slo_ms", 0.0,
+            "time-to-first-token SLO in milliseconds; a request whose "
+            "TTFT exceeds it counts on serving.slo_violations"
+            "{metric=ttft}.  0 disables the check")
+define_flag("serving_tpot_slo_ms", 0.0,
+            "per-output-token latency (TPOT) SLO in milliseconds; each "
+            "decoded token whose imputed inter-token gap exceeds it "
+            "counts on serving.slo_violations{metric=tpot}.  0 disables "
+            "the check")
 define_flag("serving_overlap",  True,
             "double-buffer the serving tick loop: dispatch tick t+1's "
             "compiled step (feeding tick t's on-device last-token handle "

@@ -402,6 +402,8 @@ class Model:
         An empty checkpoint root with resume=True starts fresh, so the
         same launch command works before and after a preemption."""
         assert train_data is not None, "train_data must be given"
+        from ..observability import http as _obs_http
+        _obs_http.start_from_flags()   # /metrics endpoint, flag-gated
         # restart the loss-sync phase: each fit performs exactly
         # ceil(steps/K) host reads and step 0 always syncs (so logs
         # carry a 'loss' from the first callback on)

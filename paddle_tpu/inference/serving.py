@@ -54,6 +54,8 @@ import numpy as np
 
 from .. import flags as _flags
 from ..framework.tensor import Tensor
+from ..observability import compile_tracker as _compile
+from ..observability import export as _export
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
 
@@ -88,6 +90,37 @@ _M_OVERLAP = _metrics.counter(
     "serving.overlap_dispatches", "ticks dispatched before the previous "
     "tick was harvested (double-buffered fast path)")
 
+# --- request lifecycle tracing (ISSUE 6): every request's
+# enqueue -> admit (queue wait) -> prefill -> first token -> per-tick
+# decode -> finish timeline feeds streaming quantile sketches, so
+# p50/p90/p99 TTFT/TPOT are readable at any moment from stats(), the
+# registry snapshot, or the /metrics scrape — O(1) memory, gated with
+# everything else on FLAGS_enable_metrics (off = no timestamps taken).
+_M_TTFT = _metrics.quantile(
+    "serving.ttft_seconds", "time to first token: request enqueue to the "
+    "first output token materialized on the host (queue wait + prefill)")
+_M_TPOT = _metrics.quantile(
+    "serving.tpot_seconds", "inter-token latency (TPOT): per decoded "
+    "token, the harvest-to-harvest gap divided by the tokens it yielded")
+_M_E2E = _metrics.quantile(
+    "serving.e2e_seconds", "end-to-end request latency: enqueue to the "
+    "token that finished the request")
+_M_QWAIT = _metrics.quantile(
+    "serving.queue_wait_seconds", "enqueue to admission start (deferred "
+    "requests accumulate real pool-exhausted wait here)")
+_M_SLO = _metrics.counter(
+    "serving.slo_violations", "latency SLO breaches, by metric=ttft "
+    "(per request, against FLAGS_serving_ttft_slo_ms) or metric=tpot "
+    "(per token, against FLAGS_serving_tpot_slo_ms); 0-valued flags "
+    "disable the checks")
+_M_QUEUE_DEPTH = _metrics.gauge(
+    "serving.queue_depth", "requests inside the engine (admission queue "
+    "+ running slots)")
+_M_RUNNING = _metrics.gauge(
+    "serving.running", "batch slots currently holding a request")
+_M_WAITING = _metrics.gauge(
+    "serving.waiting", "requests queued for admission")
+
 
 class Request:
     """One generation request; results accumulate in `output_ids`."""
@@ -118,6 +151,14 @@ class Request:
         self.output_ids: List[int] = []
         self.done = False
         self.slot: Optional[int] = None
+        # lifecycle trace timestamps (perf_counter; stamped only while
+        # FLAGS_enable_metrics is on — None means "not traced")
+        self._t_enqueue: Optional[float] = None
+        self._t_admit: Optional[float] = None
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        self._ticks = 0
+        self.trace: Optional[dict] = None   # final record, set at finish
 
     def _sample(self, logits_row: np.ndarray) -> int:
         if not self.do_sample:
@@ -257,7 +298,10 @@ class ServingEngine:
                 logits, new_pools
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        self._decode_fn = jax.jit(step, donate_argnums=donate)
+        self._decode_fn = _compile.wrap_first_call(
+            jax.jit(step, donate_argnums=donate), "serving.decode",
+            (("variant", "host_sampling_k1"), ("max_batch", self.B),
+             ("block_size", self.bs)))
         return self._decode_fn
 
     def _tick_program(self, k: int):
@@ -314,7 +358,10 @@ class ServingEngine:
             return jnp.transpose(toks), pools        # [B, k]
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = self._tick_fns[k] = jax.jit(tick, donate_argnums=donate)
+        fn = self._tick_fns[k] = _compile.wrap_first_call(
+            jax.jit(tick, donate_argnums=donate), "serving.tick",
+            (("steps_per_tick", k), ("max_batch", self.B),
+             ("block_size", self.bs)))
         return fn
 
     def _prefill_program(self, L_pad: int):
@@ -337,8 +384,10 @@ class ServingEngine:
             return row, new_pools
 
         donate = (1,) if jax.default_backend() != "cpu" else ()
-        fn = self._prefill_fns[L_pad] = jax.jit(
-            prefill, donate_argnums=donate)
+        fn = self._prefill_fns[L_pad] = _compile.wrap_first_call(
+            jax.jit(prefill, donate_argnums=donate), "serving.prefill",
+            (("L_pad", L_pad), ("max_batch", self.B),
+             ("block_size", self.bs)))
         return fn
 
     # ----------------------------------------------------------- scheduler
@@ -354,8 +403,11 @@ class ServingEngine:
 
     def add_request(self, req: Request):
         L = len(req.prompt_ids)
+        traced = _metrics.enabled()
         if L + req.max_new_tokens > self.max_context:
             _M_REJECTIONS.inc(reason="over_context")
+            if traced:
+                self._reject_trace(req, "over_context")
             raise ValueError(
                 f"request needs {L + req.max_new_tokens}"
                 f" tokens > max_context {self.max_context}")
@@ -368,12 +420,27 @@ class ServingEngine:
             - self._blocks_for(L))
         if worst > self.num_blocks:
             _M_REJECTIONS.inc(reason="capacity")
+            if traced:
+                self._reject_trace(req, "capacity")
             raise ValueError(
                 f"request needs {worst} blocks worst-case but the pool "
                 f"has {self.num_blocks}; raise num_blocks or lower "
                 "max_new_tokens")
+        if traced:
+            req._t_enqueue = time.perf_counter()
         self.waiting.append(req)
+        self._update_pressure()
         return req
+
+    def _reject_trace(self, req: Request, reason: str) -> None:
+        """Rejections are lifecycle endpoints too: a scraper reading
+        /requests sees WHY traffic bounced, not just that it did."""
+        rec = {"rid": req.rid, "outcome": f"rejected:{reason}",
+               "prompt_len": len(req.prompt_ids),
+               "max_new_tokens": req.max_new_tokens}
+        req.trace = rec
+        _flight.default_recorder().record_event("request", **rec)
+        _export.record_request(rec)
 
     def _blocks_for(self, n_tokens: int) -> int:
         return math.ceil(n_tokens / self.bs)
@@ -398,6 +465,10 @@ class ServingEngine:
                 _M_REJECTIONS.inc(reason="pool_exhausted")
             return False
         self.waiting.popleft()
+        # admission starts NOW: everything before this point was queue
+        # wait (incl. pool-exhausted deferrals — the tail /metrics must
+        # surface under overload)
+        t_admit = time.perf_counter() if _metrics.enabled() else None
         slot = self.free_slots.popleft()
         blocks = [self.free_blocks.popleft() for _ in range(need_now)]
         self.tables[slot, :] = 0
@@ -439,6 +510,20 @@ class ServingEngine:
             self.tables[slot, col] = 0
         _M_ADMISSIONS.inc()
         first = req._sample(np.asarray(row))
+        if t_admit is not None:
+            # np.asarray(row) above was the host sync: the first token
+            # really exists now, so this is TTFT, not enqueue time
+            t_first = time.perf_counter()
+            req._t_admit, req._t_first = t_admit, t_first
+            req._t_last = t_first
+            if req._t_enqueue is not None:
+                qwait = t_admit - req._t_enqueue
+                ttft = t_first - req._t_enqueue
+                _M_QWAIT.observe(qwait)
+                _M_TTFT.observe(ttft)
+                slo = _flags.get_flag("serving_ttft_slo_ms")
+                if slo > 0 and ttft * 1e3 > slo:
+                    _M_SLO.inc(metric="ttft")
         req.output_ids.append(first)
         req.slot = slot
         self.slot_req[slot] = req
@@ -460,6 +545,15 @@ class ServingEngine:
         _M_POOL.set(round(1.0 - len(self.free_blocks)
                           / max(self.num_blocks, 1), 4))
         _M_SLOTS.set(round(1.0 - len(self.free_slots) / max(self.B, 1), 4))
+        self._update_pressure()
+
+    def _update_pressure(self):
+        # registered scheduler-pressure gauges (ISSUE 6 satellite): the
+        # exporter shows queue depth without calling into the engine
+        running = self.B - len(self.free_slots)
+        _M_RUNNING.set(running)
+        _M_WAITING.set(len(self.waiting))
+        _M_QUEUE_DEPTH.set(running + len(self.waiting))
 
     def _maybe_finish(self, req: Request, tok: int):
         if req.done:
@@ -467,6 +561,32 @@ class ServingEngine:
         if (req.eos_token_id is not None and tok == req.eos_token_id) or \
                 len(req.output_ids) >= req.max_new_tokens:
             req.done = True
+            # _t_first may lag _t_enqueue if the metrics gate flipped
+            # between enqueue and admission; trace only complete timelines
+            if _metrics.enabled() and req._t_enqueue is not None \
+                    and req._t_first is not None:
+                self._finish_trace(req)
+
+    def _finish_trace(self, req: Request) -> None:
+        """Request reached its terminal token: close the lifecycle trace
+        — e2e into the sketch, the per-request record into the flight
+        ring (post-mortem) and the /requests export ring (scrape)."""
+        t = time.perf_counter()
+        e2e = t - req._t_enqueue
+        _M_E2E.observe(e2e)
+        n_out = len(req.output_ids)
+        rec = {"rid": req.rid, "outcome": "finished",
+               "prompt_len": len(req.prompt_ids), "tokens_out": n_out,
+               "ticks": req._ticks,
+               "queue_wait_s": round(req._t_admit - req._t_enqueue, 6),
+               "prefill_s": round(req._t_first - req._t_admit, 6),
+               "ttft_s": round(req._t_first - req._t_enqueue, 6),
+               "tpot_mean_s": round((t - req._t_first)
+                                    / max(n_out - 1, 1), 6),
+               "e2e_s": round(e2e, 6)}
+        req.trace = rec
+        _flight.default_recorder().record_event("request", **rec)
+        _export.record_request(rec)
 
     def _evict(self, slot: int):
         req = self.slot_req[slot]
@@ -595,10 +715,14 @@ class ServingEngine:
         logits_np = None
         toks_before = self.tokens_out
         sampled = 0
+        harvested_by: List = []   # (req, tokens harvested this tick)
         for slot in pend.active:
             req = pend.reqs[slot]
             if req.done:
                 continue         # whole row is EOS overrun
+            n_before = len(req.output_ids)
+            harvested_by.append((req, n_before))
+            req._ticks += 1
             self.last_tok[slot] = int(toks[slot, -1])
             for j in range(k):
                 if req.done:
@@ -627,6 +751,20 @@ class ServingEngine:
         self._last_harvest_t = t_done
         dt = t_done - t_from
         harvested = self.tokens_out - toks_before
+        if _metrics.enabled():
+            # per-token inter-token latency (TPOT): tokens arrive k at a
+            # time, so each of this harvest's tokens is imputed an equal
+            # share of the gap since the request's previous token
+            tpot_slo = _flags.get_flag("serving_tpot_slo_ms")
+            for req, n_before in harvested_by:
+                n_new = len(req.output_ids) - n_before
+                if n_new <= 0 or req._t_last is None:
+                    continue
+                gap = (t_done - req._t_last) / n_new
+                req._t_last = t_done
+                _M_TPOT.observe(gap, weight=n_new)
+                if tpot_slo > 0 and gap * 1e3 > tpot_slo:
+                    _M_SLO.inc(n_new, metric="tpot")
         self.ticks += 1
         _M_TICKS.inc()
         _M_TICK_S.observe(dt)
@@ -697,6 +835,8 @@ class ServingEngine:
         one tick in flight: dispatch t+1 (chaining t's device last-token
         column), THEN harvest t — device compute and host harvest/
         detokenize overlap instead of strictly alternating."""
+        from ..observability import http as _http
+        _http.start_from_flags()   # no-op unless FLAGS_metrics_port > 0
         pend = None
         while True:
             if pend is None:
@@ -721,9 +861,25 @@ class ServingEngine:
         return self.finished
 
     def stats(self) -> dict:
-        return {"steps": self.steps, "ticks": self.ticks,
-                "tokens_out": self.tokens_out,
-                "free_blocks": len(self.free_blocks),
-                "reserved": self.reserved,
-                "active": len(self._active_slots()),
-                "waiting": len(self.waiting)}
+        running = self.B - len(self.free_slots)
+        out = {"steps": self.steps, "ticks": self.ticks,
+               "tokens_out": self.tokens_out,
+               "free_blocks": len(self.free_blocks),
+               "reserved": self.reserved,
+               "active": len(self._active_slots()),
+               "running": running,
+               "waiting": len(self.waiting),
+               "queue_depth": running + len(self.waiting)}
+        # p50/p90/p99 straight off the streaming sketches — process-wide
+        # (the sketches aggregate every engine in the process, like the
+        # /metrics scrape they feed)
+        lat = {}
+        for key, sk in (("ttft", _M_TTFT), ("tpot", _M_TPOT),
+                        ("e2e", _M_E2E), ("queue_wait", _M_QWAIT)):
+            if not sk.count():
+                continue
+            lat[key] = {f"p{round(q * 100)}": round(sk.quantile(q), 6)
+                        for q in (0.5, 0.9, 0.99)}
+        if lat:
+            out["latency"] = lat
+        return out

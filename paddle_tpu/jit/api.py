@@ -40,6 +40,7 @@ import numpy as np
 
 from ..framework import random as _random
 from ..framework.tensor import Tensor
+from ..observability import compile_tracker as _compile_tracker
 from ..observability import metrics as _metrics
 from ..ops import registry as _registry
 from . import sot as _sot
@@ -61,6 +62,27 @@ __all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module"]
 
 def _is_tracer(v) -> bool:
     return isinstance(v, jax.core.Tracer)
+
+
+def _blame_signature(sig):
+    """Reshape an `_arg_key` signature tuple into named per-arg entries
+    so the compile tracker's recompile diff reads "arg0.shape: (2, 3) ->
+    (4, 3)" instead of a positional tuple dump."""
+    if sig is None:
+        return None
+    out = []
+    for i, entry in enumerate(sig):
+        if isinstance(entry, tuple) and entry and entry[0] in ("T", "A"):
+            d = {"kind": "tensor" if entry[0] == "T" else "array",
+                 "shape": entry[1], "dtype": entry[2]}
+            if entry[0] == "T" and len(entry) > 3:
+                d["stop_gradient"] = entry[3]
+            out.append((f"arg{i}", d))
+        elif isinstance(entry, tuple) and entry and entry[0] == "S":
+            out.append((f"arg{i}", {"static": repr(entry[1])[:80]}))
+        else:
+            out.append((f"arg{i}", repr(entry)[:80]))
+    return tuple(out)
 
 
 class _TensorSlot:
@@ -361,11 +383,11 @@ class StaticFunction:
         jitted = jax.jit(functional, donate_argnums=donate)
         self._stats["signatures"] += 1
         _M_JIT_TRACES.inc(fn=self.__name__)
-        _M_JIT_COMPILE_S.observe(_time.perf_counter() - _t_build0,
-                                 fn=self.__name__, stage="trace")
+        build_s = _time.perf_counter() - _t_build0
+        _M_JIT_COMPILE_S.observe(build_s, fn=self.__name__, stage="trace")
         return {"slots": slots, "mutable_idx": mutable_idx,
                 "readonly_idx": readonly_idx, "jitted": jitted,
-                "spec": spec, "fresh": True,
+                "spec": spec, "fresh": True, "build_s": build_s,
                 "burned": tuple(burned) if burned is not None else None}
 
     # errors that mean "this function cannot trace as one graph" (value-
@@ -430,6 +452,7 @@ class StaticFunction:
         """First value-specialized build for this signature."""
         entry = {"sot": True, "specs": {}, "last": None}
         prog = self._build(args, kwargs, sot=True)
+        prog["sig"] = key[1]
         if prog["burned"] is not None and len(prog["burned"]) == 0:
             # nothing was concretized: the break came from something the
             # hooks cannot guard (dynamic shapes, host reads) — replaying
@@ -472,6 +495,7 @@ class StaticFunction:
                             "specializations for one signature"))
                     return self._fn(*args, **kwargs)
                 prog = self._build(args, kwargs, sot=True)
+                prog["sig"] = key[1]
                 entry["specs"][prog["burned"]] = prog
                 entry["last"] = prog["burned"]
                 self._stats["sot_specializations"] += 1
@@ -494,6 +518,7 @@ class StaticFunction:
         prog = self._cache.get(key)
         if prog is None:
             prog = self._build(args, kwargs)
+            prog["sig"] = key[1]
             self._cache[key] = prog
         return self._run_prog(prog, args, kwargs)
 
@@ -538,8 +563,14 @@ class StaticFunction:
                     t._grad = g
         if first_call:
             prog.pop("fresh", None)
-            _M_JIT_COMPILE_S.observe(_time.perf_counter() - _t_exec0,
-                                     fn=self.__name__, stage="compile")
+            exec_s = _time.perf_counter() - _t_exec0
+            _M_JIT_COMPILE_S.observe(exec_s, fn=self.__name__,
+                                     stage="compile")
+            # recompile blame (ISSUE 6): one event per built program,
+            # seconds = trace pass + XLA compile/first run
+            _compile_tracker.record_compile(
+                self.__name__, _blame_signature(prog.get("sig")),
+                prog.get("build_s", 0.0) + exec_s)
         if prog.get("burned"):
             # guard check BEFORE any state commit: a miss discards this
             # run (inputs were not donated) and re-dispatches

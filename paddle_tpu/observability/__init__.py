@@ -51,14 +51,16 @@ from . import metrics  # noqa: F401
 from . import flops  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import telemetry  # noqa: F401
+from . import quantiles  # noqa: F401
+from . import compile_tracker  # noqa: F401
 from .metrics import (  # noqa: F401
-    counter, gauge, histogram, snapshot, reset, export_json,
+    counter, gauge, histogram, quantile, snapshot, reset, export_json,
 )
 
 __all__ = ["metrics", "harness", "span", "telemetry", "flight_recorder",
-           "flops",
-           "counter", "gauge", "histogram", "snapshot", "reset",
-           "export_json"]
+           "flops", "quantiles", "compile_tracker", "export", "http",
+           "counter", "gauge", "histogram", "quantile", "snapshot",
+           "reset", "export_json"]
 
 _SPAN_SECONDS = metrics.histogram(
     "spans.seconds", "wall time of observability.span regions")
@@ -100,9 +102,9 @@ class span:
 
 
 def __getattr__(name):
-    # harness is a leaf module only bench/test flows need; keep it lazy so
-    # `import paddle_tpu` never pays for it
-    if name == "harness":
+    # leaf modules only bench/test/scrape flows need; kept lazy so
+    # `import paddle_tpu` never pays for them
+    if name in ("harness", "export", "http"):
         import importlib
-        return importlib.import_module(".harness", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

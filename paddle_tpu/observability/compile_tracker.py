@@ -1,0 +1,191 @@
+"""Recompile blame: who compiled, how long, and *what changed*.
+
+ISSUE 6 tentpole (b), and the diagnostic for ROADMAP item 1 (compile_s
+163-370s against a 36 ms step): every jit entry point — `jit/api.py`
+whole-step captures, the serving engine's tick/prefill/decode program
+caches, the fused-optimizer program builder — reports each compilation
+here as ``(callable name, abstract signature, wall seconds)``.  The
+tracker keeps per-callable cumulative cost and, for a RE-compile, diffs
+the new signature against the previous one for the same callable to
+name exactly what changed ("arg0.shape: (2, 3) -> (4, 3)",
+"L_pad: 16 -> 32", "k: 4 -> 1") — the difference between "serving
+stalled 90 s" and "a new prompt bucket compiled a new prefill program".
+
+Readout: :func:`compile_report` (the dump CLI's ``--compile-report``,
+embedded in bench rung records), plus two registry instruments the
+Prometheus exporter serves as ``compile_events_total{fn=...}`` and
+``compile_seconds_total{fn=...}``.
+
+Signatures are nested tuples/dicts of hashable leaves; ``(name, value)``
+pairs and dict entries diff by *name* (so causes read "k: 1 -> 4"),
+positional tuples by index path.  Events store the signature as repr so
+reports stay JSON-able.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["record_compile", "compile_report", "reset",
+           "wrap_first_call", "diff_signatures"]
+
+_M_EVENTS = _metrics.counter(
+    "compile.events", "program compilations recorded by the compile "
+    "tracker, by callable (label fn=)")
+_M_SECONDS = _metrics.counter(
+    "compile.seconds_total", "cumulative wall seconds spent compiling "
+    "(trace + XLA compile + first run), by callable (label fn=)")
+
+_MAX_EVENTS = 256
+
+_lock = threading.RLock()
+# name -> {"compiles", "seconds_total", "signature", "last_cause"}
+_callables: Dict[str, Dict[str, Any]] = {}
+_events: deque = deque(maxlen=_MAX_EVENTS)
+
+
+# ---------------------------------------------------------------- diffing
+
+def _diff(old: Any, new: Any, path: str, out: List[str]) -> None:
+    if old == new:
+        return
+    if isinstance(old, dict) and isinstance(new, dict):
+        for k in sorted(set(old) | set(new), key=repr):
+            sub = f"{path}.{k}" if path else str(k)
+            if k not in old:
+                out.append(f"{sub}: <absent> -> {new[k]!r}")
+            elif k not in new:
+                out.append(f"{sub}: {old[k]!r} -> <absent>")
+            else:
+                _diff(old[k], new[k], sub, out)
+        return
+    if isinstance(old, (tuple, list)) and isinstance(new, (tuple, list)):
+        # (name, value) pair: diff by name so causes read "k: 1 -> 4"
+        if (len(old) == len(new) == 2 and isinstance(old[0], str)
+                and old[0] == new[0]):
+            sub = f"{path}.{old[0]}" if path else old[0]
+            _diff(old[1], new[1], sub, out)
+            return
+        if len(old) != len(new):
+            out.append(f"{path or 'signature'}: arity "
+                       f"{len(old)} -> {len(new)}")
+            return
+        for i, (a, b) in enumerate(zip(old, new)):
+            if (isinstance(a, (tuple, list)) and len(a) == 2
+                    and isinstance(a[0], str)
+                    and isinstance(b, (tuple, list)) and len(b) == 2
+                    and a[0] == b[0]):
+                _diff(a, b, path, out)   # pair element: name, not index
+            else:
+                _diff(a, b, f"{path}[{i}]" if path else f"[{i}]", out)
+        return
+    out.append(f"{path or 'value'}: {old!r} -> {new!r}")
+
+
+def diff_signatures(old: Any, new: Any, limit: int = 4) -> str:
+    """Human-readable blame line for a signature change."""
+    if old is None:
+        return "first compile"
+    diffs: List[str] = []
+    _diff(old, new, "", diffs)
+    if not diffs:
+        return "identical signature (cache was dropped or a different "\
+               "program variant compiled)"
+    head = "; ".join(diffs[:limit])
+    if len(diffs) > limit:
+        head += f" (+{len(diffs) - limit} more)"
+    return head
+
+
+# -------------------------------------------------------------- recording
+
+def record_compile(name: str, signature: Any,
+                   seconds: float) -> Dict[str, Any]:
+    """Record one compilation event; returns the event record."""
+    seconds = float(seconds)
+    with _lock:
+        ent = _callables.get(name)
+        if ent is None:
+            ent = _callables[name] = {
+                "compiles": 0, "seconds_total": 0.0,
+                "signature": None, "last_cause": None}
+        cause = diff_signatures(ent["signature"], signature)
+        ent["compiles"] += 1
+        ent["seconds_total"] += seconds
+        ent["signature"] = signature
+        ent["last_cause"] = cause
+        event = {"fn": name, "seconds": round(seconds, 4),
+                 "cumulative_seconds": round(ent["seconds_total"], 4),
+                 "compile_no": ent["compiles"], "cause": cause,
+                 "signature": repr(signature)[:300],
+                 "unix_time": round(time.time(), 3)}
+        _events.append(event)
+    _M_EVENTS.inc(fn=name)
+    _M_SECONDS.inc(seconds, fn=name)
+    return event
+
+
+def wrap_first_call(fn: Callable, name: str, signature: Any) -> Callable:
+    """Wrap a freshly-jitted program so its FIRST call — where jax pays
+    trace + XLA compile — is timed and recorded as a compilation event.
+    After that the wrapper is one boolean check per call (against a
+    multi-millisecond compiled step)."""
+    compiled = [False]
+
+    def wrapper(*args, **kwargs):
+        if compiled[0]:
+            return fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = fn(*args, **kwargs)
+        compiled[0] = True
+        record_compile(name, signature, time.perf_counter() - t0)
+        return out
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+# ---------------------------------------------------------------- readout
+
+def compile_report(top: int = 10,
+                   events: int = 32) -> Dict[str, Any]:
+    """Compilation cost ledger: top compilers by cumulative seconds and
+    the recompile events with their blamed signature changes."""
+    with _lock:
+        per = [{"fn": n, "compiles": e["compiles"],
+                "seconds_total": round(e["seconds_total"], 4),
+                "last_cause": e["last_cause"]}
+               for n, e in _callables.items()]
+        evs = list(_events)
+    per.sort(key=lambda e: (-e["seconds_total"], e["fn"]))
+    recompiles = [e for e in evs if e["compile_no"] > 1]
+    return {"schema": "paddle_tpu.compile_report/v1",
+            "total_compiles": sum(e["compiles"] for e in per),
+            "total_seconds": round(sum(e["seconds_total"] for e in per), 4),
+            "by_callable": per[:top],
+            "recompiles": recompiles[-events:],
+            "recent_events": evs[-events:]}
+
+
+def total_compiles() -> int:
+    with _lock:
+        return sum(e["compiles"] for e in _callables.values())
+
+
+def get(name: str) -> Optional[Dict[str, Any]]:
+    """Per-callable entry (compiles, seconds_total, last signature/cause)."""
+    with _lock:
+        ent = _callables.get(name)
+        return dict(ent) if ent is not None else None
+
+
+def reset() -> None:
+    """Drop all recorded state (bench resets per rung so each record
+    carries its own compile evidence)."""
+    with _lock:
+        _callables.clear()
+        _events.clear()

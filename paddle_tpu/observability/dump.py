@@ -3,8 +3,11 @@
     python -m paddle_tpu.observability.dump             # newest flight dump
     python -m paddle_tpu.observability.dump --dir prof/ # search there
     python -m paddle_tpu.observability.dump --registry  # live registry
+    python -m paddle_tpu.observability.dump --prom      # Prometheus text
+    python -m paddle_tpu.observability.dump --compile-report
 
-Prints ONE JSON document on stdout.  Default mode locates the newest
+Prints ONE JSON document on stdout (``--prom`` prints Prometheus text
+exposition instead — the same bytes the /metrics endpoint serves).  Default mode locates the newest
 ``flight_*.json`` written by the flight recorder (automatic NaN/hang/
 exception dumps or ``bench.py`` failure artifacts) in ``--dir`` (falls
 back to ``FLAGS_flight_dump_dir``, then the cwd) and echoes it;
@@ -44,6 +47,12 @@ def main(argv=None) -> int:
     p.add_argument("--registry", action="store_true",
                    help="print this process's metrics registry snapshot "
                         "instead of a flight dump")
+    p.add_argument("--prom", action="store_true",
+                   help="print this process's registry in Prometheus "
+                        "text exposition format (what /metrics serves)")
+    p.add_argument("--compile-report", action="store_true",
+                   help="print this process's compile tracker report "
+                        "(top compilers, recompile blame) as JSON")
     p.add_argument("--path", default=None,
                    help="print this exact dump file (skips the search)")
     args = p.parse_args(argv)
@@ -51,6 +60,16 @@ def main(argv=None) -> int:
     if args.registry:
         from . import metrics
         print(metrics.export_json())
+        return 0
+    if args.prom:
+        from . import export
+        # a fresh CLI process shows the import-time instruments, so this
+        # doubles as a renderer smoke check (like --registry)
+        sys.stdout.write(export.render_prometheus())
+        return 0
+    if args.compile_report:
+        from . import compile_tracker
+        print(json.dumps(compile_tracker.compile_report(), indent=1))
         return 0
 
     path = args.path

@@ -101,6 +101,30 @@ def probe_backend() -> Dict[str, Any]:
     return out
 
 
+# Backend-INIT failure fingerprints (ISSUE 6 satellite / ROADMAP
+# housekeeping): BENCH_r05 died rc=1 because PJRT `make_c_api_client`
+# failed inside a rung AFTER the probe — the error class is
+# environmental (no chip through the tunnel), so the record must say
+# `backend_unavailable` like the probe-gated rungs, not `error`.
+_BACKEND_INIT_TYPES = ("RuntimeError", "XlaRuntimeError",
+                       "JaxRuntimeError", "InternalError")
+_BACKEND_INIT_MARKERS = ("make_c_api_client", "Unable to initialize backend",
+                         "failed to initialize backend",
+                         "No visible device", "no backend",
+                         "Failed to get global TPU topology",
+                         "PJRT_Client_Create", "DEADLINE_EXCEEDED: Failed "
+                         "to connect")
+
+
+def is_backend_init_error(e: BaseException) -> bool:
+    """True when an exception is a backend/PJRT initialization failure
+    rather than a bug inside the rung."""
+    if type(e).__name__ not in _BACKEND_INIT_TYPES:
+        return False
+    msg = str(e)
+    return any(m in msg for m in _BACKEND_INIT_MARKERS)
+
+
 def _ctx(probe: Dict[str, Any], smoke: bool) -> SimpleNamespace:
     return SimpleNamespace(
         smoke=smoke, probe=probe,
@@ -135,6 +159,8 @@ def run_rung(rung: Rung, probe: Optional[Dict[str, Any]] = None,
                     est_cold_s=rung.est_cold_s)
     if collect_metrics:
         _metrics.reset()
+        from . import compile_tracker as _compile
+        _compile.reset()
     _flight.default_recorder().record_event("rung_begin", rung=rung.name)
     t0 = time.perf_counter()
     try:
@@ -145,13 +171,24 @@ def run_rung(rung: Rung, probe: Optional[Dict[str, Any]] = None,
     except (KeyboardInterrupt, SystemExit):
         raise                   # the operator's abort outranks degradation
     except BaseException as e:  # noqa: BLE001 - a rung must never kill a run
-        rec = dict(base, ok=False,
-                   error=f"{type(e).__name__}: {e}"[:500])
+        err = f"{type(e).__name__}: {e}"[:500]
+        if is_backend_init_error(e):
+            # a dead/unreachable backend discovered mid-rung is the same
+            # ANSWER as a failed probe: degrade, don't report a code bug
+            rec = dict(base, ok=False, reason="backend_unavailable",
+                       error=err)
+        else:
+            rec = dict(base, ok=False, error=err)
         _flight.default_recorder().record_event(
-            "rung_error", rung=rung.name, error=rec["error"][:300])
+            "rung_error", rung=rung.name, error=err[:300])
     rec["elapsed_s"] = round(time.perf_counter() - t0, 3)
     if collect_metrics:
         rec["metrics"] = _metrics.snapshot()
+        from . import compile_tracker as _compile
+        if _compile.total_compiles():
+            # before/after evidence for the ROADMAP item-1 cache/AOT
+            # work: what this rung compiled, for how long, and why
+            rec["compile_report"] = _compile.compile_report()
     return rec
 
 

@@ -1,0 +1,152 @@
+"""Scrape endpoint: a stdlib HTTP daemon serving /metrics, /healthz and
+/requests.
+
+ISSUE 6 tentpole (c): the answer to "what is p99 TTFT right now?" from
+OUTSIDE the process.  One ``http.server.ThreadingHTTPServer`` on a
+daemon thread — no third-party dependency, nothing on the hot path (the
+handler reads the registry under its locks exactly like ``snapshot()``).
+
+Endpoints:
+
+* ``GET /metrics``  — the registry in Prometheus text exposition format
+  (:func:`.export.render_prometheus`), content type
+  ``text/plain; version=0.0.4``.
+* ``GET /healthz``  — liveness JSON (``{"ok": true, ...}``); a scraper
+  or load balancer can distinguish "process up" from "port dead".
+* ``GET /requests`` — the last-K per-request serving trace records as a
+  JSON array (``?n=`` caps K, default 64).
+
+Security: binds ``FLAGS_metrics_host`` (default ``127.0.0.1`` — the
+endpoint exposes operational data, so exposure beyond the host must be
+an explicit operator decision).  ``FLAGS_metrics_port`` (default 0 =
+disabled) gates auto-start: :func:`start_from_flags` is called by
+``ServingEngine.run()`` and ``Model.fit()`` and is a no-op unless the
+flag is set.  Calling :func:`serve` directly with ``port=0`` binds an
+ephemeral port (tests).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import export as _export
+from . import metrics as _metrics
+
+__all__ = ["MetricsServer", "serve", "start_from_flags", "stop", "current"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_metrics/1.0"
+
+    def _send(self, code: int, content_type: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        try:
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                body = _export.render_prometheus().encode()
+                self._send(200,
+                           "text/plain; version=0.0.4; charset=utf-8",
+                           body)
+            elif url.path == "/healthz":
+                import os
+                doc = {"ok": True, "pid": os.getpid(),
+                       "unix_time": round(time.time(), 3),
+                       "metrics_enabled": _metrics.enabled()}
+                self._send(200, "application/json",
+                           json.dumps(doc).encode())
+            elif url.path == "/requests":
+                try:
+                    n = int(parse_qs(url.query).get("n", ["64"])[0])
+                except (ValueError, IndexError):
+                    n = 64
+                body = json.dumps(_export.recent_requests(n),
+                                  default=repr).encode()
+                self._send(200, "application/json", body)
+            else:
+                self._send(404, "text/plain; charset=utf-8",
+                           b"not found; endpoints: /metrics /healthz "
+                           b"/requests\n")
+        except BrokenPipeError:  # scraper hung up mid-response
+            pass
+
+    def log_message(self, format, *args):  # noqa: A002 - http.server API
+        pass  # scrapes every few seconds must not spam stderr
+
+
+class MetricsServer:
+    """One running scrape endpoint; ``port`` is the BOUND port (useful
+    when constructed with port 0)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="paddle-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_lock = threading.Lock()
+_server: Optional[MetricsServer] = None
+
+
+def serve(port: int, host: str = "127.0.0.1") -> MetricsServer:
+    """Start (or return) the process's scrape endpoint.  Idempotent: a
+    second call returns the running server regardless of arguments."""
+    global _server
+    with _lock:
+        if _server is None:
+            _server = MetricsServer(port, host)
+        return _server
+
+
+def start_from_flags() -> Optional[MetricsServer]:
+    """Auto-start hook for the long-running entry points
+    (``ServingEngine.run``, ``Model.fit``): starts the endpoint when
+    ``FLAGS_metrics_port`` > 0, else a no-op.  Never raises — a busy
+    port must not take down training/serving."""
+    if _server is not None:
+        return _server
+    try:
+        from .. import flags as _flags
+        port = int(_flags.get_flag("metrics_port"))
+        if port <= 0:
+            return None
+        host = str(_flags.get_flag("metrics_host"))
+        return serve(port, host)
+    except Exception:  # noqa: BLE001 - observability must not kill the job
+        return None
+
+
+def current() -> Optional[MetricsServer]:
+    return _server
+
+
+def stop() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None

@@ -35,7 +35,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry",
-    "counter", "gauge", "histogram",
+    "counter", "gauge", "histogram", "quantile",
     "snapshot", "reset", "export_json",
     "enabled", "set_enabled",
 ]
@@ -216,6 +216,32 @@ class Histogram(_Metric):
             s = self._series.get(tuple(sorted(labels.items())))
             return s[1] if s else 0.0
 
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        """Percentile estimate by linear interpolation inside the bucket
+        holding rank ``q`` (ISSUE 6 satellite: one query API across the
+        fixed-bucket histograms and the quantile sketches).  Bucket edges
+        are clamped to the observed [min, max], which also gives the
+        ``+Inf`` bucket a finite upper edge."""
+        with self._lock:
+            s = self._series.get(tuple(sorted(labels.items())))
+            if s is None or not s[0]:
+                return None
+            count, _, mn, mx, bucket_counts = s
+            bucket_counts = list(bucket_counts)
+        rank = min(max(float(q), 0.0), 1.0) * count
+        cum = 0.0
+        for i, c in enumerate(bucket_counts):
+            if not c:
+                continue
+            lo = self.buckets[i - 1] if i > 0 else mn
+            hi = self.buckets[i] if i < len(self.buckets) else mx
+            lo = min(max(lo, mn), mx)
+            hi = min(max(hi, mn), mx)
+            if cum + c >= rank:
+                return lo + (hi - lo) * ((rank - cum) / c)
+            cum += c
+        return mx
+
     def total_count(self) -> int:
         """Observation count over every label series (telemetry diffs
         this across a step bracket)."""
@@ -269,6 +295,17 @@ class Registry:
                   buckets: Optional[Sequence[float]] = None) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
 
+    def quantile(self, name: str, help: str = "",  # noqa: A002
+                 alpha: float = 0.01,
+                 quantiles: Optional[Sequence[float]] = None):
+        """Streaming quantile-sketch instrument (TTFT/TPOT percentiles —
+        see :mod:`.quantiles`); rendered as a Prometheus summary."""
+        from .quantiles import DEFAULT_QUANTILES, Quantile
+        return self._get_or_create(
+            Quantile, name, help, alpha=alpha,
+            quantiles=tuple(quantiles) if quantiles is not None
+            else DEFAULT_QUANTILES)
+
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
@@ -316,6 +353,11 @@ def gauge(name: str, help: str = "") -> Gauge:  # noqa: A002
 def histogram(name: str, help: str = "",  # noqa: A002
               buckets: Optional[Sequence[float]] = None) -> Histogram:
     return _default.histogram(name, help, buckets)
+
+
+def quantile(name: str, help: str = "", alpha: float = 0.01,  # noqa: A002
+             quantiles: Optional[Sequence[float]] = None):
+    return _default.quantile(name, help, alpha, quantiles)
 
 
 def get(name: str) -> Optional[_Metric]:

@@ -58,6 +58,7 @@ import numpy as np
 
 from .. import flags as _flags
 from ..framework.tensor import Tensor
+from ..observability import compile_tracker as _compile_tracker
 from ..observability import metrics as _metrics
 
 __all__ = ["enabled", "try_step", "scaler_step"]
@@ -323,8 +324,19 @@ def _plan(opt, work, scaler, clip_static):
         return None
     if prog is None:
         _M_FUSED.inc_key(_K_MISS)
-        prog = cache[key] = _build_program(
-            rule, statics, clip_key, reduce_fn, scaler_cfg, donate)
+        # recompile blame (ISSUE 6): the first call of a fresh fused
+        # program is where the trace+XLA compile lands; the signature
+        # names what re-triggers it (a new leaf aval, clip/scaler config)
+        blame_sig = (("leaves", len(leaves)),
+                     ("clip", repr(clip_key)[:120]),
+                     ("scaler", scaler_cfg is not None),
+                     ("donate", donate),
+                     ("params", tuple(repr(_aval_key(p._value))
+                                      for p, *_ in leaves)))
+        prog = cache[key] = _compile_tracker.wrap_first_call(
+            _build_program(rule, statics, clip_key, reduce_fn,
+                           scaler_cfg, donate),
+            "optimizer.fused_step", blame_sig)
     elif _metrics._ENABLED:
         _M_FUSED.inc_key(_K_HIT)
     return prog, key, leaves, masters, states, state_names

@@ -126,6 +126,83 @@ def test_fault_tolerance_rung_schema(tmp_path):
     assert val["resume_bitexact"] is True
 
 
+def test_backend_init_failure_degrades_at_rung_start(monkeypatch):
+    """ROADMAP housekeeping (BENCH_r05): a PJRT `make_c_api_client`
+    failure INSIDE a rung (after a passing probe) must degrade to
+    `ok:false reason:backend_unavailable` like probe-gated rungs — not
+    surface as a code-bug `error` record (let alone rc=1)."""
+    import jax
+
+    def boom():
+        raise RuntimeError(
+            "Unable to initialize backend 'tpu': INTERNAL: "
+            "make_c_api_client failed: could not connect")
+    monkeypatch.setattr(jax, "devices", boom)
+
+    @harness.register_rung("_t_backend_init")
+    def rung(ctx):
+        jax.devices()     # the first backend touch inside the rung
+
+    @harness.register_rung("_t_real_bug")
+    def bug_rung(ctx):
+        raise RuntimeError("an actual code bug, not the backend")
+
+    try:
+        rec = harness.run_rung(harness.get_rung("_t_backend_init"),
+                               probe={"ok": True, "platform": "tpu",
+                                      "device_kind": "tpu", "n_devices": 1,
+                                      "error": None})
+        assert rec["ok"] is False
+        assert rec["reason"] == "backend_unavailable"
+        assert "make_c_api_client" in rec["error"]
+        assert harness.validate_record(rec) is None
+        # a RuntimeError that is NOT a backend-init fingerprint stays a
+        # plain error record (real bugs must not hide as env issues)
+        rec = harness.run_rung(harness.get_rung("_t_real_bug"),
+                               probe={"ok": True, "platform": "cpu",
+                                      "device_kind": "cpu", "n_devices": 1,
+                                      "error": None})
+        assert rec["ok"] is False and "reason" not in rec
+        assert "actual code bug" in rec["error"]
+    finally:
+        harness._REGISTRY.pop("_t_backend_init", None)
+        harness._REGISTRY.pop("_t_real_bug", None)
+
+
+def test_request_trace_rung_schema():
+    """Pin the ISSUE 6 `request_trace` rung's record schema: TTFT/TPOT
+    percentiles from the lifecycle sketches plus the tracing-overhead
+    split (ticks/s metrics-gate on vs off), regression key
+    `trace_overhead_pct`.  Runs the rung at smoke scale on CPU."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_rt", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_request_trace(ctx)
+    rec = {"rung": "request_trace", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("request_trace").smoke
+    assert bench._REGRESSION_KEYS["request_trace"] == "trace_overhead_pct"
+    for key in ("ttft_p50_ms", "ttft_p99_ms", "tpot_p50_ms",
+                "tpot_p99_ms", "e2e_p50_ms"):
+        assert val[key] > 0, key
+    assert val["ttft_p99_ms"] >= val["ttft_p50_ms"]
+    assert val["requests_traced"] >= 4
+    assert val["ticks_per_sec_on"] > 0 and val["ticks_per_sec_off"] > 0
+    # the acceptance bound is <=2 on a quiet box; CI containers are
+    # noisy, so the schema pin only rejects gross regressions
+    assert 0.0 <= val["trace_overhead_pct"] < 25.0
+
+
 def test_fused_optimizer_rung_schema():
     """Pin the round-7 `fused_optimizer` rung's record schema: the
     regression key (`speedup`) and the per-cell dispatch/wall fields the

@@ -1,0 +1,250 @@
+"""Scrape surface (ISSUE 6): quantile sketches, the Prometheus text
+exporter, the HTTP endpoint, and the dump CLI's --prom/--compile-report.
+
+The exporter test is a GOLDEN test: the rendered text is compared
+byte-for-byte against the expected exposition document (label escaping,
+bucket cumulativeness incl. +Inf, summary quantile lines)."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import (compile_tracker, export, metrics,
+                                      quantiles)
+from paddle_tpu.observability import http as obs_http
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    metrics.reset()
+    yield
+    paddle.set_flags({"enable_metrics": True})
+    metrics.reset()
+    obs_http.stop()
+
+
+# ------------------------------------------------------------ the sketch
+
+def test_sketch_relative_error_bound():
+    """10k-sample exponential stream: p50/p90/p99 within the 1% relative
+    error bound (plus sampling slack) of numpy's exact quantiles."""
+    rng = np.random.RandomState(0)
+    vals = rng.exponential(0.05, 10000)
+    sk = quantiles.QuantileSketch(alpha=0.01)
+    for v in vals:
+        sk.add(v)
+    assert sk.count == 10000
+    np.testing.assert_allclose(sk.sum, vals.sum(), rtol=1e-9)
+    for q in (0.5, 0.9, 0.99):
+        true = np.quantile(vals, q)
+        assert abs(sk.quantile(q) - true) / true < 0.02, q
+
+
+def test_sketch_merge_equals_combined_stream():
+    """Mergeability (the property the export tier needs to combine
+    per-shard sketches): merge(a, b) == sketch(a ++ b) exactly."""
+    rng = np.random.RandomState(1)
+    vals = rng.gamma(2.0, 0.01, 4000)
+    a, b, whole = (quantiles.QuantileSketch(), quantiles.QuantileSketch(),
+                   quantiles.QuantileSketch())
+    for v in vals[:2000]:
+        a.add(v)
+        whole.add(v)
+    for v in vals[2000:]:
+        b.add(v)
+        whole.add(v)
+    a.merge(b)
+    for q in (0.1, 0.5, 0.99):
+        assert a.quantile(q) == whole.quantile(q)
+    assert a.count == whole.count
+    assert a.sum == pytest.approx(whole.sum)   # float summation order
+
+
+def test_sketch_memory_bound_preserves_upper_quantiles():
+    """A stream spanning 12 decades overflows max_bins; the collapse
+    folds LOW bins, so p99 keeps its error bound."""
+    sk = quantiles.QuantileSketch(alpha=0.01, max_bins=64)
+    rng = np.random.RandomState(2)
+    vals = 10.0 ** rng.uniform(-9, 3, 5000)
+    for v in vals:
+        sk.add(v)
+    assert len(sk._bins) <= 64
+    true = np.quantile(vals, 0.99)
+    assert abs(sk.quantile(0.99) - true) / true < 0.05
+
+
+def test_sketch_zero_and_weighted_observations():
+    sk = quantiles.QuantileSketch()
+    sk.add(0.0)                  # a queue wait can be exactly zero
+    sk.add(0.010, weight=99)     # TPOT imputes one gap to k tokens
+    assert sk.count == 100
+    assert sk.quantile(0.001) == 0.0
+    assert abs(sk.quantile(0.9) - 0.010) / 0.010 < 0.01
+
+
+def test_quantile_metric_is_gated_and_labelled():
+    qm = metrics.quantile("t.q_gate", "gate test")
+    paddle.set_flags({"enable_metrics": False})
+    qm.observe(1.0, route="a")
+    assert qm.count(route="a") == 0
+    paddle.set_flags({"enable_metrics": True})
+    qm.observe(1.0, route="a")
+    qm.observe(3.0, route="b")
+    assert qm.count(route="a") == 1 and qm.count(route="b") == 1
+    snap = metrics.snapshot()["t.q_gate"]
+    assert snap["type"] == "quantile"
+    by = {tuple(s["labels"].items()): s["value"] for s in snap["series"]}
+    assert by[(("route", "a"),)]["quantiles"]["0.5"] == 1.0
+    # snapshot must stay JSON-able (export_json contract)
+    json.dumps(snap)
+
+
+def test_histogram_percentile_interpolation():
+    """ISSUE 6 satellite: percentile(q) with linear interpolation inside
+    the bucket, observed-min/max clamping the edge buckets (+Inf)."""
+    h = metrics.histogram("t.hist_pct", "h", buckets=(1.0, 2.0, 4.0))
+    assert h.percentile(0.5) is None        # no data
+    for v in (0.5, 1.5, 3.0, 8.0):
+        h.observe(v)
+    # rank 2 of 4 falls at the top of the (1, 2] bucket
+    assert h.percentile(0.5) == pytest.approx(2.0)
+    # rank 3 tops the (2, 4] bucket
+    assert h.percentile(0.75) == pytest.approx(4.0)
+    # the +Inf bucket interpolates toward the observed max, not infinity
+    assert 4.0 < h.percentile(0.99) <= 8.0
+    assert h.percentile(1.0) == pytest.approx(8.0)
+    # min clamps the first bucket's lower edge
+    assert h.percentile(0.0) == pytest.approx(0.5)
+
+
+# ----------------------------------------------------- exporter (golden)
+
+GOLDEN = """\
+# HELP g_jobs test gauge
+# TYPE g_jobs gauge
+g_jobs 3
+# HELP lat_hist latencies
+# TYPE lat_hist histogram
+lat_hist_bucket{le="0.1"} 1
+lat_hist_bucket{le="1"} 3
+lat_hist_bucket{le="+Inf"} 4
+lat_hist_sum 5.85
+lat_hist_count 4
+# HELP req_total reqs with "quotes" and \\n
+# TYPE req_total counter
+req_total{path="a\\"b\\\\c\\nd"} 2
+req_total{path="plain"} 1
+# HELP ttft_q ttft sketch
+# TYPE ttft_q summary
+ttft_q{engine="e1",quantile="0.5"} 0.25
+ttft_q{engine="e1",quantile="0.9"} 0.25
+ttft_q{engine="e1",quantile="0.99"} 0.25
+ttft_q_sum{engine="e1"} 0.25
+ttft_q_count{engine="e1"} 1
+"""
+
+
+def test_prometheus_golden_rendering():
+    """Byte-exact exposition: name sanitization (dots -> underscores),
+    label escaping, cumulative buckets closed by +Inf, summary quantile
+    lines.  A single sketch observation makes its quantiles exact."""
+    reg = metrics.Registry()
+    c = reg.counter("req.total", 'reqs with "quotes" and \n')
+    c.inc(2, path='a"b\\c\nd')
+    c.inc(1, path="plain")
+    g = reg.gauge("g.jobs", "test gauge")
+    g.set(3)
+    h = reg.histogram("lat.hist", "latencies", buckets=(0.1, 1.0))
+    for v in (0.05, 0.3, 0.5, 5.0):
+        h.observe(v)
+    q = reg.quantile("ttft.q", "ttft sketch")
+    q.observe(0.25, engine="e1")
+    assert export.render_prometheus(reg) == GOLDEN
+
+
+def test_prometheus_skips_empty_instruments():
+    reg = metrics.Registry()
+    reg.counter("never.written", "no series")
+    assert export.render_prometheus(reg) == ""
+
+
+# ------------------------------------------------------------------ HTTP
+
+def test_http_endpoint_smoke():
+    """Start on port 0 (ephemeral), GET /metrics + /healthz + /requests,
+    assert content types and a known counter line."""
+    c = metrics.counter("t.http_smoke", "known counter")
+    c.inc(7, kind="x")
+    export.clear_requests()
+    export.record_request({"rid": 1, "outcome": "finished",
+                           "ttft_s": 0.01})
+    srv = obs_http.serve(0)
+    try:
+        assert srv.port > 0
+        r = urllib.request.urlopen(srv.url + "/metrics", timeout=10)
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        body = r.read().decode()
+        assert 't_http_smoke{kind="x"} 7' in body
+        r = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert r.headers["Content-Type"] == "application/json"
+        doc = json.loads(r.read())
+        assert doc["ok"] is True and doc["pid"] == os.getpid()
+        r = urllib.request.urlopen(srv.url + "/requests?n=5", timeout=10)
+        reqs = json.loads(r.read())
+        assert reqs and reqs[-1]["rid"] == 1
+        assert reqs[-1]["outcome"] == "finished"
+        # n=0 means none, not "the whole ring" (items[-0:] pitfall)
+        assert json.loads(urllib.request.urlopen(
+            srv.url + "/requests?n=0", timeout=10).read()) == []
+        # unknown path: 404, server stays alive
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url + "/nope", timeout=10)
+        assert urllib.request.urlopen(srv.url + "/healthz",
+                                      timeout=10).status == 200
+        # idempotent: a second serve() returns the same server
+        assert obs_http.serve(0) is srv
+    finally:
+        obs_http.stop()
+    assert obs_http.current() is None
+
+
+def test_start_from_flags_is_gated():
+    from paddle_tpu.flags import flag_guard
+    assert paddle.get_flags(["metrics_port"])["metrics_port"] == 0
+    assert obs_http.start_from_flags() is None      # default: off
+    with flag_guard(metrics_port=0):
+        assert obs_http.start_from_flags() is None
+
+
+# ------------------------------------------------------------------- CLI
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.observability.dump", *args],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+
+
+def test_dump_cli_prom_and_compile_report():
+    out = _run_cli("--prom")
+    assert out.returncode == 0, out.stderr[-500:]
+    # a fresh process has no recorded series; any output must be valid
+    # exposition lines (comment or name{...} value)
+    for line in out.stdout.splitlines():
+        assert line.startswith("#") or " " in line
+    out = _run_cli("--compile-report")
+    assert out.returncode == 0, out.stderr[-500:]
+    doc = json.loads(out.stdout)
+    assert doc["schema"] == "paddle_tpu.compile_report/v1"
+    assert doc["total_compiles"] == 0 and doc["by_callable"] == []
