@@ -73,6 +73,11 @@ from .framework.tensor_variants import SelectedRows, StringTensor  # noqa: F401,
 from . import metric  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
 from . import observability  # noqa: F401,E402
+# persistent XLA compilation cache: applied HERE, once, before any
+# program can compile (the backend-init seat) — FLAGS_compilation_cache_dir
+# set in the environment makes warm restarts skip XLA entirely
+from .core import compile_cache as _compile_cache  # noqa: E402
+_compile_cache.initialize_from_flags()
 from . import static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import distribution  # noqa: F401,E402

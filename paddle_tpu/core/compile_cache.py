@@ -1,0 +1,256 @@
+"""Persistent XLA compilation cache — the cold-start killer.
+
+ROADMAP item 1 / ISSUE 7 tentpole (a): BENCH_r02-r04 measured
+``compile_s`` of 117-370 s against 35 ms steps, so every restart (and at
+production scale restarts are *constant* — autoscaling, preemption,
+deploys) pays minutes of XLA work to rebuild byte-identical executables.
+jax already ships the fix — ``jax_compilation_cache_dir`` persists
+compiled executables keyed by (HLO, compile options, jax/XLA version,
+accelerator) — but it was applied ad hoc in two places with two
+different hard-coded directories.  This module is the ONE seat:
+
+* ``FLAGS_compilation_cache_dir`` (+ ``FLAGS_enable_compilation_cache``,
+  ``FLAGS_compilation_cache_min_entry_bytes``,
+  ``FLAGS_compilation_cache_min_compile_secs``) are the operator
+  surface; :func:`initialize_from_flags` applies them once at package
+  import — before any backend touch — and the flag ``on_change`` hooks
+  re-apply at runtime.
+* ``bench.py`` and ``incubate.autotune`` route through
+  :func:`configure` instead of private ``jax.config.update`` blocks.
+* Cache effectiveness is *observable*: jax's monitoring events feed the
+  ``compile.cache_hits_total`` / ``compile.cache_misses_total`` registry
+  counters (rendered by the Prometheus exporter under exactly those
+  names) and :func:`cache_report` — hits, misses, hit ratio, on-disk
+  entries/bytes, retrieval seconds — which
+  ``observability.compile_tracker.compile_report()`` embeds so one
+  ``--compile-report`` readout answers both "who compiled" and "did the
+  persistent cache absorb it".
+
+Cache keying (what makes an entry reusable): the key hashes the
+optimized HLO module, the compile options (donation, device assignment),
+and the jax/jaxlib + PJRT platform versions.  Same program + same
+toolchain + same accelerator ⇒ warm restarts skip XLA entirely; any of
+those changing ⇒ a clean miss, never a stale executable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from .. import flags as _flags
+from ..observability import metrics as _metrics
+
+__all__ = [
+    "configure", "initialize_from_flags", "cache_report", "active_dir",
+    "is_enabled", "DEFAULT_AUTOTUNE_DIR",
+]
+
+# the directory incubate.autotune's kernel.enable used to hard-code; it
+# is now just the fallback when FLAGS_compilation_cache_dir is unset
+DEFAULT_AUTOTUNE_DIR = os.path.join("~", ".paddle_tpu_cache")
+
+_M_HITS = _metrics.counter(
+    "compile.cache_hits_total", "persistent compilation-cache hits: an "
+    "XLA compile request served from FLAGS_compilation_cache_dir "
+    "instead of compiling (the warm-restart fast path)")
+_M_MISSES = _metrics.counter(
+    "compile.cache_misses_total", "persistent compilation-cache misses: "
+    "compile requests that ran XLA and (when above the entry-size/"
+    "compile-time floors) wrote a new cache entry")
+
+# jax monitoring event names (stable across the 0.4.x line we support)
+_EV_HIT = "/jax/compilation_cache/cache_hits"
+_EV_MISS = "/jax/compilation_cache/cache_misses"
+_EV_RETRIEVAL = "/jax/compilation_cache/cache_retrieval_time_sec"
+_EV_SAVED = "/jax/compilation_cache/compile_time_saved_sec"
+
+_lock = threading.RLock()
+_state: Dict[str, Any] = {
+    "dir": None,           # the directory actually applied to jax
+    "listeners": False,    # monitoring listeners installed once
+    "hits": 0, "misses": 0,
+    "retrieval_s": 0.0,    # wall seconds spent reading cache entries
+    "saved_s": 0.0,        # jax's estimate of compile seconds avoided
+}
+
+
+# ----------------------------------------------------------- monitoring
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _EV_HIT:
+        with _lock:
+            _state["hits"] += 1
+        _M_HITS.inc()
+    elif event == _EV_MISS:
+        with _lock:
+            _state["misses"] += 1
+        _M_MISSES.inc()
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event == _EV_RETRIEVAL:
+        with _lock:
+            _state["retrieval_s"] += float(duration)
+    elif event == _EV_SAVED:
+        # jax reports (estimated compile time - retrieval time); it can
+        # go slightly negative for tiny programs — keep the honest sum
+        with _lock:
+            _state["saved_s"] += float(duration)
+
+
+def _install_listeners() -> None:
+    """Register the jax monitoring listeners exactly once (they are
+    process-global; double registration would double-count)."""
+    with _lock:
+        if _state["listeners"]:
+            return
+        try:
+            from jax._src import monitoring
+            monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(_on_duration)
+            _state["listeners"] = True
+        except Exception:  # noqa: BLE001 - older/newer jax: cache still
+            pass           # works, only the hit/miss evidence is lost
+
+
+# ---------------------------------------------------------- application
+
+def _config_update(name: str, value) -> bool:
+    import jax
+    try:
+        jax.config.update(name, value)
+        return True
+    except Exception:  # noqa: BLE001 - option name varies across jax
+        return False
+
+
+def configure(directory: Optional[str] = None, *,
+              min_entry_bytes: Optional[int] = None,
+              min_compile_secs: Optional[float] = None,
+              enable: Optional[bool] = None) -> Optional[str]:
+    """Apply the persistent-cache configuration to jax; returns the
+    active cache directory (None = disabled).
+
+    Every argument defaults to its flag
+    (``FLAGS_compilation_cache_dir`` etc.), so ``configure()`` with no
+    arguments is "apply whatever the flags say" — the idempotent call
+    sites in ``paddle_tpu/__init__``, ``bench.py`` and
+    ``incubate.autotune`` all reduce to that.  The FLAG stays the source
+    of truth across re-applies: callers that want a directory to survive
+    later flag changes must set ``FLAGS_compilation_cache_dir`` (as
+    ``bench.py`` and autotune do), not just pass ``directory=``.  Safe
+    to call before OR after backend init: ``jax.config`` updates are
+    plain config state and the cache is consulted per compile request.
+    """
+    # flag reads happen OUTSIDE _lock: flags.set_flags holds the flags
+    # lock while its on_change hook enters configure(), so taking the
+    # locks here in the opposite order would be an AB-BA deadlock
+    if enable is None:
+        enable = bool(_flags.get_flag("enable_compilation_cache"))
+    if directory is None:
+        directory = str(_flags.get_flag("compilation_cache_dir"))
+    if min_entry_bytes is None:
+        min_entry_bytes = int(
+            _flags.get_flag("compilation_cache_min_entry_bytes"))
+    if min_compile_secs is None:
+        min_compile_secs = float(
+            _flags.get_flag("compilation_cache_min_compile_secs"))
+    with _lock:
+        directory = directory or None
+        if not enable:
+            directory = None
+        if directory:
+            directory = os.path.abspath(os.path.expanduser(directory))
+            os.makedirs(directory, exist_ok=True)
+        _config_update("jax_compilation_cache_dir", directory)
+        if directory:
+            _config_update("jax_persistent_cache_min_compile_time_secs",
+                           float(min_compile_secs))
+            _config_update("jax_persistent_cache_min_entry_size_bytes",
+                           int(min_entry_bytes))
+        # jax LATCHES cache-in-use at the first compile of the process
+        # (and pins the cache object to the dir it initialized with):
+        # without a reset, enabling after anything compiled is silently
+        # ignored, and disabling keeps feeding a stale dir.  Return it
+        # to pristine so the next compile re-reads the config we just
+        # wrote.
+        try:
+            from jax._src import compilation_cache as _jax_cc
+            _jax_cc.reset_cache()
+        except Exception:  # noqa: BLE001 - private across jax versions
+            pass
+        _state["dir"] = directory
+    if directory:
+        _install_listeners()
+    return directory
+
+
+def initialize_from_flags() -> Optional[str]:
+    """One-shot apply at package import (the "backend init" seat: it
+    runs before the first program can possibly compile).  A no-op when
+    ``FLAGS_compilation_cache_dir`` is empty, so a user driving
+    ``jax_compilation_cache_dir`` directly is never overridden."""
+    if not str(_flags.get_flag("compilation_cache_dir")):
+        return None
+    return configure()
+
+
+def flags_changed(_value=None) -> None:
+    """on_change hook for every compilation_cache_* flag: re-apply.
+    Only acts once a directory is in play (set now or set before), so
+    merely flipping the min-size flags pre-enable stays a no-op."""
+    if str(_flags.get_flag("compilation_cache_dir")) or _state["dir"]:
+        configure()
+
+
+# -------------------------------------------------------------- readout
+
+def active_dir() -> Optional[str]:
+    """The cache directory currently applied to jax (None = disabled)."""
+    with _lock:
+        return _state["dir"]
+
+
+def is_enabled() -> bool:
+    return active_dir() is not None
+
+
+def cache_report() -> Dict[str, Any]:
+    """Cache effectiveness, process-local counters + on-disk totals:
+    ``{enabled, dir, hits, misses, hit_ratio, entries, bytes,
+    retrieval_seconds, compile_seconds_saved}``.  Embedded in
+    ``compile_tracker.compile_report()`` and the ``--compile-report``
+    CLI so hit ratio reads next to the compile ledger it explains."""
+    with _lock:
+        d = _state["dir"]
+        hits, misses = _state["hits"], _state["misses"]
+        retrieval_s, saved_s = _state["retrieval_s"], _state["saved_s"]
+    entries = 0
+    total_bytes = 0
+    if d and os.path.isdir(d):
+        try:
+            for fname in os.listdir(d):
+                path = os.path.join(d, fname)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                total_bytes += size
+                if not fname.endswith("-atime"):  # jax's access stamps
+                    entries += 1
+        except OSError:
+            pass
+    requests = hits + misses
+    return {
+        "enabled": d is not None,
+        "dir": d,
+        "hits": hits,
+        "misses": misses,
+        "hit_ratio": round(hits / requests, 4) if requests else None,
+        "entries": entries,
+        "bytes": total_bytes,
+        "retrieval_seconds": round(retrieval_s, 4),
+        "compile_seconds_saved": round(saved_s, 4),
+    }

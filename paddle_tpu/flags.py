@@ -229,6 +229,51 @@ define_flag("dataloader_retry_backoff_s", 0.05,
             "base backoff seconds between DataLoader fetch retries "
             "(doubles per attempt)")
 
+# Cold start (core/compile_cache.py, inference/serving.py ISSUE 7):
+# persistent XLA compilation cache + serving AOT warmup + pad ladders.
+def _compile_cache_flag_changed(_value):
+    from .core import compile_cache as _cc
+    _cc.flags_changed()
+
+
+define_flag("compilation_cache_dir", "",
+            "directory of the persistent XLA compilation cache "
+            "(jax_compilation_cache_dir), applied once at import and "
+            "re-applied on change; warm restarts then skip XLA "
+            "compilation for every already-seen program.  Empty (the "
+            "default) leaves jax's own configuration untouched",
+            on_change=_compile_cache_flag_changed)
+define_flag("enable_compilation_cache", True,
+            "master switch for the persistent compilation cache; 0 "
+            "keeps FLAGS_compilation_cache_dir inert (and detaches an "
+            "already-applied dir on change)",
+            on_change=_compile_cache_flag_changed)
+define_flag("compilation_cache_min_entry_bytes", -1,
+            "smallest serialized executable worth persisting "
+            "(jax_persistent_cache_min_entry_size_bytes); -1 (the "
+            "default) caches everything — restart-to-first-token wants "
+            "even the small serving programs warm",
+            on_change=_compile_cache_flag_changed)
+define_flag("compilation_cache_min_compile_secs", 0.0,
+            "smallest compile wall time worth persisting "
+            "(jax_persistent_cache_min_compile_time_secs); 0.0 (the "
+            "default) caches everything",
+            on_change=_compile_cache_flag_changed)
+define_flag("serving_warmup", False,
+            "ServingEngine.run() calls warmup() before admitting "
+            "traffic: precompile the full program grid the engine can "
+            "ever dispatch (every pad bucket x tick size x decode "
+            "variant), so post-warmup traffic triggers ZERO compiles; "
+            "stats()['warmup'] reports warmup_s and program count")
+define_flag("serving_pad_buckets", "",
+            "comma-separated ascending prompt pad-bucket ladder for the "
+            "serving engine (e.g. '64,256,1024'), clamped to the block "
+            "table; one source of truth shared by admission padding, "
+            "worst-case block accounting and the warmup grid.  Empty "
+            "(the default) keeps the power-of-two ladder.  Prompts "
+            "beyond the ladder fall back to the power-of-two bucket "
+            "(one blamed compile names the new L_pad)")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

@@ -3,8 +3,15 @@
 Parity: `python/paddle/incubate/autotune.py:24` set_config (kernel /
 layout / dataloader tuning).  TPU seat: XLA owns kernel autotuning; the
 knobs with real effect here are the persistent compilation cache
-(kernel.enable) and dataloader tuning (accepted and recorded — the
-io.DataLoader picks worker counts itself on this host).
+(kernel.enable — saved autotune results ride the cached executables) and
+dataloader tuning (accepted and recorded — the io.DataLoader picks
+worker counts itself on this host).
+
+kernel.enable routes through :mod:`paddle_tpu.core.compile_cache` — the
+ONE cache-dir source of truth (``FLAGS_compilation_cache_dir``; this
+module's legacy ``~/.paddle_tpu_cache`` survives only as the fallback
+when the flag is unset).  ``get_config()`` reports the directory
+actually applied.
 """
 
 from __future__ import annotations
@@ -37,13 +44,19 @@ def set_config(config=None):
             _config[k].update(v)
     if _config["kernel"]["enable"]:
         # XLA's kernel autotune runs unconditionally; the persistent
-        # compile cache is the knob that saves its results across runs
-        import jax
+        # compile cache is the knob that saves its results across runs.
+        # Setting the FLAG (not just jax.config) keeps one source of
+        # truth: later flag changes re-apply rather than silently
+        # detaching the dir enabled here.
         try:
-            import os
-            d = os.path.join(os.path.expanduser("~"), ".paddle_tpu_cache")
-            os.makedirs(d, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir", d)
+            from .. import flags as _flags
+            from ..core import compile_cache as _cc
+            if not str(_flags.get_flag("compilation_cache_dir")):
+                _flags.set_flags({
+                    "compilation_cache_dir": _cc.DEFAULT_AUTOTUNE_DIR})
+            else:
+                _cc.configure()
+            _config["kernel"]["cache_dir"] = _cc.active_dir()
         except Exception:  # noqa: BLE001 - cache dir is best-effort
             pass
 

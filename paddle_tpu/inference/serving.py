@@ -38,6 +38,19 @@ Block accounting reserves the worst case (prompt + max_new_tokens) at
 admission, so a running sequence can never hit pool exhaustion
 mid-flight (no preemption needed — the reference scheduler's "no-evict"
 configuration).
+
+Cold start (ISSUE 7): the set of programs the engine can EVER dispatch
+is small and enumerable — one tick program per {steps_per_tick, 1-step
+tail} (greedy and sampled share it: sampling params are device inputs
+and ``lax.cond`` compiles both branches), the host-sampling k=1 decode
+program (``FLAGS_serving_device_sampling`` is read at dispatch, so both
+variants warm), and one prefill program per pad bucket.  The pad buckets come from ONE ladder
+(``FLAGS_serving_pad_buckets`` or the power-of-two default, clamped to
+the block table) shared by admission padding, worst-case block
+accounting, and :meth:`ServingEngine.warmup`, which walks exactly that
+grid — AOT ``.lower().compile()`` where it works, an inert dummy-input
+execution otherwise — so with ``FLAGS_serving_warmup=1`` the compile
+tracker records ZERO events once ``run()`` admits traffic.
 """
 
 from __future__ import annotations
@@ -212,7 +225,8 @@ class ServingEngine:
     def __init__(self, model, max_batch: int = 4,
                  max_context: Optional[int] = None, block_size: int = 64,
                  num_blocks: Optional[int] = None,
-                 steps_per_tick: int = 1):
+                 steps_per_tick: int = 1,
+                 pad_buckets=None):
         # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
         # host round trip harvests k tokens per slot (the tunnel's RTT
         # otherwise caps serving at ~1/RTT steps); admissions join at
@@ -269,6 +283,21 @@ class ServingEngine:
         self._tick_fns = {}
         self._prefill_fns = {}
         self._last_harvest_t = None
+        # the pad-bucket ladder: ONE source of truth for "which prompt
+        # shapes exist" — admission padding, worst-case accounting, and
+        # the warmup grid all read it (snapshot at construction; the
+        # flag is process-wide but a running engine's grid must not
+        # shift under an already-taken warmup)
+        if pad_buckets is None:
+            pad_buckets = _flags.get_flag("serving_pad_buckets")
+        ladder = self._parse_pad_buckets(pad_buckets)
+        cap = self.nb_per_seq * self.bs
+        if ladder:
+            ladder = tuple(sorted({min(b, cap) for b in ladder}))
+        else:
+            ladder = self._default_ladder()
+        self.pad_ladder = ladder
+        self._warmup_info = None
 
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
@@ -390,15 +419,147 @@ class ServingEngine:
              ("block_size", self.bs)))
         return fn
 
+    # -------------------------------------------------------------- warmup
+    def _warm_call(self, fn, args, aot, install):
+        """Consume one program's compile during warmup.
+
+        AOT path: ``.lower().compile()`` the inner jit function, run the
+        executable once on the inert dummy args (validates the call
+        convention and threads the donated pools through), and install a
+        shim that calls the compiled executable directly — later traffic
+        never re-enters jit tracing at all.  Anything raising falls back
+        to a plain dummy-input call of the wrapped program, which marks
+        its `wrap_first_call` tracker entry compiled the ordinary way.
+        Returns (program output, used_aot)."""
+        inner = getattr(fn, "__wrapped__", None)
+        mark = getattr(fn, "_mark_compiled", None)
+        if aot and inner is not None and mark is not None \
+                and hasattr(inner, "lower"):
+            try:
+                t0 = time.perf_counter()
+                compiled = inner.lower(*args).compile()
+                out = compiled(*args)
+                mark(time.perf_counter() - t0)
+
+                def shim(*a, _c=compiled):
+                    return _c(*a)
+                shim.__wrapped__ = inner
+                install(shim)
+                return out, True
+            except Exception:  # noqa: BLE001 - AOT is an optimization;
+                pass           # the jit path below always works
+        return fn(*args), False
+
+    def warmup(self, aot: bool = True) -> dict:
+        """Precompile the COMPLETE program grid this engine can ever
+        dispatch, before traffic arrives: one tick program per tick size
+        in {steps_per_tick, 1} (greedy and sampled decode share each —
+        per-slot sampling params are device inputs and both `lax.cond`
+        branches compile), the host-sampling k=1 decode program, and one
+        prefill program per pad-ladder bucket.  BOTH sampling variants
+        warm regardless of the current ``FLAGS_serving_device_sampling``
+        value: the flag is read live at every dispatch, so a mid-run
+        flip must not route traffic to an un-warmed program.  Dummy
+        inputs are inert: all-zero tables and seq_lens route every
+        write to the reserved scratch block 0 and hold every slot
+        inactive, so warmup is safe even mid-flight.
+
+        Idempotent; returns (and stashes for ``stats()``) ``{warmup_s,
+        programs, aot_programs, grid}``.  After warmup, traffic over the
+        ladder triggers ZERO compile-tracker events — the acceptance
+        criterion ``FLAGS_serving_warmup=1`` buys."""
+        if self._warmup_info is not None:
+            return self._warmup_info
+        t0 = time.perf_counter()
+        param_vals = [self._sd[k]._value for k in self._keys]
+        saved = dict((k, self._sd[k]._value) for k in self._keys)
+        B, nb = self.B, self.nb_per_seq
+        z = lambda shape, dt: jnp.zeros(shape, dt)  # noqa: E731
+        grid = []
+        n_aot = 0
+        try:
+            samp = (z((B,), jnp.bool_), jnp.ones((B,), jnp.float32),
+                    z((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+                    z((B,), jnp.uint32), z((B,), jnp.int32))
+            sched = (z((B, nb), jnp.int32), z((B,), jnp.int32),
+                     z((B,), jnp.int32))
+            for k in sorted({self.steps_per_tick, 1}, reverse=True):
+                out, was_aot = self._warm_call(
+                    self._tick_program(k),
+                    (param_vals, self.pools) + sched + samp, aot,
+                    lambda f, _k=k: self._tick_fns.__setitem__(_k, f))
+                self.pools = out[1]
+                n_aot += was_aot
+                grid.append({"program": "tick", "steps_per_tick": k})
+            out, was_aot = self._warm_call(
+                self._decode_program(),
+                (param_vals, self.pools) + sched, aot,
+                lambda f: setattr(self, "_decode_fn", f))
+            self.pools = out[2]
+            n_aot += was_aot
+            grid.append({"program": "decode", "steps_per_tick": 1})
+            for L_pad in self.pad_ladder:
+                out, was_aot = self._warm_call(
+                    self._prefill_program(L_pad),
+                    (param_vals, self.pools, z((1, nb), jnp.int32),
+                     z((1, L_pad), jnp.int32), jnp.int32(1)), aot,
+                    lambda f, _L=L_pad:
+                        self._prefill_fns.__setitem__(_L, f))
+                self.pools = out[1]
+                n_aot += was_aot
+                grid.append({"program": "prefill", "L_pad": L_pad})
+        finally:
+            for kk, v in saved.items():
+                self._sd[kk]._value = v
+        self._warmup_info = {
+            "warmup_s": round(time.perf_counter() - t0, 4),
+            "programs": len(grid), "aot_programs": n_aot, "grid": grid}
+        return self._warmup_info
+
     # ----------------------------------------------------------- scheduler
+    @staticmethod
+    def _parse_pad_buckets(spec) -> tuple:
+        """FLAGS_serving_pad_buckets / the `pad_buckets` kwarg: a
+        comma-separated string or int sequence; () = use the default
+        power-of-two ladder."""
+        if spec is None:
+            return ()
+        if isinstance(spec, str):
+            vals = [int(s) for s in
+                    (c.strip() for c in spec.split(",")) if s]
+        else:
+            vals = [int(v) for v in spec]
+        if any(v <= 0 for v in vals):
+            raise ValueError(
+                f"serving_pad_buckets entries must be positive: {vals}")
+        return tuple(vals)
+
+    def _default_ladder(self) -> tuple:
+        """Power-of-two buckets from block_size up, clamped to the block
+        table — exactly the shapes the legacy `_pad_bucket` formula
+        (min(pow2, capacity)) could produce, materialized so the warmup
+        grid can enumerate them."""
+        cap = self.nb_per_seq * self.bs
+        out, b = [], max(self.bs, 1)
+        while b < cap:
+            out.append(b)
+            b *= 2
+        out.append(cap)
+        return tuple(out)
+
     def _pad_bucket(self, L: int) -> int:
-        """Prompt pad length: power-of-two bucket (bounds the number of
-        compiled prefill programs) CLAMPED to the block-table capacity.
-        Without the clamp a non-power-of-two max_context (e.g. 96 with
-        block_size 16, prompt 70 -> bucket 128) makes need_now exceed
-        nb_per_seq and admission crashes mid-flight leaking blocks
-        (ADVICE r5 #1/#4).  Both bounds are block multiples, so the min
-        is too."""
+        """Prompt pad length: smallest ladder bucket that fits (bounds
+        the number of compiled prefill programs), CLAMPED to the
+        block-table capacity.  Without the clamp a non-power-of-two
+        max_context (e.g. 96 with block_size 16, prompt 70 -> bucket
+        128) makes need_now exceed nb_per_seq and admission crashes
+        mid-flight leaking blocks (ADVICE r5 #1/#4).  A prompt beyond a
+        CUSTOM ladder's top rung falls back to the power-of-two bucket
+        (still clamped): the request is served, at the price of one
+        compile the tracker blames on the new L_pad."""
+        for b in self.pad_ladder:
+            if L <= b:
+                return b
         return min(_bucket(L, self.bs), self.nb_per_seq * self.bs)
 
     def add_request(self, req: Request):
@@ -837,7 +998,10 @@ class ServingEngine:
         detokenize overlap instead of strictly alternating."""
         from ..observability import http as _http
         _http.start_from_flags()   # no-op unless FLAGS_metrics_port > 0
-        pend = None
+        if self._warmup_info is None \
+                and _flags.get_flag("serving_warmup"):
+            self.warmup()          # compile the whole grid BEFORE
+        pend = None                # traffic waits on a program build
         while True:
             if pend is None:
                 if not (self.waiting or self._active_slots()):
@@ -869,7 +1033,11 @@ class ServingEngine:
                "active": len(self._active_slots()),
                "running": running,
                "waiting": len(self.waiting),
-               "queue_depth": running + len(self.waiting)}
+               "queue_depth": running + len(self.waiting),
+               "pad_buckets": list(self.pad_ladder)}
+        if self._warmup_info is not None:
+            out["warmup"] = {k: self._warmup_info[k] for k in
+                             ("warmup_s", "programs", "aot_programs")}
         # p50/p90/p99 straight off the streaming sketches — process-wide
         # (the sketches aggregate every engine in the process, like the
         # /metrics scrape they feed)

@@ -145,7 +145,18 @@ def wrap_first_call(fn: Callable, name: str, signature: Any) -> Callable:
         compiled[0] = True
         record_compile(name, signature, time.perf_counter() - t0)
         return out
+
+    def mark_compiled(seconds: float) -> None:
+        """The program was compiled OUTSIDE the wrapper (serving warmup
+        AOT-lowers the inner jit fn): record the event now and make the
+        wrapper's future calls free of first-call bookkeeping."""
+        if not compiled[0]:
+            compiled[0] = True
+            record_compile(name, signature, seconds)
     wrapper.__wrapped__ = fn
+    wrapper._compile_name = name
+    wrapper._compile_signature = signature
+    wrapper._mark_compiled = mark_compiled
     return wrapper
 
 
@@ -163,12 +174,21 @@ def compile_report(top: int = 10,
         evs = list(_events)
     per.sort(key=lambda e: (-e["seconds_total"], e["fn"]))
     recompiles = [e for e in evs if e["compile_no"] > 1]
-    return {"schema": "paddle_tpu.compile_report/v1",
-            "total_compiles": sum(e["compiles"] for e in per),
-            "total_seconds": round(sum(e["seconds_total"] for e in per), 4),
-            "by_callable": per[:top],
-            "recompiles": recompiles[-events:],
-            "recent_events": evs[-events:]}
+    report = {"schema": "paddle_tpu.compile_report/v1",
+              "total_compiles": sum(e["compiles"] for e in per),
+              "total_seconds": round(sum(e["seconds_total"] for e in per), 4),
+              "by_callable": per[:top],
+              "recompiles": recompiles[-events:],
+              "recent_events": evs[-events:]}
+    try:
+        # the other half of the compile story (ISSUE 7): did the
+        # persistent cache absorb these compiles?  hit ratio + on-disk
+        # entries/bytes land next to the ledger they explain
+        from ..core import compile_cache as _cc
+        report["persistent_cache"] = _cc.cache_report()
+    except Exception:  # noqa: BLE001 - report must render regardless
+        pass
+    return report
 
 
 def total_compiles() -> int:

@@ -203,6 +203,43 @@ def test_request_trace_rung_schema():
     assert 0.0 <= val["trace_overhead_pct"] < 25.0
 
 
+def test_cold_start_rung_schema():
+    """Pin the ISSUE 7 `cold_start` rung's record schema: two
+    subprocesses sharing a cache dir time first-program-ready cold vs
+    warm (regression key `cold_start_warm_speedup`), plus the serving
+    warmup evidence — programs compiled, warmup seconds, and ZERO
+    compile-tracker events once traffic ran.  Smoke scale on CPU."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_cs", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_cold_start(ctx)
+    rec = {"rung": "cold_start", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("cold_start").smoke
+    assert bench._REGRESSION_KEYS["cold_start"] == "cold_start_warm_speedup"
+    assert val["cold_first_program_s"] > 0
+    assert val["warm_first_program_s"] > 0
+    # the acceptance claim: the warm restart read executables from the
+    # shared cache instead of compiling (hit evidence + a real speedup;
+    # noisy CI keeps the bound modest — trend rides the regression key)
+    assert val["cold_cache_misses"] > 0 and val["warm_cache_hits"] > 0
+    assert val["cold_start_warm_speedup"] > 1.0
+    # the serving half: a warmed engine compiles NOTHING under traffic
+    assert val["serving_warmup_programs"] >= 4
+    assert val["serving_warmup_s"] > 0
+    assert val["post_warmup_compiles"] == 0
+
+
 def test_fused_optimizer_rung_schema():
     """Pin the round-7 `fused_optimizer` rung's record schema: the
     regression key (`speedup`) and the per-cell dispatch/wall fields the

@@ -402,12 +402,16 @@ def test_bench_cpu_smoke_subprocess(tmp_path):
     """CI/tooling satellite: `python bench.py --rungs cpu --smoke` runs in
     seconds on CPU, exits 0, and every rung emits schema-valid JSON."""
     art = tmp_path / "smoke.json"
-    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_BUDGET_S="400")
+    # budget/timeout sized for the grown smoke ladder (cold_start spawns
+    # two nested interpreters) on a co-tenant-loaded box; the bench's
+    # own budget gate degrades tail rungs to reason:"budget" before the
+    # hard timeout can fire
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_BUDGET_S="450")
     env.pop("XLA_FLAGS", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--rungs", "cpu", "--smoke", "--out", str(art)],
-        capture_output=True, text=True, timeout=390, cwd=REPO, env=env)
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     headline = json.loads(proc.stdout.strip().splitlines()[-1])
     assert headline["metric"] == "gpt124m_train_tokens_per_sec"
