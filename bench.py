@@ -80,6 +80,7 @@ _REGRESSION_KEYS = {
     "fault_tolerance": "save_mb_per_s",
     "request_trace": "trace_overhead_pct",
     "cold_start": "cold_start_warm_speedup",
+    "analyze": "analyze_files_per_sec",
 }
 
 _ENV_PROBE = {}
@@ -1309,6 +1310,42 @@ print(json.dumps({"first_program_ready_s": round(ready_s, 4),
             "serving_warmup_s": w["warmup_s"],
             "serving_warmup_programs": w["programs"],
             "post_warmup_compiles": int(post)}
+
+
+@harness.register_rung("analyze", est_cold_s=40, smoke=True)
+def bench_analyze(ctx):
+    """ISSUE 8 rung: graft-lint wall time + findings over the tree.
+
+    The tier-1 ratchet runs the analyzer on every CI pass, so its
+    runtime is a build-latency budget: `analyze_files_per_sec` is the
+    regression key (collapsing means a rule went quadratic), and the
+    findings counts make the ratchet trajectory visible across rounds —
+    `findings_new` must be 0 on a committed tree."""
+    from paddle_tpu.tooling.analyze import (DEFAULT_BASELINE_PATH,
+                                            analyze_paths, load_baseline,
+                                            new_findings)
+    from paddle_tpu.tooling.analyze.core import iter_source_files
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    # walk the tree ONCE: the explicit file list goes straight into
+    # analyze_paths (file paths short-circuit its own walk), so the
+    # timed interval is pure parse+rules — the budget the ratchet pays
+    files = iter_source_files([os.path.join(repo, "paddle_tpu"),
+                               os.path.join(repo, "bench.py")])
+    n_files = len(files)
+    t0 = time.perf_counter()
+    findings = analyze_paths(files, root=repo)
+    wall = time.perf_counter() - t0
+    new = new_findings(findings, load_baseline(DEFAULT_BASELINE_PATH))
+    per_rule = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return {"analyze_wall_s": round(wall, 3),
+            "analyze_files": n_files,
+            "analyze_files_per_sec": round(n_files / max(wall, 1e-9), 1),
+            "findings_total": len(findings),
+            "findings_new": len(new),
+            "findings_per_rule": per_rule}
 
 
 # ====================================================================== main

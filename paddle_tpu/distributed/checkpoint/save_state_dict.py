@@ -119,7 +119,13 @@ def plan_save(state_dict: Dict, rank: Optional[int] = None) -> SavePlan:
         md.global_shape[key] = global_shape
         entries = md.state_dict_metadata.setdefault(key, [])
         for i, (offset, arr) in enumerate(_collect_local_pieces(key, val)):
-            arr = np.ascontiguousarray(arr)
+            # a REAL copy, not ascontiguousarray: np.asarray of a CPU jax
+            # array (and a passthrough numpy leaf) is a zero-copy VIEW of
+            # the live buffer, so the async writer would read whatever
+            # the optimizer donates/overwrites next — the documented
+            # "caller may donate after plan_save returns" contract needs
+            # the snapshot to own its bytes (graft-lint R002/R003 class)
+            arr = np.array(arr, copy=True, order="C")
             entries.append(LocalTensorMetadata(offset, tuple(arr.shape),
                                                str(arr.dtype)))
             md.storage_metadata[LocalTensorIndex(key, offset)] = file_name

@@ -35,6 +35,11 @@ class _Flag:
 
 _registry: Dict[str, _Flag] = {}
 _lock = threading.RLock()
+# serializes on_change hook execution (NOT value reads/writes): hooks run
+# outside _registry's lock so they may take module locks, but two racing
+# set_flags must not interleave the same hook — RLock so a hook may
+# itself call set_flags
+_hook_lock = threading.RLock()
 
 
 def _coerce(ftype: type, raw: Any) -> Any:
@@ -74,25 +79,47 @@ def get_flag(name: str) -> Any:
 
 
 def set_flags(flags: Dict[str, Any]) -> None:
+    hooks = []
     with _lock:
+        # validate AND coerce every value before assigning any: a bad
+        # name or an uncoercible value must not leave the dict half-
+        # applied (assigned values whose hooks then never run)
+        coerced = []
         for name, v in flags.items():
             if name not in _registry:
                 raise ValueError(f"Unknown flag: {name!r}")
-        deferred_exc = None
-        for name, v in flags.items():
             f = _registry[name]
-            f.value = _coerce(f.type, v)
+            coerced.append((f, _coerce(f.type, v)))
+        for f, v in coerced:
+            f.value = v
             if f.on_change is not None:
-                try:
-                    f.on_change(f.value)
-                except BaseException as e:
-                    # every flag in the dict must still be assigned (a
-                    # flag_guard restore can't be left half-applied); the
-                    # first hook failure is re-raised after
-                    if deferred_exc is None:
-                        deferred_exc = e
-        if deferred_exc is not None:
-            raise deferred_exc
+                hooks.append((f.on_change, f.name))
+    # on_change hooks run OUTSIDE the registry lock (graft-lint R005, the
+    # PR 7 AB-BA class): a hook that acquires a module lock, while any
+    # other thread holds that module lock and READS a flag, deadlocked
+    # when hooks ran under _lock.  (Calling set_flags/flag_guard while
+    # holding such a module lock is still an inversion — R005 flags it.)
+    # Values are therefore visible to concurrent readers before their
+    # hooks finish — hooks must tolerate that (they always had to: reads
+    # never waited for hooks' effects on OTHER modules).  _hook_lock
+    # serializes hook execution, and each hook receives the flag's value
+    # re-read INSIDE that critical section: two racing set_flags run
+    # their hooks in some order, and whichever runs last applies the
+    # registry's final value — hook-applied state converges instead of
+    # ending inverted (assign-A, assign-B, hook-B, hook-A).  Every flag
+    # is assigned before any hook runs (a flag_guard restore can't be
+    # left half-applied); the first hook failure is re-raised after all
+    # hooks ran.
+    deferred_exc = None
+    with _hook_lock:
+        for hook, name in hooks:
+            try:
+                hook(get_flag(name))
+            except BaseException as e:
+                if deferred_exc is None:
+                    deferred_exc = e
+    if deferred_exc is not None:
+        raise deferred_exc
 
 
 class flag_guard:
@@ -273,6 +300,20 @@ define_flag("serving_pad_buckets", "",
             "(the default) keeps the power-of-two ladder.  Prompts "
             "beyond the ladder fall back to the power-of-two bucket "
             "(one blamed compile names the new L_pad)")
+
+def _jaxsan_flag_changed(enabled):
+    from .testing import jaxsan as _jaxsan
+    _jaxsan._sync_enabled(enabled)
+
+
+define_flag("enable_jaxsan", False,
+            "runtime trace-safety sanitizer (testing.jaxsan): checksum "
+            "host buffers fed to in-flight compiled programs (verify at "
+            "harvest; in-place mutation raises JaxsanError) and poison "
+            "donated leaves after donated program calls so use-after-"
+            "donate fails loudly even on CPU where donation is a no-op; "
+            "off (the default) = a single-boolean-check no-op",
+            on_change=_jaxsan_flag_changed)
 
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,

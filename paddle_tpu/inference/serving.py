@@ -67,6 +67,7 @@ import numpy as np
 
 from .. import flags as _flags
 from ..framework.tensor import Tensor
+from ..testing import jaxsan as _jaxsan
 from ..observability import compile_tracker as _compile
 from ..observability import export as _export
 from ..observability import flight_recorder as _flight
@@ -191,10 +192,10 @@ class _PendingTick:
     a second dispatch may slice its last column first (overlap)."""
 
     __slots__ = ("active", "k", "toks", "logits", "reqs", "t0",
-                 "device_sampling", "overlapped", "step_no")
+                 "device_sampling", "overlapped", "step_no", "san")
 
     def __init__(self, active, k, toks, logits, reqs, t0,
-                 device_sampling, step_no):
+                 device_sampling, step_no, san=None):
         self.active = active
         self.k = k
         self.toks = toks
@@ -204,6 +205,7 @@ class _PendingTick:
         self.device_sampling = device_sampling
         self.overlapped = False
         self.step_no = step_no
+        self.san = san
 
 
 def _bucket(n: int, minimum: int) -> int:
@@ -643,9 +645,14 @@ class ServingEngine:
         saved = dict((k, self._sd[k]._value) for k in self._keys)
         try:
             try:
+                # the table row must be a PRIVATE copy (graft-lint R002):
+                # jnp.asarray of the numpy view aliases zero-copy, and
+                # both the error path and the pad-block release below
+                # mutate self.tables before np.asarray(row) syncs — an
+                # in-flight prefill would read the mutated block ids
                 row, self.pools = self._prefill_program(L_pad)(
                     param_vals, self.pools,
-                    jnp.asarray(self.tables[slot:slot + 1]),
+                    jnp.asarray(self.tables[slot:slot + 1].copy()),
                     jnp.asarray(prompt), jnp.int32(L))
             finally:
                 for k, v in saved.items():
@@ -825,8 +832,12 @@ class ServingEngine:
         # before the program consumes them, and jax device_put may alias
         # numpy memory zero-copy — without the copy, this tick's own
         # post-dispatch bookkeeping (and any overlapped next tick's
-        # block draws) would race the in-flight program's reads
-        dev = lambda a: jnp.asarray(a.copy())              # noqa: E731
+        # block draws) would race the in-flight program's reads.  The
+        # copy is routed through the jaxsan shield (a plain .copy() with
+        # FLAGS_enable_jaxsan off): checksummed at dispatch, verified at
+        # harvest, so reintroducing the aliasing bug fails loudly
+        san = _jaxsan.token("serving.tick")
+        dev = lambda a: jnp.asarray(_jaxsan.shield(san, a))  # noqa: E731
         last = last_tok_dev if last_tok_dev is not None \
             else dev(self.last_tok)
         logits = None
@@ -859,7 +870,7 @@ class ServingEngine:
         return _PendingTick(active=active, k=k, toks=toks, logits=logits,
                             reqs=list(self.slot_req), t0=t0,
                             device_sampling=device_sampling,
-                            step_no=self.steps)
+                            step_no=self.steps, san=san)
 
     def _harvest_tick(self, pend) -> None:
         """Block on the tick's device tokens and feed the requests:
@@ -873,6 +884,10 @@ class ServingEngine:
             # error (OOM, XlaRuntimeError) surfaces HERE, not at the
             # guarded dispatch — keep the post-mortem dump coverage
             toks = np.asarray(pend.toks)
+        # the program has materialized: every host buffer fed at dispatch
+        # must still hash to its dispatch-time checksum (jaxsan; no-op
+        # unless FLAGS_enable_jaxsan)
+        _jaxsan.verify(pend.san)
         logits_np = None
         toks_before = self.tokens_out
         sampled = 0

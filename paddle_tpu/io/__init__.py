@@ -466,25 +466,58 @@ class DataLoader:
 
     # ----------------------------------------------- device-side prefetch
     @staticmethod
-    def _to_device(obj):
+    def _to_device(obj, copy: bool = False):
         """Force every batch leaf onto the device (jax.device_put for any
         numpy stragglers; collate output is usually already device-backed
         Tensors).  Runs on the prefetch thread so the H2D DMA of batch
-        t+1 overlaps step t's compute."""
+        t+1 overlaps step t's compute.
+
+        ``copy=True`` snapshots numpy leaves first (graft-lint R002): a
+        CUSTOM collate_fn (or an IterableDataset generator) may hand back
+        a buffer the dataset owns and refills per batch — device_put
+        aliases numpy zero-copy on CPU and transfers asynchronously on
+        TPU, so without a private copy the in-flight step reads whatever
+        the producer wrote next.  Our own default collate always
+        allocates fresh arrays, and multiprocess batches crossed a
+        pickle/shared-memory boundary, so those skip the copy."""
         import jax
         if isinstance(obj, Tensor):
             if isinstance(obj._value, np.ndarray):
-                obj._value = jax.device_put(obj._value)
+                src = obj._value.copy() if copy else obj._value
+                obj._value = jax.device_put(src)
             return obj
         if isinstance(obj, np.ndarray):
-            return jax.device_put(obj)
+            return jax.device_put(obj.copy() if copy else obj)
         if isinstance(obj, tuple):
-            return tuple(DataLoader._to_device(x) for x in obj)
+            return tuple(DataLoader._to_device(x, copy) for x in obj)
         if isinstance(obj, list):
-            return [DataLoader._to_device(x) for x in obj]
+            return [DataLoader._to_device(x, copy) for x in obj]
         if isinstance(obj, dict):
-            return {k: DataLoader._to_device(v) for k, v in obj.items()}
+            return {k: DataLoader._to_device(v, copy)
+                    for k, v in obj.items()}
         return obj
+
+    def _loader_mode(self) -> str:
+        """The ONE mode-selection decision `_iter_inner` dispatches on:
+        'iterable' | 'inline' | 'multiprocess' | 'thread'."""
+        import os
+        if self._iterable_mode:
+            return "iterable"
+        if self.num_workers <= 0:
+            return "inline"
+        if os.environ.get("PADDLE_TPU_THREAD_LOADER") == "1":
+            return "thread"
+        return "multiprocess"
+
+    def _batches_need_copy(self) -> bool:
+        """Do prefetched batches carry buffers of unknown ownership?
+        True when a user collate_fn produced them in-process (it may
+        reuse/refill one buffer per batch — the PR 3 aliasing class);
+        False when our default collate allocated them or they crossed a
+        worker-process boundary (pickle/shm = already a private copy)."""
+        if self._custom_collate is None:
+            return False
+        return self._loader_mode() != "multiprocess"
 
     def _iter_device_prefetch(self, inner):
         """Double-buffered background fetch: batch fetch + collate +
@@ -492,6 +525,7 @@ class DataLoader:
         queue of 2 = the classic double buffer).  Abandoning the iterator
         mid-epoch stops the thread, closes the inner iterator (so
         multiprocess workers terminate) and drains the queue."""
+        copy = self._batches_need_copy()
         q: "queue.Queue" = queue.Queue(maxsize=2)
         sentinel = object()
         stop = threading.Event()
@@ -509,7 +543,7 @@ class DataLoader:
         def producer():
             try:
                 for batch in inner:
-                    if not put(self._to_device(batch)):
+                    if not put(self._to_device(batch, copy)):
                         return  # consumer gone
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 error.append(e)
@@ -549,15 +583,15 @@ class DataLoader:
         return inner
 
     def _iter_inner(self):
-        if self._iterable_mode:
+        mode = self._loader_mode()
+        if mode == "iterable":
             yield from self._iter_iterable()
             return
-        if self.num_workers <= 0:
+        if mode == "inline":
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
-        import os
-        if os.environ.get("PADDLE_TPU_THREAD_LOADER") != "1":
+        if mode == "multiprocess":
             yield from self._iter_multiprocess()
             return
         # threaded prefetch pipeline

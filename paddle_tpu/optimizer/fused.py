@@ -378,6 +378,25 @@ def _execute(opt, plan, scaler, grads_override=None):
             opt._accumulators["master_weight"][id(p)] = new_m[i]
         for n, s in zip(state_names, new_s[i]):
             opt._accumulators[n][id(p)] = s
+    _poison_donated_inputs(params, masters, states, new_p, new_m, new_s)
+
+
+def _poison_donated_inputs(params, masters, states, new_p, new_m, new_s):
+    """jaxsan (FLAGS_enable_jaxsan, default off): the fused program
+    donates params/masters/states on TPU — on CPU donation is ignored,
+    so a stale reference to a pre-step buffer reads plausible bytes in
+    every CPU test and garbage in production.  Poisoning the superseded
+    input leaves right after the rebind turns that latent use-after-
+    donate into an immediate loud jax deleted-array error.  Leaves the
+    program passed through by identity are kept alive."""
+    from ..testing import jaxsan as _jaxsan
+    if not _jaxsan.enabled():
+        return
+    old = list(params) + [m for m in masters if m is not None]
+    for st in states:
+        old.extend(st)
+    keep = list(new_p) + list(new_m) + [s for st in new_s for s in st]
+    _jaxsan.poison_donated(old, site="optimizer.fused_step", keep=keep)
 
 
 def try_step(opt, work) -> bool:

@@ -65,13 +65,20 @@ class Executor:
         if not program.steps and not fetch_list:
             return []  # startup programs are empty by design
         env = program.replay(feed or {})
-        return _fetch(program, env, fetch_list, return_numpy)
+        outs = _fetch(program, env, fetch_list)
+        if return_numpy:
+            return [np.asarray(o._value) for o in outs]
+        return outs
 
     def close(self):
         pass
 
 
-def _fetch(program, env, fetch_list, return_numpy):
+def _fetch(program, env, fetch_list):
+    """Resolve fetch targets to live Tensors.  Returns Tensors ONLY: this
+    runs inside CompiledProgram's to_static capture, where a numpy
+    materialization would concretize a tracer (graft-lint R001) — the
+    eager callers convert to numpy after the program returns."""
     outs = []
     for f in fetch_list or []:
         t = None
@@ -87,7 +94,7 @@ def _fetch(program, env, fetch_list, return_numpy):
             raise KeyError(
                 f"fetch target {f!r} was not produced by this program "
                 "(fetch the tensor returned inside its program_guard)")
-        outs.append(np.asarray(t._value) if return_numpy else t)
+        outs.append(t)
     return outs
 
 
@@ -111,7 +118,7 @@ class CompiledProgram:
         if key not in self._compiled:
             def fn(*arrays):
                 env = self.program.replay(dict(zip(names, arrays)))
-                return _fetch(self.program, env, fetch, return_numpy=False)
+                return _fetch(self.program, env, fetch)
             self._compiled[key] = to_static(fn, full_graph=True)
         outs = self._compiled[key](
             *[np.asarray(feed[n]) for n in names])

@@ -1,0 +1,226 @@
+"""jaxsan: a runtime trace-safety sanitizer (chaos-harness style).
+
+graft-lint's R002/R003 rules catch the *shape* of the two silent-
+corruption classes statically; jaxsan turns surviving instances into
+immediate loud failures at run time, gated on ``FLAGS_enable_jaxsan``
+(default OFF — the disabled paths are a single boolean check, same cost
+model as the chaos harness and the metrics gate):
+
+* **In-flight host-buffer checksums** (the PR 3 race class).  A dispatch
+  site takes a :func:`token`, routes every host buffer it hands the
+  device through :func:`shield` (which checksums it), and calls
+  :func:`verify` at its harvest/sync point.  Any in-place mutation of a
+  fed buffer between dispatch and harvest raises :class:`JaxsanError`
+  naming the site — instead of the program silently reading the mutated
+  bytes.  The serving tick loop is wired through this.
+
+* **Donated-leaf poisoning** (the use-after-donate class).  On CPU, jax
+  *ignores* donation, so code that reads a donated buffer after the call
+  works in every CPU test and corrupts on TPU.  :func:`poison_donated`
+  deletes the donated jax buffers the moment the program has returned
+  (``Array.delete()`` — any later use raises jax's "deleted" error) and
+  garbage-fills donated numpy mirrors, so the latent bug fails loudly in
+  CPU CI.  The fused optimizer step is wired through this.
+
+* **Deliberate re-injection** (tests).  :func:`unsafe_alias` makes every
+  shielded dispatch skip its private copy — reintroducing the exact
+  aliasing race the private copies fix — so a test can prove the
+  checksums actually catch the race class (the same arm-then-observe
+  discipline as `testing.chaos`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from contextlib import contextmanager
+from typing import Any, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "JaxsanError", "enabled", "token", "shield", "feed", "verify",
+    "poison_donated", "unsafe_alias", "alias_armed",
+]
+
+
+class JaxsanError(RuntimeError):
+    """A sanitized invariant was violated (this is the loud failure)."""
+
+
+# Synced from FLAGS_enable_jaxsan (flags.py installs the hook).
+_ENABLED = False
+_ALIAS_ARMED = False
+_lock = threading.Lock()
+
+
+def _sync_enabled(value: bool) -> None:
+    global _ENABLED
+    _ENABLED = bool(value)
+
+
+def _init_from_flag() -> None:
+    try:
+        from .. import flags as _flags
+        _sync_enabled(_flags.get_flag("enable_jaxsan"))
+    except Exception:  # noqa: BLE001 - flag not registered yet
+        pass
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def _counter(name: str, help_: str):
+    from ..observability import metrics as _metrics
+    return _metrics.counter(name, help_)
+
+
+def _m_checks():
+    return _counter("jaxsan.checks", "host-buffer checksum verifications "
+                    "(labels: site)")
+
+
+def _m_violations():
+    return _counter("jaxsan.violations", "sanitizer trips, by kind="
+                    "inflight_mutation|use_after_donate (each also "
+                    "raised as JaxsanError)")
+
+
+def _m_poisoned():
+    return _counter("jaxsan.poisoned", "donated leaves poisoned after a "
+                    "donated program call (labels: site)")
+
+
+def _digest(arr: np.ndarray) -> bytes:
+    return hashlib.sha1(np.ascontiguousarray(arr).tobytes()).digest()
+
+
+class Token:
+    """One dispatch's fed-buffer ledger: (buffer, checksum) pairs."""
+
+    __slots__ = ("site", "entries", "verified")
+
+    def __init__(self, site: str):
+        self.site = site
+        self.entries: List[Tuple[np.ndarray, bytes]] = []
+        self.verified = False
+
+    def feed(self, arr: np.ndarray) -> None:
+        self.entries.append((arr, _digest(arr)))
+
+
+def token(site: str) -> Optional[Token]:
+    """Open a ledger for one dispatch; None when the sanitizer is off
+    (every other entry point is None-safe, so instrumented sites carry
+    zero cost disabled)."""
+    return Token(site) if _ENABLED else None
+
+
+def feed(tok: Optional[Token], arr):
+    """Checksum ``arr`` into the ledger (numpy only; passthrough)."""
+    if tok is not None and isinstance(arr, np.ndarray):
+        tok.feed(arr)
+    return arr
+
+
+def shield(tok: Optional[Token], arr: np.ndarray) -> np.ndarray:
+    """The private-copy chokepoint for host buffers handed to an async
+    program.  Normal operation returns ``arr.copy()`` (the R002 fix) and
+    checksums what the device actually received; under
+    :func:`unsafe_alias` the copy is SKIPPED — the original buffer is
+    fed and checksummed, so the scheduler's own post-dispatch
+    bookkeeping trips :func:`verify` exactly the way the real race
+    corrupted real programs."""
+    if tok is None:
+        return arr.copy()
+    buf = arr if _ALIAS_ARMED else arr.copy()
+    tok.feed(buf)
+    return buf
+
+
+def verify(tok: Optional[Token]) -> None:
+    """The harvest-side check: every fed buffer must still hash to its
+    dispatch-time checksum."""
+    if tok is None or tok.verified:
+        return
+    tok.verified = True
+    _m_checks().inc(len(tok.entries), site=tok.site)
+    for i, (arr, dig) in enumerate(tok.entries):
+        if _digest(arr) != dig:
+            _m_violations().inc(kind="inflight_mutation")
+            raise JaxsanError(
+                f"jaxsan [{tok.site}]: host buffer #{i} "
+                f"(shape {arr.shape}, {arr.dtype}) was mutated in place "
+                "while the dispatched program could still read it — the "
+                "device input must be a private copy, or the mutation "
+                "must wait for the harvest sync")
+
+
+@contextmanager
+def unsafe_alias():
+    """TEST-ONLY: make shielded dispatch sites feed the live buffer
+    (no private copy), deliberately reintroducing the aliasing race so
+    the checksums can be proven to catch it."""
+    global _ALIAS_ARMED
+    with _lock:
+        prev, _ALIAS_ARMED = _ALIAS_ARMED, True
+    try:
+        yield
+    finally:
+        with _lock:
+            _ALIAS_ARMED = prev
+
+
+def alias_armed() -> bool:
+    return _ALIAS_ARMED
+
+
+def poison_donated(leaves: Iterable[Any], site: str = "",
+                   keep: Iterable[Any] = ()) -> int:
+    """Poison buffers that a just-returned program DONATED (or would
+    donate on an accelerator): jax arrays are deleted — any later read
+    raises jax's deleted-array error with this call in the stack — and
+    numpy mirrors are garbage-filled so stale reads are unmissable.
+
+    ``keep`` guards passthrough aliasing: a leaf that IS one of the
+    program's outputs (identity) is never poisoned.  Tracers are skipped
+    (under a to_static capture the donation is the captured program's
+    business, not this eager call's).  Returns the number of leaves
+    poisoned."""
+    if not _ENABLED:
+        return 0
+    import jax
+    keep_ids = {id(k) for k in keep}
+    seen = set()
+    n = 0
+    for leaf in leaves:
+        if leaf is None or id(leaf) in keep_ids or id(leaf) in seen:
+            continue
+        seen.add(id(leaf))
+        if isinstance(leaf, jax.core.Tracer):
+            continue
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.delete()
+                n += 1
+            except Exception:  # noqa: BLE001 - already deleted/committed
+                pass
+        elif isinstance(leaf, np.ndarray) and leaf.flags.writeable:
+            if np.issubdtype(leaf.dtype, np.floating):
+                leaf.fill(np.nan)
+            elif np.issubdtype(leaf.dtype, np.unsignedinteger):
+                # .min would be 0 — plausible-looking token/block ids;
+                # the poison must be unmissable
+                leaf.fill(np.iinfo(leaf.dtype).max)
+            elif np.issubdtype(leaf.dtype, np.integer):
+                leaf.fill(np.iinfo(leaf.dtype).min)
+            elif leaf.dtype == np.bool_:
+                leaf.fill(True)
+            n += 1
+    if n:
+        _m_poisoned().inc(n, site=site or "unknown")
+    return n
+
+
+_init_from_flag()

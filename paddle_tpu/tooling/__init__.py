@@ -1,0 +1,4 @@
+"""Developer tooling that ships with the package but stays off every
+runtime path: the `analyze` static analyzer (graft-lint) lives here so CI,
+the bench harness and contributors all run the exact same checks
+(`python -m paddle_tpu.tooling.analyze`)."""

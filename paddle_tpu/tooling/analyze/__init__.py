@@ -1,0 +1,44 @@
+"""graft-lint: a JAX/TPU-aware static analyzer for this codebase.
+
+Usage:
+    python -m paddle_tpu.tooling.analyze              # ratchet vs baseline
+    python -m paddle_tpu.tooling.analyze --list       # every finding
+    python -m paddle_tpu.tooling.analyze --update-baseline
+
+Rules (suppress inline with ``# graft-lint: disable=RXXX``):
+
+==== =========================== =======================================
+R001 host-sync-in-traced-code    `.item()`/`float()`/`np.asarray` on a
+                                 value inside a jitted / to_static-ed /
+                                 program-registered function
+R002 alias-unsafe-device-input   numpy buffer handed to the device then
+                                 mutated in place in the same scope
+                                 (the PR 3 in-flight aliasing race)
+R003 use-after-donate            buffer passed at a donated argnum and
+                                 referenced afterwards (silent on CPU,
+                                 corruption on TPU)
+R004 trace-time-flag-read        FLAGS_* / get_flag inside a traced body
+                                 — frozen at trace, dead at dispatch
+R005 lock-order-inversion        `with <lock>` nesting cycles across
+                                 modules, incl. the flags lock edges
+                                 (the PR 7 AB-BA deadlock class)
+R006 unsynced-timing             perf_counter interval around an async
+                                 dispatch with no block_until_ready —
+                                 measures enqueue, not compute
+==== =========================== =======================================
+
+The committed ratchet baseline (`baseline.json` next to this package)
+makes tier-1 fail on any NEW finding while grandfathering the audited
+existing ones — the codebase can only get cleaner.
+"""
+
+from .core import (DEFAULT_BASELINE_PATH, Finding, analyze_paths,
+                   baseline_counts, load_baseline, new_findings,
+                   save_baseline)
+from .rules import RULES, get_rules
+
+__all__ = [
+    "Finding", "analyze_paths", "RULES", "get_rules",
+    "load_baseline", "save_baseline", "baseline_counts", "new_findings",
+    "DEFAULT_BASELINE_PATH",
+]

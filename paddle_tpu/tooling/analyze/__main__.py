@@ -1,0 +1,135 @@
+"""graft-lint CLI.
+
+Default mode is the RATCHET: analyze, diff against the committed
+baseline, print only findings beyond it, exit non-zero iff any exist.
+That is what tier-1 (`tests/test_static_analysis.py`) and CI run; a
+clean tree exits 0 even though the baseline carries audited findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .core import (DEFAULT_BASELINE_PATH, analyze_paths, load_baseline,
+                   new_findings, save_baseline)
+
+
+def default_paths() -> list:
+    """The package tree plus the repo-level drivers when present."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))          # .../paddle_tpu
+    repo = os.path.dirname(pkg)
+    paths = [pkg]
+    for extra in ("bench.py", "__graft_entry__.py"):
+        p = os.path.join(repo, extra)
+        if os.path.exists(p):
+            paths.append(p)
+    return paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tooling.analyze",
+        description="graft-lint: JAX/TPU-aware static analysis "
+                    "(rules R001-R006, ratcheted baseline)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "paddle_tpu package + bench.py)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE_PATH,
+                   help="ratchet baseline path (default: the committed "
+                        "tooling/analyze/baseline.json)")
+    p.add_argument("--check-baseline", action="store_true",
+                   help="explicit ratchet mode (the default behavior; "
+                        "kept as a named flag for CI readability)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the current findings "
+                        "and exit 0")
+    p.add_argument("--list", action="store_true",
+                   help="print EVERY finding (ignores the baseline); "
+                        "exit non-zero iff any findings")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of text lines")
+    args = p.parse_args(argv)
+
+    paths = args.paths or default_paths()
+    root = os.path.commonpath([os.path.abspath(p) for p in paths])
+    if os.path.isfile(root):
+        root = os.path.dirname(root)
+    # repo-relative paths in findings/baseline: anchor at the repo root
+    # (parent of the package) when analyzing the default tree
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    if os.path.commonpath([root, pkg]) == pkg or root == pkg:
+        root = os.path.dirname(pkg)
+
+    rules = args.rules.split(",") if args.rules else None
+    errors: list = []
+    t0 = time.perf_counter()
+    try:
+        findings = analyze_paths(paths, root=root, rules=rules,
+                                 collect_errors=errors)
+    except (FileNotFoundError, ValueError) as e:
+        # bad path / non-.py file / unknown rule id: loud exit, never a
+        # vacuous green run
+        print(str(e), file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    if args.update_baseline:
+        # a rule- or path-filtered run sees only a SLICE of the
+        # findings; writing it over the committed baseline would
+        # silently drop every other rule's/file's grandfathered entries
+        # and fail the next full ratchet.  (A custom --baseline is the
+        # escape hatch for scoped/experimental baselines.)
+        if rules is not None:
+            print("graft-lint: refusing --update-baseline with --rules "
+                  "(the baseline must cover ALL rules; rerun without "
+                  "--rules)", file=sys.stderr)
+            return 2
+        if args.paths and args.baseline == DEFAULT_BASELINE_PATH:
+            print("graft-lint: refusing --update-baseline of the "
+                  "committed baseline from an explicit path subset; "
+                  "rerun with no paths (full default tree) or pass a "
+                  "custom --baseline", file=sys.stderr)
+            return 2
+        save_baseline(args.baseline, findings)
+        print(f"graft-lint: baseline updated with {len(findings)} "
+              f"finding(s) -> {args.baseline}")
+        return 0
+
+    if args.list:
+        shown = findings
+        verdict_new = findings
+    else:
+        baseline = load_baseline(args.baseline)
+        shown = new_findings(findings, baseline)
+        verdict_new = shown
+
+    if args.json:
+        print(json.dumps({
+            "schema": "paddle_tpu.graft-lint/v1",
+            "elapsed_s": round(elapsed, 3),
+            "total_findings": len(findings),
+            "new_findings": [f.to_json() for f in verdict_new],
+            "parse_errors": errors,
+        }, indent=1))
+    else:
+        for f in shown:
+            print(f.format())
+        for e in errors:
+            print(f"graft-lint: parse error (skipped): {e}",
+                  file=sys.stderr)
+        mode = "total" if args.list else "new (beyond baseline)"
+        print(f"graft-lint: {len(shown)} {mode} finding(s), "
+              f"{len(findings)} total, {elapsed:.2f}s")
+    return 1 if verdict_new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,6 +240,39 @@ def test_cold_start_rung_schema():
     assert val["post_warmup_compiles"] == 0
 
 
+def test_analyze_rung_schema():
+    """Pin the ISSUE 8 `analyze` rung's record schema: graft-lint wall
+    seconds + findings counts over the tree, regression key
+    `analyze_files_per_sec` (the analyzer runs in tier-1 on every CI
+    pass, so its runtime is a build-latency budget).  Smoke on CPU."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_an", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_analyze(ctx)
+    rec = {"rung": "analyze", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("analyze").smoke
+    assert bench._REGRESSION_KEYS["analyze"] == "analyze_files_per_sec"
+    # the 30s acceptance budget, with headroom for noisy CI boxes
+    assert 0 < val["analyze_wall_s"] < 30.0
+    assert val["analyze_files"] > 100            # really saw the tree
+    assert val["analyze_files_per_sec"] > 0
+    # a committed tree is clean against its committed baseline
+    assert val["findings_new"] == 0
+    assert val["findings_total"] >= 0
+    assert isinstance(val["findings_per_rule"], dict)
+
+
 def test_fused_optimizer_rung_schema():
     """Pin the round-7 `fused_optimizer` rung's record schema: the
     regression key (`speedup`) and the per-cell dispatch/wall fields the

@@ -1,0 +1,760 @@
+"""graft-lint (`paddle_tpu/tooling/analyze`) + the jaxsan runtime
+sanitizer (`paddle_tpu/testing/jaxsan`), ISSUE 8.
+
+Three layers:
+1. per-rule fixture snippets — each rule catches its bad fixture, passes
+   its good twin, and honors inline `# graft-lint: disable=RXXX`;
+2. the ratchet — baselined findings pass, injected new findings fail,
+   `--update-baseline` refreshes, and the REAL tree is clean against the
+   committed baseline in under the 30s budget (this test IS the tier-1
+   wiring of `python -m paddle_tpu.tooling.analyze --check-baseline`);
+3. jaxsan — the in-flight checksum catches a deliberately re-injected
+   aliasing race (serving, `unsafe_alias`), donated-leaf poisoning makes
+   use-after-donate loud on CPU, and the real-finding fixes from this PR
+   each keep a regression test.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.flags import flag_guard
+from paddle_tpu.tooling.analyze import (DEFAULT_BASELINE_PATH,
+                                        analyze_paths, load_baseline,
+                                        new_findings, save_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+
+def run_src(tmp_path, files, rules=None):
+    """Write {name: source} into tmp_path and analyze it."""
+    tmp_path.mkdir(parents=True, exist_ok=True)
+    for name, src in files.items():
+        (tmp_path / name).write_text(src)
+    return analyze_paths([str(tmp_path)], root=str(tmp_path), rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ================================================== per-rule fixtures
+
+R001_BAD = """\
+import jax
+import numpy as np
+
+def step(x):
+    return float(np.asarray(x).sum())
+
+prog = jax.jit(step)
+"""
+
+R001_GOOD = """\
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def step(x):
+    return jnp.sum(x)
+
+prog = jax.jit(step)
+
+def host_read(x):          # NOT traced: host syncs are fine here
+    return float(np.asarray(x).sum())
+"""
+
+
+def test_r001_catches_host_sync_in_traced_fn(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R001_BAD})
+    assert "R001" in rules_of(fs)
+    f = next(f for f in fs if f.rule == "R001")
+    assert f.path == "mod.py" and f.line == 5 and f.symbol == "step"
+
+
+def test_r001_passes_good_twin(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R001_GOOD}, rules=["R001"]) == []
+
+
+def test_r001_nested_helper_called_from_traced_is_traced(tmp_path):
+    src = """\
+import jax
+import numpy as np
+
+def helper(v):
+    return v.item()
+
+def step(x):
+    return helper(x * 2)
+
+prog = jax.jit(step)
+"""
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R001"])
+    assert len(fs) == 1 and fs[0].symbol == "helper"
+
+
+R002_BAD = """\
+import jax.numpy as jnp
+
+def tick(buf):
+    dev = jnp.asarray(buf)
+    buf[0] = 1
+    return dev
+"""
+
+R002_GOOD = """\
+import jax.numpy as jnp
+
+def tick(buf):
+    dev = jnp.asarray(buf.copy())
+    buf[0] = 1
+    return dev
+"""
+
+
+def test_r002_catches_mutation_after_handoff(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R002_BAD}, rules=["R002"])
+    assert len(fs) == 1 and fs[0].line == 5
+
+
+def test_r002_private_copy_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R002_GOOD}, rules=["R002"]) == []
+
+
+def test_r002_cross_method_view_race(tmp_path):
+    """The PR 3 / `_try_admit` shape: a self-buffer VIEW handed to the
+    device in one method, the base mutated by another method."""
+    bad = """\
+import jax.numpy as jnp
+
+class Engine:
+    def dispatch(self):
+        return jnp.asarray(self.tables[0:1])
+
+    def evict(self, slot):
+        self.tables[slot, :] = 0
+"""
+    good = bad.replace("self.tables[0:1]", "self.tables[0:1].copy()")
+    fs = run_src(tmp_path / "bad", {"mod.py": bad}, rules=["R002"])
+    assert len(fs) == 1 and "evict" in fs[0].message
+    assert run_src(tmp_path / "good", {"mod.py": good},
+                   rules=["R002"]) == []
+
+
+R003_BAD = """\
+import jax
+
+def step(x):
+    return x * 2
+
+prog = jax.jit(step, donate_argnums=(0,))
+
+def run(x):
+    y = prog(x)
+    return x + y
+"""
+
+R003_GOOD = """\
+import jax
+
+def step(x):
+    return x * 2
+
+prog = jax.jit(step, donate_argnums=(0,))
+
+def run(x):
+    y = prog(x)
+    x = y
+    return x + 1
+"""
+
+
+def test_r003_catches_use_after_donate(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R003_BAD}, rules=["R003"])
+    assert len(fs) == 1
+    assert "argnum 0" in fs[0].message and fs[0].line == 10
+
+
+def test_r003_rebind_from_outputs_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R003_GOOD}, rules=["R003"]) == []
+
+
+def test_r003_multiline_donated_call_not_self_flagged(tmp_path):
+    """A donated call reformatted across lines must not count its own
+    argument expression as a post-call use."""
+    src = R003_GOOD.replace("    y = prog(x)", "    y = prog(\n        x)")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R003"]) == []
+
+
+R004_BAD = """\
+import jax
+
+def step(x):
+    if get_flag("serving_overlap"):
+        return x * 2
+    return x * FLAGS_scale
+
+prog = jax.jit(step)
+"""
+
+R004_GOOD = """\
+import jax
+
+def step(x, overlap):
+    return x * 2 if overlap else x
+
+def dispatch(x):
+    overlap = get_flag("serving_overlap")   # live at dispatch
+    return jax.jit(step, static_argnums=(1,))(x, overlap)
+"""
+
+
+def test_r004_catches_trace_time_flag_read(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R004_BAD}, rules=["R004"])
+    assert len(fs) == 2                      # get_flag AND FLAGS_* read
+    assert {f.line for f in fs} == {4, 6}
+
+
+def test_r004_dispatch_time_read_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R004_GOOD}, rules=["R004"]) == []
+
+
+R005_BAD = """\
+import threading
+
+_lock = threading.Lock()
+
+
+def enable():
+    with _lock:
+        set_flags({"x": 1})     # runs on_change hooks under _lock...
+
+
+def _hook(v):
+    with _lock:                 # ...and the hook wants _lock: AB-BA
+        pass
+
+define_flag("x", 1, on_change=_hook)
+"""
+
+R005_GOOD = """\
+import threading
+
+_lock = threading.Lock()
+
+
+def configure():
+    with _lock:
+        return get_flag("x")    # reads are a leaf lock: always legal
+
+
+def enable():
+    set_flags({"x": 1})         # mutation OUTSIDE the module lock
+
+
+def _hook(v):
+    with _lock:
+        pass
+
+define_flag("x", 1, on_change=_hook)
+"""
+
+
+def test_r005_catches_lock_order_cycle(tmp_path):
+    fs = run_src(tmp_path, {"cachemod.py": R005_BAD}, rules=["R005"])
+    assert len(fs) >= 2                      # both edges of the cycle
+    assert any("flags._hook_lock" in f.message for f in fs)
+
+
+def test_r005_set_outside_lock_and_reads_under_lock_are_clean(tmp_path):
+    assert run_src(tmp_path, {"cachemod.py": R005_GOOD},
+                   rules=["R005"]) == []
+
+
+def test_r005_callback_defined_under_lock_is_not_an_edge(tmp_path):
+    """A function DEFINED inside a with-lock block does not run under
+    that lock — no false cycle against a legitimate reverse nesting."""
+    src = """\
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def make_callback():
+    with lock_a:
+        def cb():
+            with lock_b:
+                pass
+        return cb
+
+
+def other():
+    with lock_b:
+        with lock_a:
+            pass
+"""
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R005"]) == []
+
+
+def test_cli_nonexistent_path_is_an_error(tmp_path):
+    """A typoed path must not make the ratchet pass vacuously on zero
+    files — missing paths, non-.py files and committed-baseline
+    overwrites from a path subset all exit loudly."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         str(tmp_path / "no_such_dir")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert out.returncode == 2
+    assert "no such path" in out.stderr
+    notpy = tmp_path / "data.txt"
+    notpy.write_text("hello")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze", str(notpy)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert out.returncode == 2 and "not a Python source" in out.stderr
+    # the committed baseline cannot be rewritten from a path subset
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         str(tmp_path / "ok.py"), "--update-baseline"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert out.returncode == 2 and "path subset" in out.stderr
+
+
+def test_set_flags_is_atomic_under_coercion_failure():
+    """A bad value anywhere in the dict must leave EVERY flag untouched
+    (and run no hooks) — a half-applied dict whose early hooks never ran
+    desyncs hook-applied module state from the registry."""
+    from paddle_tpu import flags as _flags
+    fired = []
+    _flags.define_flag("_test_atomic_a", 0, on_change=fired.append)
+    _flags.define_flag("_test_atomic_b", 0)
+    before = _flags.get_flag("_test_atomic_a")
+    with pytest.raises(ValueError):
+        _flags.set_flags({"_test_atomic_a": 7, "_test_atomic_b": "nope"})
+    assert _flags.get_flag("_test_atomic_a") == before
+    assert fired == []
+    _flags.set_flags({"_test_atomic_a": 7, "_test_atomic_b": 1})
+    assert fired == [7]
+
+
+R006_BAD = """\
+import time
+import jax
+
+prog = jax.jit(lambda x: x * 2)
+
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = prog(x)
+    return time.perf_counter() - t0
+"""
+
+R006_GOOD = """\
+import time
+import jax
+
+prog = jax.jit(lambda x: x * 2)
+
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = prog(x)
+    jax.block_until_ready(y)
+    return time.perf_counter() - t0
+"""
+
+
+def test_r006_catches_unsynced_timing(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R006_BAD}, rules=["R006"])
+    assert len(fs) == 1 and fs[0].line == 10
+
+
+def test_r006_synced_timing_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R006_GOOD}, rules=["R006"]) == []
+
+
+def test_r006_input_side_conversion_is_not_a_sync(tmp_path):
+    """np.asarray feeding the dispatch's INPUT runs before enqueue — it
+    must not be mistaken for the missing output sync; wrapping the
+    dispatch's OUTPUT does count."""
+    bad = R006_BAD.replace("    y = prog(x)",
+                           "    import numpy as np\n"
+                           "    y = prog(np.asarray(x))")
+    fs = run_src(tmp_path / "bad", {"mod.py": bad}, rules=["R006"])
+    assert len(fs) == 1
+    good = R006_BAD.replace("    y = prog(x)",
+                            "    import numpy as np\n"
+                            "    y = np.asarray(prog(x))")
+    assert run_src(tmp_path / "good", {"mod.py": good},
+                   rules=["R006"]) == []
+
+
+# ===================================================== suppressions
+
+def test_inline_suppression_same_line(tmp_path):
+    src = R002_BAD.replace(
+        "    buf[0] = 1", "    buf[0] = 1  # graft-lint: disable=R002")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R002"]) == []
+
+
+def test_suppression_on_preceding_comment_line(tmp_path):
+    src = R002_BAD.replace(
+        "    buf[0] = 1",
+        "    # graft-lint: disable=R002\n    buf[0] = 1")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R002"]) == []
+
+
+def test_suppression_disable_all_and_wrong_rule(tmp_path):
+    allsrc = R002_BAD.replace(
+        "    buf[0] = 1", "    buf[0] = 1  # graft-lint: disable=all")
+    assert run_src(tmp_path, {"mod.py": allsrc}, rules=["R002"]) == []
+    wrong = R002_BAD.replace(
+        "    buf[0] = 1", "    buf[0] = 1  # graft-lint: disable=R001")
+    assert len(run_src(tmp_path, {"mod.py": wrong}, rules=["R002"])) == 1
+
+
+def test_finding_format_is_stable(tmp_path):
+    import re
+    fs = run_src(tmp_path, {"mod.py": R002_BAD}, rules=["R002"])
+    assert re.match(r"^mod\.py:\d+:\d+: R002 \[.*\] ", fs[0].format())
+
+
+# ========================================================= ratchet
+
+def test_ratchet_baseline_pass_inject_fail_update(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R002_BAD})
+    baseline_path = tmp_path / "baseline.json"
+    save_baseline(str(baseline_path), fs)
+    # baselined finding: clean
+    assert new_findings(fs, load_baseline(str(baseline_path))) == []
+    # inject a NEW violation in another file: exactly it is reported
+    (tmp_path / "mod2.py").write_text(R003_BAD)
+    fs2 = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    fresh = new_findings(fs2, load_baseline(str(baseline_path)))
+    assert rules_of(fresh) == ["R003"]
+    # update-baseline refreshes: clean again
+    save_baseline(str(baseline_path), fs2)
+    assert new_findings(fs2, load_baseline(str(baseline_path))) == []
+
+
+def test_ratchet_fingerprints_survive_line_drift(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R002_BAD})
+    baseline_path = tmp_path / "baseline.json"
+    save_baseline(str(baseline_path), fs)
+    # prepend comments: every line number shifts, fingerprints must not
+    (tmp_path / "mod.py").write_text("# moved\n# around\n" + R002_BAD)
+    fs2 = analyze_paths([str(tmp_path)], root=str(tmp_path))
+    assert fs2[0].line != fs[0].line
+    assert new_findings(fs2, load_baseline(str(baseline_path))) == []
+
+
+def test_cli_clean_tree_exits_zero_and_violation_exits_nonzero(tmp_path):
+    """The acceptance contract: the committed baseline makes a clean run
+    exit 0; one injected violation exits non-zero."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    clean = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "0 new" in clean.stdout
+    (tmp_path / "violation.py").write_text(R001_BAD)
+    bad = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert "R001" in bad.stdout
+    # --update-baseline to a scratch file turns the same run green
+    scratch = tmp_path / "b.json"
+    upd = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         str(tmp_path), "--baseline", str(scratch), "--update-baseline"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert upd.returncode == 0
+    ok = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         str(tmp_path), "--baseline", str(scratch)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert ok.returncode == 0
+
+
+def test_tier1_ratchet_tree_is_clean_within_budget():
+    """THE tier-1 gate: graft-lint over the real tree vs the committed
+    baseline — any new finding fails CI here, and the run must fit the
+    30s acceptance budget."""
+    t0 = time.perf_counter()
+    findings = analyze_paths([PKG, os.path.join(REPO, "bench.py")],
+                             root=REPO)
+    elapsed = time.perf_counter() - t0
+    fresh = new_findings(findings, load_baseline(DEFAULT_BASELINE_PATH))
+    assert fresh == [], "new graft-lint findings (fix or baseline " \
+        "them):\n" + "\n".join(f.format() for f in fresh)
+    assert elapsed < 30.0, f"graft-lint took {elapsed:.1f}s (budget 30s)"
+
+
+# ================================================ jaxsan (runtime half)
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt3_tiny())
+    m.eval()
+    return m
+
+
+def test_jaxsan_checksum_catches_inflight_mutation_api():
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=True):
+        tok = jaxsan.token("unit.site")
+        buf = np.arange(8, dtype=np.int32)
+        fed = jaxsan.shield(tok, buf)
+        fed[3] = 99                       # mutate what the device sees
+        with pytest.raises(jaxsan.JaxsanError, match="unit.site"):
+            jaxsan.verify(tok)
+
+
+def test_jaxsan_disabled_is_noop_copy():
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=False):
+        assert jaxsan.token("x") is None
+        buf = np.arange(4)
+        out = jaxsan.shield(None, buf)
+        assert out is not buf and np.array_equal(out, buf)
+        jaxsan.verify(None)               # None-safe
+
+
+def test_jaxsan_serving_catches_reinjected_alias_race(model):
+    """Arm `unsafe_alias` (drop the private copies the PR 3 fix added)
+    and the scheduler's own post-dispatch bookkeeping must trip the
+    harvest checksum — the race class fails LOUD instead of corrupting
+    decode state."""
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.testing import jaxsan
+    p = np.asarray([5, 6, 7], np.int32)
+    with flag_guard(enable_jaxsan=True):
+        eng = ServingEngine(model, max_batch=2, max_context=64,
+                            block_size=16)
+        eng.add_request(Request(p, max_new_tokens=6))
+        with jaxsan.unsafe_alias():
+            with pytest.raises(jaxsan.JaxsanError, match="serving.tick"):
+                eng.run()
+
+
+def test_jaxsan_serving_clean_run_token_parity(model):
+    """With the sanitizer ON but no fault armed, serving behaves
+    bit-identically (the shield is the same private copy) and the
+    checksums all verify."""
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.observability import metrics as _metrics
+    p = np.asarray([5, 6, 7], np.int32)
+
+    def serve():
+        eng = ServingEngine(model, max_batch=2, max_context=64,
+                            block_size=16)
+        r = eng.add_request(Request(p, max_new_tokens=6))
+        eng.run()
+        return list(r.output_ids)
+
+    with flag_guard(enable_jaxsan=False):
+        plain = serve()
+    _metrics.reset()
+    with flag_guard(enable_jaxsan=True):
+        sanitized = serve()
+    assert sanitized == plain
+    snap = _metrics.snapshot()
+    checks = snap["jaxsan.checks"]["series"][0]["value"]
+    assert checks > 0
+    assert "jaxsan.violations" not in snap or not \
+        snap["jaxsan.violations"]["series"]
+
+
+def test_jaxsan_poison_makes_use_after_donate_loud():
+    """CPU ignores donation, so reading a donated buffer 'works' in CPU
+    tests; poisoned, it raises immediately."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=True):
+        prog = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+        x = jnp.arange(4.0)
+        y = prog(x)
+        n = jaxsan.poison_donated([x], site="unit.donate", keep=[y])
+        assert n == 1
+        with pytest.raises(RuntimeError):
+            np.asarray(x)                 # deleted buffer: loud
+        np.testing.assert_allclose(np.asarray(y), [1, 2, 3, 4])
+
+
+def test_jaxsan_fused_optimizer_poisons_stale_param_refs():
+    """The fused-optimizer contract (PR 4): params/masters/states are
+    donated to the one-step program.  Under jaxsan, a stale reference to
+    a pre-step buffer raises instead of silently reading pre-update
+    bytes; the optimizer itself keeps stepping normally."""
+    from paddle_tpu import nn, optimizer
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = optimizer.Adam(learning_rate=0.1,
+                         parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(2, 4).astype(np.float32))
+
+    def one_step():
+        loss = net(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    with flag_guard(enable_jaxsan=True, fused_optimizer=True):
+        one_step()                        # builds + runs fused program
+        stale = net.parameters()[0]._value
+        one_step()                        # donates/poisons `stale`
+        with pytest.raises(RuntimeError):
+            np.asarray(stale)
+        one_step()                        # still stepping fine
+    live = np.asarray(net.parameters()[0]._value)
+    assert np.all(np.isfinite(live))
+
+
+# ==================================== real-finding fix regressions
+
+def test_fixed_serving_and_executor_are_lint_clean():
+    """The two analyzer-surfaced fixes stay fixed: serving's prefill
+    table-row handoff (R002) and the executor fetch path (R001)."""
+    fs = analyze_paths(
+        [os.path.join(PKG, "inference", "serving.py"),
+         os.path.join(PKG, "static", "executor.py")], root=REPO)
+    assert [f for f in fs if f.rule in ("R001", "R002")] == []
+
+
+def test_plan_save_snapshot_owns_its_bytes():
+    """plan_save's documented contract — 'caller may donate after it
+    returns' — requires REAL copies: np.asarray of a CPU jax array is a
+    zero-copy view of the live buffer (the R002/R003 class this PR
+    fixed in distributed/checkpoint)."""
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.checkpoint.save_state_dict import \
+        plan_save
+    src = jnp.arange(16.0).reshape(4, 4)
+    t = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    t._value = src
+    rng_state = np.arange(8, dtype=np.int64)        # numpy leaf
+    plan = plan_save({"w": t, "rng": rng_state})
+    for arr in plan.payload.values():
+        assert not np.shares_memory(arr, np.asarray(src))
+        assert not np.shares_memory(arr, rng_state)
+    # the donation itself: delete the source buffer, snapshot survives
+    src.delete()
+    rng_state.fill(-1)
+    w = next(v for k, v in plan.payload.items() if k.startswith("w|"))
+    np.testing.assert_allclose(w, np.arange(16.0).reshape(4, 4))
+    r = next(v for k, v in plan.payload.items() if k.startswith("rng|"))
+    np.testing.assert_array_equal(r, np.arange(8))
+
+
+def test_dataloader_private_copies_for_reused_custom_collate_buffer():
+    """io/ prefetch fix (R002 class): a custom collate_fn that refills
+    ONE buffer per batch must not alias the in-flight device input —
+    every consumed batch keeps its own values even when the producer
+    thread runs ahead."""
+    from paddle_tpu import io
+
+    class Counting(io.Dataset):
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            return i
+
+    shared = np.zeros((2,), np.float32)
+
+    def reusing_collate(samples):
+        shared[:] = samples               # the footgun: one live buffer
+        return shared
+
+    loader = io.DataLoader(Counting(), batch_size=2,
+                           collate_fn=reusing_collate)
+    assert loader._batches_need_copy()
+    with flag_guard(dataloader_device_prefetch=True):
+        seen = []
+        for batch in loader:
+            time.sleep(0.05)              # let the producer run ahead
+            seen.append(np.asarray(batch).tolist())
+    assert seen == [[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]]
+    # default collate allocates fresh arrays: no copy tax
+    assert not io.DataLoader(Counting(),
+                             batch_size=2)._batches_need_copy()
+
+
+def test_set_flags_hooks_run_outside_registry_lock():
+    """R005 root-cause fix: an on_change hook that takes a module lock,
+    while another thread holds that module lock and reads a flag, must
+    NOT AB-BA deadlock (it did when hooks ran under the flags lock)."""
+    from paddle_tpu import flags as _flags
+    mod_lock = threading.Lock()
+    in_reader = threading.Event()
+    release_reader = threading.Event()
+
+    def hook(_v):
+        with mod_lock:
+            pass
+
+    _flags.define_flag("_test_r005_hook_flag", 0, on_change=hook)
+
+    read_val = []
+
+    def reader():
+        with mod_lock:
+            in_reader.set()
+            release_reader.wait(5)
+            read_val.append(_flags.get_flag("_test_r005_hook_flag"))
+
+    done = []
+
+    def setter():
+        _flags.set_flags({"_test_r005_hook_flag": 1})
+        done.append(True)
+
+    rt = threading.Thread(target=reader, daemon=True)
+    st = threading.Thread(target=setter, daemon=True)
+    rt.start()
+    assert in_reader.wait(5)
+    st.start()
+    time.sleep(0.2)                       # let the setter reach the hook
+    release_reader.set()
+    rt.join(5)
+    st.join(5)
+    assert not rt.is_alive() and not st.is_alive(), \
+        "AB-BA deadlock between the flags lock and a module lock"
+    assert done == [True] and read_val == [1]
+
+
+def test_executor_fetch_numpy_conversion_stays_eager():
+    """Executor fix (R001): fetch returns numpy on the eager path and
+    the compiled path, with no numpy materialization inside capture."""
+    from paddle_tpu import static as pstatic
+    from paddle_tpu.static.executor import CompiledProgram, Executor
+    main = pstatic.Program()
+    start = pstatic.Program()
+    with pstatic.program_guard(main, start):
+        a = pstatic.data("a", (2, 2), "float32")
+        out = (a * 2.0) + 1.0
+    exe = Executor()
+    feed = {"a": np.ones((2, 2), np.float32)}
+    eager = exe.run(main, feed=feed, fetch_list=[out])
+    np.testing.assert_allclose(eager[0], np.full((2, 2), 3.0))
+    compiled = exe.run(CompiledProgram(main), feed=feed, fetch_list=[out],
+                       return_numpy=True)
+    assert isinstance(compiled[0], np.ndarray)
+    np.testing.assert_allclose(compiled[0], np.full((2, 2), 3.0))
