@@ -315,6 +315,25 @@ define_flag("enable_jaxsan", False,
             "off (the default) = a single-boolean-check no-op",
             on_change=_jaxsan_flag_changed)
 
+# Scale-out serving (inference/serving.py, inference/tp.py,
+# inference/prefix_cache.py — ISSUE 9).
+define_flag("serving_tp_degree", 1,
+            "tensor-parallel degree of the serving engine's compiled "
+            "programs: weights (attention heads + FFN/vocab columns) and "
+            "the paged KV pools are sharded over a 'tp' mesh axis of the "
+            "first N local devices, the host scheduler stays rank-0 and "
+            "broadcasts admissions/tick inputs.  1 (the default) is the "
+            "single-program path; >1 requires a GPT-family model whose "
+            "head/FFN/vocab dims divide the degree")
+define_flag("serving_prefix_cache", True,
+            "refcounted prompt-prefix reuse over the serving block "
+            "table: full prompt blocks are registered in a hash-chain "
+            "index, an admission whose prefix is resident points its "
+            "table at the shared blocks and prefills only the suffix "
+            "(copy-on-write when a shared block would be written; index "
+            "eviction under pool pressure frees only orphaned blocks); "
+            "0 restores prefill-per-request")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

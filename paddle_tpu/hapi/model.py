@@ -431,8 +431,16 @@ class Model:
         steps = len(train_loader) if hasattr(train_loader, "__len__") else None
         if skip_steps and steps is not None and skip_steps >= steps:
             # the checkpoint landed on an epoch boundary: resume at the
-            # top of the next epoch instead of replaying an empty tail
+            # top of the next epoch instead of replaying an empty tail.
+            # The SAVE-TIME numpy state must still be restored HERE —
+            # mid-epoch resume restores it after the skip completes, but
+            # with no steps to skip that code never runs, and the next
+            # epoch's shuffle permutation would be drawn from an
+            # unrelated stream (the divergence the SIGTERM-at-epoch-end
+            # resume test used to flake on)
             start_epoch += 1
+            if resume_rng.get("numpy") is not None:
+                np.random.set_state(resume_rng["numpy"])
             skip_steps, resume_rng = 0, None
         cbks = config_callbacks(
             callbacks, model=self, batch_size=batch_size, epochs=epochs,

@@ -39,6 +39,17 @@ admission, so a running sequence can never hit pool exhaustion
 mid-flight (no preemption needed — the reference scheduler's "no-evict"
 configuration).
 
+Scale-out (ISSUE 9): ``FLAGS_serving_tp_degree`` rebuilds every program
+as a ``shard_map`` over a 'tp' mesh axis — weights column-parallel, KV
+pools sharded along the head axis, scheduler state replicated (the
+rank-0 broadcast) — with decode streams BIT-identical to degree 1
+(`inference/tp.py` has the no-split-reductions layout contract).
+``FLAGS_serving_prefix_cache`` adds refcounted prompt-prefix reuse over
+the block table: a resident prefix is a pointer copy at admission, the
+suffix runs a chunked prefill program, shared blocks copy-on-write when
+the last prompt token must be recomputed, and index eviction under pool
+pressure frees only orphaned blocks (`inference/prefix_cache.py`).
+
 Cold start (ISSUE 7): the set of programs the engine can EVER dispatch
 is small and enumerable — one tick program per {steps_per_tick, 1-step
 tail} (greedy and sampled share it: sampling params are device inputs
@@ -57,6 +68,7 @@ from __future__ import annotations
 
 import math
 from collections import deque
+from contextlib import contextmanager
 from typing import List, Optional
 
 import time
@@ -72,6 +84,7 @@ from ..observability import compile_tracker as _compile
 from ..observability import export as _export
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from .prefix_cache import PrefixCache
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -103,6 +116,15 @@ _M_SAMPLED = _metrics.counter(
 _M_OVERLAP = _metrics.counter(
     "serving.overlap_dispatches", "ticks dispatched before the previous "
     "tick was harvested (double-buffered fast path)")
+_M_PREFIX_HITS = _metrics.counter(
+    "serving.prefix_hits", "admissions whose prompt prefix was resident "
+    "in the shared-block index (prefill skipped for those blocks)")
+_M_PREFIX_MISSES = _metrics.counter(
+    "serving.prefix_misses", "admissions that found no resident prefix "
+    "(full prefill ran); counted only with the prefix cache enabled")
+_M_PREFIX_SHARED = _metrics.counter(
+    "serving.prefix_blocks_shared", "physical KV blocks reused from the "
+    "prefix index instead of recomputed (incl. copy-on-write sources)")
 
 # --- request lifecycle tracing (ISSUE 6): every request's
 # enqueue -> admit (queue wait) -> prefill -> first token -> per-tick
@@ -172,6 +194,7 @@ class Request:
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self._ticks = 0
+        self._prefix_blocks = 0   # shared blocks reused at admission
         self.trace: Optional[dict] = None   # final record, set at finish
 
     def _sample(self, logits_row: np.ndarray) -> int:
@@ -208,6 +231,28 @@ class _PendingTick:
         self.san = san
 
 
+def _next_tokens(logits, do_sample, temperature, top_k, top_p, seeds,
+                 tok_pos, j):
+    """One decode step's token choice over [B, V] logits: greedy rows
+    argmax, sampling rows draw from fold_in(key(seed), position) over
+    the per-row filtered logits; an all-greedy mix skips the [B, V]
+    sort at run time.  Shared verbatim by the degree-1 and TP tick
+    bodies so the choice math is one definition."""
+    from ..models.generation import _process_logits_rows
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def drawn():
+        filtered = _process_logits_rows(
+            logits.astype(jnp.float32), temperature, top_k, top_p)
+        keys = jax.vmap(lambda s, p: jax.random.fold_in(
+            jax.random.key(s), p + j))(seeds, tok_pos)
+        samp = jax.vmap(jax.random.categorical)(
+            keys, filtered).astype(jnp.int32)
+        return jnp.where(do_sample, samp, greedy)
+
+    return jax.lax.cond(jnp.any(do_sample), drawn, lambda: greedy)
+
+
 def _bucket(n: int, minimum: int) -> int:
     b = max(minimum, 1)
     while b < n:
@@ -228,7 +273,8 @@ class ServingEngine:
                  max_context: Optional[int] = None, block_size: int = 64,
                  num_blocks: Optional[int] = None,
                  steps_per_tick: int = 1,
-                 pad_buckets=None):
+                 pad_buckets=None, tp_degree: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
         # host round trip harvests k tokens per slot (the tunnel's RTT
         # otherwise caps serving at ~1/RTT steps); admissions join at
@@ -251,11 +297,48 @@ class ServingEngine:
         self._sd = model.state_dict()
         self._keys = sorted(self._sd)
         dtype = self._sd[self._keys[0]]._value.dtype
+        # --- tensor-parallel decode (ISSUE 9): shard the programs over a
+        # 'tp' mesh axis — weights column-parallel (heads/FFN/vocab), KV
+        # pools along the head axis; the host scheduler stays rank-0 and
+        # every replicated input (tables, seq_lens, sampling params) is
+        # the broadcast admission.  Degree 1 (the default) is bit-for-bit
+        # today's single-program path; >1 snapshots the weights into the
+        # sharded layout at construction (live _sd re-binds per dispatch
+        # stay a degree-1-only feature).
+        self.tp = int(tp_degree if tp_degree is not None
+                      else _flags.get_flag("serving_tp_degree"))
+        if self.tp < 1:
+            raise ValueError(f"serving_tp_degree must be >= 1: {self.tp}")
+        self._tp_mesh = None
+        self._tp_params = None
+        self._tp_specs = None
+        self._tp_meta = None
+        if self.tp > 1:
+            from ..distributed import mesh as _mesh_mod
+            from . import tp as _tp
+            devs = list(jax.devices())
+            if len(devs) < self.tp:
+                raise ValueError(
+                    f"serving_tp_degree={self.tp} needs {self.tp} local "
+                    f"devices; jax sees {len(devs)}")
+            self._tp_mesh = _mesh_mod.build_mesh(
+                {_tp.AXIS: self.tp}, devices=devs[:self.tp])
+            plan = _tp.build_plan(model, self.tp)
+            self._tp_params = _tp.shard_plan(plan, self._tp_mesh)
+            self._tp_specs = plan.specs
+            self._tp_meta = plan.meta
         # physical pools per layer; block 0 is the pad/scratch block
-        self.pools = [
-            (jnp.zeros((nh, num_blocks + 1, block_size, hd), dtype),
-             jnp.zeros((nh, num_blocks + 1, block_size, hd), dtype))
-            for _ in range(cfg.num_layers)]
+        # (TP: sharded along the head axis so each rank holds its heads'
+        # blocks — the KV-memory scale-out)
+        def _pool():
+            z = jnp.zeros((nh, num_blocks + 1, block_size, hd), dtype)
+            if self._tp_mesh is None:
+                return z
+            from jax.sharding import NamedSharding
+            from . import tp as _tp
+            return jax.device_put(
+                z, NamedSharding(self._tp_mesh, _tp.pool_spec()))
+        self.pools = [(_pool(), _pool()) for _ in range(cfg.num_layers)]
         # host-side scheduler state
         self.tables = np.zeros((max_batch, self.nb_per_seq), np.int32)
         self.seq_lens = np.zeros((max_batch,), np.int32)
@@ -284,7 +367,18 @@ class ServingEngine:
         self._decode_fn = None
         self._tick_fns = {}
         self._prefill_fns = {}
+        self._prefill_cont_fns = {}
+        self._cow_fn = None
         self._last_harvest_t = None
+        # --- prefix/KV reuse (ISSUE 9): physical blocks are refcounted
+        # (table references + one per index entry) so a prompt prefix
+        # resident in the shared-block index is a pointer copy at
+        # admission; rc==1 everywhere when the cache is off, making the
+        # alloc/release helpers the single accounting path either way
+        self.block_rc = np.zeros((num_blocks + 1,), np.int64)
+        enable_prefix = (prefix_cache if prefix_cache is not None
+                         else _flags.get_flag("serving_prefix_cache"))
+        self.prefix = PrefixCache(block_size) if enable_prefix else None
         # the pad-bucket ladder: ONE source of truth for "which prompt
         # shapes exist" — admission padding, worst-case accounting, and
         # the warmup grid all read it (snapshot at construction; the
@@ -311,8 +405,46 @@ class ServingEngine:
         for k, v in zip(self._keys, param_vals):
             self._sd[k]._value = v
 
+    @contextmanager
+    def _params_for_call(self):
+        """The program-parameter argument plus the save/restore bracket
+        the degree-1 path needs (its programs re-bind the model's live
+        tensors while tracing).  TP programs are pure functions of the
+        sharded snapshot, so nothing to save."""
+        if self._tp_params is not None:
+            yield self._tp_params
+            return
+        vals = [self._sd[k]._value for k in self._keys]
+        saved = dict(zip(self._keys, vals))
+        try:
+            yield vals
+        finally:
+            for k, v in saved.items():
+                self._sd[k]._value = v
+
+    def _blame(self, *extra):
+        base = (("max_batch", self.B), ("block_size", self.bs))
+        if self.tp > 1:
+            base = base + (("tp", self.tp),)
+        return extra + base
+
+    def _shard_tp(self, fn, in_specs, out_specs):
+        """Wrap a program body in shard_map over the tp mesh.  By
+        convention the params arg takes the plan's spec tree, the pools
+        arg P('tp') (head axis), and every scheduler input P() — the
+        rank-0 broadcast.  check_vma off: replication of the outputs is
+        guaranteed by construction (every rank computes the full logits
+        after the vocab all-gather), which the rep-checker cannot always
+        prove through the sampling primitives."""
+        from ..core import jax_compat as _jc
+        return _jc.shard_map(fn, mesh=self._tp_mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
     def _decode_program(self):
         if self._decode_fn is not None:
+            return self._decode_fn
+        if self._tp_mesh is not None:
+            self._decode_fn = self._build_tp_decode()
             return self._decode_fn
         from ..framework.dygraph import no_grad
 
@@ -331,8 +463,7 @@ class ServingEngine:
         donate = (1,) if jax.default_backend() != "cpu" else ()
         self._decode_fn = _compile.wrap_first_call(
             jax.jit(step, donate_argnums=donate), "serving.decode",
-            (("variant", "host_sampling_k1"), ("max_batch", self.B),
-             ("block_size", self.bs)))
+            self._blame(("variant", "host_sampling_k1")))
         return self._decode_fn
 
     def _tick_program(self, k: int):
@@ -348,8 +479,10 @@ class ServingEngine:
         fn = self._tick_fns.get(k)
         if fn is not None:
             return fn
+        if self._tp_mesh is not None:
+            fn = self._tick_fns[k] = self._build_tp_tick(k)
+            return fn
         from ..framework.dygraph import no_grad
-        from ..models.generation import _process_logits_rows
 
         def tick(param_vals, pools, tables, seq_lens, last_tok,
                  do_sample, temperature, top_k, top_p, seeds, tok_pos):
@@ -363,21 +496,8 @@ class ServingEngine:
                         Tensor._wrap(last[:, None]), views,
                         pos_offset=Tensor._wrap(lens[:, None]))
                 logits = logits_t._value[:, -1, :]
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-                def drawn():
-                    filtered = _process_logits_rows(
-                        logits.astype(jnp.float32), temperature,
-                        top_k, top_p)
-                    keys = jax.vmap(lambda s, p: jax.random.fold_in(
-                        jax.random.key(s), p + j))(seeds, tok_pos)
-                    samp = jax.vmap(jax.random.categorical)(
-                        keys, filtered).astype(jnp.int32)
-                    return jnp.where(do_sample, samp, greedy)
-
-                # an all-greedy mix skips the [B, V] sort at run time
-                nxt = jax.lax.cond(jnp.any(do_sample),
-                                   drawn, lambda: greedy)
+                nxt = _next_tokens(logits, do_sample, temperature,
+                                   top_k, top_p, seeds, tok_pos, j)
                 active = lens > 0
                 nxt = jnp.where(active, nxt, 0)
                 lens = jnp.where(active, lens + 1, 0)
@@ -391,13 +511,74 @@ class ServingEngine:
         donate = (1,) if jax.default_backend() != "cpu" else ()
         fn = self._tick_fns[k] = _compile.wrap_first_call(
             jax.jit(tick, donate_argnums=donate), "serving.tick",
-            (("steps_per_tick", k), ("max_batch", self.B),
-             ("block_size", self.bs)))
+            self._blame(("steps_per_tick", k)))
         return fn
+
+    # ------------------------------------------------------ TP programs
+    def _build_tp_tick(self, k: int):
+        """The k-step tick as a shard_map program: same scan/sampling
+        shape as the degree-1 tick, with the forward running on each
+        rank's weight/pool shards (`tp.forward_tp`).  Token choice sees
+        the FULL logits (replicated after the vocab all-gather), so the
+        streams are bit-identical to degree 1."""
+        from jax.sharding import PartitionSpec as _P
+        from . import tp as _tp
+        meta, bs = self._tp_meta, self.bs
+
+        def tick(params, pools, tables, seq_lens, last_tok,
+                 do_sample, temperature, top_k, top_p, seeds, tok_pos):
+            def body(carry, j):
+                pools, lens, last = carry
+                logits, pools = _tp.forward_tp(
+                    meta, params, last[:, None], pools, tables, lens,
+                    lens[:, None], bs)
+                nxt = _next_tokens(logits[:, -1, :], do_sample,
+                                   temperature, top_k, top_p, seeds,
+                                   tok_pos, j)
+                active = lens > 0
+                nxt = jnp.where(active, nxt, 0)
+                lens = jnp.where(active, lens + 1, 0)
+                return (pools, lens, nxt), nxt
+
+            (pools, _, _), toks = jax.lax.scan(
+                body, (pools, seq_lens, last_tok), jnp.arange(k))
+            return jnp.transpose(toks), pools
+
+        body = self._shard_tp(
+            tick, (self._tp_specs, _tp.pool_spec()) + (_P(),) * 9,
+            (_P(), _tp.pool_spec()))
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        return _compile.wrap_first_call(
+            jax.jit(body, donate_argnums=donate), "serving.tick",
+            self._blame(("steps_per_tick", k)))
+
+    def _build_tp_decode(self):
+        from jax.sharding import PartitionSpec as _P
+        from . import tp as _tp
+        meta, bs = self._tp_meta, self.bs
+
+        def step(params, pools, tables, seq_lens, last_tok):
+            logits, pools = _tp.forward_tp(
+                meta, params, last_tok[:, None], pools, tables, seq_lens,
+                seq_lens[:, None], bs)
+            logits = logits[:, -1, :]
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                logits, pools
+
+        body = self._shard_tp(
+            step, (self._tp_specs, _tp.pool_spec()) + (_P(),) * 3,
+            (_P(), _P(), _tp.pool_spec()))
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        return _compile.wrap_first_call(
+            jax.jit(body, donate_argnums=donate), "serving.decode",
+            self._blame(("variant", "host_sampling_k1")))
 
     def _prefill_program(self, L_pad: int):
         fn = self._prefill_fns.get(L_pad)
         if fn is not None:
+            return fn
+        if self._tp_mesh is not None:
+            fn = self._prefill_fns[L_pad] = self._build_tp_prefill(L_pad)
             return fn
         from ..framework.dygraph import no_grad
 
@@ -417,9 +598,115 @@ class ServingEngine:
         donate = (1,) if jax.default_backend() != "cpu" else ()
         fn = self._prefill_fns[L_pad] = _compile.wrap_first_call(
             jax.jit(prefill, donate_argnums=donate), "serving.prefill",
-            (("L_pad", L_pad), ("max_batch", self.B),
-             ("block_size", self.bs)))
+            self._blame(("L_pad", L_pad)))
         return fn
+
+    def _build_tp_prefill(self, L_pad: int):
+        from jax.sharding import PartitionSpec as _P
+        from . import tp as _tp
+        meta, bs = self._tp_meta, self.bs
+
+        def prefill(params, pools, table_row, prompt, true_len):
+            zero = jnp.zeros((1,), jnp.int32)
+            logits, pools = _tp.forward_tp(
+                meta, params, prompt, pools, table_row, zero, 0, bs)
+            row = jax.lax.dynamic_index_in_dim(
+                logits[0], true_len - 1, axis=0, keepdims=False)
+            return row, pools
+
+        body = self._shard_tp(
+            prefill, (self._tp_specs, _tp.pool_spec(), _P(), _P(), _P()),
+            (_P(), _tp.pool_spec()))
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        return _compile.wrap_first_call(
+            jax.jit(body, donate_argnums=donate), "serving.prefill",
+            self._blame(("L_pad", L_pad)))
+
+    def _prefill_cont_program(self, L_pad: int):
+        """Suffix prefill for a prefix-cache hit: the first ``start``
+        tokens' KV is already resident through the slot's table (shared
+        blocks); this program writes ONLY the suffix chunk (padded to
+        the same ladder bucket the full prefill uses — the warmup grid
+        stays enumerable) at positions start..start+true_len-1 and
+        returns the last real token's logits.  ``start`` is a traced
+        scalar, so one program per bucket serves every split point."""
+        fn = self._prefill_cont_fns.get(L_pad)
+        if fn is not None:
+            return fn
+        from ..models.kv_cache import PagedChunkView
+
+        if self._tp_mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            from . import tp as _tp
+            meta, bs = self._tp_meta, self.bs
+
+            def cont(params, pools, table_row, suffix, true_len, start):
+                lens = jnp.reshape(start, (1,))
+                logits, pools = _tp.forward_tp(
+                    meta, params, suffix, pools, table_row, lens, start,
+                    bs, view_cls=PagedChunkView)
+                row = jax.lax.dynamic_index_in_dim(
+                    logits[0], true_len - 1, axis=0, keepdims=False)
+                return row, pools
+
+            body = self._shard_tp(
+                cont, (self._tp_specs, _tp.pool_spec()) + (_P(),) * 4,
+                (_P(), _tp.pool_spec()))
+            donate = (1,) if jax.default_backend() != "cpu" else ()
+            fn = self._prefill_cont_fns[L_pad] = _compile.wrap_first_call(
+                jax.jit(body, donate_argnums=donate),
+                "serving.prefill_cont", self._blame(("L_pad", L_pad)))
+            return fn
+        from ..framework.dygraph import no_grad
+
+        def cont(param_vals, pools, table_row, suffix, true_len, start):
+            self._bind(param_vals)
+            lens = jnp.reshape(start, (1,))
+            views = [PagedChunkView.from_parts(kk, vv, table_row, lens,
+                                               self.bs)
+                     for kk, vv in pools]
+            with no_grad():
+                logits_t, new_views = self.model.forward_with_cache(
+                    Tensor._wrap(suffix), views,
+                    pos_offset=Tensor._wrap(start))
+            row = jax.lax.dynamic_index_in_dim(
+                logits_t._value[0], true_len - 1, axis=0, keepdims=False)
+            new_pools = [(c.k, c.v) for c in new_views]
+            return row, new_pools
+
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = self._prefill_cont_fns[L_pad] = _compile.wrap_first_call(
+            jax.jit(cont, donate_argnums=donate), "serving.prefill_cont",
+            self._blame(("L_pad", L_pad)))
+        return fn
+
+    def _cow_program(self):
+        """Copy-on-write block copy: duplicate physical block ``src``
+        into ``dst`` across every layer's pools, on device (one program;
+        src/dst are traced scalars).  Admission uses it when a shared
+        block must receive the recomputed last prompt token."""
+        if self._cow_fn is not None:
+            return self._cow_fn
+
+        def cow(pools, src, dst):
+            out = []
+            for kk, vv in pools:
+                out.append((kk.at[:, dst].set(kk[:, src]),
+                            vv.at[:, dst].set(vv[:, src])))
+            return out
+
+        if self._tp_mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            from . import tp as _tp
+            body = self._shard_tp(cow, (_tp.pool_spec(), _P(), _P()),
+                                  _tp.pool_spec())
+        else:
+            body = cow
+        donate = (0,) if jax.default_backend() != "cpu" else ()
+        self._cow_fn = _compile.wrap_first_call(
+            jax.jit(body, donate_argnums=donate), "serving.cow",
+            self._blame())
+        return self._cow_fn
 
     # -------------------------------------------------------------- warmup
     def _warm_call(self, fn, args, aot, install):
@@ -473,13 +760,11 @@ class ServingEngine:
         if self._warmup_info is not None:
             return self._warmup_info
         t0 = time.perf_counter()
-        param_vals = [self._sd[k]._value for k in self._keys]
-        saved = dict((k, self._sd[k]._value) for k in self._keys)
         B, nb = self.B, self.nb_per_seq
         z = lambda shape, dt: jnp.zeros(shape, dt)  # noqa: E731
         grid = []
         n_aot = 0
-        try:
+        with self._params_for_call() as param_vals:
             samp = (z((B,), jnp.bool_), jnp.ones((B,), jnp.float32),
                     z((B,), jnp.int32), jnp.ones((B,), jnp.float32),
                     z((B,), jnp.uint32), z((B,), jnp.int32))
@@ -510,9 +795,30 @@ class ServingEngine:
                 self.pools = out[1]
                 n_aot += was_aot
                 grid.append({"program": "prefill", "L_pad": L_pad})
-        finally:
-            for kk, v in saved.items():
-                self._sd[kk]._value = v
+            if self.prefix is not None:
+                # prefix-cache hit path: one suffix-prefill program per
+                # ladder bucket + the CoW block copy.  Dummies are inert:
+                # an all-zero table routes every write to scratch block 0
+                # and the CoW copies block 0 onto itself.
+                for L_pad in self.pad_ladder:
+                    out, was_aot = self._warm_call(
+                        self._prefill_cont_program(L_pad),
+                        (param_vals, self.pools, z((1, nb), jnp.int32),
+                         z((1, L_pad), jnp.int32), jnp.int32(1),
+                         jnp.int32(0)), aot,
+                        lambda f, _L=L_pad:
+                            self._prefill_cont_fns.__setitem__(_L, f))
+                    self.pools = out[1]
+                    n_aot += was_aot
+                    grid.append({"program": "prefill_cont",
+                                 "L_pad": L_pad})
+                out, was_aot = self._warm_call(
+                    self._cow_program(),
+                    (self.pools, jnp.int32(0), jnp.int32(0)), aot,
+                    lambda f: setattr(self, "_cow_fn", f))
+                self.pools = out
+                n_aot += was_aot
+                grid.append({"program": "cow"})
         self._warmup_info = {
             "warmup_s": round(time.perf_counter() - t0, 4),
             "programs": len(grid), "aot_programs": n_aot, "grid": grid}
@@ -608,17 +914,96 @@ class ServingEngine:
     def _blocks_for(self, n_tokens: int) -> int:
         return math.ceil(n_tokens / self.bs)
 
+    # --------------------------------------------- block refcounting
+    # Physical blocks are refcounted so the prefix index and multiple
+    # request tables can share them.  With the cache off every block has
+    # exactly one reference (its table) and these reduce to the old
+    # popleft/append accounting.
+    def _alloc_block(self) -> int:
+        blk = self.free_blocks.popleft()
+        self.block_rc[blk] = 1
+        return blk
+
+    def _ref_block(self, blk: int) -> None:
+        self.block_rc[blk] += 1
+
+    def _release_block(self, blk: int) -> bool:
+        """Drop one reference; frees the block (returns True) only when
+        orphaned — a shared block survives its other holders."""
+        self.block_rc[blk] -= 1
+        if self.block_rc[blk] <= 0:
+            self.block_rc[blk] = 0
+            self.free_blocks.append(blk)
+            return True
+        return False
+
     def _try_admit(self) -> bool:
         if not self.waiting or not self.free_slots:
             return False
         req = self.waiting[0]
         L = len(req.prompt_ids)
-        L_pad = self._pad_bucket(L)
-        need_now = self._blocks_for(L_pad)      # <= nb_per_seq by clamp
+        # --- prefix lookup: the longest resident full-block prefix is a
+        # pointer copy; reuse is capped at L-1 so at least one suffix
+        # token runs forward (its logits are the request's first token).
+        # The cap makes copy-on-write exactly the fully-cached aligned
+        # case: the last prompt token must be recomputed INTO a block the
+        # index still shares.
+        chain: List[int] = []
+        cached_len = 0
+        match = None
+        if self.prefix is not None:
+            # a deferred request retries every loop iteration: cache its
+            # lookup across retries (the hash chain is O(prompt)) —
+            # valid only within the index epoch, since an eviction could
+            # free-and-reallocate a matched block under us
+            match = getattr(req, "_prefix_match", None)
+            if match is None \
+                    or getattr(req, "_prefix_epoch", -1) \
+                    != self.prefix.epoch:
+                match = self.prefix.lookup(req.prompt_ids)
+                req._prefix_match = match
+                req._prefix_epoch = self.prefix.epoch
+            chain = match.blocks
+            cached_len = min(len(chain) * self.bs, L - 1)
+            if cached_len <= 0:
+                chain, cached_len = [], 0
+        split_col = cached_len // self.bs
+        cow = bool(chain) and (cached_len % self.bs != 0)
+        if chain:
+            need_now = self._blocks_for(L) - split_col
+        else:
+            L_pad = self._pad_bucket(L)
+            need_now = self._blocks_for(L_pad)  # <= nb_per_seq by clamp
         # full reservation: prompt blocks now + growth to the worst case
         total_need = self._blocks_for(L + req.max_new_tokens)
         growth = max(0, total_need - self._blocks_for(L))
-        if len(self.free_blocks) - self.reserved < need_now + growth:
+        # pin the reused blocks BEFORE any index eviction can run: a
+        # chain entry freed and reallocated under us would alias garbage
+        for b in chain[:split_col]:
+            self._ref_block(b)
+        cow_src = chain[split_col] if cow else None
+        if cow_src is not None:
+            self._ref_block(cow_src)
+
+        def unpin():
+            for b in chain[:split_col]:
+                self._release_block(b)
+            if cow_src is not None:
+                self._release_block(cow_src)
+
+        short = need_now + growth - (len(self.free_blocks) - self.reserved)
+        if short > 0 and self.prefix is not None:
+            # pool pressure: orphaned index blocks are reclaimable —
+            # evict leaf entries (LRU) until the admission fits or
+            # nothing evictable remains.  Entries whose block is still
+            # table-referenced are skipped (freeing them gains nothing
+            # and would only cold-start a hot prefix)
+            self.prefix.evict(short, self._release_block,
+                              lambda b: int(self.block_rc[b]) == 1)
+            short = need_now + growth \
+                - (len(self.free_blocks) - self.reserved)
+        if short > 0:
+            unpin()
             # admission deferred on a drained pool: counted ONCE per
             # request so rejected/stalled traffic is diagnosable from the
             # metrics snapshot alone (the request stays queued and admits
@@ -633,49 +1018,98 @@ class ServingEngine:
         # surface under overload)
         t_admit = time.perf_counter() if _metrics.enabled() else None
         slot = self.free_slots.popleft()
-        blocks = [self.free_blocks.popleft() for _ in range(need_now)]
+        blocks = [self._alloc_block() for _ in range(need_now)]
         self.tables[slot, :] = 0
-        self.tables[slot, :need_now] = blocks
+        for col, b in enumerate(chain[:split_col]):
+            self.tables[slot, col] = b
+        for i, b in enumerate(blocks):
+            self.tables[slot, split_col + i] = b
         req._growth_left = growth
         self.reserved += growth
 
-        param_vals = [self._sd[k]._value for k in self._keys]
-        prompt = np.zeros((1, L_pad), np.int32)
-        prompt[0, :L] = req.prompt_ids
-        saved = dict((k, self._sd[k]._value) for k in self._keys)
         try:
-            try:
-                # the table row must be a PRIVATE copy (graft-lint R002):
-                # jnp.asarray of the numpy view aliases zero-copy, and
-                # both the error path and the pad-block release below
-                # mutate self.tables before np.asarray(row) syncs — an
-                # in-flight prefill would read the mutated block ids
-                row, self.pools = self._prefill_program(L_pad)(
-                    param_vals, self.pools,
-                    jnp.asarray(self.tables[slot:slot + 1].copy()),
-                    jnp.asarray(prompt), jnp.int32(L))
-            finally:
-                for k, v in saved.items():
-                    self._sd[k]._value = v
+            with self._params_for_call() as param_vals:
+                if chain:
+                    if cow_src is not None:
+                        # the shared block holds the cached positions of
+                        # the last prompt block; copy it so the suffix
+                        # write lands in a private block
+                        self.pools = self._cow_program()(
+                            self.pools, jnp.int32(cow_src),
+                            jnp.int32(self.tables[slot, split_col]))
+                    Ls = L - cached_len
+                    L_pad_s = self._pad_bucket(Ls)
+                    suffix = np.zeros((1, L_pad_s), np.int32)
+                    suffix[0, :Ls] = req.prompt_ids[cached_len:]
+                    # private table-row copy: same R002 aliasing contract
+                    # as the full-prefill call below
+                    row, self.pools = self._prefill_cont_program(L_pad_s)(
+                        param_vals, self.pools,
+                        jnp.asarray(self.tables[slot:slot + 1].copy()),
+                        jnp.asarray(suffix), jnp.int32(Ls),
+                        jnp.int32(cached_len))
+                else:
+                    prompt = np.zeros((1, L_pad), np.int32)
+                    prompt[0, :L] = req.prompt_ids
+                    # the table row must be a PRIVATE copy (graft-lint
+                    # R002): jnp.asarray of the numpy view aliases
+                    # zero-copy, and both the error path and the
+                    # pad-block release below mutate self.tables before
+                    # np.asarray(row) syncs — an in-flight prefill would
+                    # read the mutated block ids
+                    row, self.pools = self._prefill_program(L_pad)(
+                        param_vals, self.pools,
+                        jnp.asarray(self.tables[slot:slot + 1].copy()),
+                        jnp.asarray(prompt), jnp.int32(L))
         except BaseException:
             # admission failed mid-flight: undo every host-side draw so
-            # nothing leaks (blocks back to the pool, slot freed, growth
-            # reservation returned); the request is dropped from the
-            # queue and the error propagates to the caller
-            self.tables[slot, :] = 0
-            self.free_blocks.extend(blocks)
+            # nothing leaks (references dropped — shared blocks survive
+            # their other holders — slot freed, growth reservation
+            # returned); the request is dropped from the queue and the
+            # error propagates to the caller
+            for col in range(self.nb_per_seq):
+                if self.tables[slot, col]:
+                    self._release_block(int(self.tables[slot, col]))
+                    self.tables[slot, col] = 0
+            if cow_src is not None:
+                self._release_block(cow_src)
             self.free_slots.appendleft(slot)
             self.reserved -= growth
             req._growth_left = 0
             _M_REJECTIONS.inc(reason="error")
             raise
-        # release pad-bucket blocks beyond the prompt's real span (their
-        # stale contents are masked by seq_lens and overwritten by any
-        # future owner before becoming visible)
-        keep = self._blocks_for(L)
-        for col in range(keep, need_now):
-            self.free_blocks.append(int(self.tables[slot, col]))
-            self.tables[slot, col] = 0
+        if cow_src is not None:
+            self._release_block(cow_src)   # copy dispatched; pin over
+        if not chain:
+            # release pad-bucket blocks beyond the prompt's real span
+            # (their stale contents are masked by seq_lens and
+            # overwritten by any future owner before becoming visible)
+            keep = self._blocks_for(L)
+            for col in range(keep, need_now):
+                self._release_block(int(self.tables[slot, col]))
+                self.tables[slot, col] = 0
+        if self.prefix is not None:
+            # register this prompt's full blocks as shareable: reused
+            # entries are touched, new full-block columns become entries
+            # (one index reference each).  Registered blocks are never
+            # written again: decode starts at position L, which lives in
+            # an unregistered (partial or fresh) column.
+            fullb = L // self.bs
+            self.prefix.register(
+                req.prompt_ids,
+                [int(self.tables[slot, c]) for c in range(fullb)],
+                self._ref_block, match=match)
+            shared = split_col + (1 if cow_src is not None else 0)
+            req._prefix_blocks = shared
+            if chain:
+                self.prefix.hits += 1
+                _M_PREFIX_HITS.inc()
+                self.prefix.blocks_shared += shared
+                if shared:
+                    _M_PREFIX_SHARED.inc(shared)
+            else:
+                self.prefix.misses += 1
+                _M_PREFIX_MISSES.inc()
         _M_ADMISSIONS.inc()
         first = req._sample(np.asarray(row))
         if t_admit is not None:
@@ -709,8 +1143,18 @@ class ServingEngine:
         self._maybe_finish(req, first)
         return True
 
+    def _free_capacity(self) -> int:
+        """Free blocks INCLUDING those held only by the prefix index —
+        the allocator reclaims them on demand (index eviction), so every
+        observability surface (stats, the pool gauge, flight records)
+        reports the same number: what an admission could actually get."""
+        free = len(self.free_blocks)
+        if self.prefix is not None:
+            free += self.prefix.reclaimable(self.block_rc)
+        return free
+
     def _update_occupancy(self):
-        _M_POOL.set(round(1.0 - len(self.free_blocks)
+        _M_POOL.set(round(1.0 - self._free_capacity()
                           / max(self.num_blocks, 1), 4))
         _M_SLOTS.set(round(1.0 - len(self.free_slots) / max(self.B, 1), 4))
         self._update_pressure()
@@ -751,7 +1195,8 @@ class ServingEngine:
                "ttft_s": round(req._t_first - req._t_enqueue, 6),
                "tpot_mean_s": round((t - req._t_first)
                                     / max(n_out - 1, 1), 6),
-               "e2e_s": round(e2e, 6)}
+               "e2e_s": round(e2e, 6),
+               "prefix_blocks": req._prefix_blocks}
         req.trace = rec
         _flight.default_recorder().record_event("request", **rec)
         _export.record_request(rec)
@@ -764,7 +1209,9 @@ class ServingEngine:
         req._growth_left = 0
         for col in range(self.nb_per_seq):
             if self.tables[slot, col]:
-                self.free_blocks.append(int(self.tables[slot, col]))
+                # drop the table reference; blocks shared with the
+                # prefix index (or another slot) survive the eviction
+                self._release_block(int(self.tables[slot, col]))
                 self.tables[slot, col] = 0
         self.seq_lens[slot] = 0
         self.last_tok[slot] = 0
@@ -821,12 +1268,10 @@ class ServingEngine:
                              int(self.seq_lens[slot]) + k):
                 col = pos // self.bs
                 if pos % self.bs == 0 and self.tables[slot, col] == 0:
-                    blk = self.free_blocks.popleft()
+                    blk = self._alloc_block()
                     self.reserved -= 1
                     self.slot_req[slot]._growth_left -= 1
                     self.tables[slot, col] = blk
-        param_vals = [self._sd[kk]._value for kk in self._keys]
-        saved = dict((kk, self._sd[kk]._value) for kk in self._keys)
         device_sampling = _flags.get_flag("serving_device_sampling")
         # device inputs get PRIVATE host copies: async dispatch returns
         # before the program consumes them, and jax device_put may alias
@@ -841,28 +1286,25 @@ class ServingEngine:
         last = last_tok_dev if last_tok_dev is not None \
             else dev(self.last_tok)
         logits = None
-        try:
-            with _flight.guard("serving.tick"):
-                if not device_sampling and k == 1:
-                    # host-sampling fallback: the k=1 program returns the
-                    # logits the per-row host sampler needs
-                    greedy, logits, self.pools = self._decode_program()(
-                        param_vals, self.pools, dev(self.tables),
-                        dev(self.seq_lens), last)
-                    toks = greedy[:, None]
-                else:
-                    # the one k-step tick program; with sampling off the
-                    # demotion guarantees no sampled row is active, the
-                    # all-False mask takes the greedy cond branch
-                    toks, self.pools = self._tick_program(k)(
-                        param_vals, self.pools, dev(self.tables),
-                        dev(self.seq_lens), last,
-                        dev(self.samp_do), dev(self.samp_temp),
-                        dev(self.samp_topk), dev(self.samp_topp),
-                        dev(self.samp_seed), dev(self.tok_pos))
-        finally:
-            for kk, v in saved.items():
-                self._sd[kk]._value = v
+        with self._params_for_call() as param_vals, \
+                _flight.guard("serving.tick"):
+            if not device_sampling and k == 1:
+                # host-sampling fallback: the k=1 program returns the
+                # logits the per-row host sampler needs
+                greedy, logits, self.pools = self._decode_program()(
+                    param_vals, self.pools, dev(self.tables),
+                    dev(self.seq_lens), last)
+                toks = greedy[:, None]
+            else:
+                # the one k-step tick program; with sampling off the
+                # demotion guarantees no sampled row is active, the
+                # all-False mask takes the greedy cond branch
+                toks, self.pools = self._tick_program(k)(
+                    param_vals, self.pools, dev(self.tables),
+                    dev(self.seq_lens), last,
+                    dev(self.samp_do), dev(self.samp_temp),
+                    dev(self.samp_topk), dev(self.samp_topp),
+                    dev(self.samp_seed), dev(self.tok_pos))
         self.steps += k
         for slot in active:
             self.seq_lens[slot] += k
@@ -959,7 +1401,7 @@ class ServingEngine:
                 "tokens": harvested, "overlap": pend.overlapped,
                 "tokens_per_sec": round(harvested / dt, 1) if dt else 0.0,
                 "active": len(pend.active), "waiting": len(self.waiting),
-                "free_blocks": len(self.free_blocks)})
+                "free_blocks": self._free_capacity()})
 
     def _tick_size(self, active) -> int:
         """Steps this tick may batch: bounded by the configured tick
@@ -1041,15 +1483,30 @@ class ServingEngine:
 
     def stats(self) -> dict:
         running = self.B - len(self.free_slots)
+        # blocks held ONLY by the prefix index are free capacity: the
+        # allocator reclaims them on demand (index eviction), so the
+        # "nothing leaked" invariant free_blocks == num_blocks holds
+        # after a drained engine even with resident prefixes
+        reclaimable = self.prefix.reclaimable(self.block_rc) \
+            if self.prefix is not None else 0
         out = {"steps": self.steps, "ticks": self.ticks,
                "tokens_out": self.tokens_out,
-               "free_blocks": len(self.free_blocks),
+               "free_blocks": len(self.free_blocks) + reclaimable,
                "reserved": self.reserved,
                "active": len(self._active_slots()),
                "running": running,
                "waiting": len(self.waiting),
                "queue_depth": running + len(self.waiting),
-               "pad_buckets": list(self.pad_ladder)}
+               "pad_buckets": list(self.pad_ladder),
+               "tp_degree": self.tp}
+        if self.prefix is not None:
+            out["prefix_cache"] = {
+                "entries": len(self.prefix),
+                "hits": self.prefix.hits,
+                "misses": self.prefix.misses,
+                "blocks_shared": self.prefix.blocks_shared,
+                "evictions": self.prefix.evictions,
+                "reclaimable_blocks": reclaimable}
         if self._warmup_info is not None:
             out["warmup"] = {k: self._warmup_info[k] for k in
                              ("warmup_s", "programs", "aot_programs")}

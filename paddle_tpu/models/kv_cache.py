@@ -26,7 +26,7 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["StaticKVCache", "PagedKVCache"]
+__all__ = ["StaticKVCache", "PagedKVCache", "PagedChunkView"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -173,6 +173,70 @@ class PagedKVCache:
             self.k, self.v, self.tables, k, v)
         new.seq_lens = self.seq_lens + s
         return new, _dense_causal(q, k, v)
+
+
+class PagedChunkView(PagedKVCache):
+    """Offset-aware CHUNK prefill over a paged pool: ``s > 1`` new
+    tokens appended to sequences that already hold ``seq_lens`` cached
+    tokens, attending over the cached prefix AND the chunk.
+
+    This is the program shape prefix-cache admission needs (ISSUE 9):
+    a request whose prompt prefix is resident in shared blocks writes
+    only its SUFFIX — `update_and_attend` writes token j of the chunk
+    at absolute position ``seq_lens + j`` through the block table and
+    runs dense attention of the chunk queries against the table's
+    linearized blocks with an offset causal mask.  Positions beyond the
+    table's capacity route their writes to the reserved pad block 0
+    (same convention as the serving engine's padded prompts).
+
+    The base class intentionally rejects this case ("prefill in one
+    chunk"): from-empty prefill never needs the gather, and the
+    serving engine keeps using the cheaper base program when nothing is
+    cached.  Decode steps (``s == 1``) fall through to the base paged
+    kernel unchanged."""
+
+    def update_and_attend(self, q, k, v):
+        if q.shape[1] == 1:
+            return super().update_and_attend(q, k, v)
+        B, s, nh, hd = q.shape
+        if k.shape[2] != nh:
+            raise NotImplementedError(
+                "chunked prefill with GQA kv heads: pools are allocated "
+                "per query head; serve GQA models without prefix reuse")
+        nb = self.tables.shape[1]
+        start = self.seq_lens                          # [B] cached tokens
+        pos = start[:, None] + jnp.arange(s, dtype=start.dtype)  # [B, s]
+        cols = pos // self.bs
+        blk = jnp.take_along_axis(self.tables,
+                                  jnp.clip(cols, 0, nb - 1), axis=1)
+        # positions past the table write the pad block (never a clipped
+        # read of the LAST column, which would corrupt a real block)
+        blk = jnp.where(cols < nb, blk, 0)
+        slot = (pos % self.bs).astype(jnp.int32)
+        new = PagedChunkView.__new__(PagedChunkView)
+        new.bs, new.tables = self.bs, self.tables
+        new.k = self.k.at[:, blk, slot].set(
+            jnp.transpose(k.astype(self.k.dtype), (2, 0, 1, 3)))
+        new.v = self.v.at[:, blk, slot].set(
+            jnp.transpose(v.astype(self.v.dtype), (2, 0, 1, 3)))
+        new.seq_lens = self.seq_lens + s
+        # linearize the table (cached prefix + just-written chunk) and
+        # attend with the offset causal mask: query at absolute position
+        # p sees keys 0..p — all real written positions for real queries
+        # (padded chunk rows attend garbage and are discarded upstream)
+        k_lin = jnp.take(new.k, self.tables, axis=1)   # [nh, B, nb, bs, hd]
+        v_lin = jnp.take(new.v, self.tables, axis=1)
+        k_lin = k_lin.reshape(nh, B, nb * self.bs, hd)
+        v_lin = v_lin.reshape(nh, B, nb * self.bs, hd)
+        logits = jnp.einsum("bqhd,hbkd->bhqk", q.astype(jnp.float32),
+                            k_lin.astype(jnp.float32)) / math.sqrt(hd)
+        kpos = jnp.arange(nb * self.bs, dtype=pos.dtype)
+        mask = kpos[None, :] <= pos[:, :, None]        # [B, s, K]
+        logits = jnp.where(mask[:, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,hbkd->bqhd", probs,
+                         v_lin.astype(jnp.float32)).astype(q.dtype)
+        return new, out
 
 
 def _dense_causal(q, k, v):

@@ -244,6 +244,7 @@ def test_model_average_apply_restore():
 # ---------------------------------------------------------- vision families
 @pytest.mark.parametrize("ctor", ["densenet121", "squeezenet1_1",
                                   "shufflenet_v2_x0_25", "mobilenet_v1"])
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_new_vision_families_forward_backward(ctor):
     from paddle_tpu.vision import models as M
     paddle.seed(0)
@@ -257,6 +258,7 @@ def test_new_vision_families_forward_backward(ctor):
     assert m.parameters()[0].grad is not None
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_googlenet_aux_heads():
     from paddle_tpu.vision import models as M
     paddle.seed(0)
@@ -272,6 +274,7 @@ def test_googlenet_aux_heads():
     assert out.shape == [2, 5]
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_inception_v3_forward_backward():
     from paddle_tpu.vision import models as M
     paddle.seed(0)
@@ -285,6 +288,7 @@ def test_inception_v3_forward_backward():
     assert m.parameters()[0].grad is not None
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_mobilenet_v3_forward_backward():
     from paddle_tpu.vision import models as M
     paddle.seed(0)

@@ -9,6 +9,7 @@ plus degraded/healthy env probes on CPU and pin the split it must make.
 import json
 
 import numpy as np  # noqa: F401  (suite convention)
+import pytest
 
 from paddle_tpu.observability import harness
 
@@ -238,6 +239,42 @@ def test_cold_start_rung_schema():
     assert val["serving_warmup_programs"] >= 4
     assert val["serving_warmup_s"] > 0
     assert val["post_warmup_compiles"] == 0
+
+
+@pytest.mark.slow   # one subprocess compiles the TP program grid — too
+                    # heavy for the tier-1 budget; full runs cover it
+def test_serving_tp_rung_schema():
+    """Pin the ISSUE 9 `serving_tp` rung's record schema: simulated TP
+    degree {1, 2} x prefix-cache sweep recording tokens/sec/chip and
+    TTFT p50 per degree, the degree-2-vs-1 bit-parity verdict, and the
+    `prefix_hit_speedup` regression key (median full-prefill seconds
+    over median suffix-prefill seconds)."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_tp", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_serving_tp(ctx)
+    rec = {"rung": "serving_tp", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("serving_tp").smoke
+    assert bench._REGRESSION_KEYS["serving_tp"] == "prefix_hit_speedup"
+    # the two acceptance claims: TP decode is bit-identical across
+    # degrees, and a prefix hit really skips prefill work
+    assert val["parity_tp2_vs_tp1"] is True
+    assert val["prefix_hit_speedup"] > 1.0
+    assert val["prefix_hits"] >= 4
+    assert val["tokens_per_sec_chip_tp1"] > 0
+    assert val["tokens_per_sec_chip_tp2"] > 0
+    assert val["ttft_p50_ms_tp1"] > 0 and val["ttft_p50_ms_tp2"] > 0
 
 
 def test_analyze_rung_schema():

@@ -53,6 +53,7 @@ def test_attention_mask_excludes_padding():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_mlm_learns_identity_with_masking():
     """15%-style masking: model must learn to reconstruct masked tokens."""
     paddle.seed(0)

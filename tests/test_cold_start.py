@@ -172,9 +172,14 @@ def test_warmup_grid_zero_compiles_then_one_blamed_outside(model):
         info = eng.warmup()
         # 2 tick variants (k=2 + the k=1 tail; greedy and sampled share
         # each) + the host-sampling decode program + 3 prefill buckets
-        assert info["programs"] == 6
+        # + (prefix cache, ISSUE 9) 3 suffix-prefill buckets + the CoW
+        # block copy
+        assert info["programs"] == 10
         assert [g["L_pad"] for g in info["grid"]
                 if g["program"] == "prefill"] == [16, 32, 64]
+        assert [g["L_pad"] for g in info["grid"]
+                if g["program"] == "prefill_cont"] == [16, 32, 64]
+        assert [g["program"] for g in info["grid"]].count("cow") == 1
         assert eng.warmup() is info                   # idempotent
         before = compile_tracker.total_compiles()
         # budgets of 7 = 1 prefill token + 2 full k=2 ticks + k=1 tails,
@@ -183,7 +188,7 @@ def test_warmup_grid_zero_compiles_then_one_blamed_outside(model):
         assert compile_tracker.total_compiles() == before
         assert all(len(r.output_ids) == 7 for r in reqs)
         st = eng.stats()
-        assert st["warmup"]["programs"] == 6
+        assert st["warmup"]["programs"] == 10
         assert st["warmup"]["warmup_s"] > 0
         assert st["pad_buckets"] == [16, 32, 64]
         # outside the ladder: prompt 70 -> pow2 fallback bucket 128
@@ -230,7 +235,8 @@ def test_warmup_covers_both_sampling_variants(model):
                             block_size=16, steps_per_tick=1)
         info = eng.warmup()     # taken with device sampling ON
         assert [g["program"] for g in info["grid"]] == \
-            ["tick", "decode", "prefill", "prefill"]
+            ["tick", "decode", "prefill", "prefill",
+             "prefill_cont", "prefill_cont", "cow"]
         before = compile_tracker.total_compiles()
         with flag_guard(serving_device_sampling=False):
             # sampled request on the host-sampling path -> decode program

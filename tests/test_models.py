@@ -148,6 +148,7 @@ def test_gpt_param_count():
     assert n == expect, (n, expect)
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_gpt_moe_trains_and_ep_shards():
     """GPT-MoE: alternating MoE blocks train under jit; expert weights
     shard over an ep mesh axis with identical eval outputs."""

@@ -359,6 +359,7 @@ def _import_bench():
     return mod
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_bench_backend_unavailable_exits_zero(monkeypatch, tmp_path,
                                               capsys):
     """Acceptance: with `jax.devices` raising, bench.py exits 0 and the

@@ -25,6 +25,7 @@ def t(arr):
 
 # ---------------------------------------------------- branch specialization
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_ifelse_early_return_specializes():
     """ref test_break_graph.py::ifelse_func — `if` on a tensor value with
     returns inside both arms: two guarded programs, zero eager calls."""
@@ -45,6 +46,7 @@ def test_ifelse_early_return_specializes():
     assert st["eager_calls"] == 0 and not st["graph_breaks"]
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_multi_output_branches():
     """ref test_break_graph.py::multi_output — early return of different
     expressions per branch."""
@@ -60,6 +62,7 @@ def test_multi_output_branches():
     assert sf._stats["sot_specializations"] == 2
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_bool_in_expression():
     """ref test_builtin_bool.py — bool(tensor) consumed by Python `and`;
     both truth values specialize."""
@@ -97,6 +100,7 @@ def test_range_over_tensor_bound():
     assert sf._stats["guard_misses"] >= 1
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_item_burn_guard():
     """.item() on a traced scalar burns + guards (the scale-factor
     pattern of GradScaler-style host reads)."""
@@ -109,6 +113,7 @@ def test_item_burn_guard():
     assert sf._stats["sot_specializations"] == 2
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_guard_thrash_falls_back():
     """A float burn that never repeats exhausts MAX_SPECIALIZATIONS and
     falls back to eager WITH a recorded reason (no silent thrash)."""
@@ -130,6 +135,7 @@ def test_guard_thrash_falls_back():
 
 # -------------------------------------------------------------- observability
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_status_reports_breaks_and_specs():
     """paddle.jit.status(): the break-reason report the reference SOT
     logs (jit/sot/utils/exceptions.py taxonomy)."""
@@ -154,6 +160,7 @@ def test_status_reports_breaks_and_specs():
     assert "SOT" in bs["graph_breaks"][0]["reason"]
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_state_not_committed_on_guard_miss():
     """A guard miss discards the run: parameter mutations from the
     wrong-branch program must NOT land (the no-donation contract)."""
@@ -179,6 +186,7 @@ def test_state_not_committed_on_guard_miss():
     np.testing.assert_allclose(w.numpy(), [12.0])
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_closure_constant_concretization_stays_synced():
     """A non-traced (closure-constant) tensor concretized between traced
     burns must consume its burn entry without emitting a guard — the

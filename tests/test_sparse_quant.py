@@ -172,6 +172,7 @@ def test_ptq_calibrate_then_convert():
     assert np.abs(q_out - calib_out).max() < 0.2  # but close
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_qat_lenet_roundtrips_through_predictor(tmp_path):
     """VERDICT r2 item 10: a QAT fake-quantized LeNet must save ->
     load -> predict with outputs matching the in-memory quantized model
@@ -344,6 +345,7 @@ def test_subm_conv_preserves_sparsity_pattern():
     assert tuple(out.shape) == (1, 3, 3, 5)
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_sparse_model_trains_end_to_end():
     """VERDICT done-criterion: a small sparse conv net (SubmConv3D ->
     BatchNorm -> ReLU -> Conv3D -> pooled logits) trains end-to-end;
@@ -428,6 +430,7 @@ def _train_and_eval(net, x, y, steps=12, lr=5e-3):
     return float((pred == y).mean())
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_qat_lenet_accuracy_matches_fp32():
     """VERDICT done-criterion: QAT LeNet reaches fp32-parity-epsilon
     accuracy on a classification task."""

@@ -304,6 +304,7 @@ def other():
     assert run_src(tmp_path, {"mod.py": src}, rules=["R005"]) == []
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_cli_nonexistent_path_is_an_error(tmp_path):
     """A typoed path must not make the ratchet pass vacuously on zero
     files — missing paths, non-.py files and committed-baseline
@@ -459,6 +460,7 @@ def test_ratchet_fingerprints_survive_line_drift(tmp_path):
     assert new_findings(fs2, load_baseline(str(baseline_path))) == []
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_cli_clean_tree_exits_zero_and_violation_exits_nonzero(tmp_path):
     """The acceptance contract: the committed baseline makes a clean run
     exit 0; one injected violation exits non-zero."""

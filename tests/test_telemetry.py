@@ -336,6 +336,7 @@ def test_exception_in_train_step_dumps(tmp_path):
                in e["error"] for e in doc["events"])
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_hybrid_step_feeds_timeline_and_watchdog(tmp_path):
     """The fleet hybrid step records telemetry and its periodic loss
     probe trips on a poisoned parameter tree."""
@@ -392,6 +393,7 @@ def test_serving_tick_flight_records_and_deferral_reason():
     assert rej.value(reason="pool_exhausted") == 1  # once, not per tick
 
 
+@pytest.mark.slow   # tier-1 budget (ISSUE 9): heavy, not on the serving/training core path
 def test_bench_rung_failure_writes_flight_dump(tmp_path):
     """Satellite: a dying rung leaves a flight-recorder dump next to the
     JSON record, so an rc!=0-style artifact still carries evidence."""
