@@ -81,6 +81,7 @@ _REGRESSION_KEYS = {
     "request_trace": "trace_overhead_pct",
     "cold_start": "cold_start_warm_speedup",
     "serving_tp": "prefix_hit_speedup",
+    "spec_decode": ("spec_decode_speedup", "quant_weight_ratio"),
     "analyze": "analyze_files_per_sec",
 }
 
@@ -1313,6 +1314,32 @@ print(json.dumps({"first_program_ready_s": round(ready_s, 4),
             "post_warmup_compiles": int(post)}
 
 
+def _run_result_subprocess(name: str, code: str, timeout: int = 900):
+    """Shared scaffold of the RESULT-line subprocess rungs (serving_tp,
+    spec_decode): run ``code`` in a fresh interpreter with the parent's
+    JAX_PLATFORMS pin dropped (the child forces its own CPU mesh),
+    fail loudly with the stderr tail on a nonzero rc or a missing
+    RESULT line, and return the parsed payload."""
+    import json as _json
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout, cwd=repo)
+    if proc.returncode != 0:
+        raise RuntimeError(f"{name} subprocess rc={proc.returncode}:"
+                           f" {proc.stderr[-400:]}")
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("RESULT ")]
+    if not lines:
+        raise RuntimeError(f"{name} subprocess emitted no RESULT line:"
+                           f" {proc.stderr[-400:]}")
+    return _json.loads(lines[-1][len("RESULT "):])
+
+
 @harness.register_rung("serving_tp", est_cold_s=120, smoke=True)
 def bench_serving_tp(ctx):
     """ISSUE 9 rung: scale-out serving evidence.
@@ -1326,10 +1353,6 @@ def bench_serving_tp(ctx):
     median full-prefill seconds over median suffix-prefill seconds for
     the same requests (regression key; it collapsing toward 1.0 means
     prefix reuse stopped skipping work)."""
-    import json as _json
-    import subprocess
-
-    repo = os.path.dirname(os.path.abspath(__file__))
     code = r"""
 import json, os, time
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -1395,17 +1418,7 @@ out["parity_tp2_vs_tp1"] = out["tp2"].pop("streams") == \
     out["tp1"].pop("streams")
 print("RESULT " + json.dumps(out))
 """
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    proc = subprocess.run([sys.executable, "-c", code], env=env,
-                          capture_output=True, text=True, timeout=900,
-                          cwd=repo)
-    if proc.returncode != 0:
-        raise RuntimeError(f"serving_tp subprocess rc={proc.returncode}:"
-                           f" {proc.stderr[-400:]}")
-    line = [ln for ln in proc.stdout.splitlines()
-            if ln.startswith("RESULT ")][-1]
-    res = _json.loads(line[len("RESULT "):])
+    res = _run_result_subprocess("serving_tp", code)
     return {"tokens_per_sec_chip_tp1": res["tp1"]["tokens_per_sec_chip"],
             "tokens_per_sec_chip_tp2": res["tp2"]["tokens_per_sec_chip"],
             "ttft_p50_ms_tp1": res["tp1"]["ttft_p50_ms"],
@@ -1414,6 +1427,103 @@ print("RESULT " + json.dumps(out))
             "prefix_hit_speedup": res["prefix_hit_speedup"],
             "prefix_hits": res["prefix_stats"]["hits"],
             "prefix_blocks_shared": res["prefix_stats"]["blocks_shared"]}
+
+
+@harness.register_rung("spec_decode", est_cold_s=150, smoke=True)
+def bench_spec_decode(ctx):
+    """ISSUE 10 rung: speculative + quantized serving evidence.
+
+    One CPU subprocess sweeps {spec off, on} x {quant off, int8} over a
+    greedy decode workload (draft = same-weights copy, the acceptance
+    upper bound: the smoke rung measures the MACHINERY — one verify
+    forward harvesting k tokens per host round trip — not a distilled
+    draft's accept rate), recording decode tokens/sec, the acceptance
+    rate, and the engine's weight-byte accounting.  Regression keys:
+    `spec_decode_speedup` (spec-on/quant-off tokens/sec over the plain
+    engine; collapsing toward/below its round-to-round band means the
+    draft bubble stopped paying for itself) and `quant_weight_ratio`
+    (fp weight bytes over int8 snapshot bytes; collapsing toward 1.0
+    means quantization stopped covering tensors).  Also asserts the
+    losslessness headline: spec-on greedy streams equal spec-off (the
+    rung FAILS — ok:false — on a parity break, so the gate is real)."""
+    code = r"""
+import json, os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["FLAGS_enable_metrics"] = "1"
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import Request, ServingEngine
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+
+paddle.seed(0)
+model = GPTForCausalLM(gpt3_tiny())
+model.eval()
+paddle.seed(0)
+draft = GPTForCausalLM(gpt3_tiny())
+draft.eval()
+rng = np.random.RandomState(0)
+prompts = [rng.randint(1, 1000, (L,)) for L in (12, 24, 40, 18)]
+out = {}
+
+def drive(eng, budget=24):
+    reqs = [eng.add_request(Request(p, max_new_tokens=budget))
+            for p in prompts]
+    eng.run()
+    return reqs
+
+for spec in (False, True):
+    for quant in ("", "int8"):
+        eng = ServingEngine(
+            model, max_batch=4, max_context=128, block_size=16,
+            steps_per_tick=2, quant=quant,
+            draft_model=(draft if spec else None), spec_decode=spec,
+            spec_k=4)
+        # budget must clear spec_k + 1 or the warm pass never
+        # dispatches a spec tick and its compile lands in the
+        # measured pass; the second pass settles caches so the
+        # measured one is steady-state
+        drive(eng, budget=8)
+        drive(eng, budget=24)
+        toks0 = eng.tokens_out
+        t0 = time.perf_counter()
+        reqs = drive(eng)
+        dt = time.perf_counter() - t0
+        key = f"spec{int(spec)}_quant{int(bool(quant))}"
+        rec = {"tokens_per_sec": round((eng.tokens_out - toks0) / dt, 1),
+               "streams": [list(r.output_ids) for r in reqs]}
+        if spec:
+            rec["accept_rate"] = eng.stats()["speculative"]["accept_rate"]
+        if quant:
+            rec["quant_weight_ratio"] = eng.stats()["quant"]["ratio"]
+        out[key] = rec
+
+base = out["spec0_quant0"].pop("streams")
+out["parity_spec_vs_plain"] = out["spec1_quant0"].pop("streams") == base
+qbase = out["spec0_quant1"].pop("streams")
+out["parity_spec_quant"] = out["spec1_quant1"].pop("streams") == qbase
+print("RESULT " + json.dumps(out))
+"""
+    res = _run_result_subprocess("spec_decode", code)
+    if not (res["parity_spec_vs_plain"] and res["parity_spec_quant"]):
+        # losslessness is the rung's headline claim: a parity break is
+        # a FAILED rung, not a recorded curiosity
+        raise RuntimeError(
+            "spec losslessness parity failed: "
+            f"plain={res['parity_spec_vs_plain']} "
+            f"quant={res['parity_spec_quant']}")
+    plain = res["spec0_quant0"]["tokens_per_sec"]
+    spec_on = res["spec1_quant0"]["tokens_per_sec"]
+    return {"tokens_per_sec_plain": plain,
+            "tokens_per_sec_spec": spec_on,
+            "tokens_per_sec_quant": res["spec0_quant1"]["tokens_per_sec"],
+            "tokens_per_sec_spec_quant":
+                res["spec1_quant1"]["tokens_per_sec"],
+            "spec_decode_speedup": round(spec_on / max(plain, 1e-9), 2),
+            "spec_accept_rate": res["spec1_quant0"]["accept_rate"],
+            "quant_weight_ratio":
+                res["spec0_quant1"]["quant_weight_ratio"],
+            "parity_spec_vs_plain": bool(res["parity_spec_vs_plain"]),
+            "parity_spec_quant": bool(res["parity_spec_quant"])}
 
 
 @harness.register_rung("analyze", est_cold_s=40, smoke=True)

@@ -334,6 +334,33 @@ define_flag("serving_prefix_cache", True,
             "eviction under pool pressure frees only orphaned blocks); "
             "0 restores prefill-per-request")
 
+# Speculative + quantized serving (inference/speculative.py,
+# inference/quant.py — ISSUE 10).
+define_flag("serving_spec_decode", False,
+            "draft/verify speculative decoding in the serving engine "
+            "(requires a draft model at construction: "
+            "ServingEngine(model, draft_model=...)): the draft proposes "
+            "FLAGS_serving_spec_k tokens per slot inside one compiled "
+            "program and the target judges every proposal in a "
+            "single chunk verify forward — lossless (greedy streams "
+            "bit-identical to the plain engine; seeded sampling follows "
+            "the rejection-sampling correction, so the output "
+            "distribution is unchanged)")
+define_flag("serving_spec_k", 4,
+            "draft tokens proposed per slot per speculative tick; a "
+            "tick emits 1..k tokens depending on acceptance.  Slots "
+            "whose remaining budget is under k fall back to the plain "
+            "tick programs")
+define_flag("serving_quant", "",
+            "weight-only quantized serving: 'int8' snapshots the "
+            "engine's matmul weights per-output-channel absmax int8 at "
+            "construction and dequantizes inside the compiled programs "
+            "(~4x less fp32 weight memory on device; logits change "
+            "within a small parity budget).  Composes with "
+            "FLAGS_serving_tp_degree (quantize-then-shard is bit-exact) "
+            "and spec decode.  Empty (the default) serves full-precision "
+            "weights")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

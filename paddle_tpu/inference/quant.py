@@ -1,0 +1,171 @@
+"""Weight-only quantized serving: engine weight snapshots as int8.
+
+The serving engine's programs take the model parameters as inputs (the
+degree-1 path re-binds the live tensors per dispatch; the TP path
+snapshots a sharded pytree at construction).  ``FLAGS_serving_quant``
+swaps that parameter payload for an int8 snapshot built here:
+
+* :func:`snapshot` — quantize a state-dict's matmul weights per output
+  channel (`quantization.weight_only`) at engine construction; the
+  returned :class:`WeightSnapshot` IS the program input from then on
+  (device weight residency drops to int8 + one scale per channel, the
+  serving-memory win — more concurrent engines/slots per chip).
+* :func:`dequant_values` — the traced inverse, called INSIDE every
+  compiled program right before the weights are bound, so XLA fuses the
+  scale multiply into the consuming matmuls ("dequant-in-matmul").
+* :func:`quantize_plan` — the TP hook: quantizes a `tp.TPPlan`'s 2D+
+  weight leaves BEFORE `tp.shard_plan` places them, replacing each leaf
+  with a ``{"q", "s"}`` pair whose PartitionSpecs mirror the weight's.
+  Scales keep their reduced axis (size 1), so the weight's own spec is
+  valid for the scale, and per-channel independence makes
+  quantize-then-shard bit-identical to shard-then-quantize.
+
+Which leaves quantize: 2D ``*.weight`` matrices.  Token embeddings
+(``wte`` / ``embed_tokens``) reduce over the hidden axis — one scale
+per vocab row serves BOTH the lookup and the tied logits head.
+Positional embeddings (``wpe`` / rotary tables) stay in floating point:
+they never feed a matmul, so int8 would buy bytes at pure accuracy
+cost.  1D tensors (LN, biases) always stay fp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..quantization.weight_only import dequantize_int8, quantize_absmax_int8
+
+__all__ = ["WeightSnapshot", "snapshot", "dequant_values",
+           "quantize_plan", "plan_stats", "MODES"]
+
+MODES = ("int8",)
+
+# key-name hints, checked against the LAST two dotted components
+_EMBED_HINTS = ("wte", "embed_tokens", "tok_embeddings")
+_SKIP_HINTS = ("wpe", "pos_emb", "position_embeddings", "rotary")
+
+
+def _quant_axis(key: str, arr) -> Optional[int]:
+    """Reduction (contraction) axis for this leaf, or None = keep fp."""
+    if getattr(arr, "ndim", 0) != 2 or not key.endswith(".weight"):
+        return None
+    parts = key.lower().split(".")
+    tail = parts[-3:-1] if len(parts) >= 3 else parts[:-1]
+    if any(h in p for p in tail for h in _SKIP_HINTS):
+        return None
+    if any(h in p for p in tail for h in _EMBED_HINTS):
+        return 1          # [V, H]: per-vocab-row scale (lookup + tied head)
+    return 0              # [in, out] linear: per-output-column scale
+
+
+class WeightSnapshot:
+    """Engine-lifetime quantized parameter payload.
+
+    ``values`` is positionally aligned with the engine's sorted key
+    list: a plain array for fp leaves, an ``(int8, scale)`` tuple for
+    quantized ones; ``axes`` records the reduction axis per slot (None
+    = fp) — the static metadata :func:`dequant_values` needs at trace
+    time.  Byte counts feed ``stats()["quant"]``.
+    """
+
+    def __init__(self, values: List[Any], axes: List[Optional[int]],
+                 weight_bytes: int, fp_weight_bytes: int):
+        self.values = values
+        self.axes = axes
+        self.weight_bytes = weight_bytes
+        self.fp_weight_bytes = fp_weight_bytes
+
+    @property
+    def ratio(self) -> float:
+        return round(self.fp_weight_bytes / max(self.weight_bytes, 1), 2)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"mode": "int8",
+                "quantized_tensors": sum(a is not None for a in self.axes),
+                "weight_bytes": self.weight_bytes,
+                "fp_weight_bytes": self.fp_weight_bytes,
+                "ratio": self.ratio}
+
+
+def snapshot(keys: List[str], values: List[Any],
+             mode: str = "int8") -> WeightSnapshot:
+    """Quantize a state-dict snapshot (host side, once per engine)."""
+    if mode not in MODES:
+        raise ValueError(f"FLAGS_serving_quant supports {MODES}; "
+                         f"got {mode!r}")
+    out, axes, qb, fb = [], [], 0, 0
+    for key, v in zip(keys, values):
+        v = jnp.asarray(v)
+        fb += v.size * v.dtype.itemsize
+        axis = _quant_axis(key, v)
+        if axis is None:
+            out.append(v)
+            qb += v.size * v.dtype.itemsize
+        else:
+            q, s = quantize_absmax_int8(v, axis=axis)
+            out.append((q, s))
+            qb += q.size + s.size * s.dtype.itemsize
+        axes.append(axis)
+    return WeightSnapshot(out, axes, qb, fb)
+
+
+def dequant_values(values, axes) -> List[Any]:
+    """Traced: restore the fp parameter list a model bind expects."""
+    return [v if a is None else dequantize_int8(*v)
+            for v, a in zip(values, axes)]
+
+
+def quantize_plan(plan) -> None:
+    """Quantize a TP plan IN PLACE before `shard_plan` places it.
+
+    Every 2D+ matmul weight leaf (qkv_w is [H, 3, nh, hd]) becomes
+    ``{"q": int8, "s": scale}``; the spec tree gets the weight's own
+    spec for both members (the scale's size-1 reduced axis makes that
+    valid).  Reduction axis is the contraction dim: axis 0 everywhere
+    (tp.forward_tp contracts every matmul over the leading input dim)
+    except ``wte`` [V, H], reduced over H so the per-row scale shards
+    with the vocab axis.
+    """
+    def q(leaf_name: str, holder, spec_holder) -> None:
+        w = holder[leaf_name]
+        axis = 1 if leaf_name == "wte" else 0
+        qv, s = quantize_absmax_int8(w, axis=axis)
+        holder[leaf_name] = {"q": qv, "s": s}
+        spec_holder[leaf_name] = {"q": spec_holder[leaf_name],
+                                  "s": spec_holder[leaf_name]}
+
+    q("wte", plan.params, plan.specs)
+    for blk, spec in zip(plan.params["blocks"], plan.specs["blocks"]):
+        for name in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
+            q(name, blk, spec)
+    plan.meta["quant"] = "int8"
+
+
+def plan_stats(plan) -> Dict[str, Any]:
+    """Weight-byte accounting over a quantized TP plan (pre-shard
+    host tree): same schema as :meth:`WeightSnapshot.stats`."""
+    acc = {"qb": 0, "fb": 0, "n": 0}
+
+    def walk(x):
+        if isinstance(x, dict):
+            if set(x) == {"q", "s"}:
+                q, s = x["q"], x["s"]
+                acc["qb"] += q.size + s.size * s.dtype.itemsize
+                acc["fb"] += q.size * s.dtype.itemsize
+                acc["n"] += 1
+                return
+            for v in x.values():
+                walk(v)
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+        else:
+            b = x.size * x.dtype.itemsize
+            acc["qb"] += b
+            acc["fb"] += b
+
+    walk(plan.params)
+    return {"mode": plan.meta.get("quant"), "quantized_tensors": acc["n"],
+            "weight_bytes": acc["qb"], "fp_weight_bytes": acc["fb"],
+            "ratio": round(acc["fb"] / max(acc["qb"], 1), 2)}

@@ -50,6 +50,22 @@ suffix runs a chunked prefill program, shared blocks copy-on-write when
 the last prompt token must be recomputed, and index eviction under pool
 pressure frees only orphaned blocks (`inference/prefix_cache.py`).
 
+Speculative + quantized serving (ISSUE 10):
+``FLAGS_serving_spec_decode`` (+ ``draft_model=`` at construction)
+adds ONE more compiled program — the spec tick: a k-step draft scan
+proposes ``FLAGS_serving_spec_k`` tokens per slot, the target judges
+all k proposals in a single `PagedChunkView` chunk verify forward,
+and per-slot accept masks emit 1..k tokens LOSSLESSLY (greedy
+bit-identical to the plain engine; seeded sampling corrected by
+rejection sampling — `inference/speculative.py`).  The draft keeps its
+own pools behind the SAME block table, so prefix sharing, CoW and the
+refcount accounting cover both models; rejected positions roll back by
+construction (only seq_lens += accepted becomes durable).
+``FLAGS_serving_quant=int8`` snapshots the matmul weights per-channel
+absmax int8 at construction and dequantizes in-trace
+(`inference/quant.py`): ~4x less fp32 weight memory on device, bounded
+logit deviation, bit-exact across TP degrees.
+
 Cold start (ISSUE 7): the set of programs the engine can EVER dispatch
 is small and enumerable — one tick program per {steps_per_tick, 1-step
 tail} (greedy and sampled share it: sampling params are device inputs
@@ -84,6 +100,7 @@ from ..observability import compile_tracker as _compile
 from ..observability import export as _export
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from . import quant as _squant
 from .prefix_cache import PrefixCache
 
 __all__ = ["Request", "ServingEngine"]
@@ -125,6 +142,13 @@ _M_PREFIX_MISSES = _metrics.counter(
 _M_PREFIX_SHARED = _metrics.counter(
     "serving.prefix_blocks_shared", "physical KV blocks reused from the "
     "prefix index instead of recomputed (incl. copy-on-write sources)")
+_M_SPEC_PROPOSED = _metrics.counter(
+    "serving.spec_proposed_tokens", "draft tokens proposed to the "
+    "speculative verify forward (k per live slot per spec tick); the "
+    "acceptance rate is spec_accepted_tokens / spec_proposed_tokens")
+_M_SPEC_ACCEPTED = _metrics.counter(
+    "serving.spec_accepted_tokens", "draft tokens accepted by the "
+    "verify forward (greedy argmax match or rejection-sampling accept)")
 
 # --- request lifecycle tracing (ISSUE 6): every request's
 # enqueue -> admit (queue wait) -> prefill -> first token -> per-tick
@@ -195,6 +219,8 @@ class Request:
         self._t_last: Optional[float] = None
         self._ticks = 0
         self._prefix_blocks = 0   # shared blocks reused at admission
+        self._spec_proposed = 0   # draft tokens proposed for this request
+        self._spec_accepted = 0   # ...and accepted by the verify forward
         self.trace: Optional[dict] = None   # final record, set at finish
 
     def _sample(self, logits_row: np.ndarray) -> int:
@@ -212,10 +238,16 @@ class Request:
 class _PendingTick:
     """One compiled decode tick in flight.  `toks` ([B, k] int32) is a
     device handle the host has not blocked on — harvest materializes it;
-    a second dispatch may slice its last column first (overlap)."""
+    a second dispatch may slice its last column first (overlap).
+
+    A SPECULATIVE tick (``spec``) additionally carries the per-slot
+    emitted counts / accepted-draft counts and the new seq_lens /
+    last-token device handles an overlapped next spec tick chains on
+    (the host cannot know the accepted length until harvest)."""
 
     __slots__ = ("active", "k", "toks", "logits", "reqs", "t0",
-                 "device_sampling", "overlapped", "step_no", "san")
+                 "device_sampling", "overlapped", "step_no", "san",
+                 "spec", "counts", "accepts", "new_lens", "new_last")
 
     def __init__(self, active, k, toks, logits, reqs, t0,
                  device_sampling, step_no, san=None):
@@ -229,6 +261,11 @@ class _PendingTick:
         self.overlapped = False
         self.step_no = step_no
         self.san = san
+        self.spec = False
+        self.counts = None
+        self.accepts = None
+        self.new_lens = None
+        self.new_last = None
 
 
 def _next_tokens(logits, do_sample, temperature, top_k, top_p, seeds,
@@ -274,7 +311,10 @@ class ServingEngine:
                  num_blocks: Optional[int] = None,
                  steps_per_tick: int = 1,
                  pad_buckets=None, tp_degree: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 draft_model=None, spec_decode: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
+                 quant: Optional[str] = None):
         # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
         # host round trip harvests k tokens per slot (the tunnel's RTT
         # otherwise caps serving at ~1/RTT steps); admissions join at
@@ -297,6 +337,24 @@ class ServingEngine:
         self._sd = model.state_dict()
         self._keys = sorted(self._sd)
         dtype = self._sd[self._keys[0]]._value.dtype
+        # --- weight-only quantization (ISSUE 10): snapshot the matmul
+        # weights per-channel int8 at construction; every program takes
+        # the int8 payload as input and dequantizes IN-trace right
+        # before binding (`_bind_params`), so device weight residency is
+        # int8.  Like TP, quant implies snapshot semantics: later
+        # mutations of the live model tensors do not reach the engine.
+        qmode = quant if quant is not None \
+            else _flags.get_flag("serving_quant")
+        self.quant_mode = str(qmode or "")
+        if self.quant_mode and self.quant_mode not in _squant.MODES:
+            # checked HERE so the TP plan path fails as loudly as the
+            # degree-1 snapshot path (a typo'd mode must not silently
+            # serve int8 accuracy)
+            raise ValueError(
+                f"FLAGS_serving_quant supports {_squant.MODES}; "
+                f"got {self.quant_mode!r}")
+        self._qw = None
+        self._quant_stats = None
         # --- tensor-parallel decode (ISSUE 9): shard the programs over a
         # 'tp' mesh axis — weights column-parallel (heads/FFN/vocab), KV
         # pools along the head axis; the host scheduler stays rank-0 and
@@ -324,9 +382,21 @@ class ServingEngine:
             self._tp_mesh = _mesh_mod.build_mesh(
                 {_tp.AXIS: self.tp}, devices=devs[:self.tp])
             plan = _tp.build_plan(model, self.tp)
+            if self.quant_mode:
+                # quantize BEFORE sharding: per-channel scales keep
+                # their reduced axis, so each rank's (int8, scale)
+                # shard dequantizes to an exact slice of the full
+                # dequantized matrix — quant x TP stays bit-parity
+                _squant.quantize_plan(plan)
+                self._quant_stats = _squant.plan_stats(plan)
             self._tp_params = _tp.shard_plan(plan, self._tp_mesh)
             self._tp_specs = plan.specs
             self._tp_meta = plan.meta
+        elif self.quant_mode:
+            self._qw = _squant.snapshot(
+                self._keys, [self._sd[k]._value for k in self._keys],
+                self.quant_mode)
+            self._quant_stats = self._qw.stats()
         # physical pools per layer; block 0 is the pad/scratch block
         # (TP: sharded along the head axis so each rank holds its heads'
         # blocks — the KV-memory scale-out)
@@ -339,6 +409,75 @@ class ServingEngine:
             return jax.device_put(
                 z, NamedSharding(self._tp_mesh, _tp.pool_spec()))
         self.pools = [(_pool(), _pool()) for _ in range(cfg.num_layers)]
+        # --- speculative decoding (ISSUE 10): the draft model proposes
+        # spec_k tokens per slot inside one compiled program; the target
+        # judges all k proposals in one chunk verify forward
+        # (inference/speculative.py has the losslessness contract).  The
+        # draft keeps its OWN paged pools indexed by the SAME block
+        # table — one allocator/refcount/prefix path covers both models.
+        spec = (spec_decode if spec_decode is not None
+                else _flags.get_flag("serving_spec_decode"))
+        self.spec = bool(spec)
+        self.spec_k = int(spec_k if spec_k is not None
+                          else _flags.get_flag("serving_spec_k"))
+        self.draft = draft_model if self.spec else None
+        self.dpools = None
+        self._dsd = None
+        self._dkeys = None
+        self._dqw = None
+        self._tp_draft_vals = None
+        self._spec_fn = None
+        self.spec_ticks = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        if self.spec:
+            if draft_model is None:
+                raise ValueError(
+                    "speculative decoding needs a draft model: "
+                    "ServingEngine(model, draft_model=...) — or disable "
+                    "FLAGS_serving_spec_decode")
+            if self.spec_k < 1:
+                raise ValueError(
+                    f"serving_spec_k must be >= 1: {self.spec_k}")
+            dcfg = draft_model.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {dcfg.vocab_size} != target "
+                    f"{cfg.vocab_size}")
+            if dcfg.max_seq_len < self.max_context:
+                raise ValueError(
+                    f"draft max_seq_len {dcfg.max_seq_len} < engine "
+                    f"max_context {self.max_context}")
+            self._dsd = draft_model.state_dict()
+            self._dkeys = sorted(self._dsd)
+            if self.quant_mode:
+                self._dqw = _squant.snapshot(
+                    self._dkeys,
+                    [self._dsd[k]._value for k in self._dkeys],
+                    self.quant_mode)
+            dnh = dcfg.num_heads
+            dhd = dcfg.hidden_size // dnh
+            ddtype = self._dsd[self._dkeys[0]]._value.dtype
+
+            def _dpool():
+                z = jnp.zeros((dnh, num_blocks + 1, block_size, dhd),
+                              ddtype)
+                if self._tp_mesh is None:
+                    return z
+                from jax.sharding import NamedSharding, PartitionSpec
+                # draft pools replicate: every rank runs the full
+                # (small) draft forward; only the verify is sharded
+                return jax.device_put(
+                    z, NamedSharding(self._tp_mesh, PartitionSpec()))
+            self.dpools = [(_dpool(), _dpool())
+                           for _ in range(dcfg.num_layers)]
+            if self._tp_mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(self._tp_mesh, PartitionSpec())
+                vals = (self._dqw.values if self._dqw is not None
+                        else [self._dsd[k]._value for k in self._dkeys])
+                self._tp_draft_vals = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(jnp.asarray(a), rep), vals)
         # host-side scheduler state
         self.tables = np.zeros((max_batch, self.nb_per_seq), np.int32)
         self.seq_lens = np.zeros((max_batch,), np.int32)
@@ -405,22 +544,60 @@ class ServingEngine:
         for k, v in zip(self._keys, param_vals):
             self._sd[k]._value = v
 
+    def _bind_params(self, param_vals):
+        """Bind a program's parameter INPUT into the live model tensors
+        (trace time).  A quantized payload dequantizes in-trace first —
+        the dequant-in-matmul seam: XLA fuses the per-channel scale
+        multiply into the consuming matmuls, and the program's weight
+        inputs stay int8 on device."""
+        if self._qw is not None:
+            param_vals = _squant.dequant_values(param_vals,
+                                                self._qw.axes)
+        self._bind(param_vals)
+
+    def _bind_draft(self, draft_vals):
+        """Same contract for the draft model (spec decode)."""
+        if self._dqw is not None:
+            draft_vals = _squant.dequant_values(draft_vals,
+                                                self._dqw.axes)
+        for k, v in zip(self._dkeys, draft_vals):
+            self._dsd[k]._value = v
+
+    def _draft_vals(self):
+        """The draft-parameter program input: the TP-replicated or
+        quantized snapshot when one exists, else the live tensors (the
+        degree-1 fp contract: weight updates reach the next dispatch)."""
+        if self._tp_draft_vals is not None:
+            return self._tp_draft_vals
+        if self._dqw is not None:
+            return self._dqw.values
+        return [self._dsd[k]._value for k in self._dkeys]
+
     @contextmanager
     def _params_for_call(self):
         """The program-parameter argument plus the save/restore bracket
         the degree-1 path needs (its programs re-bind the model's live
-        tensors while tracing).  TP programs are pure functions of the
-        sharded snapshot, so nothing to save."""
-        if self._tp_params is not None:
-            yield self._tp_params
-            return
-        vals = [self._sd[k]._value for k in self._keys]
-        saved = dict(zip(self._keys, vals))
+        tensors while tracing).  TP target programs are pure functions
+        of the sharded snapshot — but the draft model is bound at trace
+        time in EVERY mode, so its tensors always get the bracket."""
+        dsaved = ({k: self._dsd[k]._value for k in self._dkeys}
+                  if self._dsd is not None else None)
         try:
-            yield vals
+            if self._tp_params is not None:
+                yield self._tp_params
+                return
+            vals = (self._qw.values if self._qw is not None
+                    else [self._sd[k]._value for k in self._keys])
+            saved = {k: self._sd[k]._value for k in self._keys}
+            try:
+                yield vals
+            finally:
+                for k, v in saved.items():
+                    self._sd[k]._value = v
         finally:
-            for k, v in saved.items():
-                self._sd[k]._value = v
+            if dsaved is not None:
+                for k, v in dsaved.items():
+                    self._dsd[k]._value = v
 
     def _blame(self, *extra):
         base = (("max_batch", self.B), ("block_size", self.bs))
@@ -449,7 +626,7 @@ class ServingEngine:
         from ..framework.dygraph import no_grad
 
         def step(param_vals, pools, tables, seq_lens, last_tok):
-            self._bind(param_vals)
+            self._bind_params(param_vals)
             views = self._views(pools, tables, seq_lens)
             with no_grad():
                 logits_t, new_views = self.model.forward_with_cache(
@@ -486,7 +663,7 @@ class ServingEngine:
 
         def tick(param_vals, pools, tables, seq_lens, last_tok,
                  do_sample, temperature, top_k, top_p, seeds, tok_pos):
-            self._bind(param_vals)
+            self._bind_params(param_vals)
 
             def body(carry, j):
                 pools, lens, last = carry
@@ -583,7 +760,7 @@ class ServingEngine:
         from ..framework.dygraph import no_grad
 
         def prefill(param_vals, pools, table_row, prompt, true_len):
-            self._bind(param_vals)
+            self._bind_params(param_vals)
             zero = jnp.zeros((1,), jnp.int32)
             views = self._views(pools, table_row, zero)
             with no_grad():
@@ -595,11 +772,43 @@ class ServingEngine:
             new_pools = [(c.k, c.v) for c in new_views]
             return row, new_pools
 
-        donate = (1,) if jax.default_backend() != "cpu" else ()
+        if self.spec:
+            def prefill_spec(param_vals, draft_vals, pools, dpools,
+                             table_row, prompt, true_len):
+                row, new_pools = prefill(param_vals, pools, table_row,
+                                         prompt, true_len)
+                self._bind_draft(draft_vals)
+                dnew = self._draft_prompt_write(dpools, table_row, prompt)
+                return row, new_pools, dnew
+            body, donate = prefill_spec, (2, 3)
+        else:
+            body, donate = prefill, (1,)
+        donate = donate if jax.default_backend() != "cpu" else ()
         fn = self._prefill_fns[L_pad] = _compile.wrap_first_call(
-            jax.jit(prefill, donate_argnums=donate), "serving.prefill",
+            jax.jit(body, donate_argnums=donate), "serving.prefill",
             self._blame(("L_pad", L_pad)))
         return fn
+
+    def _draft_prompt_write(self, dpools, table_row, prompt, start=None):
+        """Traced helper: run the draft forward over a (padded) prompt
+        chunk purely for its KV WRITES — the logits are discarded (the
+        request's first token comes from the target prefill).  With
+        ``start`` the chunk is a suffix at that offset (prefix-cache
+        hit; the shared blocks already hold the prefix's draft KV from
+        the admission that registered them)."""
+        from ..framework.dygraph import no_grad
+        from ..models.kv_cache import PagedChunkView, PagedKVCache
+        if start is None:
+            lens, cls, off = jnp.zeros((1,), jnp.int32), PagedKVCache, 0
+        else:
+            lens, cls, off = jnp.reshape(start, (1,)), PagedChunkView, \
+                Tensor._wrap(start)
+        dviews = [cls.from_parts(kk, vv, table_row, lens, self.bs)
+                  for kk, vv in dpools]
+        with no_grad():
+            _, dnew = self.draft.forward_with_cache(
+                Tensor._wrap(prompt), dviews, pos_offset=off)
+        return [(c.k, c.v) for c in dnew]
 
     def _build_tp_prefill(self, L_pad: int):
         from jax.sharding import PartitionSpec as _P
@@ -614,10 +823,27 @@ class ServingEngine:
                 logits[0], true_len - 1, axis=0, keepdims=False)
             return row, pools
 
-        body = self._shard_tp(
-            prefill, (self._tp_specs, _tp.pool_spec(), _P(), _P(), _P()),
-            (_P(), _tp.pool_spec()))
-        donate = (1,) if jax.default_backend() != "cpu" else ()
+        if self.spec:
+            def prefill_spec(params, draft_vals, pools, dpools,
+                             table_row, prompt, true_len):
+                row, pools = prefill(params, pools, table_row, prompt,
+                                     true_len)
+                self._bind_draft(draft_vals)
+                dnew = self._draft_prompt_write(dpools, table_row, prompt)
+                return row, pools, dnew
+            body = self._shard_tp(
+                prefill_spec,
+                (self._tp_specs, _P(), _tp.pool_spec(), _P(), _P(),
+                 _P(), _P()),
+                (_P(), _tp.pool_spec(), _P()))
+            donate = (2, 3)
+        else:
+            body = self._shard_tp(
+                prefill,
+                (self._tp_specs, _tp.pool_spec(), _P(), _P(), _P()),
+                (_P(), _tp.pool_spec()))
+            donate = (1,)
+        donate = donate if jax.default_backend() != "cpu" else ()
         return _compile.wrap_first_call(
             jax.jit(body, donate_argnums=donate), "serving.prefill",
             self._blame(("L_pad", L_pad)))
@@ -649,10 +875,26 @@ class ServingEngine:
                     logits[0], true_len - 1, axis=0, keepdims=False)
                 return row, pools
 
-            body = self._shard_tp(
-                cont, (self._tp_specs, _tp.pool_spec()) + (_P(),) * 4,
-                (_P(), _tp.pool_spec()))
-            donate = (1,) if jax.default_backend() != "cpu" else ()
+            if self.spec:
+                def cont_spec(params, draft_vals, pools, dpools,
+                              table_row, suffix, true_len, start):
+                    row, pools = cont(params, pools, table_row, suffix,
+                                      true_len, start)
+                    self._bind_draft(draft_vals)
+                    dnew = self._draft_prompt_write(dpools, table_row,
+                                                    suffix, start=start)
+                    return row, pools, dnew
+                body = self._shard_tp(
+                    cont_spec,
+                    (self._tp_specs, _P(), _tp.pool_spec()) + (_P(),) * 5,
+                    (_P(), _tp.pool_spec(), _P()))
+                donate = (2, 3)
+            else:
+                body = self._shard_tp(
+                    cont, (self._tp_specs, _tp.pool_spec()) + (_P(),) * 4,
+                    (_P(), _tp.pool_spec()))
+                donate = (1,)
+            donate = donate if jax.default_backend() != "cpu" else ()
             fn = self._prefill_cont_fns[L_pad] = _compile.wrap_first_call(
                 jax.jit(body, donate_argnums=donate),
                 "serving.prefill_cont", self._blame(("L_pad", L_pad)))
@@ -660,7 +902,7 @@ class ServingEngine:
         from ..framework.dygraph import no_grad
 
         def cont(param_vals, pools, table_row, suffix, true_len, start):
-            self._bind(param_vals)
+            self._bind_params(param_vals)
             lens = jnp.reshape(start, (1,))
             views = [PagedChunkView.from_parts(kk, vv, table_row, lens,
                                                self.bs)
@@ -674,9 +916,21 @@ class ServingEngine:
             new_pools = [(c.k, c.v) for c in new_views]
             return row, new_pools
 
-        donate = (1,) if jax.default_backend() != "cpu" else ()
+        if self.spec:
+            def cont_spec(param_vals, draft_vals, pools, dpools,
+                          table_row, suffix, true_len, start):
+                row, new_pools = cont(param_vals, pools, table_row,
+                                      suffix, true_len, start)
+                self._bind_draft(draft_vals)
+                dnew = self._draft_prompt_write(dpools, table_row,
+                                                suffix, start=start)
+                return row, new_pools, dnew
+            body, donate = cont_spec, (2, 3)
+        else:
+            body, donate = cont, (1,)
+        donate = donate if jax.default_backend() != "cpu" else ()
         fn = self._prefill_cont_fns[L_pad] = _compile.wrap_first_call(
-            jax.jit(cont, donate_argnums=donate), "serving.prefill_cont",
+            jax.jit(body, donate_argnums=donate), "serving.prefill_cont",
             self._blame(("L_pad", L_pad)))
         return fn
 
@@ -684,7 +938,9 @@ class ServingEngine:
         """Copy-on-write block copy: duplicate physical block ``src``
         into ``dst`` across every layer's pools, on device (one program;
         src/dst are traced scalars).  Admission uses it when a shared
-        block must receive the recomputed last prompt token."""
+        block must receive the recomputed last prompt token.  With spec
+        decode the draft pools share the block ids, so the same program
+        copies the draft layers too."""
         if self._cow_fn is not None:
             return self._cow_fn
 
@@ -695,18 +951,56 @@ class ServingEngine:
                             vv.at[:, dst].set(vv[:, src])))
             return out
 
+        if self.spec:
+            def body(pools, dpools, src, dst):
+                return cow(pools, src, dst), cow(dpools, src, dst)
+            donate = (0, 1)
+        else:
+            body, donate = cow, (0,)
         if self._tp_mesh is not None:
             from jax.sharding import PartitionSpec as _P
             from . import tp as _tp
-            body = self._shard_tp(cow, (_tp.pool_spec(), _P(), _P()),
-                                  _tp.pool_spec())
-        else:
-            body = cow
-        donate = (0,) if jax.default_backend() != "cpu" else ()
+            if self.spec:
+                body = self._shard_tp(
+                    body, (_tp.pool_spec(), _P(), _P(), _P()),
+                    (_tp.pool_spec(), _P()))
+            else:
+                body = self._shard_tp(body, (_tp.pool_spec(), _P(), _P()),
+                                      _tp.pool_spec())
+        donate = donate if jax.default_backend() != "cpu" else ()
         self._cow_fn = _compile.wrap_first_call(
             jax.jit(body, donate_argnums=donate), "serving.cow",
             self._blame())
         return self._cow_fn
+
+    def _spec_program(self):
+        """The ONE compiled speculative tick (draft k-step scan + target
+        k-token chunk verify + accept masks — `inference/speculative.py`).
+        Signature: (params, draft_params, pools, dpools, tables,
+        seq_lens, last_tok, do_sample, temperature, top_k, top_p,
+        seeds) -> (toks [B,k], counts, accepts, new_lens, new_last,
+        pools, dpools).  Under TP the draft runs replicated while the
+        verify is the sharded forward; every scheduler input stays the
+        rank-0 broadcast."""
+        if self._spec_fn is not None:
+            return self._spec_fn
+        from . import speculative as _spec
+        k = self.spec_k
+        if self._tp_mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            from . import tp as _tp
+            body = self._shard_tp(
+                _spec.build_tp_spec_tick(self, k),
+                (self._tp_specs, _P(), _tp.pool_spec(), _P())
+                + (_P(),) * 8,
+                (_P(),) * 5 + (_tp.pool_spec(), _P()))
+        else:
+            body = _spec.build_spec_tick(self, k)
+        donate = (2, 3) if jax.default_backend() != "cpu" else ()
+        self._spec_fn = _compile.wrap_first_call(
+            jax.jit(body, donate_argnums=donate), "serving.spec_tick",
+            self._blame(("spec_k", k)))
+        return self._spec_fn
 
     # -------------------------------------------------------------- warmup
     def _warm_call(self, fn, args, aot, install):
@@ -770,6 +1064,13 @@ class ServingEngine:
                     z((B,), jnp.uint32), z((B,), jnp.int32))
             sched = (z((B, nb), jnp.int32), z((B,), jnp.int32),
                      z((B,), jnp.int32))
+            # spec-decode engines thread (draft_params, draft_pools)
+            # through prefill/cont/cow and own the spec tick program
+            dvals = self._draft_vals() if self.spec else None
+
+            def _set_dpools(out_tail):
+                if self.spec:
+                    self.dpools = out_tail
             for k in sorted({self.steps_per_tick, 1}, reverse=True):
                 out, was_aot = self._warm_call(
                     self._tick_program(k),
@@ -785,14 +1086,27 @@ class ServingEngine:
             self.pools = out[2]
             n_aot += was_aot
             grid.append({"program": "decode", "steps_per_tick": 1})
+            if self.spec:
+                out, was_aot = self._warm_call(
+                    self._spec_program(),
+                    (param_vals, dvals, self.pools, self.dpools)
+                    + sched + samp[:5], aot,
+                    lambda f: setattr(self, "_spec_fn", f))
+                self.pools, self.dpools = out[5], out[6]
+                n_aot += was_aot
+                grid.append({"program": "spec_tick",
+                             "spec_k": self.spec_k})
             for L_pad in self.pad_ladder:
+                dpref = ((dvals, self.pools, self.dpools) if self.spec
+                         else (self.pools,))
                 out, was_aot = self._warm_call(
                     self._prefill_program(L_pad),
-                    (param_vals, self.pools, z((1, nb), jnp.int32),
+                    (param_vals,) + dpref + (z((1, nb), jnp.int32),
                      z((1, L_pad), jnp.int32), jnp.int32(1)), aot,
                     lambda f, _L=L_pad:
                         self._prefill_fns.__setitem__(_L, f))
                 self.pools = out[1]
+                _set_dpools(out[2] if self.spec else None)
                 n_aot += was_aot
                 grid.append({"program": "prefill", "L_pad": L_pad})
             if self.prefix is not None:
@@ -801,22 +1115,30 @@ class ServingEngine:
                 # an all-zero table routes every write to scratch block 0
                 # and the CoW copies block 0 onto itself.
                 for L_pad in self.pad_ladder:
+                    dpref = ((dvals, self.pools, self.dpools)
+                             if self.spec else (self.pools,))
                     out, was_aot = self._warm_call(
                         self._prefill_cont_program(L_pad),
-                        (param_vals, self.pools, z((1, nb), jnp.int32),
+                        (param_vals,) + dpref + (z((1, nb), jnp.int32),
                          z((1, L_pad), jnp.int32), jnp.int32(1),
                          jnp.int32(0)), aot,
                         lambda f, _L=L_pad:
                             self._prefill_cont_fns.__setitem__(_L, f))
                     self.pools = out[1]
+                    _set_dpools(out[2] if self.spec else None)
                     n_aot += was_aot
                     grid.append({"program": "prefill_cont",
                                  "L_pad": L_pad})
+                cow_args = ((self.pools, self.dpools) if self.spec
+                            else (self.pools,))
                 out, was_aot = self._warm_call(
                     self._cow_program(),
-                    (self.pools, jnp.int32(0), jnp.int32(0)), aot,
+                    cow_args + (jnp.int32(0), jnp.int32(0)), aot,
                     lambda f: setattr(self, "_cow_fn", f))
-                self.pools = out
+                if self.spec:
+                    self.pools, self.dpools = out
+                else:
+                    self.pools = out
                 n_aot += was_aot
                 grid.append({"program": "cow"})
         self._warmup_info = {
@@ -1029,22 +1351,35 @@ class ServingEngine:
 
         try:
             with self._params_for_call() as param_vals:
+                # spec-decode engines thread (draft_params, draft_pools)
+                # through admission so the draft model's prompt KV lands
+                # in its pools via the same table row / block ids
+                dpref = ((self._draft_vals(), self.pools, self.dpools)
+                         if self.spec else (self.pools,))
                 if chain:
                     if cow_src is not None:
                         # the shared block holds the cached positions of
                         # the last prompt block; copy it so the suffix
                         # write lands in a private block
-                        self.pools = self._cow_program()(
-                            self.pools, jnp.int32(cow_src),
+                        cow_args = ((self.pools, self.dpools)
+                                    if self.spec else (self.pools,))
+                        out = self._cow_program()(
+                            *cow_args, jnp.int32(cow_src),
                             jnp.int32(self.tables[slot, split_col]))
+                        if self.spec:
+                            self.pools, self.dpools = out
+                        else:
+                            self.pools = out
+                        dpref = ((dpref[0], self.pools, self.dpools)
+                                 if self.spec else (self.pools,))
                     Ls = L - cached_len
                     L_pad_s = self._pad_bucket(Ls)
                     suffix = np.zeros((1, L_pad_s), np.int32)
                     suffix[0, :Ls] = req.prompt_ids[cached_len:]
                     # private table-row copy: same R002 aliasing contract
                     # as the full-prefill call below
-                    row, self.pools = self._prefill_cont_program(L_pad_s)(
-                        param_vals, self.pools,
+                    out = self._prefill_cont_program(L_pad_s)(
+                        param_vals, *dpref,
                         jnp.asarray(self.tables[slot:slot + 1].copy()),
                         jnp.asarray(suffix), jnp.int32(Ls),
                         jnp.int32(cached_len))
@@ -1057,10 +1392,14 @@ class ServingEngine:
                     # pad-block release below mutate self.tables before
                     # np.asarray(row) syncs — an in-flight prefill would
                     # read the mutated block ids
-                    row, self.pools = self._prefill_program(L_pad)(
-                        param_vals, self.pools,
+                    out = self._prefill_program(L_pad)(
+                        param_vals, *dpref,
                         jnp.asarray(self.tables[slot:slot + 1].copy()),
                         jnp.asarray(prompt), jnp.int32(L))
+                if self.spec:
+                    row, self.pools, self.dpools = out
+                else:
+                    row, self.pools = out
         except BaseException:
             # admission failed mid-flight: undo every host-side draw so
             # nothing leaks (references dropped — shared blocks survive
@@ -1197,6 +1536,9 @@ class ServingEngine:
                                     / max(n_out - 1, 1), 6),
                "e2e_s": round(e2e, 6),
                "prefix_blocks": req._prefix_blocks}
+        if self.spec:
+            rec["spec_accept_rate"] = round(
+                req._spec_accepted / max(req._spec_proposed, 1), 4)
         req.trace = rec
         _flight.default_recorder().record_event("request", **rec)
         _export.record_request(rec)
@@ -1239,13 +1581,13 @@ class ServingEngine:
         self._harvest_tick(pend)
         return True
 
-    def _dispatch_tick(self, boundary: bool = True, last_tok_dev=None):
+    def _dispatch_tick(self, boundary: bool = True, chain=None):
         """Launch one compiled decode tick and return it IN FLIGHT.
 
         At a tick ``boundary`` the scheduler work runs first (admit
-        what fits, evict finished).  ``last_tok_dev`` feeds a previous
-        tick's on-device last-token column straight back in (the
-        overlap path) instead of the host `last_tok` array.  JAX async
+        what fits, evict finished).  ``chain`` is the previous in-flight
+        `_PendingTick` (the overlap path): its on-device outputs feed
+        straight back in instead of the host arrays.  JAX async
         dispatch means the returned `_PendingTick.toks` is a device
         handle nothing has blocked on; host seq_lens/tok_pos advance
         NOW so a second dispatch sees the in-flight state."""
@@ -1260,6 +1602,14 @@ class ServingEngine:
         if not active:
             return None
         t0 = time.perf_counter()
+        device_sampling = _flags.get_flag("serving_device_sampling")
+        # a chained dispatch continues its predecessor's kind (the
+        # overlap gate matched them); at a boundary, spec eligibility is
+        # re-evaluated against the live budgets
+        use_spec = (bool(chain.spec) if chain is not None
+                    else self._spec_eligible(active, device_sampling))
+        if use_spec:
+            return self._dispatch_spec(active, t0, chain)
         k = self._tick_size(active)
         # ensure a physical block exists for every position this tick
         # will write (all draws covered by the admission reservation)
@@ -1272,7 +1622,6 @@ class ServingEngine:
                     self.reserved -= 1
                     self.slot_req[slot]._growth_left -= 1
                     self.tables[slot, col] = blk
-        device_sampling = _flags.get_flag("serving_device_sampling")
         # device inputs get PRIVATE host copies: async dispatch returns
         # before the program consumes them, and jax device_put may alias
         # numpy memory zero-copy — without the copy, this tick's own
@@ -1283,7 +1632,7 @@ class ServingEngine:
         # harvest, so reintroducing the aliasing bug fails loudly
         san = _jaxsan.token("serving.tick")
         dev = lambda a: jnp.asarray(_jaxsan.shield(san, a))  # noqa: E731
-        last = last_tok_dev if last_tok_dev is not None \
+        last = chain.toks[:, -1] if chain is not None \
             else dev(self.last_tok)
         logits = None
         with self._params_for_call() as param_vals, \
@@ -1314,6 +1663,72 @@ class ServingEngine:
                             device_sampling=device_sampling,
                             step_no=self.steps, san=san)
 
+    def _spec_eligible(self, active, device_sampling) -> bool:
+        """May this tick run draft/verify?  Needs the subsystem (engine
+        built with a draft model), on-device sampling (the host sampler
+        cannot verify), and every active slot able to absorb the full
+        spec_k emitted tokens — the budget tail falls back to the plain
+        tick programs, which are in the warmup grid anyway."""
+        if not self.spec or not device_sampling:
+            return False
+        for slot in active:
+            req = self.slot_req[slot]
+            if req.max_new_tokens - int(self.tok_pos[slot]) < self.spec_k:
+                return False
+        return True
+
+    def _dispatch_spec(self, active, t0, chain=None):
+        """Launch one speculative tick (draft scan + verify) in flight.
+
+        Draft and verify both write positions ``seq..seq+spec_k-1``;
+        only the accepted prefix becomes durable — the rest is masked
+        by seq_lens and overwritten by the next chunk (rollback by
+        construction).  Host seq_lens/tok_pos advance by the UPPER
+        BOUND k now (budget clamps and a chained dispatch's block
+        coverage need a bound, not the truth) and harvest refunds the
+        shortfall ``k - accepted`` per slot.  A chained dispatch feeds
+        the predecessor's on-device new_lens/new_last handles — the
+        draft phase of tick t+1 runs in tick t's harvest bubble."""
+        k = self.spec_k
+        for slot in active:
+            base = int(self.seq_lens[slot])
+            for pos in range(base, base + k):
+                col = pos // self.bs
+                if pos % self.bs == 0 and self.tables[slot, col] == 0:
+                    blk = self._alloc_block()
+                    self.reserved -= 1
+                    self.slot_req[slot]._growth_left -= 1
+                    self.tables[slot, col] = blk
+        san = _jaxsan.token("serving.tick")
+        dev = lambda a: jnp.asarray(_jaxsan.shield(san, a))  # noqa: E731
+        if chain is not None:
+            lens_in, last_in = chain.new_lens, chain.new_last
+        else:
+            lens_in, last_in = dev(self.seq_lens), dev(self.last_tok)
+        with self._params_for_call() as param_vals, \
+                _flight.guard("serving.tick"):
+            toks, counts, accepts, new_lens, new_last, self.pools, \
+                self.dpools = self._spec_program()(
+                    param_vals, self._draft_vals(), self.pools,
+                    self.dpools, dev(self.tables), lens_in, last_in,
+                    dev(self.samp_do), dev(self.samp_temp),
+                    dev(self.samp_topk), dev(self.samp_topp),
+                    dev(self.samp_seed))
+        self.steps += k + 1          # k draft forwards + one verify
+        for slot in active:
+            self.seq_lens[slot] += k
+            self.tok_pos[slot] += k
+        pend = _PendingTick(active=active, k=k, toks=toks, logits=None,
+                            reqs=list(self.slot_req), t0=t0,
+                            device_sampling=True, step_no=self.steps,
+                            san=san)
+        pend.spec = True
+        pend.counts = counts
+        pend.accepts = accepts
+        pend.new_lens = new_lens
+        pend.new_last = new_last
+        return pend
+
     def _harvest_tick(self, pend) -> None:
         """Block on the tick's device tokens and feed the requests:
         append, EOS/budget-check, host-sample (fallback path only).
@@ -1333,32 +1748,75 @@ class ServingEngine:
         logits_np = None
         toks_before = self.tokens_out
         sampled = 0
+        spec_accepted = 0
+        spec_proposed = 0
         harvested_by: List = []   # (req, tokens harvested this tick)
-        for slot in pend.active:
-            req = pend.reqs[slot]
-            if req.done:
-                continue         # whole row is EOS overrun
-            n_before = len(req.output_ids)
-            harvested_by.append((req, n_before))
-            req._ticks += 1
-            self.last_tok[slot] = int(toks[slot, -1])
-            for j in range(k):
+        if pend.spec:
+            # speculative tick: per-slot emitted counts (1..k) and
+            # accepted-draft counts materialize with the tokens; refund
+            # the dispatch-time upper-bound advance (k per slot) down
+            # to the true emitted length — relative, so it composes
+            # with any further conservative advance already applied by
+            # an overlapped next dispatch
+            counts = np.asarray(pend.counts)
+            accepts = np.asarray(pend.accepts)
+            for slot in pend.active:
+                req = pend.reqs[slot]
+                c = int(counts[slot])
+                self.seq_lens[slot] -= k - c
+                self.tok_pos[slot] -= k - c
                 if req.done:
-                    break        # post-eos tokens are discarded (the
-                                 # compiled tick keeps decoding; the cache
-                                 # rows die with the eviction)
-                if req.do_sample and not pend.device_sampling:
-                    if logits_np is None:
-                        logits_np = np.asarray(pend.logits)
-                    tok = req._sample(logits_np[slot])
-                    self.last_tok[slot] = tok
-                else:
+                    continue     # whole row is EOS overrun
+                n_before = len(req.output_ids)
+                harvested_by.append((req, n_before))
+                req._ticks += 1
+                spec_proposed += k
+                spec_accepted += int(accepts[slot])
+                req._spec_proposed += k
+                req._spec_accepted += int(accepts[slot])
+                self.last_tok[slot] = int(toks[slot, c - 1])
+                for j in range(c):
+                    if req.done:
+                        break    # post-eos tokens are discarded
                     tok = int(toks[slot, j])
-                if req.do_sample:
-                    sampled += 1
-                req.output_ids.append(tok)
-                self.tokens_out += 1
-                self._maybe_finish(req, tok)
+                    if req.do_sample:
+                        sampled += 1
+                    req.output_ids.append(tok)
+                    self.tokens_out += 1
+                    self._maybe_finish(req, tok)
+            self.spec_ticks += 1
+            self.spec_proposed += spec_proposed
+            self.spec_accepted += spec_accepted
+            if spec_proposed:
+                _M_SPEC_PROPOSED.inc(spec_proposed)
+            if spec_accepted:
+                _M_SPEC_ACCEPTED.inc(spec_accepted)
+        else:
+            for slot in pend.active:
+                req = pend.reqs[slot]
+                if req.done:
+                    continue     # whole row is EOS overrun
+                n_before = len(req.output_ids)
+                harvested_by.append((req, n_before))
+                req._ticks += 1
+                self.last_tok[slot] = int(toks[slot, -1])
+                for j in range(k):
+                    if req.done:
+                        break    # post-eos tokens are discarded (the
+                                 # compiled tick keeps decoding; the
+                                 # cache rows die with the eviction)
+                    if req.do_sample and not pend.device_sampling:
+                        if logits_np is None:
+                            logits_np = np.asarray(pend.logits)
+                        tok = req._sample(logits_np[slot])
+                        self.last_tok[slot] = tok
+                    else:
+                        tok = int(toks[slot, j])
+                    if req.do_sample:
+                        sampled += 1
+                    req.output_ids.append(tok)
+                    self.tokens_out += 1
+                    self._maybe_finish(req, tok)
         # wall time ATTRIBUTABLE to this tick: an overlapped tick was
         # dispatched before the previous harvest finished, so clock it
         # from that harvest, not from its own dispatch — tick_seconds
@@ -1395,13 +1853,17 @@ class ServingEngine:
         if _metrics.enabled():
             # the flight ring keeps the last-K ticks, so a post-mortem
             # dump of a wedged/crashed engine shows what was in flight
-            _flight.default_recorder().record_step({
+            rec = {
                 "timeline": "serving", "step": pend.step_no,
                 "wall_s": round(dt, 6), "decode_steps": k,
                 "tokens": harvested, "overlap": pend.overlapped,
                 "tokens_per_sec": round(harvested / dt, 1) if dt else 0.0,
                 "active": len(pend.active), "waiting": len(self.waiting),
-                "free_blocks": self._free_capacity()})
+                "free_blocks": self._free_capacity()}
+            if pend.spec:
+                rec["spec"] = True
+                rec["spec_accepted"] = spec_accepted
+            _flight.default_recorder().record_step(rec)
 
     def _tick_size(self, active) -> int:
         """Steps this tick may batch: bounded by the configured tick
@@ -1431,14 +1893,33 @@ class ServingEngine:
         join at a REAL boundary: their prefill must not race the
         in-flight tick's pool writes), and at least one budgeted token
         per active request beyond the in-flight tick (the block-budget
-        clamp that keeps EOS overrun inside the reservation)."""
+        clamp that keeps EOS overrun inside the reservation).  The
+        chained dispatch continues `pend`'s KIND: a spec tick chains a
+        spec tick (on the device seq_lens/last handles, needing spec_k
+        budget beyond the in-flight upper bound), a plain tick a plain
+        one — a kind switch is a real boundary (harvest first)."""
         if not _flags.get_flag("serving_overlap"):
-            return False
-        if not pend.device_sampling and any(
-                pend.reqs[s].do_sample for s in pend.active):
             return False
         if self.waiting:
             return False
+        if pend.spec:
+            if not _flags.get_flag("serving_device_sampling"):
+                return False     # mid-run flip: verify owns sampling
+            for slot in pend.active:
+                req = self.slot_req[slot]
+                if req is None or req.done:
+                    return False
+                if req.max_new_tokens - int(self.tok_pos[slot]) \
+                        < self.spec_k:
+                    return False
+            return True
+        if not pend.device_sampling and any(
+                pend.reqs[s].do_sample for s in pend.active):
+            return False
+        if self.spec and self._spec_eligible(
+                pend.active, _flags.get_flag("serving_device_sampling")):
+            return False         # plain->spec switch (e.g. the sampling
+                                 # flag flipped back on): boundary first
         for slot in pend.active:
             req = self.slot_req[slot]
             if req is None or req.done:
@@ -1468,8 +1949,7 @@ class ServingEngine:
                     continue     # waiting on evictions, as before
             nxt = None
             if self._can_overlap(pend):
-                nxt = self._dispatch_tick(boundary=False,
-                                          last_tok_dev=pend.toks[:, -1])
+                nxt = self._dispatch_tick(boundary=False, chain=pend)
                 if nxt is not None:
                     nxt.overlapped = True
                     _M_OVERLAP.inc()
@@ -1499,6 +1979,16 @@ class ServingEngine:
                "queue_depth": running + len(self.waiting),
                "pad_buckets": list(self.pad_ladder),
                "tp_degree": self.tp}
+        if self.spec:
+            out["speculative"] = {
+                "spec_k": self.spec_k,
+                "ticks": self.spec_ticks,
+                "proposed_tokens": self.spec_proposed,
+                "accepted_tokens": self.spec_accepted,
+                "accept_rate": round(
+                    self.spec_accepted / max(self.spec_proposed, 1), 4)}
+        if self._quant_stats is not None:
+            out["quant"] = dict(self._quant_stats)
         if self.prefix is not None:
             out["prefix_cache"] = {
                 "entries": len(self.prefix),

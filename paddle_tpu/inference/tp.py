@@ -162,6 +162,21 @@ def shard_plan(plan: TPPlan, mesh) -> Dict[str, Any]:
     return place(plan.params, plan.specs)
 
 
+def _w(leaf):
+    """Weight-only quantized leaves (``{"q", "s"}`` pairs installed by
+    `inference/quant.quantize_plan`) dequantize IN-TRACE right before
+    their matmul — XLA fuses the per-channel scale multiply into the
+    contraction, so device weight residency stays int8.  The scale was
+    computed per channel BEFORE sharding and keeps its reduced axis, so
+    each rank's (q, s) shard dequantizes bit-identically to a slice of
+    the full dequantized matrix — quant composes with the TP bit-parity
+    contract."""
+    if isinstance(leaf, dict):
+        from ..quantization.weight_only import dequantize_int8
+        return dequantize_int8(leaf["q"], leaf["s"])
+    return leaf
+
+
 def _layer_norm(x, w, b, eps):
     # exact mirror of nn/functional/norm.py::_layer_norm_impl over the
     # last axis (the only shape GPT uses) — parity with degree 1 demands
@@ -190,7 +205,8 @@ def forward_tp(meta, params, ids, pools, tables, seq_lens, pos_offset,
     # adds exact zeros elsewhere
     v0 = (idx * Vl).astype(ids.dtype)
     in_range = (ids >= v0) & (ids < v0 + Vl)
-    rows = jnp.take(params["wte"], jnp.clip(ids - v0, 0, Vl - 1), axis=0)
+    wte = _w(params["wte"])   # also the tied head below
+    rows = jnp.take(wte, jnp.clip(ids - v0, 0, Vl - 1), axis=0)
     rows = jnp.where(in_range[..., None], rows, 0)
     pos = jnp.arange(s, dtype=jnp.int32) + pos_offset
     x = jax.lax.psum(rows, AXIS) + jnp.take(params["wpe"], pos, axis=0)
@@ -202,7 +218,8 @@ def forward_tp(meta, params, ids, pools, tables, seq_lens, pos_offset,
     for li, blk in enumerate(params["blocks"]):
         eps1, eps2 = meta["ln_eps"][li]
         h = _layer_norm(x, blk["ln1_w"], blk["ln1_b"], eps1)
-        qkv = jnp.matmul(h, blk["qkv_w"].reshape(meta["H"], 3 * nh_l * hd)) \
+        qkv = jnp.matmul(h, _w(blk["qkv_w"]).reshape(
+            meta["H"], 3 * nh_l * hd)) \
             + blk["qkv_b"].reshape(3 * nh_l * hd)
         qkv = qkv.reshape(B, s, 3, nh_l, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -211,12 +228,13 @@ def forward_tp(meta, params, ids, pools, tables, seq_lens, pos_offset,
         new_view, out = view.update_and_attend(q, k, v)
         new_pools.append((new_view.k, new_view.v))
         out = gather(out.reshape(B, s, nh_l * hd))        # heads -> full
-        y = gather(jnp.matmul(out, blk["proj_w"]) + blk["proj_b"])
+        y = gather(jnp.matmul(out, _w(blk["proj_w"])) + blk["proj_b"])
         x = x + y
         h2 = _layer_norm(x, blk["ln2_w"], blk["ln2_b"], eps2)
         a = gather(jax.nn.gelu(
-            jnp.matmul(h2, blk["fc1_w"]) + blk["fc1_b"], approximate=True))
-        x = x + gather(jnp.matmul(a, blk["fc2_w"]) + blk["fc2_b"])
+            jnp.matmul(h2, _w(blk["fc1_w"])) + blk["fc1_b"],
+            approximate=True))
+        x = x + gather(jnp.matmul(a, _w(blk["fc2_w"])) + blk["fc2_b"])
     h = _layer_norm(x, params["lnf_w"], params["lnf_b"], meta["lnf_eps"])
-    logits = gather(jnp.matmul(h, jnp.swapaxes(params["wte"], -1, -2)))
+    logits = gather(jnp.matmul(h, jnp.swapaxes(wte, -1, -2)))
     return logits, new_pools

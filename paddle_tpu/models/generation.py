@@ -51,6 +51,24 @@ def _process_logits_rows(logits, temperature, top_k, top_p):
     return logits
 
 
+def _process_logits_tokens(logits, temperature, top_k, top_p):
+    """k-token twin of `_process_logits_rows` for the speculative-decode
+    verify forward: ``logits`` is [B, S, V] (one row per scored chunk
+    position) and each SLOT's sampling params apply to every one of its
+    S positions.  Row-major flatten keeps slot b's position s at index
+    ``b * S + s``, so `jnp.repeat(params, S)` lines the params up with
+    the flattened rows exactly.
+
+    logits: jnp (B, S, V) float; temperature/top_p float [B]; top_k
+    int [B].  Returns filtered logits, same shape.
+    """
+    B, S, V = logits.shape
+    rows = _process_logits_rows(
+        logits.reshape(B * S, V), jnp.repeat(temperature, S),
+        jnp.repeat(top_k, S), jnp.repeat(top_p, S))
+    return rows.reshape(B, S, V)
+
+
 def _process_logits(logits, temperature, top_k, top_p):
     """logits: jnp (B, V) -> filtered logits ready for sampling."""
     if temperature != 1.0:

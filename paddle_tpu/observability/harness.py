@@ -319,7 +319,9 @@ def regression_check(current: Sequence[Dict[str, Any]],
 
     ``current`` is this run's harness records; ``previous`` a path to a
     BENCH_*.json (default: newest in the repo); ``keys`` maps rung name ->
-    higher-is-better metric key.  Separates code regressions from
+    higher-is-better metric key (or a sequence of them — the first
+    labels the rung, the rest report as ``<rung>.<key>``).  Separates
+    code regressions from
     tunnel-window artifacts the way round 4/5 learned to (a latency-bound
     rung whose drop tracks the dispatch-floor worsening is ENV-SUSPECT,
     not a regression).
@@ -337,14 +339,24 @@ def regression_check(current: Sequence[Dict[str, Any]],
     if env_probe is None:
         env_probe = cur_by_name.get("env_probe", {})
     deltas: Dict[str, float] = {}
-    for name, key in keys.items():
+    rung_of: Dict[str, str] = {}
+    for name, keyspec in keys.items():
+        # a rung may own several regression keys (e.g. spec_decode's
+        # speedup AND weight ratio): the first labels the rung itself,
+        # the rest label as "<rung>.<key>"
+        key_list = ((keyspec,) if isinstance(keyspec, str)
+                    else tuple(keyspec))
         if name not in cur_by_name or name not in prev:
             continue
-        if key not in cur_by_name[name] or key not in prev[name]:
-            continue
-        old, new = float(prev[name][key]), float(cur_by_name[name][key])
-        if old > 0:
-            deltas[name] = round((new - old) / old, 4)
+        for i, key in enumerate(key_list):
+            if key not in cur_by_name[name] or key not in prev[name]:
+                continue
+            label = name if i == 0 else f"{name}.{key}"
+            old = float(prev[name][key])
+            new = float(cur_by_name[name][key])
+            if old > 0:
+                deltas[label] = round((new - old) / old, 4)
+                rung_of[label] = name
     if not deltas:
         return None
     prev_env = prev.get("env_probe", {})
@@ -356,7 +368,7 @@ def regression_check(current: Sequence[Dict[str, Any]],
     for name, v in sorted(deltas.items()):
         if v >= -0.03:
             continue
-        cur = cur_by_name[name]
+        cur = cur_by_name[rung_of[name]]
         reason = None
         if cur.get("latency_bound") and floor:
             if pfloor:

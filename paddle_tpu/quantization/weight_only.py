@@ -1,0 +1,53 @@
+"""Weight-only int8 quantization for the inference path.
+
+Parity seat: the reference's weight-only quantized inference ops
+(`paddle/phi/kernels/fusion/gpu/fused_weight_only_linear_pass` family,
+AWQ/GPTQ-style deployment in PaddleNLP): matmul weights are stored as
+int8 with per-output-channel absmax scales and dequantized inside the
+compiled matmul, trading a cheap elementwise multiply for ~4x less
+weight memory (fp32 baseline; the reference counts ~2x from fp16).
+
+TPU-native shape: quantization happens ONCE at engine weight-snapshot
+time (host side); the int8 tensor + scale ride into the compiled
+program as inputs, and `dequantize_int8` runs INSIDE the traced
+program, so XLA fuses the scale multiply into the consumer matmul and
+device weight residency is int8.
+
+The per-channel contract that makes tensor-parallel slicing safe:
+scales keep their reduced axis (``keepdims=True``), so a scale tensor
+has exactly the weight's rank with size 1 on the reduction axis.
+Because every channel is quantized independently, slicing along any
+NON-reduced axis commutes with quantization bit-for-bit:
+``quantize(w)[..., s]  ==  quantize(w[..., s])`` — which is why a TP
+plan can quantize first and shard after (inference/quant.py) and still
+be bit-identical to a rank-local quantization.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_absmax_int8", "dequantize_int8", "QMAX"]
+
+QMAX = 127  # symmetric int8: the -128 code is never produced
+
+
+def quantize_absmax_int8(w, axis: int = 0):
+    """Per-channel symmetric absmax int8 over the ``axis`` dimension
+    (the matmul contraction axis, so each OUTPUT channel owns a scale).
+
+    Returns ``(q, scale)``: ``q`` int8 with ``w``'s shape, ``scale``
+    ``w``'s dtype with ``shape[axis] == 1`` (keepdims).  All-zero
+    channels quantize to zeros with scale 1 (dequant stays exact).
+    """
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / QMAX, 1).astype(w.dtype)
+    q = jnp.clip(jnp.round(w / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale):
+    """``q * scale`` back in the scale's (original weight) dtype; traced
+    inside compiled programs so XLA fuses it into the consuming matmul."""
+    return (q.astype(scale.dtype) * scale)

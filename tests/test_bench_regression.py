@@ -277,6 +277,71 @@ def test_serving_tp_rung_schema():
     assert val["ttft_p50_ms_tp1"] > 0 and val["ttft_p50_ms_tp2"] > 0
 
 
+@pytest.mark.slow   # the subprocess compiles four engine configs —
+                    # too heavy for the tier-1 budget; full runs cover it
+def test_spec_decode_rung_schema():
+    """Pin the ISSUE 10 `spec_decode` rung's record schema: the
+    spec {off,on} x quant {off,int8} sweep with both parity verdicts,
+    the acceptance rate, and BOTH regression keys
+    (`spec_decode_speedup`, `quant_weight_ratio`) wired as a tuple —
+    exercising the multi-key regression_check path."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_spec", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_spec_decode(ctx)
+    rec = {"rung": "spec_decode", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("spec_decode").smoke
+    assert bench._REGRESSION_KEYS["spec_decode"] == (
+        "spec_decode_speedup", "quant_weight_ratio")
+    # the acceptance claims: spec greedy streams are lossless (with and
+    # without quant), the same-weights draft accepts ~everything, and
+    # the int8 snapshot really shrinks the weights
+    assert val["parity_spec_vs_plain"] is True
+    assert val["parity_spec_quant"] is True
+    assert val["spec_accept_rate"] > 0.9
+    assert val["quant_weight_ratio"] > 2.0
+    assert val["spec_decode_speedup"] > 0
+    for key in ("tokens_per_sec_plain", "tokens_per_sec_spec",
+                "tokens_per_sec_quant", "tokens_per_sec_spec_quant"):
+        assert val[key] > 0, key
+
+
+def test_multi_key_regression_check_labels_secondary_keys(tmp_path):
+    """The harness accepts a tuple of regression keys per rung: the
+    first labels the rung, later ones report as `<rung>.<key>` — both
+    deltas computed against the previous artifact."""
+    import json as _json
+    prev = tmp_path / "BENCH_r90.json"
+    prev.write_text(_json.dumps({
+        "schema": harness.SCHEMA,
+        "records": [{"rung": "spec_decode", "ok": True, "device": "cpu",
+                     "elapsed_s": 1.0,
+                     "value": {"spec_decode_speedup": 2.0,
+                               "quant_weight_ratio": 4.0}}]}))
+    cur = [{"rung": "spec_decode", "ok": True, "device": "cpu",
+            "elapsed_s": 1.0,
+            "value": {"spec_decode_speedup": 1.0,
+                      "quant_weight_ratio": 4.0}}]
+    rep = harness.regression_check(
+        cur, previous=str(prev),
+        keys={"spec_decode": ("spec_decode_speedup",
+                              "quant_weight_ratio")})
+    assert rep["rel_delta"]["spec_decode"] == -0.5
+    assert rep["rel_delta"]["spec_decode.quant_weight_ratio"] == 0.0
+    assert "spec_decode" in rep["regressed"]
+
+
 def test_analyze_rung_schema():
     """Pin the ISSUE 8 `analyze` rung's record schema: graft-lint wall
     seconds + findings counts over the tree, regression key

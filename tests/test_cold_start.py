@@ -224,6 +224,38 @@ def test_warmup_fallback_parity_with_unwarmed(model):
     assert serve(True) == baseline
 
 
+def test_warmup_grid_spec_quant_zero_compiles(model):
+    """ISSUE 10 acceptance: with spec decode AND int8 quant on, the
+    warmup grid gains exactly the spec tick (draft/verify programs:
+    prefill/cont/cow absorb the draft writes without new programs) and
+    mixed post-warmup traffic still triggers ZERO compile-tracker
+    events."""
+    paddle.seed(0)
+    draft = GPTForCausalLM(gpt3_tiny())
+    draft.eval()
+    vocab = model.cfg.vocab_size
+    with flag_guard(serving_warmup=True, serving_pad_buckets="16,32,64"):
+        eng = ServingEngine(model, max_batch=2, max_context=128,
+                            block_size=16, steps_per_tick=2,
+                            draft_model=draft, spec_decode=True,
+                            spec_k=3, quant="int8")
+        info = eng.warmup()
+        # the 10-program prefix grid + the one spec tick
+        assert info["programs"] == 11
+        assert [g["program"] for g in info["grid"]].count("spec_tick") \
+            == 1
+        assert next(g for g in info["grid"]
+                    if g["program"] == "spec_tick")["spec_k"] == 3
+        before = compile_tracker.total_compiles()
+        reqs = _drive_mixed_traffic(eng, vocab, (12, 20, 40, 60))
+        assert compile_tracker.total_compiles() == before
+        assert all(len(r.output_ids) == 7 for r in reqs)
+        st = eng.stats()
+        assert st["speculative"]["ticks"] > 0
+        assert st["quant"]["mode"] == "int8"
+        assert st["warmup"]["programs"] == 11
+
+
 def test_warmup_covers_both_sampling_variants(model):
     """The grid always includes the host-sampling decode program AND
     the device-sampling tick: FLAGS_serving_device_sampling is read
