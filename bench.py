@@ -82,6 +82,8 @@ _REGRESSION_KEYS = {
     "cold_start": "cold_start_warm_speedup",
     "serving_tp": "prefix_hit_speedup",
     "spec_decode": ("spec_decode_speedup", "quant_weight_ratio"),
+    "continuous_batching": ("goodput_under_slo",
+                            "long_arrival_tpot_ratio"),
     "analyze": "analyze_files_per_sec",
 }
 
@@ -1524,6 +1526,160 @@ print("RESULT " + json.dumps(out))
                 res["spec0_quant1"]["quant_weight_ratio"],
             "parity_spec_vs_plain": bool(res["parity_spec_vs_plain"]),
             "parity_spec_quant": bool(res["parity_spec_quant"])}
+
+
+@harness.register_rung("continuous_batching", est_cold_s=240, smoke=True)
+def bench_continuous_batching(ctx):
+    """ISSUE 11 rung: continuous-batching evidence, measured CLIENT-side
+    (the driver timestamps each request's token arrivals around the
+    synchronous step loop, so the numbers need no metric sketches and
+    reset per cell).
+
+    (a) Long-prompt-arrival stall: one short stream decodes while one
+    long prompt is absorbed; the stream's MAX inter-token gap is the
+    stall a monolithic prefill inflicts and chunked prefill bounds.
+    `long_arrival_tpot_ratio` (monolithic gap / chunked gap, regression
+    key) collapsing toward 1.0 means chunking stopped bounding tails.
+
+    (b) Open-loop Poisson arrivals at 2-3 RPS with mixed prompt
+    lengths, chunked vs monolithic: per request TTFT + inter-token
+    gaps; a request meets SLO iff TTFT and its max gap clear thresholds
+    calibrated from (a) (the gap SLO sits between the two stall
+    medians, so it separates exactly the behavior under test).
+    `goodput_under_slo` (regression key) is the CHUNKED engine's
+    SLO-meeting requests/sec at the highest RPS;
+    `goodput_ratio_vs_monolithic` tracks the comparison headline."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m, gpt3_tiny
+
+    on_tpu = ctx.on_tpu
+    paddle.seed(0)
+    # CPU smoke needs prefill COMPUTE to dominate per-program dispatch
+    # (the pools round-trip per program without donation there), or the
+    # stall under test hides in fixed floors: a big vocab makes the
+    # monolithic prompt's final projection the stall, while pools stay
+    # small enough that a decode tick is cheap
+    cfg = gpt3_124m() if on_tpu else gpt3_tiny(vocab_size=8192,
+                                               max_seq_len=512)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    scale = 4 if on_tpu else 1
+    max_ctx = 512 * scale
+    long_len = 448 * scale
+    chunk_sz = 32 * scale
+    ladder = ",".join(str(v * scale) for v in (32, 64, 512))
+
+    def build(chunk):
+        # prefix cache OFF: a repeated long prompt would hit the index
+        # and prefill a 1-token suffix, erasing the stall this rung
+        # exists to measure (prefix reuse has its own serving_tp rung)
+        eng = ServingEngine(model, max_batch=2, max_context=max_ctx,
+                            block_size=32 * scale, steps_per_tick=1,
+                            prefill_chunk=chunk, pad_buckets=ladder,
+                            prefix_cache=False)
+        eng.warmup()       # timed windows must measure compute only
+        return eng
+
+    engines = {0: build(0), chunk_sz: build(chunk_sz)}
+
+    def drive(eng, arrivals, reqs):
+        """Synchronous step loop honoring an open-loop arrival
+        schedule; returns per-request (ttft_s, [gap_s...])."""
+        recs = [{"t_arr": None, "t_first": None, "t_last": None,
+                 "n": 0, "gaps": []} for _ in reqs]
+        t0 = time.perf_counter()
+        i = 0
+        while i < len(reqs) or eng.waiting or eng.prefilling \
+                or eng._active_slots():
+            now = time.perf_counter() - t0
+            while i < len(reqs) and arrivals[i] <= now:
+                recs[i]["t_arr"] = time.perf_counter()
+                eng.add_request(reqs[i])
+                i += 1
+            if eng.waiting or eng.prefilling or eng._active_slots():
+                eng.step()
+                t = time.perf_counter()
+                for r, rec in zip(reqs, recs):
+                    if rec["t_arr"] is None:
+                        continue
+                    n1 = len(r.output_ids)
+                    if n1 > rec["n"]:
+                        if rec["t_first"] is None:
+                            rec["t_first"] = t
+                        else:
+                            rec["gaps"].append(
+                                (t - rec["t_last"]) / (n1 - rec["n"]))
+                        rec["t_last"], rec["n"] = t, n1
+            elif i < len(reqs):
+                time.sleep(max(0.0, min(
+                    0.002, arrivals[i] - (time.perf_counter() - t0))))
+        eng.finished.clear()
+        wall = time.perf_counter() - t0
+        return recs, wall
+
+    # ---- (a) the stall A/B: running stream + one long arrival
+    def long_arrival_gap(chunk):
+        eng = engines[chunk]
+        rng = np.random.RandomState(7)
+        stream = Request(rng.randint(1, cfg.vocab_size, (8,)),
+                         max_new_tokens=80)
+        burst = Request(rng.randint(1, cfg.vocab_size, (long_len,)),
+                        max_new_tokens=4)
+        # the long prompt must arrive while the stream is MID-decode —
+        # same-boundary admission would put the stall before the
+        # stream's first token, where no inter-token gap can see it
+        recs, _ = drive(eng, [0.0, 0.3], [stream, burst])
+        return max(recs[0]["gaps"])
+
+    reps = 3 if ctx.smoke else 5
+    gap_mono = float(np.median([long_arrival_gap(0) for _ in range(reps)]))
+    gap_chunked = float(np.median(
+        [long_arrival_gap(chunk_sz) for _ in range(reps)]))
+    ratio = gap_mono / max(gap_chunked, 1e-9)
+
+    # ---- (b) Poisson arrivals; SLO calibrated between the two stalls
+    gap_slo = (gap_mono + gap_chunked) / 2.0
+    ttft_slo = 2.0          # seconds; queue pathologies, not decode noise
+    rps_levels = (2.0, 3.0)
+    n_req = 8 if ctx.smoke else 16
+    out = {}
+    for rps in rps_levels:
+        for chunk in (0, chunk_sz):
+            rng = np.random.RandomState(int(rps * 10))
+            lens = rng.choice([8, 16, 48, long_len], size=n_req,
+                              p=[0.3, 0.3, 0.2, 0.2])
+            arrivals = np.cumsum(rng.exponential(1.0 / rps, size=n_req))
+            reqs = [Request(rng.randint(1, cfg.vocab_size, (int(L),)),
+                            max_new_tokens=16) for L in lens]
+            recs, wall = drive(engines[chunk], list(arrivals), reqs)
+            good = sum(
+                1 for rec in recs
+                if rec["t_first"] is not None
+                and rec["t_first"] - rec["t_arr"] <= ttft_slo
+                and (not rec["gaps"] or max(rec["gaps"]) <= gap_slo))
+            gaps = sorted(g for rec in recs for g in rec["gaps"])
+            p99 = gaps[min(len(gaps) - 1,
+                           int(len(gaps) * 0.99))] if gaps else 0.0
+            key = f"rps{rps:g}_{'chunked' if chunk else 'mono'}"
+            out[key] = {"goodput_rps": round(good / wall, 3),
+                        "good": good, "requests": n_req,
+                        "tpot_p99_ms": round(p99 * 1e3, 3)}
+    top = f"rps{rps_levels[-1]:g}"
+    chunked_good = out[f"{top}_chunked"]["goodput_rps"]
+    mono_good = out[f"{top}_mono"]["goodput_rps"]
+    return {"goodput_under_slo": chunked_good,
+            "goodput_monolithic": mono_good,
+            "goodput_ratio_vs_monolithic": round(
+                chunked_good / max(mono_good, 1e-9), 3),
+            "long_arrival_tpot_ratio": round(ratio, 2),
+            "long_arrival_gap_mono_ms": round(gap_mono * 1e3, 3),
+            "long_arrival_gap_chunked_ms": round(gap_chunked * 1e3, 3),
+            "tpot_p99_ms_chunked": out[f"{top}_chunked"]["tpot_p99_ms"],
+            "tpot_p99_ms_mono": out[f"{top}_mono"]["tpot_p99_ms"],
+            "gap_slo_ms": round(gap_slo * 1e3, 3),
+            "prefill_chunk": chunk_sz,
+            "levels": out}
 
 
 @harness.register_rung("analyze", est_cold_s=40, smoke=True)

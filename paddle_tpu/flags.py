@@ -361,6 +361,44 @@ define_flag("serving_quant", "",
             "and spec decode.  Empty (the default) serves full-precision "
             "weights")
 
+# Continuous batching: chunked prefill + SLO-aware scheduling + the
+# streaming serve endpoint (inference/serving.py, observability/http.py
+# — ISSUE 11).
+define_flag("serving_prefill_chunk", 0,
+            "chunked prefill: absorb an arriving prompt in chunks of at "
+            "most this many tokens, interleaved between decode ticks, so "
+            "a running stream's inter-token gap is bounded by one chunk "
+            "+ one tick regardless of arriving prompt length.  Chunks "
+            "run the suffix-prefill (prefill_cont) program per ladder "
+            "bucket — streams stay BIT-identical to monolithic prefill "
+            "and the warmup grid stays enumerable.  0 (the default) "
+            "keeps legacy whole-prompt prefill")
+define_flag("serving_prefill_chunks_per_tick", 1,
+            "scheduler budget: prefill chunk programs dispatched per "
+            "tick boundary (the N of 'one decode tick + up to N "
+            "chunks'); higher drains arriving prompts faster at the "
+            "price of longer inter-token gaps for running streams")
+define_flag("serving_slo_shed", False,
+            "SLO-aware load shedding: at each scheduler boundary, while "
+            "the live TTFT/TPOT p99 sketches breach their "
+            "FLAGS_serving_{ttft,tpot}_slo_ms targets AND the waiting "
+            "queue is deeper than FLAGS_serving_shed_queue_depth, the "
+            "newest lowest-priority waiting requests are rejected with "
+            "reason=slo_shed (serving.slo_sheds counter) instead of "
+            "queueing into certain SLO violations.  Needs "
+            "FLAGS_enable_metrics (the sketches are the evidence)")
+define_flag("serving_shed_queue_depth", 8,
+            "waiting-queue watermark for FLAGS_serving_slo_shed: "
+            "shedding only engages while more requests than this are "
+            "queued for admission")
+define_flag("serving_http_port", 0,
+            "TCP port of the streaming serve endpoint (POST /generate, "
+            "Server-Sent Events token stream; same daemon also answers "
+            "the /metrics//healthz//requests scrapes), started by "
+            "ServingEngine.run()/serve_forever(); 0 (the default) = no "
+            "server.  Binds 127.0.0.1 — widening exposure is an "
+            "explicit operator decision, like FLAGS_metrics_host")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

@@ -66,6 +66,28 @@ absmax int8 at construction and dequantizes in-trace
 (`inference/quant.py`): ~4x less fp32 weight memory on device, bounded
 logit deviation, bit-exact across TP degrees.
 
+Continuous batching (ISSUE 11): ``FLAGS_serving_prefill_chunk`` makes
+prefill INCREMENTAL — an arriving prompt of any length is absorbed as
+bounded-size chunks of the suffix-prefill program (one per ladder
+bucket, ``start``/length traced scalars — zero new program shapes),
+interleaved between decode ticks by a per-tick scheduler that budgets
+each boundary as "one decode tick + up to
+``FLAGS_serving_prefill_chunks_per_tick`` chunk(s)".  Running streams'
+inter-token gap is bounded by one chunk + one tick regardless of
+arriving prompt length, and the chunked streams are BIT-identical to
+monolithic prefill (same `PagedChunkView` writes, same offset causal
+mask).  A mid-prefill slot keeps its table row SHADOWED on the request
+(the engine row stays zero) so overlapping decode ticks stay inert for
+it.  The scheduler is also SLO-aware: ``FLAGS_serving_slo_shed``
+rejects (reason=slo_shed) the newest lowest-priority waiting requests
+while the live TTFT/TPOT p99 sketches breach their targets and the
+queue is past ``FLAGS_serving_shed_queue_depth``; `Request(priority=)`
+orders admission.  ``FLAGS_serving_http_port`` exposes the engine as a
+minimal streaming frontend: ``POST /generate`` answers a Server-Sent
+Events token stream (`observability/http.py`), with client disconnect
+and timeout propagating to `Request.cancel()` -> slot eviction and
+block release at the next boundary.
+
 Cold start (ISSUE 7): the set of programs the engine can EVER dispatch
 is small and enumerable — one tick program per {steps_per_tick, 1-step
 tail} (greedy and sampled share it: sampling params are device inputs
@@ -149,6 +171,16 @@ _M_SPEC_PROPOSED = _metrics.counter(
 _M_SPEC_ACCEPTED = _metrics.counter(
     "serving.spec_accepted_tokens", "draft tokens accepted by the "
     "verify forward (greedy argmax match or rejection-sampling accept)")
+_M_PREFILL_CHUNKS = _metrics.counter(
+    "serving.prefill_chunks", "chunk prefill programs dispatched by the "
+    "continuous-batching scheduler (FLAGS_serving_prefill_chunk > 0: an "
+    "arriving prompt is absorbed in bounded chunks between decode ticks "
+    "instead of one monolithic prefill)")
+_M_SLO_SHEDS = _metrics.counter(
+    "serving.slo_sheds", "waiting requests rejected by SLO-aware load "
+    "shedding (FLAGS_serving_slo_shed: live TTFT/TPOT p99 over target "
+    "AND queue depth over the watermark); every shed also counts on "
+    "serving.rejections{reason=slo_shed}")
 
 # --- request lifecycle tracing (ISSUE 6): every request's
 # enqueue -> admit (queue wait) -> prefill -> first token -> per-tick
@@ -191,7 +223,7 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, priority: int = 0):
         Request._counter += 1
         self.rid = Request._counter
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -211,6 +243,27 @@ class Request:
         self.output_ids: List[int] = []
         self.done = False
         self.slot: Optional[int] = None
+        # scheduler knobs (ISSUE 11): higher priority admits first among
+        # waiting requests (FIFO within a priority); cancel() asks the
+        # engine to drop the request at its next scheduler boundary
+        # (waiting -> dropped, mid-prefill -> aborted, running -> slot
+        # evicted + blocks released) — a bare bool store, so the serve
+        # endpoint's handler threads may call it without a lock
+        self.priority = int(priority)
+        self.cancelled = False
+        self.shed = False             # rejected by SLO load shedding
+        # chunked-prefill admission state (engine-owned; the table row
+        # lives HERE — shadowing self.tables — until the last chunk
+        # lands, so in-flight decode ticks see an all-zero row and
+        # route their seq_len-0 writes to the pad block)
+        self._prefilling = False
+        self._prefill_chunks = 0
+        self._chunk_row = None        # np [nb_per_seq] shadow table row
+        self._chunk_off = 0           # prompt tokens written so far
+        self._chunk_t_admit = None
+        # token stream listener (the SSE endpoint): harvest puts each
+        # emitted token id, terminal states put None
+        self._stream_q = None
         # lifecycle trace timestamps (perf_counter; stamped only while
         # FLAGS_enable_metrics is on — None means "not traced")
         self._t_enqueue: Optional[float] = None
@@ -222,6 +275,17 @@ class Request:
         self._spec_proposed = 0   # draft tokens proposed for this request
         self._spec_accepted = 0   # ...and accepted by the verify forward
         self.trace: Optional[dict] = None   # final record, set at finish
+
+    def cancel(self) -> None:
+        """Ask the engine to drop this request at its next scheduler
+        boundary.  Safe from any thread (the serve endpoint calls it on
+        client disconnect / request timeout)."""
+        self.cancelled = True
+
+    def _stream_push(self, tok: Optional[int]) -> None:
+        q = self._stream_q
+        if q is not None:
+            q.put(tok)
 
     def _sample(self, logits_row: np.ndarray) -> int:
         if not self.do_sample:
@@ -247,7 +311,8 @@ class _PendingTick:
 
     __slots__ = ("active", "k", "toks", "logits", "reqs", "t0",
                  "device_sampling", "overlapped", "step_no", "san",
-                 "spec", "counts", "accepts", "new_lens", "new_last")
+                 "spec", "counts", "accepts", "new_lens", "new_last",
+                 "chunks")
 
     def __init__(self, active, k, toks, logits, reqs, t0,
                  device_sampling, step_no, san=None):
@@ -266,6 +331,7 @@ class _PendingTick:
         self.accepts = None
         self.new_lens = None
         self.new_last = None
+        self.chunks = 0     # prefill chunks run at this tick's boundary
 
 
 def _next_tokens(logits, do_sample, temperature, top_k, top_p, seeds,
@@ -314,7 +380,8 @@ class ServingEngine:
                  prefix_cache: Optional[bool] = None,
                  draft_model=None, spec_decode: Optional[bool] = None,
                  spec_k: Optional[int] = None,
-                 quant: Optional[str] = None):
+                 quant: Optional[str] = None,
+                 prefill_chunk: Optional[int] = None):
         # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
         # host round trip harvests k tokens per slot (the tunnel's RTT
         # otherwise caps serving at ~1/RTT steps); admissions join at
@@ -533,6 +600,25 @@ class ServingEngine:
             ladder = self._default_ladder()
         self.pad_ladder = ladder
         self._warmup_info = None
+        # --- chunked prefill (ISSUE 11): absorb arriving prompts in
+        # chunks of at most `chunk` tokens, each a suffix-prefill
+        # (prefill_cont) program at a traced offset, interleaved between
+        # decode ticks by the per-tick scheduler.  Snapshot at
+        # construction like the pad ladder: the warmup grid (which
+        # programs exist) must not shift under a running engine.
+        chunk = (prefill_chunk if prefill_chunk is not None
+                 else _flags.get_flag("serving_prefill_chunk"))
+        self.chunk = int(chunk)
+        if self.chunk < 0:
+            raise ValueError(
+                f"serving_prefill_chunk must be >= 0: {self.chunk}")
+        # admissions mid-chunked-prefill, oldest first (the scheduler
+        # finishes the oldest before starting the next: chunk budget
+        # spent round-robin would inflate EVERY waiting TTFT)
+        self.prefilling: deque = deque()
+        self.prefill_chunks_total = 0
+        self.slo_sheds = 0
+        self._chunks_this_boundary = 0
 
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
@@ -1096,24 +1182,31 @@ class ServingEngine:
                 n_aot += was_aot
                 grid.append({"program": "spec_tick",
                              "spec_k": self.spec_k})
-            for L_pad in self.pad_ladder:
-                dpref = ((dvals, self.pools, self.dpools) if self.spec
-                         else (self.pools,))
-                out, was_aot = self._warm_call(
-                    self._prefill_program(L_pad),
-                    (param_vals,) + dpref + (z((1, nb), jnp.int32),
-                     z((1, L_pad), jnp.int32), jnp.int32(1)), aot,
-                    lambda f, _L=L_pad:
-                        self._prefill_fns.__setitem__(_L, f))
-                self.pools = out[1]
-                _set_dpools(out[2] if self.spec else None)
-                n_aot += was_aot
-                grid.append({"program": "prefill", "L_pad": L_pad})
-            if self.prefix is not None:
-                # prefix-cache hit path: one suffix-prefill program per
-                # ladder bucket + the CoW block copy.  Dummies are inert:
-                # an all-zero table routes every write to scratch block 0
-                # and the CoW copies block 0 onto itself.
+            if self.chunk <= 0:
+                # monolithic prefill: one program per ladder bucket.  A
+                # CHUNKED engine (FLAGS_serving_prefill_chunk > 0) never
+                # dispatches these — every admission runs the
+                # suffix-prefill chunk programs below instead, so the
+                # grid swaps one program family for the other.
+                for L_pad in self.pad_ladder:
+                    dpref = ((dvals, self.pools, self.dpools)
+                             if self.spec else (self.pools,))
+                    out, was_aot = self._warm_call(
+                        self._prefill_program(L_pad),
+                        (param_vals,) + dpref + (z((1, nb), jnp.int32),
+                         z((1, L_pad), jnp.int32), jnp.int32(1)), aot,
+                        lambda f, _L=L_pad:
+                            self._prefill_fns.__setitem__(_L, f))
+                    self.pools = out[1]
+                    _set_dpools(out[2] if self.spec else None)
+                    n_aot += was_aot
+                    grid.append({"program": "prefill", "L_pad": L_pad})
+            if self.prefix is not None or self.chunk > 0:
+                # suffix-prefill-at-offset programs: the prefix-cache
+                # hit path AND the chunked-prefill path (one program per
+                # ladder bucket; `start` is traced, so every split point
+                # and chunk offset shares it).  Dummies are inert: an
+                # all-zero table routes every write to scratch block 0.
                 for L_pad in self.pad_ladder:
                     dpref = ((dvals, self.pools, self.dpools)
                              if self.spec else (self.pools,))
@@ -1129,6 +1222,9 @@ class ServingEngine:
                     n_aot += was_aot
                     grid.append({"program": "prefill_cont",
                                  "L_pad": L_pad})
+            if self.prefix is not None:
+                # the CoW block copy (the cache copies block 0 onto
+                # itself during warmup — inert)
                 cow_args = ((self.pools, self.dpools) if self.spec
                             else (self.pools,))
                 out, was_aot = self._warm_call(
@@ -1262,8 +1358,10 @@ class ServingEngine:
     def _try_admit(self) -> bool:
         if not self.waiting or not self.free_slots:
             return False
+        self._promote_waiting()
         req = self.waiting[0]
         L = len(req.prompt_ids)
+        chunked = self.chunk > 0
         # --- prefix lookup: the longest resident full-block prefix is a
         # pointer copy; reuse is capped at L-1 so at least one suffix
         # token runs forward (its logits are the request's first token).
@@ -1291,7 +1389,10 @@ class ServingEngine:
                 chain, cached_len = [], 0
         split_col = cached_len // self.bs
         cow = bool(chain) and (cached_len % self.bs != 0)
-        if chain:
+        if chain or chunked:
+            # exact blocks for the real prompt span: suffix/chunk writes
+            # go through PagedChunkView, whose padded positions route to
+            # the pad block — no bucket over-allocation to release
             need_now = self._blocks_for(L) - split_col
         else:
             L_pad = self._pad_bucket(L)
@@ -1341,13 +1442,20 @@ class ServingEngine:
         t_admit = time.perf_counter() if _metrics.enabled() else None
         slot = self.free_slots.popleft()
         blocks = [self._alloc_block() for _ in range(need_now)]
-        self.tables[slot, :] = 0
+        table_row = np.zeros((self.nb_per_seq,), np.int32)
         for col, b in enumerate(chain[:split_col]):
-            self.tables[slot, col] = b
+            table_row[col] = b
         for i, b in enumerate(blocks):
-            self.tables[slot, split_col + i] = b
+            table_row[split_col + i] = b
         req._growth_left = growth
         self.reserved += growth
+        if chunked:
+            # chunked admission: the prompt is absorbed between decode
+            # ticks by the per-tick scheduler, not here
+            return self._begin_chunked(req, slot, table_row, chain,
+                                       split_col, cow_src, cached_len,
+                                       t_admit)
+        self.tables[slot, :] = table_row
 
         try:
             with self._params_for_call() as param_vals:
@@ -1449,6 +1557,14 @@ class ServingEngine:
             else:
                 self.prefix.misses += 1
                 _M_PREFIX_MISSES.inc()
+        self._finish_admission(req, slot, row, t_admit)
+        return True
+
+    def _finish_admission(self, req, slot, row, t_admit) -> None:
+        """Shared admission tail (monolithic and chunked): host-sync the
+        prefill logits into the first token, stamp queue-wait/TTFT, and
+        activate the slot for decode ticks."""
+        L = len(req.prompt_ids)
         _M_ADMISSIONS.inc()
         first = req._sample(np.asarray(row))
         if t_admit is not None:
@@ -1466,6 +1582,7 @@ class ServingEngine:
                 if slo > 0 and ttft * 1e3 > slo:
                     _M_SLO.inc(metric="ttft")
         req.output_ids.append(first)
+        req._stream_push(first)
         req.slot = slot
         self.slot_req[slot] = req
         self.seq_lens[slot] = L
@@ -1480,7 +1597,6 @@ class ServingEngine:
         _M_TOKENS.inc()
         self._update_occupancy()
         self._maybe_finish(req, first)
-        return True
 
     def _free_capacity(self) -> int:
         """Free blocks INCLUDING those held only by the prefix index —
@@ -1512,6 +1628,7 @@ class ServingEngine:
         if (req.eos_token_id is not None and tok == req.eos_token_id) or \
                 len(req.output_ids) >= req.max_new_tokens:
             req.done = True
+            req._stream_push(None)      # close the SSE token stream
             # _t_first may lag _t_enqueue if the metrics gate flipped
             # between enqueue and admission; trace only complete timelines
             if _metrics.enabled() and req._t_enqueue is not None \
@@ -1535,7 +1652,8 @@ class ServingEngine:
                "tpot_mean_s": round((t - req._t_first)
                                     / max(n_out - 1, 1), 6),
                "e2e_s": round(e2e, 6),
-               "prefix_blocks": req._prefix_blocks}
+               "prefix_blocks": req._prefix_blocks,
+               "prefill_chunks": req._prefill_chunks}
         if self.spec:
             rec["spec_accept_rate"] = round(
                 req._spec_accepted / max(req._spec_proposed, 1), 4)
@@ -1569,15 +1687,310 @@ class ServingEngine:
         self._update_occupancy()
 
     def _active_slots(self):
-        return [s for s in range(self.B) if self.slot_req[s] is not None]
+        # a slot mid-chunked-prefill is occupied but NOT decodable: its
+        # seq_len stays 0 (the tick treats the row as inert) and its
+        # table row stays all-zero until the last chunk installs it
+        return [s for s in range(self.B)
+                if self.slot_req[s] is not None
+                and not self.slot_req[s]._prefilling]
+
+    # -------------------------------------- per-tick scheduler (ISSUE 11)
+    def _boundary_schedule(self) -> None:
+        """The scheduler work of one REAL tick boundary.
+
+        Order of business: propagate cancellations (waiting -> dropped,
+        mid-prefill -> aborted, running -> evicted with blocks
+        released), shed SLO-doomed arrivals, then admit.  Legacy mode
+        (``FLAGS_serving_prefill_chunk`` = 0) keeps the historical
+        admit-then-evict order and whole-prompt admissions.  Chunked
+        mode budgets the boundary as "up to
+        ``FLAGS_serving_prefill_chunks_per_tick`` chunk programs":
+        finish the oldest in-flight prefill first, then begin new
+        admissions — so every running stream's inter-token gap is
+        bounded by (chunk budget x one chunk) + one decode tick no
+        matter how long the arriving prompts are."""
+        for slot in list(range(self.B)):
+            req = self.slot_req[slot]
+            if req is None or not req.cancelled:
+                continue
+            if req._prefilling:
+                self._abort_prefill(req, outcome="cancelled")
+            elif not req.done:
+                self._terminal_trace(req, "cancelled")
+                self._evict(slot)
+                req._stream_push(None)
+        if self.waiting and any(r.cancelled for r in self.waiting):
+            kept = deque()
+            for r in self.waiting:
+                if r.cancelled:
+                    self._terminal_trace(r, "cancelled")
+                    self.finished.append(r)
+                    r._stream_push(None)
+                else:
+                    kept.append(r)
+            self.waiting = kept
+            self._update_pressure()
+        self._shed_waiting()
+        if self.chunk <= 0:
+            while self._try_admit():
+                pass
+            self._evict_done()
+            return
+        # chunked: evict finished FIRST — their slots and blocks fund
+        # this boundary's chunk budget
+        self._evict_done()
+        budget = max(1, int(_flags.get_flag(
+            "serving_prefill_chunks_per_tick")))
+        spent = 0
+        while spent < budget:
+            if self.prefilling:
+                req = self.prefilling[0]
+                self._prefill_chunk_step(req)
+                if not req._prefilling and self.prefilling \
+                        and self.prefilling[0] is req:
+                    self.prefilling.popleft()
+                spent += 1
+                continue
+            # beginning an admission is host-only bookkeeping (+ at
+            # most one CoW copy) — it costs no chunk budget; its first
+            # chunk, dispatched by the next loop pass, does
+            if not self._try_admit():
+                break
+
+    def _evict_done(self) -> None:
+        for slot in list(range(self.B)):
+            req = self.slot_req[slot]
+            if req is not None and not req._prefilling and req.done:
+                self._evict(slot)
+
+    def _promote_waiting(self) -> None:
+        """Move the highest-priority waiting request (FIFO within a
+        priority) to the queue head.  All-equal priorities keep strict
+        FIFO — the head stays put and legacy behavior is unchanged."""
+        if len(self.waiting) < 2:
+            return
+        best = 0
+        for i in range(1, len(self.waiting)):
+            if self.waiting[i].priority > self.waiting[best].priority:
+                best = i
+        if best:
+            req = self.waiting[best]
+            del self.waiting[best]
+            self.waiting.appendleft(req)
+
+    def _slo_breached(self) -> bool:
+        """Are the LIVE p99 sketches over a configured SLO?  Shed
+        decisions consult observed violation, not a prediction; with
+        metrics off the sketches are empty and nothing ever sheds."""
+        ttft_slo = _flags.get_flag("serving_ttft_slo_ms")
+        if ttft_slo > 0 and _M_TTFT.count() \
+                and _M_TTFT.quantile(0.99) * 1e3 > ttft_slo:
+            return True
+        tpot_slo = _flags.get_flag("serving_tpot_slo_ms")
+        if tpot_slo > 0 and _M_TPOT.count() \
+                and _M_TPOT.quantile(0.99) * 1e3 > tpot_slo:
+            return True
+        return False
+
+    def _shed_waiting(self) -> None:
+        """SLO-aware load shedding (``FLAGS_serving_slo_shed``): while
+        the engine is ALREADY violating its latency targets and the
+        waiting queue is deeper than the watermark, reject the newest
+        lowest-priority waiting requests (reason=slo_shed) instead of
+        queueing them into certain violations.  Consulted inputs: the
+        live TTFT/TPOT p99 sketches + queue depth — not just pool
+        capacity."""
+        if not self.waiting or not _flags.get_flag("serving_slo_shed"):
+            return
+        depth = int(_flags.get_flag("serving_shed_queue_depth"))
+        if len(self.waiting) <= depth or not self._slo_breached():
+            return
+        while len(self.waiting) > depth:
+            # victim: lowest priority; newest within a priority (the
+            # oldest requests keep their queue-time investment)
+            victim = len(self.waiting) - 1
+            for i in range(len(self.waiting) - 2, -1, -1):
+                if self.waiting[i].priority \
+                        < self.waiting[victim].priority:
+                    victim = i
+            req = self.waiting[victim]
+            del self.waiting[victim]
+            req.shed = True
+            self.slo_sheds += 1
+            _M_SLO_SHEDS.inc()
+            _M_REJECTIONS.inc(reason="slo_shed")
+            if _metrics.enabled():
+                self._reject_trace(req, "slo_shed")
+            self.finished.append(req)
+            req._stream_push(None)
+        self._update_pressure()
+
+    def _begin_chunked(self, req, slot, row, chain, split_col, cow_src,
+                       cached_len, t_admit) -> bool:
+        """Chunked-prefill admission: stash the allocated table row on
+        the REQUEST (a shadow row — ``self.tables[slot]`` stays
+        all-zero, so decode ticks dispatched mid-prefill route the
+        slot's inert seq_len-0 writes to the pad block instead of
+        corrupting freshly written chunks), dispatch the CoW copy if a
+        shared block must receive suffix writes, and queue the request
+        for the per-tick chunk budget."""
+        if cow_src is not None:
+            try:
+                cow_args = ((self.pools, self.dpools) if self.spec
+                            else (self.pools,))
+                out = self._cow_program()(
+                    *cow_args, jnp.int32(cow_src),
+                    jnp.int32(int(row[split_col])))
+                if self.spec:
+                    self.pools, self.dpools = out
+                else:
+                    self.pools = out
+            except BaseException:
+                for b in row:
+                    if b:
+                        self._release_block(int(b))
+                self._release_block(cow_src)          # the pin
+                self.free_slots.appendleft(slot)
+                self.reserved -= req._growth_left
+                req._growth_left = 0
+                _M_REJECTIONS.inc(reason="error")
+                raise
+            self._release_block(cow_src)   # copy dispatched; pin over
+        req.slot = slot
+        req._chunk_row = row
+        req._chunk_off = cached_len
+        req._chunk_t_admit = t_admit
+        req._prefilling = True
+        req._prefill_chunks = 0
+        self.slot_req[slot] = req
+        self.prefilling.append(req)
+        if self.prefix is not None:
+            req._prefix_blocks = split_col + (1 if cow_src is not None
+                                              else 0)
+            if chain:
+                self.prefix.hits += 1
+                _M_PREFIX_HITS.inc()
+                self.prefix.blocks_shared += req._prefix_blocks
+                if req._prefix_blocks:
+                    _M_PREFIX_SHARED.inc(req._prefix_blocks)
+            else:
+                self.prefix.misses += 1
+                _M_PREFIX_MISSES.inc()
+        self._update_occupancy()
+        return True
+
+    def _prefill_chunk_step(self, req) -> None:
+        """Dispatch ONE bounded prefill chunk for an in-flight chunked
+        admission: suffix tokens [off, off+n) padded to their ladder
+        bucket through the suffix-prefill program (``start`` = off is a
+        traced scalar — zero new programs, bit-identical writes and
+        offset causal mask).  The LAST chunk's logits row is the
+        request's first token."""
+        slot = req.slot
+        L = len(req.prompt_ids)
+        off = req._chunk_off
+        n = min(self.chunk, L - off)
+        L_pad = self._pad_bucket(n)
+        suffix = np.zeros((1, L_pad), np.int32)
+        suffix[0, :n] = req.prompt_ids[off:off + n]
+        try:
+            with self._params_for_call() as param_vals:
+                dpref = ((self._draft_vals(), self.pools, self.dpools)
+                         if self.spec else (self.pools,))
+                # private row copy: same R002 aliasing contract as the
+                # monolithic prefill's table-row argument
+                out = self._prefill_cont_program(L_pad)(
+                    param_vals, *dpref,
+                    jnp.asarray(req._chunk_row[None, :].copy()),
+                    jnp.asarray(suffix), jnp.int32(n), jnp.int32(off))
+            if self.spec:
+                row, self.pools, self.dpools = out
+            else:
+                row, self.pools = out
+        except BaseException:
+            self._abort_prefill(req)
+            _M_REJECTIONS.inc(reason="error")
+            raise
+        req._chunk_off = off + n
+        req._prefill_chunks += 1
+        self.prefill_chunks_total += 1
+        self._chunks_this_boundary += 1
+        _M_PREFILL_CHUNKS.inc()
+        if _metrics.enabled():
+            _flight.default_recorder().record_event(
+                "prefill_chunk", rid=req.rid, slot=slot, start=off,
+                tokens=n, done=req._chunk_off >= L)
+        if req._chunk_off >= L:
+            self._complete_chunked(req, row)
+
+    def _complete_chunked(self, req, row) -> None:
+        """Last chunk landed: install the shadow table row (the slot
+        becomes decodable), register the prompt's full blocks in the
+        prefix index — registration HAD to wait, chunk c+1 still writes
+        blocks chunk c filled and registered blocks are immutable —
+        and run the shared admission tail."""
+        slot = req.slot
+        L = len(req.prompt_ids)
+        self.tables[slot, :] = req._chunk_row
+        req._prefilling = False
+        req._chunk_row = None
+        if self.prefix is not None:
+            fullb = L // self.bs
+            self.prefix.register(
+                req.prompt_ids,
+                [int(self.tables[slot, c]) for c in range(fullb)],
+                self._ref_block,
+                match=getattr(req, "_prefix_match", None))
+        self._finish_admission(req, slot, row, req._chunk_t_admit)
+
+    def _abort_prefill(self, req, outcome: Optional[str] = None) -> None:
+        """Tear down a mid-chunked-prefill admission: release every
+        shadow-row block reference (shared blocks survive their other
+        holders), return the slot and the growth reservation.  With
+        ``outcome`` (cancellation) the request also gets a terminal
+        trace and lands in ``finished``."""
+        slot = req.slot
+        for b in req._chunk_row:
+            if b:
+                self._release_block(int(b))
+        req._chunk_row = None
+        req._prefilling = False
+        self.reserved -= req._growth_left
+        req._growth_left = 0
+        self.slot_req[slot] = None
+        self.free_slots.appendleft(slot)
+        req.slot = None
+        try:
+            self.prefilling.remove(req)
+        except ValueError:
+            pass
+        if outcome is not None:
+            self._terminal_trace(req, outcome)
+            self.finished.append(req)
+            req._stream_push(None)
+        self._update_occupancy()
+
+    def _terminal_trace(self, req, outcome: str) -> None:
+        """Non-finish lifecycle endpoints (cancellations) get a trace
+        record too, metrics-gated like everything else."""
+        if not _metrics.enabled():
+            return
+        rec = {"rid": req.rid, "outcome": outcome,
+               "prompt_len": len(req.prompt_ids),
+               "max_new_tokens": req.max_new_tokens,
+               "tokens_out": len(req.output_ids)}
+        req.trace = rec
+        _flight.default_recorder().record_event("request", **rec)
+        _export.record_request(rec)
 
     def step(self) -> bool:
-        """One SYNCHRONOUS scheduler tick: admit what fits, evict
-        finished, run one compiled decode tick over the current mix and
-        harvest it.  Returns True while work remains."""
+        """One SYNCHRONOUS scheduler tick: run the boundary schedule
+        (evict finished, spend the admission/chunk budget), run one
+        compiled decode tick over the current mix and harvest it.
+        Returns True while work remains."""
         pend = self._dispatch_tick(boundary=True)
         if pend is None:
-            return bool(self.waiting)
+            return bool(self.waiting or self.prefilling)
         self._harvest_tick(pend)
         return True
 
@@ -1592,12 +2005,7 @@ class ServingEngine:
         handle nothing has blocked on; host seq_lens/tok_pos advance
         NOW so a second dispatch sees the in-flight state."""
         if boundary:
-            while self._try_admit():
-                pass
-            for slot in list(range(self.B)):
-                req = self.slot_req[slot]
-                if req is not None and req.done:
-                    self._evict(slot)
+            self._boundary_schedule()
         active = self._active_slots()
         if not active:
             return None
@@ -1609,7 +2017,10 @@ class ServingEngine:
         use_spec = (bool(chain.spec) if chain is not None
                     else self._spec_eligible(active, device_sampling))
         if use_spec:
-            return self._dispatch_spec(active, t0, chain)
+            pend = self._dispatch_spec(active, t0, chain)
+            pend.chunks = self._chunks_this_boundary
+            self._chunks_this_boundary = 0
+            return pend
         k = self._tick_size(active)
         # ensure a physical block exists for every position this tick
         # will write (all draws covered by the admission reservation)
@@ -1658,10 +2069,13 @@ class ServingEngine:
         for slot in active:
             self.seq_lens[slot] += k
             self.tok_pos[slot] += k
-        return _PendingTick(active=active, k=k, toks=toks, logits=logits,
+        pend = _PendingTick(active=active, k=k, toks=toks, logits=logits,
                             reqs=list(self.slot_req), t0=t0,
                             device_sampling=device_sampling,
                             step_no=self.steps, san=san)
+        pend.chunks = self._chunks_this_boundary
+        self._chunks_this_boundary = 0
+        return pend
 
     def _spec_eligible(self, active, device_sampling) -> bool:
         """May this tick run draft/verify?  Needs the subsystem (engine
@@ -1782,6 +2196,7 @@ class ServingEngine:
                     if req.do_sample:
                         sampled += 1
                     req.output_ids.append(tok)
+                    req._stream_push(tok)
                     self.tokens_out += 1
                     self._maybe_finish(req, tok)
             self.spec_ticks += 1
@@ -1815,6 +2230,7 @@ class ServingEngine:
                     if req.do_sample:
                         sampled += 1
                     req.output_ids.append(tok)
+                    req._stream_push(tok)
                     self.tokens_out += 1
                     self._maybe_finish(req, tok)
         # wall time ATTRIBUTABLE to this tick: an overlapped tick was
@@ -1863,6 +2279,8 @@ class ServingEngine:
             if pend.spec:
                 rec["spec"] = True
                 rec["spec_accepted"] = spec_accepted
+            if pend.chunks:
+                rec["prefill_chunks"] = pend.chunks
             _flight.default_recorder().record_step(rec)
 
     def _tick_size(self, active) -> int:
@@ -1900,8 +2318,8 @@ class ServingEngine:
         one — a kind switch is a real boundary (harvest first)."""
         if not _flags.get_flag("serving_overlap"):
             return False
-        if self.waiting:
-            return False
+        if self.waiting or self.prefilling:
+            return False     # pending chunk work needs a real boundary
         if pend.spec:
             if not _flags.get_flag("serving_device_sampling"):
                 return False     # mid-run flip: verify owns sampling
@@ -1936,13 +2354,16 @@ class ServingEngine:
         detokenize overlap instead of strictly alternating."""
         from ..observability import http as _http
         _http.start_from_flags()   # no-op unless FLAGS_metrics_port > 0
+        _http.attach_engine(self)
+        _http.start_serving_from_flags()   # FLAGS_serving_http_port
         if self._warmup_info is None \
                 and _flags.get_flag("serving_warmup"):
             self.warmup()          # compile the whole grid BEFORE
         pend = None                # traffic waits on a program build
         while True:
             if pend is None:
-                if not (self.waiting or self._active_slots()):
+                if not (self.waiting or self.prefilling
+                        or self._active_slots()):
                     break
                 pend = self._dispatch_tick(boundary=True)
                 if pend is None:
@@ -1961,6 +2382,28 @@ class ServingEngine:
                 self._evict(slot)
         return self.finished
 
+    def serve_forever(self, stop_event, idle_s: float = 0.002) -> None:
+        """Drive the engine until ``stop_event`` (a threading.Event) is
+        set, serving traffic submitted concurrently — the loop behind
+        the streaming endpoint (``FLAGS_serving_http_port``): handler
+        threads `add_request` and read each request's token stream;
+        this loop ticks while work exists and naps otherwise.  Runs the
+        SYNCHRONOUS step cycle: a latency-facing frontend wants
+        admissions (and cancellations) at every boundary, not deferred
+        behind an overlapped tick."""
+        from ..observability import http as _http
+        _http.start_from_flags()
+        _http.attach_engine(self)
+        _http.start_serving_from_flags()
+        if self._warmup_info is None \
+                and _flags.get_flag("serving_warmup"):
+            self.warmup()
+        while not stop_event.is_set():
+            if self.waiting or self.prefilling or self._active_slots():
+                self.step()
+            else:
+                time.sleep(idle_s)
+
     def stats(self) -> dict:
         running = self.B - len(self.free_slots)
         # blocks held ONLY by the prefix index are free capacity: the
@@ -1978,7 +2421,11 @@ class ServingEngine:
                "waiting": len(self.waiting),
                "queue_depth": running + len(self.waiting),
                "pad_buckets": list(self.pad_ladder),
-               "tp_degree": self.tp}
+               "tp_degree": self.tp,
+               "prefill_chunk": self.chunk,
+               "prefilling": len(self.prefilling),
+               "prefill_chunks": self.prefill_chunks_total,
+               "slo_sheds": self.slo_sheds}
         if self.spec:
             out["speculative"] = {
                 "spec_k": self.spec_k,

@@ -167,8 +167,10 @@ class PagedKVCache:
             # built, so the concrete-value check covers the misuse case.)
             if int(jnp.max(self.seq_lens)) != 0:
                 raise NotImplementedError(
-                    "chunked prefill against a PagedKVCache: prefill in "
-                    "one chunk or use cache_impl='dense'")
+                    "multi-token append to non-empty sequences needs the "
+                    "offset-aware PagedChunkView (the serving engine's "
+                    "suffix/chunked-prefill view); PagedKVCache prefills "
+                    "from empty only — or use cache_impl='dense'")
         new.k, new.v = pallas_paged.paged_write_prefill(
             self.k, self.v, self.tables, k, v)
         new.seq_lens = self.seq_lens + s
@@ -180,29 +182,38 @@ class PagedChunkView(PagedKVCache):
     tokens appended to sequences that already hold ``seq_lens`` cached
     tokens, attending over the cached prefix AND the chunk.
 
-    This is the program shape prefix-cache admission needs (ISSUE 9):
-    a request whose prompt prefix is resident in shared blocks writes
-    only its SUFFIX — `update_and_attend` writes token j of the chunk
-    at absolute position ``seq_lens + j`` through the block table and
-    runs dense attention of the chunk queries against the table's
-    linearized blocks with an offset causal mask.  Positions beyond the
-    table's capacity route their writes to the reserved pad block 0
-    (same convention as the serving engine's padded prompts).
+    This is the program shape BOTH prefix-cache admission (ISSUE 9: a
+    request whose prompt prefix is resident in shared blocks writes
+    only its SUFFIX) and chunked prefill (ISSUE 11: every arriving
+    prompt is absorbed as bounded chunks between decode ticks) run on —
+    `update_and_attend` writes token j of the chunk at absolute
+    position ``seq_lens + j`` through the block table and runs dense
+    attention of the chunk queries against the table's linearized
+    blocks with an offset causal mask.  Positions beyond the table's
+    capacity route their writes to the reserved pad block 0 (same
+    convention as the serving engine's padded prompts).
 
-    The base class intentionally rejects this case ("prefill in one
-    chunk"): from-empty prefill never needs the gather, and the
-    serving engine keeps using the cheaper base program when nothing is
-    cached.  Decode steps (``s == 1``) fall through to the base paged
-    kernel unchanged."""
+    The base class intentionally rejects this case (prefill from empty
+    in one chunk): from-empty prefill never needs the gather, and the
+    serving engine keeps using the cheaper base program when neither a
+    cached prefix nor chunking is in play.  Decode steps (``s == 1``)
+    fall through to the base paged kernel unchanged.  GQA models whose
+    attention layer hands over un-repeated kv heads get them repeated
+    here to the pool's per-query-head layout (the same resolution the
+    Llama paged path applies before the cache)."""
 
     def update_and_attend(self, q, k, v):
         if q.shape[1] == 1:
             return super().update_and_attend(q, k, v)
         B, s, nh, hd = q.shape
         if k.shape[2] != nh:
-            raise NotImplementedError(
-                "chunked prefill with GQA kv heads: pools are allocated "
-                "per query head; serve GQA models without prefix reuse")
+            if nh % k.shape[2]:
+                raise ValueError(
+                    f"kv heads {k.shape[2]} do not divide query heads "
+                    f"{nh}")
+            rep = nh // k.shape[2]
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         nb = self.tables.shape[1]
         start = self.seq_lens                          # [B] cached tokens
         pos = start[:, None] + jnp.arange(s, dtype=start.dtype)  # [B, s]

@@ -1,5 +1,5 @@
-"""Scrape endpoint: a stdlib HTTP daemon serving /metrics, /healthz and
-/requests.
+"""Scrape + serve endpoint: a stdlib HTTP daemon serving /metrics,
+/healthz, /requests and (ISSUE 11) a streaming ``POST /generate``.
 
 ISSUE 6 tentpole (c): the answer to "what is p99 TTFT right now?" from
 OUTSIDE the process.  One ``http.server.ThreadingHTTPServer`` on a
@@ -15,21 +15,36 @@ Endpoints:
   or load balancer can distinguish "process up" from "port dead".
 * ``GET /requests`` — the last-K per-request serving trace records as a
   JSON array (``?n=`` caps K, default 64).
+* ``POST /generate`` — the minimal streaming serve frontend (ISSUE 11):
+  a JSON body (``prompt_ids`` + the `Request` sampling knobs +
+  ``timeout_s``) enqueues a request into the :func:`attach_engine`'d
+  serving engine and answers a Server-Sent Events token stream —
+  ``data: {"token": id}`` per emitted token, a terminal ``event: done``
+  with the full output, ``event: error`` on timeout/shed.  The handler
+  thread never touches device state: it enqueues, then drains the
+  request's token queue fed by the engine loop's harvests.  A client
+  disconnect (the keepalive ping write fails) or ``timeout_s`` expiry
+  calls ``Request.cancel()``, which the engine's next scheduler
+  boundary turns into slot eviction + block release.
 
 Security: binds ``FLAGS_metrics_host`` (default ``127.0.0.1`` — the
 endpoint exposes operational data, so exposure beyond the host must be
 an explicit operator decision).  ``FLAGS_metrics_port`` (default 0 =
 disabled) gates auto-start: :func:`start_from_flags` is called by
 ``ServingEngine.run()`` and ``Model.fit()`` and is a no-op unless the
-flag is set.  Calling :func:`serve` directly with ``port=0`` binds an
-ephemeral port (tests).
+flag is set.  ``FLAGS_serving_http_port`` (default 0 = disabled)
+auto-starts the serve endpoint on 127.0.0.1 ONLY — the generate route
+accepts work, so it never widens beyond loopback via flags.  Calling
+:func:`serve` directly with ``port=0`` binds an ephemeral port (tests).
 """
 
 from __future__ import annotations
 
 import json
+import queue as _queue
 import threading
 import time
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
@@ -37,7 +52,9 @@ from urllib.parse import parse_qs, urlparse
 from . import export as _export
 from . import metrics as _metrics
 
-__all__ = ["MetricsServer", "serve", "start_from_flags", "stop", "current"]
+__all__ = ["MetricsServer", "serve", "start_from_flags", "stop",
+           "current", "attach_engine", "current_engine",
+           "start_serving_from_flags", "serving_server"]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -79,6 +96,96 @@ class _Handler(BaseHTTPRequestHandler):
                            b"/requests\n")
         except BrokenPipeError:  # scraper hung up mid-response
             pass
+
+    # ------------------------------------------ POST /generate (SSE)
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        try:
+            url = urlparse(self.path)
+            if url.path != "/generate":
+                self._send(404, "text/plain; charset=utf-8",
+                           b"not found; POST endpoint: /generate\n")
+                return
+            self._generate()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client hung up; _generate already propagated cancel
+
+    def _sse(self, payload: dict, event: Optional[str] = None) -> None:
+        head = f"event: {event}\n" if event else ""
+        self.wfile.write(
+            (head + "data: " + json.dumps(payload) + "\n\n").encode())
+        self.wfile.flush()
+
+    def _generate(self) -> None:
+        eng = current_engine()
+        if eng is None:
+            self._send(503, "application/json",
+                       b'{"error": "no serving engine attached"}')
+            return
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt_ids = [int(t) for t in body["prompt_ids"]]
+        except (KeyError, TypeError, ValueError) as e:
+            self._send(400, "application/json", json.dumps(
+                {"error": f"bad request body: {e!r}"}).encode())
+            return
+        from ..inference.serving import Request
+        req = Request(
+            prompt_ids,
+            max_new_tokens=int(body.get("max_new_tokens", 32)),
+            eos_token_id=body.get("eos_token_id"),
+            do_sample=bool(body.get("do_sample", False)),
+            temperature=float(body.get("temperature", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=body.get("seed"),
+            priority=int(body.get("priority", 0)))
+        timeout_s = float(body.get("timeout_s", 120.0))
+        # the stream queue must exist BEFORE enqueue: the engine thread
+        # may emit the first token between add_request and our loop
+        req._stream_q = _queue.Queue()
+        try:
+            eng.add_request(req)
+        except ValueError as e:   # over_context / capacity rejection
+            self._send(400, "application/json", json.dumps(
+                {"error": str(e), "rid": req.rid}).encode())
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        deadline = time.monotonic() + timeout_s
+        i = 0
+        try:
+            while True:
+                try:
+                    tok = req._stream_q.get(timeout=0.05)
+                except _queue.Empty:
+                    if time.monotonic() > deadline:
+                        req.cancel()
+                        self._sse({"error": "timeout", "rid": req.rid,
+                                   "output_ids": list(req.output_ids)},
+                                  event="error")
+                        return
+                    # keepalive comment: also our disconnect probe — a
+                    # gone client raises here and the except below
+                    # propagates the cancel to the engine
+                    self.wfile.write(b": ping\n\n")
+                    self.wfile.flush()
+                    continue
+                if tok is None:         # terminal sentinel
+                    outcome = ("finished" if req.done else
+                               "rejected:slo_shed" if req.shed else
+                               "cancelled")
+                    self._sse({"rid": req.rid, "outcome": outcome,
+                               "output_ids": list(req.output_ids)},
+                              event="done")
+                    return
+                self._sse({"token": int(tok), "n": i})
+                i += 1
+        except (BrokenPipeError, ConnectionResetError):
+            req.cancel()            # client went away mid-stream
 
     def log_message(self, format, *args):  # noqa: A002 - http.server API
         pass  # scrapes every few seconds must not spam stderr
@@ -144,9 +251,58 @@ def current() -> Optional[MetricsServer]:
     return _server
 
 
+# ---------------------------------------------------------------------------
+# Streaming serve endpoint (ISSUE 11): POST /generate needs an engine.
+# The engine is attached as a WEAK reference — a registered engine must
+# not outlive its owner just because a server thread exists.
+_engine_ref = None
+_serving_server: Optional[MetricsServer] = None
+
+
+def attach_engine(engine) -> None:
+    """Register the serving engine POST /generate enqueues into.
+    Called by ``ServingEngine.run()``/``serve_forever()``; the LAST
+    attached engine wins (one process, one front door)."""
+    global _engine_ref
+    _engine_ref = weakref.ref(engine)
+
+
+def current_engine():
+    ref = _engine_ref
+    return ref() if ref is not None else None
+
+
+def start_serving_from_flags() -> Optional[MetricsServer]:
+    """Auto-start the streaming serve endpoint when
+    ``FLAGS_serving_http_port`` > 0 (loopback only — the route accepts
+    work).  Idempotent; never raises: a busy port must not take down
+    the engine loop."""
+    global _serving_server
+    if _serving_server is not None:
+        return _serving_server
+    try:
+        from .. import flags as _flags
+        port = int(_flags.get_flag("serving_http_port"))
+        if port <= 0:
+            return None
+        with _lock:
+            if _serving_server is None:
+                _serving_server = MetricsServer(port, "127.0.0.1")
+            return _serving_server
+    except Exception:  # noqa: BLE001 - frontend must not kill serving
+        return None
+
+
+def serving_server() -> Optional[MetricsServer]:
+    return _serving_server
+
+
 def stop() -> None:
-    global _server
+    global _server, _serving_server
     with _lock:
         if _server is not None:
             _server.close()
             _server = None
+        if _serving_server is not None:
+            _serving_server.close()
+            _serving_server = None

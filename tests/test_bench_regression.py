@@ -317,6 +317,51 @@ def test_spec_decode_rung_schema():
         assert val[key] > 0, key
 
 
+@pytest.mark.slow   # two serving engines + open-loop arrival drives —
+                    # too heavy for the tier-1 budget; full runs cover it
+def test_continuous_batching_rung_schema():
+    """Pin the ISSUE 11 `continuous_batching` rung's record schema:
+    open-loop Poisson arrivals at 2-3 RPS over chunked vs monolithic
+    engines with `goodput_under_slo` as the headline regression key,
+    plus the long-prompt-arrival stall A/B — the acceptance claim that
+    chunked prefill bounds a running stream's inter-token gap where
+    monolithic prefill cannot (`long_arrival_tpot_ratio` strictly
+    above 1)."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_cb", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_continuous_batching(ctx)
+    rec = {"rung": "continuous_batching", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("continuous_batching").smoke
+    assert bench._REGRESSION_KEYS["continuous_batching"] == (
+        "goodput_under_slo", "long_arrival_tpot_ratio")
+    # the acceptance claim: the monolithic stall strictly exceeds the
+    # chunked bound under a long-prompt arrival
+    assert val["long_arrival_tpot_ratio"] > 1.0
+    assert val["long_arrival_gap_mono_ms"] > \
+        val["long_arrival_gap_chunked_ms"]
+    assert val["goodput_under_slo"] > 0
+    assert val["goodput_monolithic"] > 0
+    assert val["goodput_ratio_vs_monolithic"] > 0
+    assert val["tpot_p99_ms_chunked"] > 0 and val["tpot_p99_ms_mono"] > 0
+    assert val["prefill_chunk"] > 0
+    # every cell reports goodput + client-side TPOT p99
+    for cell, v in val["levels"].items():
+        assert v["requests"] > 0 and v["goodput_rps"] >= 0, cell
+        assert "tpot_p99_ms" in v
+
+
 def test_multi_key_regression_check_labels_secondary_keys(tmp_path):
     """The harness accepts a tuple of regression keys per rung: the
     first labels the rung, later ones report as `<rung>.<key>` — both

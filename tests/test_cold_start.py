@@ -256,6 +256,34 @@ def test_warmup_grid_spec_quant_zero_compiles(model):
         assert st["warmup"]["programs"] == 11
 
 
+@pytest.mark.slow   # compiles a second full warmup grid — tier-1's
+                    # ~30s margin keeps only the legacy-grid pins fast
+def test_warmup_grid_chunked_zero_compiles(model):
+    """ISSUE 11 acceptance: with chunked prefill on, the warmup grid
+    swaps the monolithic prefill programs for the suffix-prefill chunk
+    programs (one per ladder bucket — chunk offsets are traced), and
+    mixed post-warmup traffic spanning every bucket still triggers
+    ZERO compile-tracker events."""
+    vocab = model.cfg.vocab_size
+    with flag_guard(serving_warmup=True, serving_pad_buckets="16,32,64",
+                    serving_prefill_chunk=8):
+        eng = ServingEngine(model, max_batch=2, max_context=128,
+                            block_size=16, steps_per_tick=2)
+        info = eng.warmup()
+        # 2 tick variants + host-sampling decode + 3 prefill_cont
+        # buckets + CoW (prefix cache on) — and NO monolithic prefill:
+        # a chunked engine never dispatches it
+        assert info["programs"] == 7
+        assert [g["L_pad"] for g in info["grid"]
+                if g["program"] == "prefill_cont"] == [16, 32, 64]
+        assert not any(g["program"] == "prefill" for g in info["grid"])
+        before = compile_tracker.total_compiles()
+        reqs = _drive_mixed_traffic(eng, vocab, (12, 20, 40, 60))
+        assert compile_tracker.total_compiles() == before
+        assert all(len(r.output_ids) == 7 for r in reqs)
+        assert eng.stats()["prefill_chunks"] > 0
+
+
 def test_warmup_covers_both_sampling_variants(model):
     """The grid always includes the host-sampling decode program AND
     the device-sampling tick: FLAGS_serving_device_sampling is read

@@ -1,0 +1,484 @@
+"""Continuous batching (ISSUE 11): chunked prefill interleaved with
+decode ticks, the SLO-aware per-tick scheduler, and the streaming serve
+endpoint.
+
+The headline contracts pinned here:
+
+* chunked prefill streams are BIT-identical to monolithic prefill
+  (same `PagedChunkView` writes, same offset causal mask), composing
+  with the prefix cache, TP degree 2, spec decode and overlap;
+* a running stream keeps receiving tokens while an arriving long
+  prompt is absorbed (the bounded inter-token-gap property monolithic
+  prefill cannot give);
+* SLO-aware shedding rejects the newest lowest-priority arrivals with
+  ``reason=slo_shed`` only while the live sketches breach targets AND
+  the queue is past the watermark;
+* ``POST /generate`` streams tokens as Server-Sent Events, and a
+  client disconnect or timeout propagates to slot eviction and block
+  release.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.flags import flag_guard
+from paddle_tpu.inference.serving import Request, ServingEngine
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+from paddle_tpu.observability import flight_recorder
+from paddle_tpu.observability import http as obs_http
+from paddle_tpu.observability import metrics as obs_metrics
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(gpt3_tiny())
+    m.eval()
+    return m
+
+
+def _serve(model, prompts, budgets, chunk, **kw):
+    eng = ServingEngine(model, max_batch=2, max_context=64,
+                        block_size=16, prefill_chunk=chunk, **kw)
+    reqs = [eng.add_request(Request(p, max_new_tokens=b))
+            for p, b in zip(prompts, budgets)]
+    eng.run()
+    assert eng.stats()["free_blocks"] == eng.num_blocks
+    assert eng.stats()["reserved"] == 0
+    return eng, [list(r.output_ids) for r in reqs]
+
+
+# ------------------------------------------------------------ bit parity
+
+def test_chunked_equals_monolithic_bit_parity(model):
+    """THE tentpole pin: a chunk size that splits both prompts unevenly
+    (29 -> 5x5+4, 11 -> 2x5+1) streams token-for-token what monolithic
+    prefill streams.  The wider sweep (more chunk sizes x custom
+    ladders) is the @slow test below."""
+    rng = np.random.RandomState(0)
+    prompts = (rng.randint(1, 1000, (29,)), rng.randint(1, 1000, (11,)))
+    budgets = (8, 6)
+    _, base = _serve(model, prompts, budgets, chunk=0)
+    eng, got = _serve(model, prompts, budgets, chunk=5)
+    assert got == base
+    assert eng.stats()["prefill_chunks"] == 6 + 3
+
+
+@pytest.mark.slow   # composition pin — full runs cover it (tier-1
+                    # budget: ISSUE 11 keeps only the core pins fast)
+def test_chunked_prefix_hit_composition(model):
+    """A prefix-cache hit under chunking is just a chunked prefill
+    starting at the cached offset: streams identical to the monolithic
+    engine's, fewer chunks for the hit, hits counted."""
+    rng = np.random.RandomState(1)
+    sysp = list(rng.randint(1, 1000, (32,)))
+    tails = [[int(t)] for t in rng.randint(1, 1000, (3,))]
+
+    def drive(chunk):
+        eng = ServingEngine(model, max_batch=2, max_context=64,
+                            block_size=16, prefill_chunk=chunk,
+                            prefix_cache=True)
+        outs, chunks = [], []
+        for t in tails:
+            r = eng.add_request(Request(sysp + t, max_new_tokens=5))
+            eng.run()
+            outs.append(list(r.output_ids))
+            chunks.append(r._prefill_chunks)
+        return eng, outs, chunks
+
+    _, base, _ = drive(0)
+    eng, got, chunks = drive(8)
+    assert got == base
+    assert eng.stats()["prefix_cache"]["hits"] >= 2
+    # miss absorbed 33 tokens in 5 chunks of 8; a hit starts at the
+    # cached offset 32 and needs ONE chunk for the 1-token suffix
+    assert chunks[0] == 5 and chunks[1] == 1 and chunks[2] == 1
+    assert eng.stats()["free_blocks"] == eng.num_blocks
+
+
+@pytest.mark.slow   # composition pin — full runs cover it
+def test_chunked_overlap_parity(model):
+    """Chunk interleaving forces real boundaries while prompts are
+    absorbing, but the overlap fast path still runs between them — and
+    streams stay identical to the synchronous loop."""
+    rng = np.random.RandomState(2)
+    prompts = (rng.randint(1, 1000, (20,)), rng.randint(1, 1000, (9,)))
+    with flag_guard(serving_overlap=False):
+        _, sync = _serve(model, prompts, (9, 7), chunk=8)
+    with flag_guard(serving_overlap=True):
+        _, ov = _serve(model, prompts, (9, 7), chunk=8)
+    assert ov == sync
+
+
+# ------------------------------------- the bounded inter-token-gap claim
+
+def test_long_arrival_bounds_running_stream(model):
+    """Structural pin of the tentpole property (no wall clocks): while
+    a 60-token prompt is absorbed, a chunked engine keeps feeding the
+    running stream every boundary; the monolithic engine absorbs the
+    whole prompt inside ONE boundary, so the stream advances at most
+    once in that window."""
+    rng = np.random.RandomState(3)
+    long_p = rng.randint(1, 1000, (60,))
+    short_p = rng.randint(1, 1000, (6,))
+
+    def drive(chunk):
+        eng = ServingEngine(model, max_batch=2, max_context=96,
+                            block_size=16, prefill_chunk=chunk,
+                            prefix_cache=False)
+        s = eng.add_request(Request(short_p, max_new_tokens=40))
+        eng.step()
+        eng.step()
+        lr = eng.add_request(Request(long_p, max_new_tokens=3))
+        grew = 0
+        while not lr.output_ids:
+            n0 = len(s.output_ids)
+            if not eng.step():
+                break
+            if len(s.output_ids) > n0:
+                grew += 1
+        eng.run()
+        assert eng.stats()["free_blocks"] == eng.num_blocks
+        return grew, lr
+
+    grew_c, lr_c = drive(10)
+    assert lr_c._prefill_chunks == 6          # ceil(60 / 10)
+    assert grew_c >= 5                        # stream fed between chunks
+    grew_m, lr_m = drive(0)
+    assert lr_m._prefill_chunks == 0
+    assert grew_m <= 1                        # the stall chunking removes
+
+
+# ----------------------------------------------- scheduler: shed/priority
+
+def test_slo_shed_rejects_newest_lowest_priority(model):
+    """With the sketches breaching and the queue past the watermark,
+    the scheduler sheds down to the watermark — newest lowest-priority
+    victims first — with reason=slo_shed on every surface."""
+    obs_metrics.reset()
+    with flag_guard(serving_slo_shed=True, serving_ttft_slo_ms=1e-4,
+                    serving_shed_queue_depth=2):
+        eng = ServingEngine(model, max_batch=1, max_context=64,
+                            block_size=16)
+        eng.add_request(Request(np.arange(1, 8), max_new_tokens=3))
+        eng.run()                     # loads the (breaching) TTFT sketch
+        rng = np.random.RandomState(4)
+        reqs = [eng.add_request(
+            Request(rng.randint(1, 1000, (7,)), max_new_tokens=3,
+                    priority=(1 if i == 0 else 0)))
+            for i in range(6)]
+        eng.run()
+    st = eng.stats()
+    assert st["slo_sheds"] == 4
+    served = [r for r in reqs if r.done]
+    shed = [r for r in reqs if r.shed]
+    assert len(served) == 2 and len(shed) == 4
+    # the priority-1 request and the oldest priority-0 request survive
+    assert reqs[0] in served and reqs[1] in served
+    for r in shed:
+        assert r.trace["outcome"] == "rejected:slo_shed"
+        assert not r.output_ids
+    snap = obs_metrics.snapshot()
+    rej = {dict(s["labels"])["reason"]: s["value"]
+           for s in snap["serving.rejections"]["series"]}
+    assert rej["slo_shed"] == 4
+    assert snap["serving.slo_sheds"]["series"][0]["value"] == 4
+    from paddle_tpu.observability.export import render_prometheus
+    assert "serving_slo_sheds 4" in render_prometheus()
+    assert st["free_blocks"] == eng.num_blocks
+
+
+def test_no_shed_without_breach_and_priority_order(model):
+    """Shedding needs BOTH conditions — a deep queue under HEALTHY
+    sketches admits everything — and admission order follows priority
+    (FIFO within a priority, the legacy order for all-equal)."""
+    with flag_guard(serving_slo_shed=True, serving_ttft_slo_ms=1e9,
+                    serving_shed_queue_depth=1):
+        eng = ServingEngine(model, max_batch=1, max_context=64,
+                            block_size=16)
+        lo = eng.add_request(Request(np.arange(1, 8), max_new_tokens=3))
+        hi = eng.add_request(Request(np.arange(2, 9), max_new_tokens=3,
+                                     priority=5))
+        mid = eng.add_request(Request(np.arange(3, 10), max_new_tokens=3,
+                                      priority=5))
+        eng.run()
+    assert eng.stats()["slo_sheds"] == 0
+    assert all(r.done for r in (lo, hi, mid))
+    assert [r.rid for r in eng.finished] == [hi.rid, mid.rid, lo.rid]
+
+
+# --------------------------------------------------------- cancellation
+
+def test_cancel_running_and_waiting_releases_everything(model):
+    """cancel() on a running request evicts its slot and releases its
+    blocks at the next boundary; on a waiting request it drops it from
+    the queue.  Nothing leaks either way."""
+    eng = ServingEngine(model, max_batch=1, max_context=64, block_size=16)
+    running = eng.add_request(Request(np.arange(1, 9), max_new_tokens=30))
+    queued = eng.add_request(Request(np.arange(2, 10), max_new_tokens=4))
+    eng.step()
+    eng.step()
+    running.cancel()
+    queued.cancel()
+    eng.run()
+    assert not running.done and len(running.output_ids) < 30
+    assert not queued.done and not queued.output_ids
+    assert running in eng.finished and queued in eng.finished
+    st = eng.stats()
+    assert st["free_blocks"] == eng.num_blocks and st["reserved"] == 0
+    assert running.trace["outcome"] == "cancelled"
+
+
+def test_cancel_mid_chunked_prefill_aborts_and_releases(model):
+    """A cancel landing while the prompt is still absorbing aborts the
+    remaining chunks and releases the shadow-row blocks."""
+    eng = ServingEngine(model, max_batch=2, max_context=96, block_size=16,
+                        prefill_chunk=8, prefix_cache=False)
+    r = eng.add_request(Request(np.arange(1, 61), max_new_tokens=4))
+    eng.step()                       # first chunk only (budget 1/tick)
+    assert r._prefilling and r._prefill_chunks >= 1
+    r.cancel()
+    eng.run()
+    assert not r.output_ids and not r.done
+    st = eng.stats()
+    assert st["free_blocks"] == eng.num_blocks and st["reserved"] == 0
+    assert st["prefilling"] == 0
+
+
+# ------------------------------------------------------- observability
+
+def test_chunk_counters_traces_and_flight_records(model):
+    """serving.prefill_chunks on /metrics, per-request prefill_chunks
+    in the lifecycle trace, chunk events + per-tick chunk counts in the
+    flight ring."""
+    obs_metrics.reset()
+    flight_recorder.default_recorder().clear()
+    eng = ServingEngine(model, max_batch=2, max_context=64, block_size=16,
+                        prefill_chunk=8, prefix_cache=False)
+    r = eng.add_request(Request(np.arange(1, 21), max_new_tokens=4))
+    eng.run()
+    assert r.trace["prefill_chunks"] == 3     # ceil(20 / 8)
+    snap = obs_metrics.snapshot()
+    assert snap["serving.prefill_chunks"]["series"][0]["value"] == 3
+    from paddle_tpu.observability.export import render_prometheus
+    text = render_prometheus()
+    assert "serving_prefill_chunks 3" in text
+    rec = flight_recorder.default_recorder()
+    chunk_events = [e for e in rec.events()
+                    if e.get("kind") == "prefill_chunk"]
+    assert len(chunk_events) == 3
+    assert chunk_events[-1]["done"] is True
+    assert chunk_events[0]["start"] == 0 and chunk_events[0]["tokens"] == 8
+    tick_recs = [s for s in rec.steps()
+                 if s.get("timeline") == "serving"
+                 and s.get("prefill_chunks")]
+    assert sum(s["prefill_chunks"] for s in tick_recs) == 3
+
+
+# ------------------------------------------------------- SSE endpoint
+
+def _sse_events(resp):
+    """Parse an SSE byte stream into (event, payload) pairs."""
+    event = None
+    for raw in resp:
+        line = raw.decode().rstrip("\n")
+        if line.startswith("event: "):
+            event = line[7:]
+        elif line.startswith("data: "):
+            yield event, json.loads(line[6:])
+            event = None
+
+
+def test_sse_generate_stream_and_disconnect_cancels(model):
+    """POST /generate streams each token as SSE and finishes with a
+    `done` event carrying the full output; hanging up mid-stream
+    propagates to slot eviction and block release."""
+    import http.client
+
+    eng = ServingEngine(model, max_batch=2, max_context=64, block_size=16,
+                        prefill_chunk=8)
+    stop = threading.Event()
+    obs_http.attach_engine(eng)
+    assert obs_http.current_engine() is eng
+    srv = obs_http.MetricsServer(0, "127.0.0.1")
+    t = threading.Thread(target=eng.serve_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        body = json.dumps({"prompt_ids": list(range(1, 10)),
+                           "max_new_tokens": 6})
+        conn.request("POST", "/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        toks, done = [], None
+        for event, d in _sse_events(resp):
+            if event == "done":
+                done = d
+                break
+            if event is None and "token" in d:
+                toks.append(d["token"])
+        conn.close()
+        assert done["outcome"] == "finished"
+        assert done["output_ids"] == toks and len(toks) == 6
+        # parity with driving the engine directly
+        ref = ServingEngine(model, max_batch=2, max_context=64,
+                            block_size=16)
+        rr = ref.add_request(Request(list(range(1, 10)),
+                                     max_new_tokens=6))
+        ref.run()
+        assert rr.output_ids == toks
+
+        # malformed body -> 400, engine unharmed
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request("POST", "/generate", body="{}",
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+        conn.close()
+
+        # disconnect mid-stream -> cancel -> eviction + block release
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request("POST", "/generate", body=json.dumps(
+            {"prompt_ids": list(range(1, 9)), "max_new_tokens": 500}),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read(40)                 # a few tokens, then hang up
+        conn.close()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = eng.stats()
+            if st["free_blocks"] == eng.num_blocks and st["active"] == 0 \
+                    and st["prefilling"] == 0:
+                break
+            time.sleep(0.05)
+        st = eng.stats()
+        assert st["free_blocks"] == eng.num_blocks and st["active"] == 0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        srv.close()
+    assert not t.is_alive()
+
+
+def test_sse_timeout_cancels_and_reports(model):
+    """A request whose timeout_s expires gets an `error` SSE event and
+    is cancelled.  The engine loop is deliberately NOT running, so the
+    request can never produce a token before the deadline — the
+    deterministic worst case; the subsequent run() turns the cancel
+    into a queue drop with nothing leaked."""
+    import http.client
+
+    eng = ServingEngine(model, max_batch=1, max_context=64, block_size=16)
+    obs_http.attach_engine(eng)
+    srv = obs_http.MetricsServer(0, "127.0.0.1")
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=60)
+        conn.request("POST", "/generate", body=json.dumps(
+            {"prompt_ids": list(range(1, 9)), "max_new_tokens": 8,
+             "timeout_s": 0.3}),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        err = next((d for ev, d in _sse_events(resp) if ev == "error"),
+                   None)
+        conn.close()
+        assert err is not None and err["error"] == "timeout"
+        assert len(eng.waiting) == 1 and eng.waiting[0].cancelled
+        eng.run()            # the boundary drops the cancelled request
+        st = eng.stats()
+        assert st["free_blocks"] == eng.num_blocks
+        assert st["waiting"] == 0 and st["active"] == 0
+    finally:
+        srv.close()
+
+
+def test_serving_http_flag_gate():
+    """FLAGS_serving_http_port=0 (the default) starts nothing."""
+    with flag_guard(serving_http_port=0):
+        assert obs_http.start_serving_from_flags() is None
+
+
+# ----------------------------------------------- heavy composition pins
+
+@pytest.mark.slow   # compiles a TP program grid — full runs cover it
+def test_chunked_tp2_parity(model):
+    """Chunked prefill composes with tensor-parallel serving: degree-2
+    chunked streams are bit-identical to degree-1 monolithic."""
+    rng = np.random.RandomState(6)
+    prompts = (rng.randint(1, 1000, (24,)), rng.randint(1, 1000, (9,)))
+    _, base = _serve(model, prompts, (7, 5), chunk=0)
+    eng, got = _serve(model, prompts, (7, 5), chunk=8, tp_degree=2)
+    assert got == base
+    assert eng.stats()["prefill_chunks"] > 0
+
+
+@pytest.mark.slow   # compiles the spec-tick grid — full runs cover it
+def test_chunked_spec_decode_parity():
+    """Chunked prefill composes with speculative decoding (the draft
+    pools absorb each chunk through the same program): greedy streams
+    stay bit-identical to the plain monolithic engine."""
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt3_tiny())
+    model.eval()
+    paddle.seed(0)
+    draft = GPTForCausalLM(gpt3_tiny())
+    draft.eval()
+    rng = np.random.RandomState(7)
+    prompts = (rng.randint(1, 1000, (22,)), rng.randint(1, 1000, (10,)))
+    _, base = _serve(model, prompts, (9, 9), chunk=0)
+    eng, got = _serve(model, prompts, (9, 9), chunk=8,
+                      draft_model=draft, spec_decode=True, spec_k=3)
+    assert got == base
+    assert eng.stats()["speculative"]["ticks"] > 0
+    assert eng.stats()["prefill_chunks"] > 0
+
+
+@pytest.mark.slow   # second model family build — full runs cover it
+def test_chunked_llama_parity():
+    """Chunked prefill is model-agnostic over forward_with_cache: the
+    Llama family (RoPE + GQA + RMSNorm) streams identically chunked or
+    monolithic."""
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    rng = np.random.RandomState(9)
+    prompts = (rng.randint(1, 500, (21,)), rng.randint(1, 500, (9,)))
+    _, base = _serve(m, prompts, (6, 5), chunk=0)
+    _, got = _serve(m, prompts, (6, 5), chunk=8)
+    assert got == base
+
+
+@pytest.mark.slow   # many engine builds — full runs cover it
+def test_chunked_parity_across_buckets_and_chunk_sizes(model):
+    """The wide sweep: custom ladders x chunk sizes x prompts landing
+    in every bucket, all bit-identical to monolithic."""
+    rng = np.random.RandomState(8)
+    prompts = tuple(rng.randint(1, 1000, (L,)) for L in (7, 18, 40, 61))
+    budgets = (5, 5, 5, 5)
+
+    def serve(chunk, ladder):
+        eng = ServingEngine(model, max_batch=2, max_context=96,
+                            block_size=16, prefill_chunk=chunk,
+                            pad_buckets=ladder)
+        reqs = [eng.add_request(Request(p, max_new_tokens=b))
+                for p, b in zip(prompts, budgets)]
+        eng.run()
+        assert eng.stats()["free_blocks"] == eng.num_blocks
+        return [list(r.output_ids) for r in reqs]
+
+    for ladder in ("", "16,48,96"):
+        base = serve(0, ladder)
+        # 96 >= every prompt: the single-chunk-per-admission edge
+        for chunk in (3, 8, 32, 96):
+            assert serve(chunk, ladder) == base, (ladder, chunk)
