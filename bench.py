@@ -1684,35 +1684,40 @@ def bench_continuous_batching(ctx):
 
 @harness.register_rung("analyze", est_cold_s=40, smoke=True)
 def bench_analyze(ctx):
-    """ISSUE 8 rung: graft-lint wall time + findings over the tree.
+    """ISSUE 8/12 rung: graft-lint wall time + per-rule findings over
+    the full default tree (package + drivers + tests/ — R010's
+    surface).
 
     The tier-1 ratchet runs the analyzer on every CI pass, so its
     runtime is a build-latency budget: `analyze_files_per_sec` is the
-    regression key (collapsing means a rule went quadratic), and the
+    regression key (collapsing means a rule went quadratic — the
+    interprocedural passes R007-R010 are the ones to watch), and the
     findings counts make the ratchet trajectory visible across rounds —
     `findings_new` must be 0 on a committed tree."""
     from paddle_tpu.tooling.analyze import (DEFAULT_BASELINE_PATH,
                                             analyze_paths, load_baseline,
                                             new_findings)
+    from paddle_tpu.tooling.analyze.__main__ import default_paths
     from paddle_tpu.tooling.analyze.core import iter_source_files
+    from paddle_tpu.tooling.analyze.rules import RULES
 
-    repo = os.path.dirname(os.path.abspath(__file__))
     # walk the tree ONCE: the explicit file list goes straight into
     # analyze_paths (file paths short-circuit its own walk), so the
     # timed interval is pure parse+rules — the budget the ratchet pays
-    files = iter_source_files([os.path.join(repo, "paddle_tpu"),
-                               os.path.join(repo, "bench.py")])
+    repo = os.path.dirname(os.path.abspath(__file__))
+    files = iter_source_files(default_paths())
     n_files = len(files)
     t0 = time.perf_counter()
     findings = analyze_paths(files, root=repo)
     wall = time.perf_counter() - t0
     new = new_findings(findings, load_baseline(DEFAULT_BASELINE_PATH))
-    per_rule = {}
+    per_rule = {r.id: 0 for r in RULES}
     for f in findings:
         per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
     return {"analyze_wall_s": round(wall, 3),
             "analyze_files": n_files,
             "analyze_files_per_sec": round(n_files / max(wall, 1e-9), 1),
+            "rules": len(RULES),
             "findings_total": len(findings),
             "findings_new": len(new),
             "findings_per_rule": per_rule}

@@ -582,6 +582,12 @@ class ServingEngine:
         # admission; rc==1 everywhere when the cache is off, making the
         # alloc/release helpers the single accounting path either way
         self.block_rc = np.zeros((num_blocks + 1,), np.int64)
+        # blocksan (ISSUE 12): shadow ledger mirroring every
+        # _alloc/_ref/_release, reconciled against tables/shadow rows/
+        # prefix index at tick boundaries.  None unless
+        # FLAGS_enable_jaxsan was on at construction — the disabled
+        # path is one `is None` check per accounting call.
+        self._blocksan = _jaxsan.block_ledger(num_blocks)
         enable_prefix = (prefix_cache if prefix_cache is not None
                          else _flags.get_flag("serving_prefix_cache"))
         self.prefix = PrefixCache(block_size) if enable_prefix else None
@@ -1339,15 +1345,21 @@ class ServingEngine:
     # popleft/append accounting.
     def _alloc_block(self) -> int:
         blk = self.free_blocks.popleft()
+        if self._blocksan is not None:
+            self._blocksan.alloc(blk)
         self.block_rc[blk] = 1
         return blk
 
     def _ref_block(self, blk: int) -> None:
+        if self._blocksan is not None:
+            self._blocksan.ref(blk)
         self.block_rc[blk] += 1
 
     def _release_block(self, blk: int) -> bool:
         """Drop one reference; frees the block (returns True) only when
         orphaned — a shared block survives its other holders."""
+        if self._blocksan is not None:
+            self._blocksan.release(blk)
         self.block_rc[blk] -= 1
         if self.block_rc[blk] <= 0:
             self.block_rc[blk] = 0
@@ -1557,6 +1569,9 @@ class ServingEngine:
             else:
                 self.prefix.misses += 1
                 _M_PREFIX_MISSES.inc()
+            # checksum the just-registered blocks (ground truth now;
+            # immutable from here) — no-op unless blocksan is armed
+            _jaxsan.blocksan_snapshot(self)
         self._finish_admission(req, slot, row, t_admit)
         return True
 
@@ -1941,6 +1956,7 @@ class ServingEngine:
                 [int(self.tables[slot, c]) for c in range(fullb)],
                 self._ref_block,
                 match=getattr(req, "_prefix_match", None))
+            _jaxsan.blocksan_snapshot(self)
         self._finish_admission(req, slot, row, req._chunk_t_admit)
 
     def _abort_prefill(self, req, outcome: Optional[str] = None) -> None:
@@ -2282,6 +2298,11 @@ class ServingEngine:
             if pend.chunks:
                 rec["prefill_chunks"] = pend.chunks
             _flight.default_recorder().record_step(rec)
+        # blocksan boundary reconciliation: the harvest is the one point
+        # where no admission is mid-flight and every transient pin has
+        # resolved — ledger vs tables/shadow rows/index, free-list
+        # agreement, registered-block checksums (no-op when disarmed)
+        _jaxsan.blocksan_verify(self)
 
     def _tick_size(self, active) -> int:
         """Steps this tick may batch: bounded by the configured tick
@@ -2380,6 +2401,9 @@ class ServingEngine:
         for slot in list(range(self.B)):
             if self.slot_req[slot] is not None and self.slot_req[slot].done:
                 self._evict(slot)
+        # drained-engine invariant: nothing leaked — every block is
+        # free or held only by the prefix index (no-op when disarmed)
+        _jaxsan.blocksan_verify(self)
         return self.finished
 
     def serve_forever(self, stop_event, idle_s: float = 0.002) -> None:

@@ -27,6 +27,22 @@ model as the chaos harness and the metrics gate):
   aliasing race the private copies fix — so a test can prove the
   checksums actually catch the race class (the same arm-then-observe
   discipline as `testing.chaos`).
+
+* **blocksan** (ISSUE 12).  A shadow refcount ledger
+  (:class:`BlockLedger`) mirrors every serving-engine
+  ``_alloc_block``/``_ref_block``/``_release_block`` call and is
+  verified against the engine's OWN data structures at tick boundaries
+  (:func:`blocksan_verify`): a double-release raises at the call site,
+  a reference the tables/shadow rows/prefix index cannot account for is
+  a leak, a structural reference the accounting path never saw is an
+  untracked alias, and the free list must be exactly the rc==0 blocks
+  with no duplicates.  Prefix-cache-REGISTERED blocks additionally
+  carry byte checksums (:func:`blocksan_snapshot`) re-verified every
+  boundary, turning the "registered blocks are immutable" contract
+  (PR 9/10: CoW, rejected spec drafts) into a runtime invariant instead
+  of a test-only parity pin.  All of it rides ``FLAGS_enable_jaxsan``
+  (the ledger is created at engine construction; off = one ``is None``
+  check per call).
 """
 
 from __future__ import annotations
@@ -41,6 +57,8 @@ import numpy as np
 __all__ = [
     "JaxsanError", "enabled", "token", "shield", "feed", "verify",
     "poison_donated", "unsafe_alias", "alias_armed",
+    "BlockLedger", "block_ledger", "blocksan_snapshot",
+    "blocksan_verify",
 ]
 
 
@@ -221,6 +239,182 @@ def poison_donated(leaves: Iterable[Any], site: str = "",
     if n:
         _m_poisoned().inc(n, site=site or "unknown")
     return n
+
+
+# ===================================================== blocksan (ISSUE 12)
+
+def _violation(kind: str, message: str) -> None:
+    _m_violations().inc(kind=kind)
+    raise JaxsanError(f"blocksan [{kind}]: {message}")
+
+
+class BlockLedger:
+    """Shadow refcount ledger for one serving engine's physical KV
+    blocks.  The engine's accessors report every acquisition/release as
+    it happens (``alloc``/``ref``/``release``); the ledger is the
+    INDEPENDENT book that :func:`blocksan_verify` reconciles against
+    the engine's actual data structures — so a code path that forgets a
+    release (or releases twice, or bypasses the accessors) cannot stay
+    silent until the pool mysteriously drains in production.
+
+    ``digests`` carries the registered-block byte checksums (block id
+    -> sha1 of the block's bytes across every layer's pools, draft
+    pools included); a block's digest dies with its last reference —
+    a freed-and-reallocated block must never be judged against its
+    previous life's bytes."""
+
+    __slots__ = ("rc", "num_blocks", "digests", "verifies")
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = int(num_blocks)
+        self.rc = np.zeros((num_blocks + 1,), np.int64)
+        self.digests: dict = {}
+        self.verifies = 0
+
+    def alloc(self, blk: int) -> None:
+        if self.rc[blk] != 0:
+            _violation(
+                "free_list_corrupt",
+                f"block {blk} allocated while the ledger still holds "
+                f"{int(self.rc[blk])} reference(s) — the free list "
+                "handed out a live block")
+        self.rc[blk] = 1
+        self.digests.pop(blk, None)
+
+    def ref(self, blk: int) -> None:
+        if self.rc[blk] <= 0:
+            _violation(
+                "untracked_reference",
+                f"block {blk} re-referenced while the ledger holds no "
+                "reference — pinning a block nobody owns aliases the "
+                "free pool")
+        self.rc[blk] += 1
+
+    def release(self, blk: int) -> None:
+        if self.rc[blk] <= 0:
+            _violation(
+                "double_release",
+                f"block {blk} released while the ledger holds no "
+                "reference — a double release frees a block some other "
+                "holder still reads")
+        self.rc[blk] -= 1
+        if self.rc[blk] == 0:
+            self.digests.pop(blk, None)
+
+
+def block_ledger(num_blocks: int) -> Optional[BlockLedger]:
+    """A ledger when the sanitizer is enabled, else None (every engine
+    call site is None-guarded, so the disabled path costs one check)."""
+    return BlockLedger(num_blocks) if _ENABLED else None
+
+
+def _block_digest(engine, blk: int) -> bytes:
+    h = hashlib.sha1()
+    pool_sets = [engine.pools]
+    if getattr(engine, "dpools", None):
+        pool_sets.append(engine.dpools)
+    for pools in pool_sets:
+        for kk, vv in pools:
+            h.update(np.asarray(kk[:, blk]).tobytes())
+            h.update(np.asarray(vv[:, blk]).tobytes())
+    return h.digest()
+
+
+def blocksan_snapshot(engine) -> None:
+    """Checksum every prefix-REGISTERED block not yet in the ledger —
+    called right after ``prefix.register``, when the block's bytes are
+    ground truth by construction.  Registered blocks are immutable
+    (decode always starts in an unregistered column; CoW copies shared
+    blocks before writing), so any later digest mismatch is corruption,
+    not staleness."""
+    led = getattr(engine, "_blocksan", None)
+    if led is None or engine.prefix is None:
+        return
+    for blk in engine.prefix.resident_blocks():
+        if blk not in led.digests:
+            led.digests[blk] = _block_digest(engine, blk)
+
+
+def blocksan_verify(engine) -> None:
+    """The tick-boundary reconciliation.  Four invariants:
+
+    1. the engine's own ``block_rc`` equals the ledger (no accounting
+       path bypassed the accessors);
+    2. the free list is exactly the rc==0 blocks, no duplicates;
+    3. the ledger equals the STRUCTURAL reference count — table rows +
+       chunked-prefill shadow rows + one per prefix-index entry — so a
+       held reference nothing points at is a leak, and a structural
+       reference the ledger never saw is untracked;
+    4. every registered block still hashes to its registration-time
+       digest (immutability across decode, rejected spec drafts, CoW).
+    """
+    led = getattr(engine, "_blocksan", None)
+    if led is None:
+        return
+    led.verifies += 1
+    _m_checks().inc(site="serving.blocksan")
+    n = engine.num_blocks
+    if not np.array_equal(led.rc[1:], engine.block_rc[1:]):
+        bad = int(np.nonzero(led.rc[1:] != engine.block_rc[1:])[0][0]) + 1
+        _violation(
+            "accounting_mismatch",
+            f"block {bad}: engine block_rc={int(engine.block_rc[bad])} "
+            f"but the ledger saw {int(led.rc[bad])} — some path "
+            "mutated refcounts without going through "
+            "_alloc/_ref/_release_block")
+    free = [int(b) for b in engine.free_blocks]
+    if len(free) != len(set(free)):
+        dup = sorted(b for b in set(free) if free.count(b) > 1)[0]
+        _violation("free_list_corrupt",
+                   f"block {dup} appears twice in free_blocks — the "
+                   "next two allocations alias one physical block")
+    want_free = {b for b in range(1, n + 1) if led.rc[b] == 0}
+    if set(free) != want_free:
+        ghost = sorted(set(free) ^ want_free)[0]
+        _violation(
+            "free_list_corrupt",
+            f"free_blocks disagrees with the ledger at block {ghost}: "
+            f"in free list={ghost in set(free)}, "
+            f"ledger rc={int(led.rc[ghost])}")
+    expected = np.zeros((n + 1,), np.int64)
+    live = engine.tables[engine.tables > 0]
+    np.add.at(expected, live.reshape(-1), 1)
+    for req in engine.slot_req:
+        row = getattr(req, "_chunk_row", None) if req is not None else None
+        if row is not None:
+            srow = np.asarray(row)
+            np.add.at(expected, srow[srow > 0].reshape(-1), 1)
+    if engine.prefix is not None:
+        for blk in engine.prefix.resident_blocks():
+            expected[blk] += 1
+    if not np.array_equal(led.rc[1:], expected[1:]):
+        idx = np.nonzero(led.rc[1:] != expected[1:])[0] + 1
+        leaks = [int(b) for b in idx if led.rc[b] > expected[b]]
+        ghosts = [int(b) for b in idx if led.rc[b] < expected[b]]
+        if leaks:
+            b = leaks[0]
+            _violation(
+                "block_leak",
+                f"block {b} holds {int(led.rc[b])} ledger reference(s) "
+                f"but only {int(expected[b])} structural holder(s) "
+                "(tables / shadow rows / prefix index) exist — a "
+                "release call is missing and the block is pool "
+                "capacity lost for the process lifetime")
+        b = ghosts[0]
+        _violation(
+            "untracked_reference",
+            f"block {b} is referenced by {int(expected[b])} "
+            f"structure(s) but the ledger saw only {int(led.rc[b])} "
+            "acquisition(s) — something installed a block id without "
+            "going through the accounting path")
+    for blk, digest in list(led.digests.items()):
+        if _block_digest(engine, blk) != digest:
+            _violation(
+                "registered_block_mutation",
+                f"prefix-registered block {blk} no longer hashes to "
+                "its registration-time bytes — a decode/spec-draft/CoW "
+                "write landed in an immutable shared block; every "
+                "request sharing this prefix now reads corrupt KV")
 
 
 _init_from_flag()

@@ -2,6 +2,7 @@
 
 Usage:
     python -m paddle_tpu.tooling.analyze              # ratchet vs baseline
+    python -m paddle_tpu.tooling.analyze --changed    # only the git diff
     python -m paddle_tpu.tooling.analyze --list       # every finding
     python -m paddle_tpu.tooling.analyze --update-baseline
 
@@ -25,8 +26,24 @@ R005 lock-order-inversion        `with <lock>` nesting cycles across
 R006 unsynced-timing             perf_counter interval around an async
                                  dispatch with no block_until_ready —
                                  measures enqueue, not compute
+R007 unbalanced-block-lifecycle  `_alloc_X`/`_ref_X` acquisition with no
+                                 `_release_X` on some path (early
+                                 return / raise / unguarded dispatch;
+                                 local helper releases count)
+R008 shard-map-partial-escape    contraction over a sharded-contracted
+                                 operand leaving a shard_map body
+                                 without a psum-family collective
+R009 under-keyed-program-cache   memoized compiled program whose traced
+                                 body reads flag/mutable-self state the
+                                 cache key does not cover
+R010 unbudgeted-heavy-test       subprocess / long-loop / sleeping test
+                                 without @pytest.mark.slow (tests only;
+                                 the tier-1 budget rule)
 ==== =========================== =======================================
 
+R007-R010 ride the interprocedural pass layer (`interproc.py`: per-
+module call graph + def-use chains over the `core.SourceFile` index);
+code rules R001-R009 skip `test_*` modules, R010 runs only on them.
 The committed ratchet baseline (`baseline.json` next to this package)
 makes tier-1 fail on any NEW finding while grandfathering the audited
 existing ones — the codebase can only get cleaner.

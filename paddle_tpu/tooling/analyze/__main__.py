@@ -11,6 +11,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -19,26 +20,72 @@ from .core import (DEFAULT_BASELINE_PATH, analyze_paths, load_baseline,
 
 
 def default_paths() -> list:
-    """The package tree plus the repo-level drivers when present."""
+    """The package tree, the repo-level drivers, and the test tree
+    (code rules R001-R009 skip ``test_*`` modules; the tier-1 budget
+    rule R010 runs ONLY on them)."""
     pkg = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))          # .../paddle_tpu
     repo = os.path.dirname(pkg)
     paths = [pkg]
-    for extra in ("bench.py", "__graft_entry__.py"):
+    for extra in ("bench.py", "__graft_entry__.py", "tests"):
         p = os.path.join(repo, extra)
         if os.path.exists(p):
             paths.append(p)
     return paths
 
 
+def changed_paths(ref: str) -> list:
+    """Python files differing from git ``ref`` (committed diff) plus
+    untracked ones — the incremental ratchet surface.  Deleted files
+    are skipped; any git failure is LOUD (RuntimeError), never an
+    empty-and-green run.  Note: the cross-file rule R005 sees only the
+    changed files here, so cycles spanning into unchanged modules need
+    the full-tree run (tier-1 keeps it)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    repo = os.path.dirname(pkg)
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--", "*.py"],
+            capture_output=True, text=True, cwd=repo, timeout=60)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard",
+             "--", "*.py"],
+            capture_output=True, text=True, cwd=repo, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(f"graft-lint --changed: git failed: {e}")
+    if diff.returncode != 0:
+        raise RuntimeError("graft-lint --changed: `git diff "
+                           f"--name-only {ref}` failed: "
+                           + diff.stderr.strip())
+    if untracked.returncode != 0:
+        raise RuntimeError("graft-lint --changed: `git ls-files "
+                           "--others` failed: "
+                           + untracked.stderr.strip())
+    names = set(diff.stdout.split()) | set(untracked.stdout.split())
+    out = []
+    for name in sorted(names):
+        p = os.path.join(repo, name)
+        if os.path.exists(p) and p.endswith(".py"):
+            out.append(p)
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.tooling.analyze",
         description="graft-lint: JAX/TPU-aware static analysis "
-                    "(rules R001-R006, ratcheted baseline)")
+                    "(rules R001-R010, ratcheted baseline)")
     p.add_argument("paths", nargs="*",
                    help="files/directories to analyze (default: the "
-                        "paddle_tpu package + bench.py)")
+                        "paddle_tpu package + bench.py + tests/)")
+    p.add_argument("--changed", metavar="REF", nargs="?", const="HEAD",
+                   default=None,
+                   help="lint only files differing from git REF "
+                        "(default HEAD) plus untracked files — the "
+                        "seconds-scale incremental gate; the full-tree "
+                        "tier-1 run stays authoritative (R005 cycles "
+                        "into unchanged files are invisible here)")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--baseline", default=DEFAULT_BASELINE_PATH,
@@ -57,16 +104,37 @@ def main(argv=None) -> int:
                    help="emit one JSON object instead of text lines")
     args = p.parse_args(argv)
 
-    paths = args.paths or default_paths()
-    root = os.path.commonpath([os.path.abspath(p) for p in paths])
-    if os.path.isfile(root):
-        root = os.path.dirname(root)
-    # repo-relative paths in findings/baseline: anchor at the repo root
-    # (parent of the package) when analyzing the default tree
     pkg = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    if os.path.commonpath([root, pkg]) == pkg or root == pkg:
+    if args.changed is not None:
+        if args.paths:
+            print("graft-lint: --changed and explicit paths are "
+                  "mutually exclusive", file=sys.stderr)
+            return 2
+        if args.update_baseline:
+            print("graft-lint: refusing --update-baseline from a "
+                  "--changed subset (the baseline must cover the whole "
+                  "tree)", file=sys.stderr)
+            return 2
+        try:
+            paths = changed_paths(args.changed)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"graft-lint: no Python files changed vs "
+                  f"{args.changed}; nothing to lint")
+            return 0
         root = os.path.dirname(pkg)
+    else:
+        paths = args.paths or default_paths()
+        root = os.path.commonpath([os.path.abspath(p) for p in paths])
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+        # repo-relative paths in findings/baseline: anchor at the repo
+        # root (parent of the package) when analyzing the default tree
+        if os.path.commonpath([root, pkg]) == pkg or root == pkg:
+            root = os.path.dirname(pkg)
 
     rules = args.rules.split(",") if args.rules else None
     errors: list = []

@@ -28,9 +28,9 @@ from dataclasses import dataclass
 from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 __all__ = [
-    "Finding", "SourceFile", "iter_source_files", "analyze_paths",
-    "baseline_counts", "load_baseline", "save_baseline", "new_findings",
-    "DEFAULT_BASELINE_PATH",
+    "Finding", "Rule", "SourceFile", "iter_source_files",
+    "analyze_paths", "baseline_counts", "load_baseline",
+    "save_baseline", "new_findings", "DEFAULT_BASELINE_PATH",
 ]
 
 # the committed ratchet baseline rides next to the analyzer itself
@@ -68,6 +68,44 @@ class Finding:
                 "col": self.col, "symbol": self.symbol,
                 "message": self.message,
                 "fingerprint": self.fingerprint()}
+
+
+# --------------------------------------------------------------- rule base
+
+class Rule:
+    """Base of every graft-lint rule (lives here so the intra-file rule
+    set in `rules.py` and the interprocedural set in `interproc.py`
+    can both build on it without importing each other)."""
+
+    id = "R000"
+    name = "base"
+    # test modules deliberately WRITE the bad patterns (jit graph-break
+    # fixtures, donation probes), so the code rules skip `test_*` files;
+    # R010 (the tier-1 budget rule) inverts this and runs ONLY on them.
+    tests_only = False
+
+    def wants(self, sf: "SourceFile") -> bool:
+        is_test = sf.stem.startswith("test_")
+        return is_test if self.tests_only else not is_test
+
+    def run(self, sources: List["SourceFile"]) -> List["Finding"]:
+        out: List[Finding] = []
+        for sf in sources:
+            if self.wants(sf):
+                out.extend(self.check_file(sf))
+        return out
+
+    def check_file(self, sf: "SourceFile") -> List["Finding"]:  # pragma: no cover
+        return []
+
+    def finding(self, sf: "SourceFile", node: ast.AST, message: str,
+                symbol: Optional[str] = None) -> "Finding":
+        return Finding(rule=self.id, path=sf.rel,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       symbol=symbol if symbol is not None
+                       else sf.symbol_for(node))
 
 
 # --------------------------------------------------------------- the model
@@ -296,16 +334,7 @@ class SourceFile:
         return None
 
     def _compute_traced(self) -> Set[ast.AST]:
-        by_name: Dict[str, List[ast.AST]] = {}
-        methods: Dict[Tuple[str, str], ast.AST] = {}
-        for fn in self.functions:
-            if isinstance(fn, ast.Lambda):
-                continue
-            by_name.setdefault(fn.name, []).append(fn)
-            cls = self.enclosing_class(fn)
-            if cls is not None:
-                methods[(cls.name, fn.name)] = fn
-
+        by_name, _methods = self._fn_tables()
         traced: Set[ast.AST] = set()
         # (a) decorators
         for fn in self.functions:
@@ -330,41 +359,80 @@ class SourceFile:
                             traced.add(fn)
         # (c) lexical nesting + (d) local calls from traced bodies, to a
         # fixpoint: a helper invoked at trace time runs at trace time.
-        # Precompute the edge graph ONCE (per-scope node buckets), then
-        # close over it — no re-walking per iteration.
-        edges: Dict[ast.AST, List[ast.AST]] = {}
-        for fn in self.functions:
-            if isinstance(fn, ast.Lambda):
-                continue
-            outs: List[ast.AST] = []
-            for node in self.scope_walk(fn):
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                    if self.enclosing_function(node) is fn:
-                        outs.append(node)   # lexical nesting
-                    continue
-                if not isinstance(node, ast.Call):
-                    continue
-                if isinstance(node.func, ast.Name):
-                    outs.extend(f for f in by_name.get(node.func.id, [])
-                                if self._visible(f, node))
-                elif isinstance(node.func, ast.Attribute) and \
-                        isinstance(node.func.value, ast.Name) and \
-                        node.func.value.id == "self":
-                    cls = self.enclosing_class(fn)
-                    if cls is not None:
-                        m = methods.get((cls.name, node.func.attr))
-                        if m is not None:
-                            outs.append(m)
-            edges[fn] = outs
+        # The edge graph is the shared per-module call graph (also the
+        # seat of the interprocedural rules R007-R010).
+        edges = self.call_edges()
         queue = list(traced)
         while queue:
             t = queue.pop()
-            for c in edges.get(t, ()):
+            for c, _site in edges.get(t, ()):
                 if c not in traced:
                     traced.add(c)
                     queue.append(c)
         return traced
+
+    # ----------------------------------------------- per-module call graph
+    def resolve_call(self, call: ast.Call) -> List[ast.AST]:
+        """Resolve a call site to functions DEFINED IN THIS FILE: bare
+        names lexically (the same discipline `_compute_traced` uses — a
+        method `step` is not the local `step`), ``self.<m>`` to the
+        enclosing class's method.  Empty for anything unresolvable
+        (imports, attributes of other objects)."""
+        by_name, methods = self._fn_tables()
+        if isinstance(call.func, ast.Name):
+            return [f for f in by_name.get(call.func.id, [])
+                    if self._visible(f, call)]
+        if isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Name) and \
+                call.func.value.id == "self":
+            cls = self.enclosing_class(call)
+            if cls is not None:
+                m = methods.get((cls.name, call.func.attr))
+                if m is not None:
+                    return [m]
+        return []
+
+    def _fn_tables(self):
+        if getattr(self, "_fn_tables_cache", None) is None:
+            by_name: Dict[str, List[ast.AST]] = {}
+            methods: Dict[Tuple[str, str], ast.AST] = {}
+            for fn in self.functions:
+                if isinstance(fn, ast.Lambda):
+                    continue
+                by_name.setdefault(fn.name, []).append(fn)
+                cls = self.enclosing_class(fn)
+                if cls is not None:
+                    methods[(cls.name, fn.name)] = fn
+            self._fn_tables_cache = (by_name, methods)
+        return self._fn_tables_cache
+
+    def call_edges(self) -> Dict[ast.AST, List[Tuple[ast.AST,
+                                                     Optional[ast.Call]]]]:
+        """The per-module CALL GRAPH: fn -> [(callee fn, call site)].
+        A lexically nested def rides as an edge with site None (it may
+        run whenever the parent does).  Memoized — `_compute_traced`
+        and every interprocedural rule share one build."""
+        if getattr(self, "_call_edges_cache", None) is not None:
+            return self._call_edges_cache
+        edges: Dict[ast.AST, List[Tuple[ast.AST,
+                                        Optional[ast.Call]]]] = {}
+        for fn in self.functions:
+            if isinstance(fn, ast.Lambda):
+                continue
+            outs: List[Tuple[ast.AST, Optional[ast.Call]]] = []
+            for node in self.scope_walk(fn):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    if self.enclosing_function(node) is fn:
+                        outs.append((node, None))   # lexical nesting
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                for callee in self.resolve_call(node):
+                    outs.append((callee, node))
+            edges[fn] = outs
+        self._call_edges_cache = edges
+        return edges
 
     # ------------------------------------------------- compiled programs
     def _unwrap_program(self, value: ast.AST):

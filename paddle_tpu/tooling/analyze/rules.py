@@ -16,33 +16,10 @@ from __future__ import annotations
 import ast
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from .core import (Finding, ProgramInfo, SourceFile, callee_segment,
-                   expr_text)
+from .core import (Finding, ProgramInfo, Rule, SourceFile,
+                   callee_segment, expr_text)
 
-__all__ = ["RULES", "get_rules"]
-
-
-class Rule:
-    id = "R000"
-    name = "base"
-
-    def run(self, sources: List[SourceFile]) -> List[Finding]:
-        out: List[Finding] = []
-        for sf in sources:
-            out.extend(self.check_file(sf))
-        return out
-
-    def check_file(self, sf: SourceFile) -> List[Finding]:  # pragma: no cover
-        return []
-
-    def finding(self, sf: SourceFile, node: ast.AST, message: str,
-                symbol: Optional[str] = None) -> Finding:
-        return Finding(rule=self.id, path=sf.rel,
-                       line=getattr(node, "lineno", 1),
-                       col=getattr(node, "col_offset", 0),
-                       message=message,
-                       symbol=symbol if symbol is not None
-                       else sf.symbol_for(node))
+__all__ = ["RULES", "Rule", "get_rules"]
 
 
 def _is_np_call(sf: SourceFile, node: ast.Call,
@@ -467,7 +444,8 @@ class LockOrderInversion(Rule):
         edges: Dict[Tuple[str, str], List[Tuple[SourceFile, ast.AST,
                                                 str]]] = {}
         for sf in sources:
-            self._collect_file(sf, edges)
+            if self.wants(sf):
+                self._collect_file(sf, edges)
         graph: Dict[str, Set[str]] = {}
         for (a, b) in edges:
             graph.setdefault(a, set()).add(b)
@@ -767,6 +745,12 @@ RULES: List[Rule] = [
     HostSyncInTracedCode(), AliasUnsafeDeviceInput(), UseAfterDonate(),
     TraceTimeFlagRead(), LockOrderInversion(), UnsyncedTiming(),
 ]
+
+# the interprocedural rule set (R007-R010) registers itself here; the
+# import is at the bottom because interproc builds on Rule above
+from .interproc import RULES_V2 as _RULES_V2  # noqa: E402
+
+RULES.extend(_RULES_V2)
 
 
 def get_rules(ids: Optional[Iterable[str]] = None) -> List[Rule]:

@@ -210,6 +210,7 @@ def test_lookahead_converges_and_syncs():
     inner = optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
     opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=5)
     first = None
+    # graft-lint: disable=R010 (tiny problem; <1s measured)
     for i in range(40):
         loss = nn.MSELoss()(net(X), Y)
         loss.backward()

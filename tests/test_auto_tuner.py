@@ -69,6 +69,7 @@ def test_trial_timeout_enforced():
 
     def trial_fn(t):
         if t.dp == 4:
+            # graft-lint: disable=R010 (killed at the 0.5s trial timeout under test)
             _time.sleep(5)
         return 1.0
 

@@ -388,10 +388,12 @@ def test_multi_key_regression_check_labels_secondary_keys(tmp_path):
 
 
 def test_analyze_rung_schema():
-    """Pin the ISSUE 8 `analyze` rung's record schema: graft-lint wall
-    seconds + findings counts over the tree, regression key
-    `analyze_files_per_sec` (the analyzer runs in tier-1 on every CI
-    pass, so its runtime is a build-latency budget).  Smoke on CPU."""
+    """Pin the ISSUE 8/12 `analyze` rung's record schema: graft-lint
+    wall seconds + per-rule findings over the grown TEN-rule set and
+    the full default tree (tests/ included — R010's surface),
+    regression key `analyze_files_per_sec` (the analyzer runs in
+    tier-1 on every CI pass, so its runtime is a build-latency
+    budget).  Smoke on CPU."""
     import importlib.util
     import os
     from types import SimpleNamespace
@@ -418,6 +420,14 @@ def test_analyze_rung_schema():
     assert val["findings_new"] == 0
     assert val["findings_total"] >= 0
     assert isinstance(val["findings_per_rule"], dict)
+    # ISSUE 12: all ten rules report (zero-filled — a rule silently
+    # dropping out of the run would otherwise look like a clean rule)
+    assert val["rules"] == 10
+    assert sorted(val["findings_per_rule"]) == [
+        f"R{i:03d}" for i in range(1, 11)]
+    # the grown rule set still sees the WHOLE default tree, tests
+    # included (the R010 surface) — well over the package alone
+    assert val["analyze_files"] > 280
 
 
 def test_fused_optimizer_rung_schema():

@@ -112,6 +112,7 @@ def test_store_cross_process():
         c.set("from_child", b"hi")
         print(c.add("shared", 10))
     """)
+    # graft-lint: disable=R010 (one -c child, no jax import; ~1.4s measured)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=60)
     assert out.returncode == 0, out.stderr
@@ -171,6 +172,7 @@ with open(out, "w") as f:
 """
 
 
+@pytest.mark.slow   # tier-1 budget (R010): 2-proc jax children, ~4s
 def test_launch_two_process_dp_parity(tmp_path):
     script = tmp_path / "train_dp.py"
     script.write_text(DP_SCRIPT)
@@ -223,6 +225,7 @@ def test_launch_elastic_restart(tmp_path):
     script.write_text(FLAKY_SCRIPT)
     env = dict(os.environ)
     env.update({"OUT_DIR": str(tmp_path)})
+    # graft-lint: disable=R010 (jax-free flaky child; ~2s measured)
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "1", "--max_restart", "1",
@@ -235,6 +238,7 @@ def test_launch_elastic_restart(tmp_path):
 def test_launch_failure_without_elastic(tmp_path):
     script = tmp_path / "fail.py"
     script.write_text("import sys; sys.exit(7)\n")
+    # graft-lint: disable=R010 (child exits immediately; ~1.6s measured)
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.distributed.launch",
          "--nproc_per_node", "1", "--log_dir", str(tmp_path / "log"),
@@ -349,6 +353,7 @@ json.dump({"w": w.numpy().tolist(), "resumed_from": start, "gen": gen},
 """
 
 
+@pytest.mark.slow   # tier-1 budget (R010): restarting jax child, ~5s
 def test_elastic_restart_resumes_from_dist_checkpoint(tmp_path):
     """End-to-end elasticity (ref elastic/manager.py:124 semantics): a
     worker dies mid-training after step 2, the launcher restarts it in a

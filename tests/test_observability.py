@@ -399,6 +399,7 @@ def test_bench_backend_unavailable_exits_zero(monkeypatch, tmp_path,
     assert "mfu" in summ and summ["steps"] >= 1
 
 
+@pytest.mark.slow   # tier-1 budget (R010): 30-100s bench child, env-flaky
 def test_bench_cpu_smoke_subprocess(tmp_path):
     """CI/tooling satellite: `python bench.py --rungs cpu --smoke` runs in
     seconds on CPU, exits 0, and every rung emits schema-valid JSON."""

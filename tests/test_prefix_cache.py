@@ -209,6 +209,51 @@ def test_chunk_view_attention_matches_from_scratch_oracle():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_chunk_view_gqa_head_repeat_matches_dense_oracle():
+    """ISSUE 12 satellite: the PR 11 GQA path — `PagedChunkView` hands
+    over UN-repeated kv heads (kv_heads < query heads) and the view
+    repeats them to the pool's per-query-head layout.  Until now this
+    rode only through Llama composition tests; pin it directly against
+    the dense oracle (repeat kv, causal attention at the offset)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.kv_cache import PagedChunkView, _dense_causal
+    rng = np.random.RandomState(1)
+    nh, kvh, hd, bs, nb = 4, 2, 8, 4, 4     # 2 query heads per kv head
+    L1, L2 = 4, 5
+    L = L1 + L2
+    q = rng.randn(1, L, nh, hd).astype(np.float32)
+    k = rng.randn(1, L, kvh, hd).astype(np.float32)
+    v = rng.randn(1, L, kvh, hd).astype(np.float32)
+    pools = (jnp.zeros((nh, nb + 1, bs, hd), jnp.float32),
+             jnp.zeros((nh, nb + 1, bs, hd), jnp.float32))
+    tables = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    view = PagedChunkView.from_parts(pools[0], pools[1], tables,
+                                     jnp.zeros((1,), jnp.int32), bs)
+    view, _ = view.update_and_attend(jnp.asarray(q[:, :L1]),
+                                     jnp.asarray(k[:, :L1]),
+                                     jnp.asarray(v[:, :L1]))
+    view2 = PagedChunkView.from_parts(view.k, view.v, tables,
+                                      jnp.full((1,), L1, jnp.int32), bs)
+    _, out = view2.update_and_attend(jnp.asarray(q[:, L1:]),
+                                     jnp.asarray(k[:, L1:]),
+                                     jnp.asarray(v[:, L1:]))
+    rep = nh // kvh
+    k_rep = np.repeat(k, rep, axis=2)
+    v_rep = np.repeat(v, rep, axis=2)
+    want = _dense_causal(jnp.asarray(q), jnp.asarray(k_rep),
+                         jnp.asarray(v_rep))[:, L1:]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # the kv-head count must divide the query heads — anything else is
+    # a loud error, not a silent wrong repeat
+    bad = PagedChunkView.from_parts(pools[0], pools[1], tables,
+                                    jnp.zeros((1,), jnp.int32), bs)
+    with np.testing.assert_raises(ValueError):
+        bad.update_and_attend(jnp.asarray(q[:, :L1]),
+                              jnp.asarray(k[:, :L1, :1][:, :, [0, 0, 0]]),
+                              jnp.asarray(v[:, :L1, :1][:, :, [0, 0, 0]]))
+
+
 def test_prefix_counters_on_metrics_and_prometheus(model):
     """Satellite: serving.prefix_* counters feed the registry snapshot
     and the /metrics exposition, gated on FLAGS_enable_metrics."""

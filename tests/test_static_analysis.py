@@ -492,12 +492,15 @@ def test_cli_clean_tree_exits_zero_and_violation_exits_nonzero(tmp_path):
 
 
 def test_tier1_ratchet_tree_is_clean_within_budget():
-    """THE tier-1 gate: graft-lint over the real tree vs the committed
-    baseline — any new finding fails CI here, and the run must fit the
-    30s acceptance budget."""
+    """THE tier-1 gate: graft-lint (all ten rules) over the full
+    default tree — package, drivers AND tests/ (R010's surface) — vs
+    the committed baseline.  Any new finding fails CI here, and the run
+    must fit the 30s acceptance budget."""
+    from paddle_tpu.tooling.analyze.__main__ import default_paths
+    paths = default_paths()
+    assert any(p.endswith("tests") for p in paths)   # R010's surface
     t0 = time.perf_counter()
-    findings = analyze_paths([PKG, os.path.join(REPO, "bench.py")],
-                             root=REPO)
+    findings = analyze_paths(paths, root=REPO)
     elapsed = time.perf_counter() - t0
     fresh = new_findings(findings, load_baseline(DEFAULT_BASELINE_PATH))
     assert fresh == [], "new graft-lint findings (fix or baseline " \
@@ -760,3 +763,591 @@ def test_executor_fetch_numpy_conversion_stays_eager():
                        return_numpy=True)
     assert isinstance(compiled[0], np.ndarray)
     np.testing.assert_allclose(compiled[0], np.full((2, 2), 3.0))
+
+
+# ====================== R007-R010: the interprocedural rules (ISSUE 12)
+
+R007_BAD_RETURN = """\
+class Engine:
+    def _alloc_block(self):
+        return self.free.popleft()
+
+    def _release_block(self, b):
+        self.free.append(b)
+
+    def admit(self, req):
+        blk = self._alloc_block()
+        if not req.ok:
+            return False
+        self.table[0] = blk
+        return True
+"""
+
+R007_GOOD_RETURN = R007_BAD_RETURN.replace(
+    "        if not req.ok:\n            return False",
+    "        if not req.ok:\n"
+    "            self._release_block(blk)\n            return False")
+
+R007_GOOD_HELPER = R007_BAD_RETURN.replace(
+    "        if not req.ok:\n            return False",
+    "        if not req.ok:\n"
+    "            self._undo(blk)\n            return False") + """\
+
+    def _undo(self, b):
+        self._release_block(b)
+"""
+
+R007_BAD_DISPATCH = """\
+import jax.numpy as jnp
+
+class Engine:
+    def _alloc_block(self):
+        return self.free.popleft()
+
+    def _release_block(self, b):
+        self.free.append(b)
+
+    def admit(self, prompt):
+        blk = self._alloc_block()
+        row = self.prefill(jnp.asarray(prompt))
+        self.table[0] = blk
+        return row
+"""
+
+R007_GOOD_DISPATCH = R007_BAD_DISPATCH.replace(
+    "        row = self.prefill(jnp.asarray(prompt))",
+    "        try:\n"
+    "            row = self.prefill(jnp.asarray(prompt))\n"
+    "        except BaseException:\n"
+    "            self._release_block(blk)\n"
+    "            raise")
+
+
+def test_r007_catches_early_return_leak(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R007_BAD_RETURN}, rules=["R007"])
+    assert len(fs) == 1 and fs[0].symbol == "Engine.admit"
+    assert "returns early" in fs[0].message
+
+
+def test_r007_release_on_path_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R007_GOOD_RETURN},
+                   rules=["R007"]) == []
+
+
+def test_r007_release_via_local_helper_is_clean(tmp_path):
+    """The interprocedural half: `_undo(blk)` releases through its
+    transitive call summary, so the early return is balanced."""
+    assert run_src(tmp_path, {"mod.py": R007_GOOD_HELPER},
+                   rules=["R007"]) == []
+
+
+def test_r007_unguarded_dispatch_exception_edge(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R007_BAD_DISPATCH},
+                 rules=["R007"])
+    assert len(fs) == 1 and "can raise" in fs[0].message
+
+
+def test_r007_guarded_dispatch_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R007_GOOD_DISPATCH},
+                   rules=["R007"]) == []
+
+
+def test_r007_escape_to_owner_state_before_dispatch_is_clean(tmp_path):
+    """The serving `_dispatch_tick` shape: the drawn block lands in the
+    table row BEFORE the dispatch — ownership escaped, nothing held."""
+    src = R007_BAD_DISPATCH.replace(
+        "        row = self.prefill(jnp.asarray(prompt))\n"
+        "        self.table[0] = blk\n",
+        "        self.table[0] = blk\n"
+        "        row = self.prefill(jnp.asarray(prompt))\n")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R007"]) == []
+
+
+def test_r007_anonymous_acquisition_is_a_leak(tmp_path):
+    src = R007_BAD_RETURN.replace(
+        "        blk = self._alloc_block()",
+        "        self._alloc_block()")
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R007"])
+    assert fs and all(f.rule == "R007" for f in fs)
+
+
+R008_BAD = """\
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def body(x, w):
+    return jnp.matmul(x, w)
+
+
+def build(mesh):
+    return shard_map(body, mesh=mesh, in_specs=(P(), P("tp", None)),
+                     out_specs=P())
+"""
+
+R008_GOOD_PSUM = R008_BAD.replace(
+    "def body(x, w):\n    return jnp.matmul(x, w)",
+    "def body(x, w):\n    y = jnp.matmul(x, w)\n"
+    "    return jax.lax.psum(y, \"tp\")")
+
+R008_GOOD_COLUMN = R008_BAD.replace(
+    'in_specs=(P(), P("tp", None))',
+    'in_specs=(P(), P(None, "tp"))')
+
+
+def test_r008_catches_partial_escape(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R008_BAD}, rules=["R008"])
+    assert len(fs) == 1 and fs[0].symbol == "body"
+    assert "psum" in fs[0].message
+
+
+def test_r008_psum_before_return_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R008_GOOD_PSUM},
+                   rules=["R008"]) == []
+
+
+def test_r008_column_parallel_is_clean(tmp_path):
+    """Sharded on the OUTPUT (non-contracted) dim: each rank computes
+    exact column slices — the TP bit-parity layout; must not flag."""
+    assert run_src(tmp_path, {"mod.py": R008_GOOD_COLUMN},
+                   rules=["R008"]) == []
+
+
+def test_r008_einsum_contracted_sharded_letter(tmp_path):
+    src = R008_BAD.replace(
+        "    return jnp.matmul(x, w)",
+        "    return jnp.einsum(\"ij,jk->ik\", x, w)").replace(
+        'in_specs=(P(), P("tp", None))',
+        'in_specs=(P(), P("tp", None))')
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R008"])
+    assert len(fs) == 1
+    good = src.replace("jk->ik\", x, w)", "jk->ijk\", x, w)")
+    assert run_src(tmp_path / "g", {"mod.py": good},
+                   rules=["R008"]) == []
+
+
+def test_r008_spec_tuple_concat_and_unknown_specs_skipped(tmp_path):
+    """The serving idiom `(unknown, helper()) + (P(),) * N` parses; a
+    param with an unresolvable spec is skipped, not guessed."""
+    src = """\
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def body(params, pools, x, w):
+    return jnp.matmul(x, w)
+
+
+def build(mesh, param_specs, pool_spec):
+    return shard_map(body, mesh=mesh,
+                     in_specs=(param_specs, pool_spec())
+                     + (P(),) * 1 + (P("tp", None),),
+                     out_specs=P())
+"""
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R008"])
+    assert len(fs) == 1          # w (sharded on its contracted dim 0)
+
+
+R009_BAD = """\
+import jax
+
+
+class Server:
+    def __init__(self):
+        self._fns = {}
+        self.scale = 1.0
+
+    def program(self, k):
+        fn = self._fns.get(k)
+        if fn is not None:
+            return fn
+
+        def step(x):
+            if get_flag("fast_mode"):
+                return x * k
+            return x + self.scale
+
+        fn = self._fns[k] = jax.jit(step)
+        return fn
+
+    def retune(self, s):
+        self.scale = s
+"""
+
+R009_GOOD_INVALIDATE = R009_BAD.replace(
+    "    def retune(self, s):\n        self.scale = s",
+    "    def retune(self, s):\n        self.scale = s\n"
+    "        self._fns = {}").replace(
+    "            if get_flag(\"fast_mode\"):\n                return x * k\n", "")
+
+R009_GOOD_FROZEN = """\
+import jax
+
+
+class Server:
+    def __init__(self):
+        self._fns = {}
+        self.scale = 1.0
+
+    def program(self, k):
+        fn = self._fns.get(k)
+        if fn is not None:
+            return fn
+
+        def step(x):
+            return x + self.scale       # init-frozen: covered
+
+        fn = self._fns[k] = jax.jit(step)
+        return fn
+"""
+
+
+def test_r009_catches_flag_and_mutable_attr_reads(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R009_BAD}, rules=["R009"])
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 2
+    assert "get_flag" in msgs and "self.scale" in msgs
+    assert all(f.symbol == "Server.program" for f in fs)
+
+
+def test_r009_cache_invalidating_mutator_is_clean(tmp_path):
+    """`retune` resets the cache alongside the mutation — no stale
+    program can survive; must not flag."""
+    assert run_src(tmp_path, {"mod.py": R009_GOOD_INVALIDATE},
+                   rules=["R009"]) == []
+
+
+def test_r009_init_frozen_attr_is_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R009_GOOD_FROZEN},
+                   rules=["R009"]) == []
+
+
+def test_r009_factory_store_is_followed(tmp_path):
+    """The serving TP twin: `fn = self._fns[k] = self._build(k)` routes
+    the traced body through a factory METHOD — its reads bake too."""
+    src = """\
+import jax
+
+
+class Server:
+    def __init__(self):
+        self._fns = {}
+        self.mode = "a"
+
+    def program(self, k):
+        fn = self._fns.get(k)
+        if fn is not None:
+            return fn
+        if k > 4:
+            fn = self._fns[k] = self._build(k)
+            return fn
+
+        def step(x):
+            return x * k
+
+        fn = self._fns[k] = jax.jit(step)
+        return fn
+
+    def _build(self, k):
+        def step(x):
+            return x * k if self.mode == "a" else x
+        return jax.jit(step)
+
+    def set_mode(self, m):
+        self.mode = m
+"""
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R009"])
+    assert len(fs) == 1 and "self.mode" in fs[0].message
+
+
+def test_r009_dispatch_time_reads_in_builder_scope_are_clean(tmp_path):
+    """Reads in the builder's own scope feed the program as INPUTS at
+    dispatch (the grad-scaler shape) — only traced-body reads bake."""
+    src = """\
+import jax
+
+
+class Server:
+    def __init__(self):
+        self._fns = {}
+        self.scale = 1.0
+
+    def program(self, k, x):
+        fn = self._fns.get(k)
+        if fn is None:
+            def step(v, s):
+                return v * s
+            fn = self._fns[k] = jax.jit(step)
+        return fn(x, self.scale)        # live input, not baked
+
+    def retune(self, s):
+        self.scale = s
+"""
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R009"]) == []
+
+
+R010_BAD_SUBPROCESS = """\
+import subprocess
+import sys
+
+
+def test_spawns_child(tmp_path):
+    out = subprocess.run([sys.executable, "-c", "print(1)"])
+    assert out.returncode == 0
+"""
+
+R010_BAD_LOOP = """\
+def test_long_training_loop(model, opt):
+    for _ in range(50):
+        loss = model()
+        loss.backward()
+        opt.step()
+"""
+
+
+def test_r010_catches_subprocess_and_loop(tmp_path):
+    fs = run_src(tmp_path, {"test_mod.py": R010_BAD_SUBPROCESS,
+                            "test_loop.py": R010_BAD_LOOP},
+                 rules=["R010"])
+    assert len(fs) == 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "subprocess" in msgs and "range(50)" in msgs
+
+
+def test_r010_slow_mark_and_module_pytestmark_exempt(tmp_path):
+    marked = "import pytest\n\n\n@pytest.mark.slow\n" + \
+        R010_BAD_SUBPROCESS.replace("import subprocess\nimport sys\n\n\n",
+                                    "import subprocess\nimport sys\n\n")
+    module = "import pytest\n\npytestmark = pytest.mark.slow\n\n" + \
+        R010_BAD_LOOP
+    assert run_src(tmp_path, {"test_marked.py": marked,
+                              "test_module.py": module},
+                   rules=["R010"]) == []
+
+
+def test_r010_only_sees_test_files_and_code_rules_skip_them(tmp_path):
+    """The scoping contract: R010 ignores non-test modules; R001-R009
+    ignore `test_*` modules (they deliberately WRITE the bad patterns
+    as fixtures)."""
+    fs = run_src(tmp_path, {"mod.py": R010_BAD_SUBPROCESS.replace(
+        "def test_spawns_child", "def test_x")}, rules=["R010"])
+    assert fs == []
+    fs = run_src(tmp_path / "b", {"test_mod.py": R002_BAD})
+    assert [f for f in fs if f.rule == "R002"] == []
+
+
+def test_new_rule_fingerprints_survive_line_drift(tmp_path):
+    """Ratchet stability for the v2 rules: prepending comments shifts
+    every line; fingerprints must not move."""
+    for name, src, rule in [("r7.py", R007_BAD_RETURN, "R007"),
+                            ("r8.py", R008_BAD, "R008"),
+                            ("r9.py", R009_BAD, "R009"),
+                            ("test_r10.py", R010_BAD_SUBPROCESS,
+                             "R010")]:
+        d = tmp_path / rule
+        fs = run_src(d, {name: src}, rules=[rule])
+        assert fs, rule
+        baseline_path = d / "baseline.json"
+        save_baseline(str(baseline_path), fs)
+        (d / name).write_text("# drift\n# drift\n" + src)
+        fs2 = analyze_paths([str(d / name)], root=str(d), rules=[rule])
+        assert fs2[0].line != fs[0].line
+        assert new_findings(fs2, load_baseline(str(baseline_path))) \
+            == [], rule
+
+
+def test_r007_suppression(tmp_path):
+    src = R007_BAD_RETURN.replace(
+        "            return False",
+        "            return False  # graft-lint: disable=R007")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R007"]) == []
+
+
+# ====================== blocksan: the serving refcount ledger (ISSUE 12)
+
+def _drained_engine(model, **kw):
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    eng = ServingEngine(model, max_batch=2, max_context=64,
+                        block_size=16, **kw)
+    req = eng.add_request(Request(np.arange(1, 20, dtype=np.int32),
+                                  max_new_tokens=6))
+    eng.run()
+    return eng, list(req.output_ids)
+
+
+def test_blocksan_clean_run_is_violation_free_and_bit_identical(model):
+    """The acceptance pin: a clean serving run under
+    FLAGS_enable_jaxsan verifies at every boundary, registers prefix
+    checksums, trips nothing, and emits the SAME tokens."""
+    from paddle_tpu.observability import metrics as _metrics
+    with flag_guard(enable_jaxsan=False):
+        _, plain = _drained_engine(model, prefix_cache=True)
+    _metrics.reset()
+    with flag_guard(enable_jaxsan=True):
+        eng, sanitized = _drained_engine(model, prefix_cache=True)
+    assert sanitized == plain
+    assert eng._blocksan is not None
+    assert eng._blocksan.verifies > 0
+    assert len(eng._blocksan.digests) > 0      # registered + checksummed
+    snap = _metrics.snapshot()
+    sites = {s["labels"].get("site"): s["value"]
+             for s in snap["jaxsan.checks"]["series"]}
+    assert sites.get("serving.blocksan", 0) > 0
+    assert "jaxsan.violations" not in snap or not \
+        snap["jaxsan.violations"]["series"]
+
+
+def test_blocksan_disabled_is_none_ledger(model):
+    with flag_guard(enable_jaxsan=False):
+        eng, _ = _drained_engine(model)
+    assert eng._blocksan is None
+
+
+def test_blocksan_catches_injected_block_leak(model):
+    """Chaos injection: draw a block through the accounting path and
+    store it nowhere — the boundary reconciliation must name it."""
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=True):
+        eng, _ = _drained_engine(model)
+        eng._alloc_block()                     # leaked on purpose
+        with pytest.raises(jaxsan.JaxsanError, match="block_leak"):
+            jaxsan.blocksan_verify(eng)
+
+
+def test_blocksan_catches_double_release(model):
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=True):
+        eng, _ = _drained_engine(model)
+        blk = eng._alloc_block()
+        eng._release_block(blk)
+        with pytest.raises(jaxsan.JaxsanError, match="double_release"):
+            eng._release_block(blk)
+
+
+def test_blocksan_catches_accounting_bypass(model):
+    """A refcount mutated WITHOUT the accessors (the class the static
+    R007 rule cannot see at run time) trips the ledger comparison."""
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=True):
+        eng, _ = _drained_engine(model)
+        blk = eng._alloc_block()
+        eng.block_rc[blk] += 1                 # bypassing _ref_block
+        with pytest.raises(jaxsan.JaxsanError,
+                           match="accounting_mismatch"):
+            jaxsan.blocksan_verify(eng)
+
+
+def test_blocksan_catches_registered_block_mutation(model):
+    """Immutability checksums: mutating a prefix-registered block's
+    pool bytes (what a buggy decode/spec-draft/CoW write would do)
+    fails the boundary verify."""
+    from paddle_tpu.testing import jaxsan
+    with flag_guard(enable_jaxsan=True):
+        eng, _ = _drained_engine(model, prefix_cache=True)
+        assert eng._blocksan.digests
+        blk = next(iter(eng._blocksan.digests))
+        kk, vv = eng.pools[0]
+        eng.pools[0] = (kk.at[:, blk, 0, 0].add(1.0), vv)
+        with pytest.raises(jaxsan.JaxsanError,
+                           match="registered_block_mutation"):
+            jaxsan.blocksan_verify(eng)
+
+
+@pytest.mark.slow   # tier-1 budget (R010): spec engine compiles draft+verify programs
+def test_blocksan_clean_across_spec_and_chunked_composition(model):
+    """Rejected spec drafts and chunked prefill write next to shared
+    blocks every tick — the checksums prove they never write INTO
+    them, on the real composition paths."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    paddle.seed(1)
+    draft = GPTForCausalLM(gpt3_tiny())
+    draft.eval()
+    for kw in (dict(prefix_cache=True, prefill_chunk=8),
+               dict(prefix_cache=True, spec_decode=True,
+                    draft_model=draft, spec_k=3)):
+        with flag_guard(enable_jaxsan=False):
+            _, plain = _drained_engine(model, **kw)
+        with flag_guard(enable_jaxsan=True):
+            eng, sanitized = _drained_engine(model, **kw)
+        assert sanitized == plain, kw
+        assert eng._blocksan.verifies > 0
+
+
+# ============================== --changed mode (ISSUE 12 satellite)
+
+def test_changed_paths_refuses_bad_ref():
+    from paddle_tpu.tooling.analyze.__main__ import changed_paths
+    with pytest.raises(RuntimeError, match="git"):
+        changed_paths("no-such-ref-xyzzy")
+
+
+@pytest.mark.slow   # tier-1 budget (R010): git + CLI subprocesses
+def test_cli_changed_mode_lints_only_the_diff(tmp_path):
+    """`--changed REF` is the seconds-scale incremental ratchet: only
+    files differing from the ref are linted, so a violation in an
+    UNCHANGED file stays the full-tree gate's business."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def git(*args):
+        out = subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+            + list(args), capture_output=True, text=True,
+            cwd=str(tmp_path), timeout=60)
+        assert out.returncode == 0, out.stderr
+        return out
+
+    git("init", "-q")
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    (tmp_path / "old_violation.py").write_text(R001_BAD)
+    git("add", "-A")
+    git("commit", "-qm", "base")
+    (tmp_path / "changed.py").write_text(R003_BAD)      # untracked
+
+    # run the CLI from the tmp repo: __main__.changed_paths anchors at
+    # the PACKAGE repo, so exercise the library path directly here
+    from paddle_tpu.tooling.analyze import analyze_paths as ap
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD", "--", "*.py"],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=60)
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--",
+         "*.py"], capture_output=True, text=True, cwd=str(tmp_path),
+        timeout=60)
+    changed = sorted(set(diff.stdout.split())
+                     | set(untracked.stdout.split()))
+    assert changed == ["changed.py"]
+    fs = ap([str(tmp_path / f) for f in changed], root=str(tmp_path))
+    assert rules_of(fs) == ["R003"]          # old_violation.py unseen
+
+    # and the real CLI end-to-end on the package repo: HEAD-diff mode
+    # runs in seconds and exits honestly
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         "--changed", "HEAD"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert out.returncode in (0, 1), out.stdout + out.stderr
+    assert "graft-lint" in out.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tooling.analyze",
+         "--changed", "no-such-ref-xyzzy"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert bad.returncode == 2
+
+
+def test_r007_raise_inside_releasing_try_is_clean(tmp_path):
+    """A `raise` inside a try whose handler releases the family is a
+    covered unwind, not a leak (review fix: the Raise branch consults
+    the same `protected` set as the dispatch exception edge)."""
+    src = R007_BAD_RETURN.replace(
+        "        if not req.ok:\n            return False\n",
+        "        try:\n"
+        "            if not req.ok:\n"
+        "                raise ValueError(\"bad\")\n"
+        "        except ValueError:\n"
+        "            self._release_block(blk)\n"
+        "            raise\n")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R007"]) == []

@@ -458,6 +458,7 @@ def test_profiler_chrome_export_roundtrip_with_spans(tmp_path):
 
 # ------------------------------------------------------------------ CLI
 
+@pytest.mark.slow   # tier-1 budget (R010): three CLI children, ~4s
 def test_dump_cli_subprocess(tmp_path):
     """Fast-tier smoke of `python -m paddle_tpu.observability.dump`
     (mirrors the bench --smoke subprocess pattern)."""
