@@ -348,18 +348,43 @@ define_flag("serving_spec_decode", False,
             "distribution is unchanged)")
 define_flag("serving_spec_k", 4,
             "draft tokens proposed per slot per speculative tick; a "
-            "tick emits 1..k tokens depending on acceptance.  Slots "
-            "whose remaining budget is under k fall back to the plain "
-            "tick programs")
+            "tick emits 1..k tokens depending on acceptance.  "
+            "Eligibility is PER SLOT (a per-slot emit cap rides into "
+            "the program as a device input): a short-budget slot emits "
+            "at most its remaining budget without demoting the rest of "
+            "the batch.  With FLAGS_serving_spec_adaptive this is "
+            "superseded by the ladder")
+define_flag("serving_spec_draft", "model",
+            "speculative proposal source: 'model' runs the draft "
+            "model's k-step scan (needs draft_model= at engine "
+            "construction); 'ngram' proposes from a per-request "
+            "host-side n-gram/suffix table over the prompt + generated "
+            "tokens (inference/drafting.py) — no draft model, no draft "
+            "KV pools, no draft prefill; proposals ride into the "
+            "verify program as device inputs.  Both are lossless "
+            "(acceptance corrects any proposal quality)")
+define_flag("serving_spec_adaptive", False,
+            "adapt the speculative k at tick boundaries from the live "
+            "acceptance rate: k steps through "
+            "FLAGS_serving_spec_k_ladder (up while acceptance is high, "
+            "down when proposals are mostly rejected).  Every ladder "
+            "rung's program is enumerated into the warmup grid, so "
+            "adaptation NEVER compiles under traffic")
+define_flag("serving_spec_k_ladder", "2,4,8",
+            "comma-separated speculative-k rungs for "
+            "FLAGS_serving_spec_adaptive (each >= 2; one compiled spec "
+            "program per rung, all warmed).  Ignored with adaptation "
+            "off — FLAGS_serving_spec_k is the single fixed k")
 define_flag("serving_quant", "",
-            "weight-only quantized serving: 'int8' snapshots the "
-            "engine's matmul weights per-output-channel absmax int8 at "
-            "construction and dequantizes inside the compiled programs "
-            "(~4x less fp32 weight memory on device; logits change "
-            "within a small parity budget).  Composes with "
-            "FLAGS_serving_tp_degree (quantize-then-shard is bit-exact) "
-            "and spec decode.  Empty (the default) serves full-precision "
-            "weights")
+            "weight-only quantized serving: 'int8' (per-output-channel "
+            "absmax codes) or 'fp8' (e4m3fn, same 1 byte/weight with "
+            "relative per-channel precision) snapshots the engine's "
+            "matmul weights at construction and dequantizes inside the "
+            "compiled programs (~4x less fp32 weight memory on device; "
+            "logits change within the mode's documented parity budget). "
+            "Composes with FLAGS_serving_tp_degree (quantize-then-shard "
+            "is bit-exact) and spec decode.  Empty (the default) serves "
+            "full-precision weights")
 
 # Continuous batching: chunked prefill + SLO-aware scheduling + the
 # streaming serve endpoint (inference/serving.py, observability/http.py

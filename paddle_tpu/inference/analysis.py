@@ -56,7 +56,8 @@ class Artifact:
         """StableHLO MLIR text of the serialized program (deserialized
         through jax.export; empty string if undecodable)."""
         try:
-            import jax
+            import jax.export     # lazy submodule: `import jax` alone
+            import jax            # does not register the attribute
             return jax.export.deserialize(
                 bytearray(self.module_bytes)).mlir_module()
         except Exception:  # pragma: no cover - foreign/corrupt artifact

@@ -1,0 +1,148 @@
+"""Model-free n-gram drafting for speculative decoding.
+
+The serving engine's spec tick accepts ANY proposal source — the
+Leviathan rejection correction in `inference/speculative.py` only needs
+the proposal distribution ``q`` to score the proposed token.  A draft
+MODEL approximates the target with k cheap forwards; this module goes
+further: a per-request suffix/n-gram table over the tokens the stream
+has already committed (prompt + generated) proposes the continuation of
+the longest recently-seen suffix — "prompt lookup" drafting.  The
+proposal costs a few dict probes on the HOST (no draft weights, no
+draft KV pools, no draft prefill), so every accepted token is a target
+forward the engine never ran.
+
+Why it pays: real serving traffic is full of copy-slack —
+summarization/extraction quote their source, chat quotes the
+conversation, code completes identifiers it already typed, and greedy
+decoding itself is strongly self-repetitive.  Whenever the next tokens
+repeat ANY earlier span, the table proposes them exactly and the verify
+forward accepts the whole run.  On novel text the proposals are wrong,
+the verify rejects them, and the stream degrades to one (still correct)
+token per tick — losslessness never depends on proposal quality.
+
+The proposal is DETERMINISTIC, which keeps the rejection correction
+simple: ``q`` is a point mass at the proposed token, so the accept
+draw reduces to ``u <= p(d)`` and the residual to ``p`` with ``d``'s
+mass removed (`speculative.build_hostdraft_tick` builds that one-hot
+``q`` in-trace from the proposed-token device input).
+
+Indexing is incremental: each request owns one :class:`NGramDraft`;
+``propose(tokens, k)`` first absorbs any tokens appended since the
+last call (O(orders) dict writes per token), then walks orders longest
+first.  For each order it remembers the LAST and the PREVIOUS start of
+every n-gram, so the current suffix never matches itself.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["NGramDraft"]
+
+
+class NGramDraft:
+    """Per-request incremental suffix/n-gram proposal table.
+
+    ``max_n`` bounds the longest suffix matched (higher = more
+    precise matches, more index memory); ``min_n`` the shortest one
+    consulted before giving up.  ``propose`` never fails: with no
+    match it repeats the stream head — a wrong-but-cheap guess the
+    verify forward simply rejects.
+    """
+
+    def __init__(self, max_n: int = 3, min_n: int = 1):
+        if not 1 <= min_n <= max_n:
+            raise ValueError(
+                f"need 1 <= min_n <= max_n; got min_n={min_n} "
+                f"max_n={max_n}")
+        self.max_n = max_n
+        self.min_n = min_n
+        self._toks: List[int] = []    # owned history (propose_stream)
+        self._len = 0             # tokens absorbed into the index
+        # per order: n-gram -> start of its last occurrence, and the
+        # occurrence before that (the suffix's own entry is its last
+        # occurrence; PREV is what a lookup actually wants)
+        self._last: Dict[int, Dict[Tuple[int, ...], int]] = {
+            n: {} for n in range(min_n, max_n + 1)}
+        self._prev: Dict[int, Dict[Tuple[int, ...], int]] = {
+            n: {} for n in range(min_n, max_n + 1)}
+        self.matched = 0          # proposals backed by a table hit
+        self.fallbacks = 0        # ...and blind head-repeat proposals
+
+    def _absorb(self, tokens: Sequence[int]) -> None:
+        if len(tokens) < self._len:
+            # a shorter history means the caller reused the drafter for
+            # a different stream; start over rather than alias grams
+            self._len = 0
+            for n in self._last:
+                self._last[n].clear()
+                self._prev[n].clear()
+        for i in range(self._len, len(tokens)):
+            for n in self._last:
+                if i + 1 < n:
+                    continue
+                start = i + 1 - n
+                gram = tuple(tokens[start:i + 1])
+                bucket = self._last[n]
+                old = bucket.get(gram)
+                if old is not None:
+                    self._prev[n][gram] = old
+                bucket[gram] = start
+        self._len = len(tokens)
+
+    def _match(self, tokens: Sequence[int]) -> int:
+        """Start index of the most recent PRIOR occurrence of the
+        longest indexed suffix, or -1."""
+        L = len(tokens)
+        for n in range(min(self.max_n, L), self.min_n - 1, -1):
+            gram = tuple(tokens[L - n:])
+            pos = self._last[n].get(gram)
+            if pos == L - n:              # the suffix itself
+                pos = self._prev[n].get(gram)
+            if pos is not None:
+                return pos + n            # continuation starts here
+        return -1
+
+    def propose_stream(self, prompt_ids: Sequence[int],
+                       output_ids: Sequence[int], k: int) -> List[int]:
+        """Draft ``k`` tokens continuing ``prompt_ids + output_ids``
+        WITHOUT materializing that concatenation per call: the drafter
+        owns a history list and appends only the output tokens that
+        arrived since the previous call, so a tick costs O(new tokens
+        + orders) however long the stream has grown.  The engine's
+        per-tick entry point (`propose` is the direct/list form)."""
+        t = self._toks
+        want = len(prompt_ids) + len(output_ids)
+        if len(t) > want:
+            # shorter history = the drafter was handed a different
+            # stream; start over (mirrors _absorb's reset)
+            t.clear()
+        if not t:
+            t.extend(int(x) for x in prompt_ids)
+        new = want - len(t)
+        if new > 0:
+            t.extend(int(x) for x in output_ids[len(output_ids) - new:])
+        return self.propose(t, k)
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        """Draft ``k`` tokens continuing ``tokens`` (the request's
+        prompt + generated ids).  Tokens appended since the previous
+        call are absorbed first, so call-per-tick is O(new + orders)."""
+        self._absorb(tokens)
+        cont = self._match(tokens)
+        if cont < 0:
+            self.fallbacks += 1
+            head = int(tokens[-1]) if tokens else 0
+            return [head] * k
+        self.matched += 1
+        out: List[int] = []
+        p = cont                          # cont <= L-1: at least one
+        for _ in range(k):                # real continuation token
+            out.append(int(tokens[p]))
+            p += 1
+            if p >= len(tokens):
+                # copying tokens[cont:] onto the end reproduces the
+                # matched suffix, whose continuation is cont again —
+                # exact for periodic streams, a guess otherwise
+                p = cont
+        return out

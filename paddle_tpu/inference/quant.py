@@ -1,4 +1,4 @@
-"""Weight-only quantized serving: engine weight snapshots as int8.
+"""Weight-only quantized serving: engine weight snapshots as int8/fp8.
 
 The serving engine's programs take the model parameters as inputs (the
 degree-1 path re-binds the live tensors per dispatch; the TP path
@@ -24,8 +24,17 @@ Which leaves quantize: 2D ``*.weight`` matrices.  Token embeddings
 (``wte`` / ``embed_tokens``) reduce over the hidden axis — one scale
 per vocab row serves BOTH the lookup and the tied logits head.
 Positional embeddings (``wpe`` / rotary tables) stay in floating point:
-they never feed a matmul, so int8 would buy bytes at pure accuracy
-cost.  1D tensors (LN, biases) always stay fp.
+they never feed a matmul, so quantizing would buy bytes at pure
+accuracy cost.  1D tensors (LN, biases) always stay fp.
+
+Both storage MODES share every seam above — ``int8`` (symmetric absmax
+codes) and ``fp8`` (e4m3fn, same one byte per weight, relative instead
+of uniform per-channel precision; `quantization/weight_only.py` has
+the tradeoff).  The mode is a snapshot-time choice: leaf selection,
+dequant-in-matmul, the TP slicing contract and the byte accounting are
+mode-independent, and each mode documents its own logit parity budget
+(int8 < 0.05, fp8 < 0.25 on the smoke preset — fp8's 3-bit mantissa
+rounds ~8x coarser than int8's 7-bit codes).
 """
 
 from __future__ import annotations
@@ -34,12 +43,14 @@ from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
 
-from ..quantization.weight_only import dequantize_int8, quantize_absmax_int8
+from ..quantization.weight_only import (dequantize, quantize_absmax_fp8,
+                                        quantize_absmax_int8)
 
 __all__ = ["WeightSnapshot", "snapshot", "dequant_values",
            "quantize_plan", "plan_stats", "MODES"]
 
-MODES = ("int8",)
+MODES = ("int8", "fp8")
+_QUANTIZERS = {"int8": quantize_absmax_int8, "fp8": quantize_absmax_fp8}
 
 # key-name hints, checked against the LAST two dotted components
 _EMBED_HINTS = ("wte", "embed_tokens", "tok_embeddings")
@@ -70,18 +81,20 @@ class WeightSnapshot:
     """
 
     def __init__(self, values: List[Any], axes: List[Optional[int]],
-                 weight_bytes: int, fp_weight_bytes: int):
+                 weight_bytes: int, fp_weight_bytes: int,
+                 mode: str = "int8"):
         self.values = values
         self.axes = axes
         self.weight_bytes = weight_bytes
         self.fp_weight_bytes = fp_weight_bytes
+        self.mode = mode
 
     @property
     def ratio(self) -> float:
         return round(self.fp_weight_bytes / max(self.weight_bytes, 1), 2)
 
     def stats(self) -> Dict[str, Any]:
-        return {"mode": "int8",
+        return {"mode": self.mode,
                 "quantized_tensors": sum(a is not None for a in self.axes),
                 "weight_bytes": self.weight_bytes,
                 "fp_weight_bytes": self.fp_weight_bytes,
@@ -94,6 +107,7 @@ def snapshot(keys: List[str], values: List[Any],
     if mode not in MODES:
         raise ValueError(f"FLAGS_serving_quant supports {MODES}; "
                          f"got {mode!r}")
+    quantize = _QUANTIZERS[mode]
     out, axes, qb, fb = [], [], 0, 0
     for key, v in zip(keys, values):
         v = jnp.asarray(v)
@@ -103,34 +117,40 @@ def snapshot(keys: List[str], values: List[Any],
             out.append(v)
             qb += v.size * v.dtype.itemsize
         else:
-            q, s = quantize_absmax_int8(v, axis=axis)
+            q, s = quantize(v, axis=axis)
             out.append((q, s))
             qb += q.size + s.size * s.dtype.itemsize
         axes.append(axis)
-    return WeightSnapshot(out, axes, qb, fb)
+    return WeightSnapshot(out, axes, qb, fb, mode)
 
 
 def dequant_values(values, axes) -> List[Any]:
     """Traced: restore the fp parameter list a model bind expects."""
-    return [v if a is None else dequantize_int8(*v)
+    return [v if a is None else dequantize(*v)
             for v, a in zip(values, axes)]
 
 
-def quantize_plan(plan) -> None:
+def quantize_plan(plan, mode: str = "int8") -> None:
     """Quantize a TP plan IN PLACE before `shard_plan` places it.
 
     Every 2D+ matmul weight leaf (qkv_w is [H, 3, nh, hd]) becomes
-    ``{"q": int8, "s": scale}``; the spec tree gets the weight's own
-    spec for both members (the scale's size-1 reduced axis makes that
-    valid).  Reduction axis is the contraction dim: axis 0 everywhere
-    (tp.forward_tp contracts every matmul over the leading input dim)
-    except ``wte`` [V, H], reduced over H so the per-row scale shards
-    with the vocab axis.
+    ``{"q": codes, "s": scale}`` in the chosen ``mode``'s storage
+    format; the spec tree gets the weight's own spec for both members
+    (the scale's size-1 reduced axis makes that valid).  Reduction
+    axis is the contraction dim: axis 0 everywhere (tp.forward_tp
+    contracts every matmul over the leading input dim) except ``wte``
+    [V, H], reduced over H so the per-row scale shards with the vocab
+    axis.
     """
+    if mode not in MODES:
+        raise ValueError(f"FLAGS_serving_quant supports {MODES}; "
+                         f"got {mode!r}")
+    quantize = _QUANTIZERS[mode]
+
     def q(leaf_name: str, holder, spec_holder) -> None:
         w = holder[leaf_name]
         axis = 1 if leaf_name == "wte" else 0
-        qv, s = quantize_absmax_int8(w, axis=axis)
+        qv, s = quantize(w, axis=axis)
         holder[leaf_name] = {"q": qv, "s": s}
         spec_holder[leaf_name] = {"q": spec_holder[leaf_name],
                                   "s": spec_holder[leaf_name]}
@@ -139,7 +159,7 @@ def quantize_plan(plan) -> None:
     for blk, spec in zip(plan.params["blocks"], plan.specs["blocks"]):
         for name in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
             q(name, blk, spec)
-    plan.meta["quant"] = "int8"
+    plan.meta["quant"] = mode
 
 
 def plan_stats(plan) -> Dict[str, Any]:

@@ -50,21 +50,29 @@ suffix runs a chunked prefill program, shared blocks copy-on-write when
 the last prompt token must be recomputed, and index eviction under pool
 pressure frees only orphaned blocks (`inference/prefix_cache.py`).
 
-Speculative + quantized serving (ISSUE 10):
-``FLAGS_serving_spec_decode`` (+ ``draft_model=`` at construction)
-adds ONE more compiled program — the spec tick: a k-step draft scan
-proposes ``FLAGS_serving_spec_k`` tokens per slot, the target judges
-all k proposals in a single `PagedChunkView` chunk verify forward,
-and per-slot accept masks emit 1..k tokens LOSSLESSLY (greedy
-bit-identical to the plain engine; seeded sampling corrected by
-rejection sampling — `inference/speculative.py`).  The draft keeps its
-own pools behind the SAME block table, so prefix sharing, CoW and the
-refcount accounting cover both models; rejected positions roll back by
-construction (only seq_lens += accepted becomes durable).
-``FLAGS_serving_quant=int8`` snapshots the matmul weights per-channel
-absmax int8 at construction and dequantizes in-trace
+Speculative + quantized serving (ISSUE 10, extended by ISSUE 13):
+``FLAGS_serving_spec_decode`` adds the spec tick — draft tokens for
+every slot judged by the target in a single `PagedChunkView` chunk
+verify forward, per-slot accept masks emitting 1..k tokens LOSSLESSLY
+(greedy bit-identical to the plain engine; seeded sampling corrected
+by rejection sampling — `inference/speculative.py`).  The proposal
+source is ``FLAGS_serving_spec_draft``: ``model`` runs a draft model's
+k-step scan over its own pools behind the SAME block table (prefix
+sharing, CoW and refcounts cover both models); ``ngram`` proposes from
+a per-request host-side suffix table (`inference/drafting.py`) and
+feeds the proposals in as DEVICE INPUTS — no draft model, pools, or
+prefill at all.  Eligibility is PER SLOT: each slot carries an emit
+cap ``min(k, remaining budget)`` into the program, so a short-budget
+slot no longer demotes the whole tick to the plain path — it just
+emits up to its cap (budget accounting refunds per slot at harvest).
+``FLAGS_serving_spec_adaptive`` steps k through the
+``FLAGS_serving_spec_k_ladder`` rungs at tick boundaries, driven by
+the live acceptance-rate EWMA; every rung's program is enumerated into
+the warmup grid, so adaptation never compiles under traffic.
+``FLAGS_serving_quant=int8|fp8`` snapshots the matmul weights
+per-channel at construction and dequantizes in-trace
 (`inference/quant.py`): ~4x less fp32 weight memory on device, bounded
-logit deviation, bit-exact across TP degrees.
+logit deviation (per-mode budget), bit-exact across TP degrees.
 
 Continuous batching (ISSUE 11): ``FLAGS_serving_prefill_chunk`` makes
 prefill INCREMENTAL — an arriving prompt of any length is absorbed as
@@ -171,6 +179,20 @@ _M_SPEC_PROPOSED = _metrics.counter(
 _M_SPEC_ACCEPTED = _metrics.counter(
     "serving.spec_accepted_tokens", "draft tokens accepted by the "
     "verify forward (greedy argmax match or rejection-sampling accept)")
+_M_SPEC_INELIGIBLE = _metrics.counter(
+    "serving.spec_ineligible_slots", "active slots dispatched into a "
+    "spec tick with a per-slot emit cap BELOW the tick's k (remaining "
+    "budget under k): they ride the same program capped instead of "
+    "demoting the whole tick to the plain path")
+_M_SPEC_K = _metrics.gauge(
+    "serving.spec_k_now", "speculative k of the most recent spec "
+    "dispatch (steps through FLAGS_serving_spec_k_ladder when "
+    "FLAGS_serving_spec_adaptive drives it)")
+_M_SPEC_SLOT_ACC = _metrics.gauge(
+    "serving.spec_slot_accept_rate", "per-slot lifetime draft "
+    "acceptance rate of the slot's CURRENT request (labelled slot=i; "
+    "the adaptive-k controller consumes the engine-wide EWMA of the "
+    "same signal)")
 _M_PREFILL_CHUNKS = _metrics.counter(
     "serving.prefill_chunks", "chunk prefill programs dispatched by the "
     "continuous-batching scheduler (FLAGS_serving_prefill_chunk > 0: an "
@@ -274,6 +296,9 @@ class Request:
         self._prefix_blocks = 0   # shared blocks reused at admission
         self._spec_proposed = 0   # draft tokens proposed for this request
         self._spec_accepted = 0   # ...and accepted by the verify forward
+        self._drafter = None      # per-request n-gram table (spec_draft=
+                                  # ngram; created lazily at first spec
+                                  # dispatch)
         self.trace: Optional[dict] = None   # final record, set at finish
 
     def cancel(self) -> None:
@@ -312,7 +337,7 @@ class _PendingTick:
     __slots__ = ("active", "k", "toks", "logits", "reqs", "t0",
                  "device_sampling", "overlapped", "step_no", "san",
                  "spec", "counts", "accepts", "new_lens", "new_last",
-                 "chunks")
+                 "chunks", "kcap")
 
     def __init__(self, active, k, toks, logits, reqs, t0,
                  device_sampling, step_no, san=None):
@@ -332,6 +357,7 @@ class _PendingTick:
         self.new_lens = None
         self.new_last = None
         self.chunks = 0     # prefill chunks run at this tick's boundary
+        self.kcap = None    # per-slot emit caps of a spec dispatch
 
 
 def _next_tokens(logits, do_sample, temperature, top_k, top_p, seeds,
@@ -380,6 +406,9 @@ class ServingEngine:
                  prefix_cache: Optional[bool] = None,
                  draft_model=None, spec_decode: Optional[bool] = None,
                  spec_k: Optional[int] = None,
+                 spec_draft: Optional[str] = None,
+                 spec_adaptive: Optional[bool] = None,
+                 spec_k_ladder=None,
                  quant: Optional[str] = None,
                  prefill_chunk: Optional[int] = None):
         # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
@@ -454,7 +483,7 @@ class ServingEngine:
                 # their reduced axis, so each rank's (int8, scale)
                 # shard dequantizes to an exact slice of the full
                 # dequantized matrix — quant x TP stays bit-parity
-                _squant.quantize_plan(plan)
+                _squant.quantize_plan(plan, self.quant_mode)
                 self._quant_stats = _squant.plan_stats(plan)
             self._tp_params = _tp.shard_plan(plan, self._tp_mesh)
             self._tp_specs = plan.specs
@@ -487,25 +516,61 @@ class ServingEngine:
         self.spec = bool(spec)
         self.spec_k = int(spec_k if spec_k is not None
                           else _flags.get_flag("serving_spec_k"))
-        self.draft = draft_model if self.spec else None
+        kind = (spec_draft if spec_draft is not None
+                else _flags.get_flag("serving_spec_draft"))
+        self.spec_kind = str(kind or "model")
+        if self.spec_kind not in ("model", "ngram"):
+            raise ValueError(
+                "FLAGS_serving_spec_draft supports 'model' or 'ngram'; "
+                f"got {self.spec_kind!r}")
+        adaptive = (spec_adaptive if spec_adaptive is not None
+                    else _flags.get_flag("serving_spec_adaptive"))
+        self.spec_adaptive = bool(adaptive)
+        # model-draft state only exists for spec_draft='model'
+        self.spec_model = self.spec and self.spec_kind == "model"
+        self.draft = draft_model if self.spec_model else None
         self.dpools = None
         self._dsd = None
         self._dkeys = None
         self._dqw = None
         self._tp_draft_vals = None
-        self._spec_fn = None
+        self._spec_fns = {}       # model-draft spec tick, per ladder k
+        self._spec_hd_fns = {}    # host-draft (ngram) twin, per ladder k
         self.spec_ticks = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        self.spec_ineligible_slots = 0
+        self.spec_k_switches = 0
+        self.spec_ladder: tuple = ()
+        self.spec_k_now = 0
+        self._accept_ewma: Optional[float] = None
+        self._spec_ticks_since_adapt = 0
         if self.spec:
-            if draft_model is None:
-                raise ValueError(
-                    "speculative decoding needs a draft model: "
-                    "ServingEngine(model, draft_model=...) — or disable "
-                    "FLAGS_serving_spec_decode")
             if self.spec_k < 1:
                 raise ValueError(
                     f"serving_spec_k must be >= 1: {self.spec_k}")
+            if self.spec_adaptive:
+                ladder = (spec_k_ladder if spec_k_ladder is not None
+                          else _flags.get_flag("serving_spec_k_ladder"))
+                self.spec_ladder = self._parse_spec_ladder(ladder)
+            else:
+                self.spec_ladder = (self.spec_k,)
+            # start at the lowest rung: ramping UP on observed
+            # acceptance risks nothing, starting high on an unknown
+            # workload wastes whole verify chunks
+            self.spec_k_now = self.spec_ladder[0]
+        if self.spec and not self.spec_model:
+            if draft_model is not None:
+                raise ValueError(
+                    "spec_draft='ngram' is model-free; drop "
+                    "draft_model= (or select spec_draft='model')")
+        if self.spec_model:
+            if draft_model is None:
+                raise ValueError(
+                    "speculative decoding needs a draft model: "
+                    "ServingEngine(model, draft_model=...) — or select "
+                    "spec_draft='ngram', or disable "
+                    "FLAGS_serving_spec_decode")
             dcfg = draft_model.cfg
             if dcfg.vocab_size != cfg.vocab_size:
                 raise ValueError(
@@ -864,7 +929,7 @@ class ServingEngine:
             new_pools = [(c.k, c.v) for c in new_views]
             return row, new_pools
 
-        if self.spec:
+        if self.spec_model:
             def prefill_spec(param_vals, draft_vals, pools, dpools,
                              table_row, prompt, true_len):
                 row, new_pools = prefill(param_vals, pools, table_row,
@@ -915,7 +980,7 @@ class ServingEngine:
                 logits[0], true_len - 1, axis=0, keepdims=False)
             return row, pools
 
-        if self.spec:
+        if self.spec_model:
             def prefill_spec(params, draft_vals, pools, dpools,
                              table_row, prompt, true_len):
                 row, pools = prefill(params, pools, table_row, prompt,
@@ -967,7 +1032,7 @@ class ServingEngine:
                     logits[0], true_len - 1, axis=0, keepdims=False)
                 return row, pools
 
-            if self.spec:
+            if self.spec_model:
                 def cont_spec(params, draft_vals, pools, dpools,
                               table_row, suffix, true_len, start):
                     row, pools = cont(params, pools, table_row, suffix,
@@ -1008,7 +1073,7 @@ class ServingEngine:
             new_pools = [(c.k, c.v) for c in new_views]
             return row, new_pools
 
-        if self.spec:
+        if self.spec_model:
             def cont_spec(param_vals, draft_vals, pools, dpools,
                           table_row, suffix, true_len, start):
                 row, new_pools = cont(param_vals, pools, table_row,
@@ -1043,7 +1108,7 @@ class ServingEngine:
                             vv.at[:, dst].set(vv[:, src])))
             return out
 
-        if self.spec:
+        if self.spec_model:
             def body(pools, dpools, src, dst):
                 return cow(pools, src, dst), cow(dpools, src, dst)
             donate = (0, 1)
@@ -1052,7 +1117,7 @@ class ServingEngine:
         if self._tp_mesh is not None:
             from jax.sharding import PartitionSpec as _P
             from . import tp as _tp
-            if self.spec:
+            if self.spec_model:
                 body = self._shard_tp(
                     body, (_tp.pool_spec(), _P(), _P(), _P()),
                     (_tp.pool_spec(), _P()))
@@ -1065,34 +1130,64 @@ class ServingEngine:
             self._blame())
         return self._cow_fn
 
-    def _spec_program(self):
-        """The ONE compiled speculative tick (draft k-step scan + target
-        k-token chunk verify + accept masks — `inference/speculative.py`).
-        Signature: (params, draft_params, pools, dpools, tables,
-        seq_lens, last_tok, do_sample, temperature, top_k, top_p,
-        seeds) -> (toks [B,k], counts, accepts, new_lens, new_last,
-        pools, dpools).  Under TP the draft runs replicated while the
-        verify is the sharded forward; every scheduler input stays the
-        rank-0 broadcast."""
-        if self._spec_fn is not None:
-            return self._spec_fn
+    def _spec_program(self, k: int):
+        """The compiled MODEL-draft speculative tick for ladder rung
+        ``k`` (draft k-step scan + target k-token chunk verify + accept
+        masks — `inference/speculative.py`).  Signature: (params,
+        draft_params, pools, dpools, tables, seq_lens, last_tok,
+        do_sample, temperature, top_k, top_p, seeds, kcap) -> (toks
+        [B,k], counts, accepts, new_lens, new_last, pools, dpools).
+        Cached PER K — the adaptive ladder steps between compiled
+        programs, never recompiles one (every rung is in the warmup
+        grid).  Under TP the draft runs replicated while the verify is
+        the sharded forward; every scheduler input stays the rank-0
+        broadcast."""
+        fn = self._spec_fns.get(k)
+        if fn is not None:
+            return fn
         from . import speculative as _spec
-        k = self.spec_k
         if self._tp_mesh is not None:
             from jax.sharding import PartitionSpec as _P
             from . import tp as _tp
             body = self._shard_tp(
                 _spec.build_tp_spec_tick(self, k),
                 (self._tp_specs, _P(), _tp.pool_spec(), _P())
-                + (_P(),) * 8,
+                + (_P(),) * 9,
                 (_P(),) * 5 + (_tp.pool_spec(), _P()))
         else:
             body = _spec.build_spec_tick(self, k)
         donate = (2, 3) if jax.default_backend() != "cpu" else ()
-        self._spec_fn = _compile.wrap_first_call(
+        fn = self._spec_fns[k] = _compile.wrap_first_call(
             jax.jit(body, donate_argnums=donate), "serving.spec_tick",
-            self._blame(("spec_k", k)))
-        return self._spec_fn
+            self._blame(("spec_k", k), ("draft", "model")))
+        return fn
+
+    def _spec_hd_program(self, k: int):
+        """The compiled HOST-draft (ngram) speculative tick for ladder
+        rung ``k``: the k proposed tokens are a device input, so the
+        program is the verify chunk + accept tail alone — no draft
+        params or pools in the signature.  (params, pools, tables,
+        seq_lens, last_tok, dtoks, do_sample, temperature, top_k,
+        top_p, seeds, kcap) -> (toks, counts, accepts, new_lens,
+        new_last, pools).  Cached per k like the model twin."""
+        fn = self._spec_hd_fns.get(k)
+        if fn is not None:
+            return fn
+        from . import speculative as _spec
+        if self._tp_mesh is not None:
+            from jax.sharding import PartitionSpec as _P
+            from . import tp as _tp
+            body = self._shard_tp(
+                _spec.build_tp_hostdraft_tick(self, k),
+                (self._tp_specs, _tp.pool_spec()) + (_P(),) * 10,
+                (_P(),) * 5 + (_tp.pool_spec(),))
+        else:
+            body = _spec.build_hostdraft_tick(self, k)
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        fn = self._spec_hd_fns[k] = _compile.wrap_first_call(
+            jax.jit(body, donate_argnums=donate), "serving.spec_tick",
+            self._blame(("spec_k", k), ("draft", "ngram")))
+        return fn
 
     # -------------------------------------------------------------- warmup
     def _warm_call(self, fn, args, aot, install):
@@ -1158,10 +1253,10 @@ class ServingEngine:
                      z((B,), jnp.int32))
             # spec-decode engines thread (draft_params, draft_pools)
             # through prefill/cont/cow and own the spec tick program
-            dvals = self._draft_vals() if self.spec else None
+            dvals = self._draft_vals() if self.spec_model else None
 
             def _set_dpools(out_tail):
-                if self.spec:
+                if self.spec_model:
                     self.dpools = out_tail
             for k in sorted({self.steps_per_tick, 1}, reverse=True):
                 out, was_aot = self._warm_call(
@@ -1179,15 +1274,31 @@ class ServingEngine:
             n_aot += was_aot
             grid.append({"program": "decode", "steps_per_tick": 1})
             if self.spec:
-                out, was_aot = self._warm_call(
-                    self._spec_program(),
-                    (param_vals, dvals, self.pools, self.dpools)
-                    + sched + samp[:5], aot,
-                    lambda f: setattr(self, "_spec_fn", f))
-                self.pools, self.dpools = out[5], out[6]
-                n_aot += was_aot
-                grid.append({"program": "spec_tick",
-                             "spec_k": self.spec_k})
+                # one spec program per LADDER rung (adaptive k steps
+                # between warmed programs, never into a compile); the
+                # host-draft variant threads no draft state at all
+                for sk in self.spec_ladder:
+                    if self.spec_model:
+                        out, was_aot = self._warm_call(
+                            self._spec_program(sk),
+                            (param_vals, dvals, self.pools, self.dpools)
+                            + sched + samp[:5]
+                            + (z((B,), jnp.int32),), aot,
+                            lambda f, _k=sk:
+                                self._spec_fns.__setitem__(_k, f))
+                        self.pools, self.dpools = out[5], out[6]
+                    else:
+                        out, was_aot = self._warm_call(
+                            self._spec_hd_program(sk),
+                            (param_vals, self.pools) + sched
+                            + (z((B, sk), jnp.int32),) + samp[:5]
+                            + (z((B,), jnp.int32),), aot,
+                            lambda f, _k=sk:
+                                self._spec_hd_fns.__setitem__(_k, f))
+                        self.pools = out[5]
+                    n_aot += was_aot
+                    grid.append({"program": "spec_tick", "spec_k": sk,
+                                 "draft": self.spec_kind})
             if self.chunk <= 0:
                 # monolithic prefill: one program per ladder bucket.  A
                 # CHUNKED engine (FLAGS_serving_prefill_chunk > 0) never
@@ -1196,7 +1307,7 @@ class ServingEngine:
                 # grid swaps one program family for the other.
                 for L_pad in self.pad_ladder:
                     dpref = ((dvals, self.pools, self.dpools)
-                             if self.spec else (self.pools,))
+                             if self.spec_model else (self.pools,))
                     out, was_aot = self._warm_call(
                         self._prefill_program(L_pad),
                         (param_vals,) + dpref + (z((1, nb), jnp.int32),
@@ -1204,7 +1315,7 @@ class ServingEngine:
                         lambda f, _L=L_pad:
                             self._prefill_fns.__setitem__(_L, f))
                     self.pools = out[1]
-                    _set_dpools(out[2] if self.spec else None)
+                    _set_dpools(out[2] if self.spec_model else None)
                     n_aot += was_aot
                     grid.append({"program": "prefill", "L_pad": L_pad})
             if self.prefix is not None or self.chunk > 0:
@@ -1215,7 +1326,7 @@ class ServingEngine:
                 # all-zero table routes every write to scratch block 0.
                 for L_pad in self.pad_ladder:
                     dpref = ((dvals, self.pools, self.dpools)
-                             if self.spec else (self.pools,))
+                             if self.spec_model else (self.pools,))
                     out, was_aot = self._warm_call(
                         self._prefill_cont_program(L_pad),
                         (param_vals,) + dpref + (z((1, nb), jnp.int32),
@@ -1224,20 +1335,20 @@ class ServingEngine:
                         lambda f, _L=L_pad:
                             self._prefill_cont_fns.__setitem__(_L, f))
                     self.pools = out[1]
-                    _set_dpools(out[2] if self.spec else None)
+                    _set_dpools(out[2] if self.spec_model else None)
                     n_aot += was_aot
                     grid.append({"program": "prefill_cont",
                                  "L_pad": L_pad})
             if self.prefix is not None:
                 # the CoW block copy (the cache copies block 0 onto
                 # itself during warmup — inert)
-                cow_args = ((self.pools, self.dpools) if self.spec
+                cow_args = ((self.pools, self.dpools) if self.spec_model
                             else (self.pools,))
                 out, was_aot = self._warm_call(
                     self._cow_program(),
                     cow_args + (jnp.int32(0), jnp.int32(0)), aot,
                     lambda f: setattr(self, "_cow_fn", f))
-                if self.spec:
+                if self.spec_model:
                     self.pools, self.dpools = out
                 else:
                     self.pools = out
@@ -1265,6 +1376,23 @@ class ServingEngine:
             raise ValueError(
                 f"serving_pad_buckets entries must be positive: {vals}")
         return tuple(vals)
+
+    @staticmethod
+    def _parse_spec_ladder(spec) -> tuple:
+        """FLAGS_serving_spec_k_ladder / the ``spec_k_ladder`` kwarg:
+        comma-separated string or int sequence; sorted, deduplicated,
+        every rung >= 2 (a 1-rung emits exactly one token per verify —
+        that is the PLAIN path's job)."""
+        if isinstance(spec, str):
+            vals = [int(s) for s in
+                    (c.strip() for c in spec.split(",")) if s]
+        else:
+            vals = [int(v) for v in spec]
+        if not vals or any(v < 2 for v in vals):
+            raise ValueError(
+                "serving_spec_k_ladder needs at least one rung, all "
+                f">= 2: {vals}")
+        return tuple(sorted(set(vals)))
 
     def _default_ladder(self) -> tuple:
         """Power-of-two buckets from block_size up, clamped to the block
@@ -1475,23 +1603,23 @@ class ServingEngine:
                 # through admission so the draft model's prompt KV lands
                 # in its pools via the same table row / block ids
                 dpref = ((self._draft_vals(), self.pools, self.dpools)
-                         if self.spec else (self.pools,))
+                         if self.spec_model else (self.pools,))
                 if chain:
                     if cow_src is not None:
                         # the shared block holds the cached positions of
                         # the last prompt block; copy it so the suffix
                         # write lands in a private block
                         cow_args = ((self.pools, self.dpools)
-                                    if self.spec else (self.pools,))
+                                    if self.spec_model else (self.pools,))
                         out = self._cow_program()(
                             *cow_args, jnp.int32(cow_src),
                             jnp.int32(self.tables[slot, split_col]))
-                        if self.spec:
+                        if self.spec_model:
                             self.pools, self.dpools = out
                         else:
                             self.pools = out
                         dpref = ((dpref[0], self.pools, self.dpools)
-                                 if self.spec else (self.pools,))
+                                 if self.spec_model else (self.pools,))
                     Ls = L - cached_len
                     L_pad_s = self._pad_bucket(Ls)
                     suffix = np.zeros((1, L_pad_s), np.int32)
@@ -1516,7 +1644,7 @@ class ServingEngine:
                         param_vals, *dpref,
                         jnp.asarray(self.tables[slot:slot + 1].copy()),
                         jnp.asarray(prompt), jnp.int32(L))
-                if self.spec:
+                if self.spec_model:
                     row, self.pools, self.dpools = out
                 else:
                     row, self.pools = out
@@ -1672,6 +1800,7 @@ class ServingEngine:
         if self.spec:
             rec["spec_accept_rate"] = round(
                 req._spec_accepted / max(req._spec_proposed, 1), 4)
+            rec["spec_draft"] = self.spec_kind
         req.trace = rec
         _flight.default_recorder().record_event("request", **rec)
         _export.record_request(rec)
@@ -1851,12 +1980,12 @@ class ServingEngine:
         for the per-tick chunk budget."""
         if cow_src is not None:
             try:
-                cow_args = ((self.pools, self.dpools) if self.spec
+                cow_args = ((self.pools, self.dpools) if self.spec_model
                             else (self.pools,))
                 out = self._cow_program()(
                     *cow_args, jnp.int32(cow_src),
                     jnp.int32(int(row[split_col])))
-                if self.spec:
+                if self.spec_model:
                     self.pools, self.dpools = out
                 else:
                     self.pools = out
@@ -1911,14 +2040,14 @@ class ServingEngine:
         try:
             with self._params_for_call() as param_vals:
                 dpref = ((self._draft_vals(), self.pools, self.dpools)
-                         if self.spec else (self.pools,))
+                         if self.spec_model else (self.pools,))
                 # private row copy: same R002 aliasing contract as the
                 # monolithic prefill's table-row argument
                 out = self._prefill_cont_program(L_pad)(
                     param_vals, *dpref,
                     jnp.asarray(req._chunk_row[None, :].copy()),
                     jnp.asarray(suffix), jnp.int32(n), jnp.int32(off))
-            if self.spec:
+            if self.spec_model:
                 row, self.pools, self.dpools = out
             else:
                 row, self.pools = out
@@ -2094,60 +2223,142 @@ class ServingEngine:
         return pend
 
     def _spec_eligible(self, active, device_sampling) -> bool:
-        """May this tick run draft/verify?  Needs the subsystem (engine
-        built with a draft model), on-device sampling (the host sampler
-        cannot verify), and every active slot able to absorb the full
-        spec_k emitted tokens — the budget tail falls back to the plain
-        tick programs, which are in the warmup grid anyway."""
+        """May this tick run draft/verify?  Needs the subsystem, on-
+        device sampling (the host sampler cannot verify), and at least
+        ONE active slot able to absorb more than a single token —
+        eligibility is PER SLOT now (each slot carries its own emit cap
+        into the program), so a short-budget slot merely rides capped
+        instead of demoting the whole tick to the plain path.  Only a
+        batch where nobody could beat the plain tick falls back."""
         if not self.spec or not device_sampling:
             return False
+        need = min(2, self.spec_k_now)
         for slot in active:
             req = self.slot_req[slot]
-            if req.max_new_tokens - int(self.tok_pos[slot]) < self.spec_k:
-                return False
-        return True
+            if req.max_new_tokens - int(self.tok_pos[slot]) >= need:
+                return True
+        return False
+
+    # adaptive-k controller constants: step up while the acceptance
+    # EWMA clears _ADAPT_UP (proposals are nearly free tokens — reach
+    # further), down when it sinks under _ADAPT_DOWN (the verify chunk
+    # is mostly wasted width), after at least _ADAPT_MIN_TICKS spec
+    # ticks at the current rung (hysteresis against single-tick noise).
+    _ADAPT_UP = 0.75
+    _ADAPT_DOWN = 0.35
+    _ADAPT_MIN_TICKS = 2
+    _EWMA_BETA = 0.5
+
+    def _adapt_step(self) -> int:
+        """Ladder index delta the controller wants RIGHT NOW (+1 / -1 /
+        0), from the live acceptance EWMA with hysteresis.  Split from
+        the state change so `_can_overlap` can ask "is a step due?"
+        without taking it — a chained dispatch reuses its
+        predecessor's k, so while a step is due the overlap gate must
+        force a real boundary or adaptation would never run for
+        model-draft engines (their spec ticks chain indefinitely under
+        the default overlap flag)."""
+        if not self.spec_adaptive or self._accept_ewma is None \
+                or self._spec_ticks_since_adapt < self._ADAPT_MIN_TICKS:
+            return 0
+        i = self.spec_ladder.index(self.spec_k_now)
+        if self._accept_ewma >= self._ADAPT_UP \
+                and i + 1 < len(self.spec_ladder):
+            return 1
+        if self._accept_ewma <= self._ADAPT_DOWN and i > 0:
+            return -1
+        return 0
+
+    def _adapt_k(self) -> int:
+        """Boundary-time adaptive-k step: move ``spec_k_now`` one rung
+        along the ladder per decision, driven by the live acceptance
+        EWMA (the same counters `stats()['speculative']` reports).
+        Every rung's program is warmed, so a step never compiles."""
+        step = self._adapt_step()
+        if step:
+            i = self.spec_ladder.index(self.spec_k_now)
+            self.spec_k_now = self.spec_ladder[i + step]
+            self.spec_k_switches += 1
+            self._spec_ticks_since_adapt = 0
+        return self.spec_k_now
 
     def _dispatch_spec(self, active, t0, chain=None):
-        """Launch one speculative tick (draft scan + verify) in flight.
+        """Launch one speculative tick (proposal + verify) in flight.
 
-        Draft and verify both write positions ``seq..seq+spec_k-1``;
+        Proposals and verify both write positions ``seq..seq+k-1``;
         only the accepted prefix becomes durable — the rest is masked
         by seq_lens and overwritten by the next chunk (rollback by
-        construction).  Host seq_lens/tok_pos advance by the UPPER
-        BOUND k now (budget clamps and a chained dispatch's block
-        coverage need a bound, not the truth) and harvest refunds the
-        shortfall ``k - accepted`` per slot.  A chained dispatch feeds
-        the predecessor's on-device new_lens/new_last handles — the
-        draft phase of tick t+1 runs in tick t's harvest bubble."""
-        k = self.spec_k
+        construction).  PER-SLOT eligibility: each slot's emit cap
+        ``kcap = min(k, remaining budget)`` rides in as a device input;
+        host seq_lens/tok_pos advance by that per-slot upper bound now
+        (budget clamps and a chained dispatch's block coverage need a
+        bound, not the truth) and harvest refunds the per-slot
+        shortfall ``kcap - emitted``.  A chained MODEL-draft dispatch
+        feeds the predecessor's on-device new_lens/new_last handles —
+        the draft phase of tick t+1 runs in tick t's harvest bubble.
+        Host-draft (ngram) ticks never chain: the next proposal needs
+        the harvested tokens.  With ``FLAGS_serving_spec_adaptive`` an
+        unchained dispatch first lets the controller step k along the
+        warmed ladder."""
+        k = chain.k if chain is not None else self._adapt_k()
+        kcap = np.zeros((self.B,), np.int32)
+        ineligible = 0
         for slot in active:
+            req = self.slot_req[slot]
+            cap = min(k, req.max_new_tokens - int(self.tok_pos[slot]))
+            kcap[slot] = cap       # >= 1: eligibility/overlap gated it
+            if cap < k:
+                ineligible += 1
             base = int(self.seq_lens[slot])
-            for pos in range(base, base + k):
+            for pos in range(base, base + cap):
                 col = pos // self.bs
                 if pos % self.bs == 0 and self.tables[slot, col] == 0:
                     blk = self._alloc_block()
                     self.reserved -= 1
-                    self.slot_req[slot]._growth_left -= 1
+                    req._growth_left -= 1
                     self.tables[slot, col] = blk
+        if ineligible:
+            self.spec_ineligible_slots += ineligible
+            _M_SPEC_INELIGIBLE.inc(ineligible)
+        _M_SPEC_K.set(k)
         san = _jaxsan.token("serving.tick")
         dev = lambda a: jnp.asarray(_jaxsan.shield(san, a))  # noqa: E731
         if chain is not None:
             lens_in, last_in = chain.new_lens, chain.new_last
         else:
             lens_in, last_in = dev(self.seq_lens), dev(self.last_tok)
+        samp = (dev(self.samp_do), dev(self.samp_temp),
+                dev(self.samp_topk), dev(self.samp_topp),
+                dev(self.samp_seed))
         with self._params_for_call() as param_vals, \
                 _flight.guard("serving.tick"):
-            toks, counts, accepts, new_lens, new_last, self.pools, \
-                self.dpools = self._spec_program()(
-                    param_vals, self._draft_vals(), self.pools,
-                    self.dpools, dev(self.tables), lens_in, last_in,
-                    dev(self.samp_do), dev(self.samp_temp),
-                    dev(self.samp_topk), dev(self.samp_topp),
-                    dev(self.samp_seed))
-        self.steps += k + 1          # k draft forwards + one verify
+            if self.spec_model:
+                toks, counts, accepts, new_lens, new_last, self.pools, \
+                    self.dpools = self._spec_program(k)(
+                        param_vals, self._draft_vals(), self.pools,
+                        self.dpools, dev(self.tables), lens_in, last_in,
+                        *samp, dev(kcap))
+                self.steps += k + 1      # k draft forwards + one verify
+            else:
+                # host-side n-gram proposals (near-zero cost; the whole
+                # draft "model" is a few dict probes per slot) ride in
+                # as device inputs — the program is one verify forward
+                dtoks = np.zeros((self.B, k), np.int32)
+                for slot in active:
+                    req = self.slot_req[slot]
+                    if req._drafter is None:
+                        from .drafting import NGramDraft
+                        req._drafter = NGramDraft()
+                    dtoks[slot] = req._drafter.propose_stream(
+                        req.prompt_ids, req.output_ids, k)
+                toks, counts, accepts, new_lens, new_last, self.pools \
+                    = self._spec_hd_program(k)(
+                        param_vals, self.pools, dev(self.tables),
+                        lens_in, last_in, dev(dtoks), *samp, dev(kcap))
+                self.steps += 1          # one chunk verify forward
         for slot in active:
-            self.seq_lens[slot] += k
-            self.tok_pos[slot] += k
+            self.seq_lens[slot] += int(kcap[slot])
+            self.tok_pos[slot] += int(kcap[slot])
         pend = _PendingTick(active=active, k=k, toks=toks, logits=None,
                             reqs=list(self.slot_req), t0=t0,
                             device_sampling=True, step_no=self.steps,
@@ -2157,6 +2368,7 @@ class ServingEngine:
         pend.accepts = accepts
         pend.new_lens = new_lens
         pend.new_last = new_last
+        pend.kcap = kcap
         return pend
 
     def _harvest_tick(self, pend) -> None:
@@ -2182,28 +2394,38 @@ class ServingEngine:
         spec_proposed = 0
         harvested_by: List = []   # (req, tokens harvested this tick)
         if pend.spec:
-            # speculative tick: per-slot emitted counts (1..k) and
+            # speculative tick: per-slot emitted counts (1..kcap) and
             # accepted-draft counts materialize with the tokens; refund
-            # the dispatch-time upper-bound advance (k per slot) down
-            # to the true emitted length — relative, so it composes
-            # with any further conservative advance already applied by
-            # an overlapped next dispatch
+            # the dispatch-time PER-SLOT upper-bound advance (kcap per
+            # slot) down to the true emitted length — relative, so it
+            # composes with any further conservative advance already
+            # applied by an overlapped next dispatch
             counts = np.asarray(pend.counts)
             accepts = np.asarray(pend.accepts)
+            metrics_on = _metrics.enabled()
             for slot in pend.active:
                 req = pend.reqs[slot]
                 c = int(counts[slot])
-                self.seq_lens[slot] -= k - c
-                self.tok_pos[slot] -= k - c
+                cap = int(pend.kcap[slot])
+                self.seq_lens[slot] -= cap - c
+                self.tok_pos[slot] -= cap - c
                 if req.done:
                     continue     # whole row is EOS overrun
                 n_before = len(req.output_ids)
                 harvested_by.append((req, n_before))
                 req._ticks += 1
+                # acceptance accounts the full k proposals (the
+                # drafter-quality signal the adaptive controller
+                # consumes), independent of the slot's emit cap
                 spec_proposed += k
                 spec_accepted += int(accepts[slot])
                 req._spec_proposed += k
                 req._spec_accepted += int(accepts[slot])
+                if metrics_on:
+                    _M_SPEC_SLOT_ACC.set(
+                        round(req._spec_accepted
+                              / max(req._spec_proposed, 1), 4),
+                        slot=slot)
                 self.last_tok[slot] = int(toks[slot, c - 1])
                 for j in range(c):
                     if req.done:
@@ -2220,6 +2442,14 @@ class ServingEngine:
             self.spec_accepted += spec_accepted
             if spec_proposed:
                 _M_SPEC_PROPOSED.inc(spec_proposed)
+                # the adaptive controller's evidence: tick-level accept
+                # rate folded into a fast EWMA (consulted at boundary
+                # dispatches by `_adapt_k`)
+                rate = spec_accepted / spec_proposed
+                self._accept_ewma = rate if self._accept_ewma is None \
+                    else (self._EWMA_BETA * self._accept_ewma
+                          + (1.0 - self._EWMA_BETA) * rate)
+                self._spec_ticks_since_adapt += 1
             if spec_accepted:
                 _M_SPEC_ACCEPTED.inc(spec_accepted)
         else:
@@ -2294,6 +2524,8 @@ class ServingEngine:
                 "free_blocks": self._free_capacity()}
             if pend.spec:
                 rec["spec"] = True
+                rec["spec_kind"] = self.spec_kind
+                rec["spec_k"] = pend.k
                 rec["spec_accepted"] = spec_accepted
             if pend.chunks:
                 rec["prefill_chunks"] = pend.chunks
@@ -2342,15 +2574,21 @@ class ServingEngine:
         if self.waiting or self.prefilling:
             return False     # pending chunk work needs a real boundary
         if pend.spec:
+            if not self.spec_model:
+                return False     # ngram proposals need the harvested
+                                 # tokens: a host draft cannot chain
+            if self._adapt_step():
+                return False     # a k step is due: chained dispatches
+                                 # reuse chain.k, so force a boundary
+                                 # and let _adapt_k move the rung
             if not _flags.get_flag("serving_device_sampling"):
                 return False     # mid-run flip: verify owns sampling
             for slot in pend.active:
                 req = self.slot_req[slot]
                 if req is None or req.done:
                     return False
-                if req.max_new_tokens - int(self.tok_pos[slot]) \
-                        < self.spec_k:
-                    return False
+                if req.max_new_tokens - int(self.tok_pos[slot]) < 1:
+                    return False     # per-slot caps need >= 1 headroom
             return True
         if not pend.device_sampling and any(
                 pend.reqs[s].do_sample for s in pend.active):
@@ -2451,13 +2689,26 @@ class ServingEngine:
                "prefill_chunks": self.prefill_chunks_total,
                "slo_sheds": self.slo_sheds}
         if self.spec:
+            per_slot = {
+                slot: round(r._spec_accepted / r._spec_proposed, 4)
+                for slot, r in enumerate(self.slot_req)
+                if r is not None and r._spec_proposed}
             out["speculative"] = {
                 "spec_k": self.spec_k,
+                "k_now": self.spec_k_now,
+                "ladder": list(self.spec_ladder),
+                "adaptive": self.spec_adaptive,
+                "k_switches": self.spec_k_switches,
+                "draft": self.spec_kind,
                 "ticks": self.spec_ticks,
                 "proposed_tokens": self.spec_proposed,
                 "accepted_tokens": self.spec_accepted,
                 "accept_rate": round(
-                    self.spec_accepted / max(self.spec_proposed, 1), 4)}
+                    self.spec_accepted / max(self.spec_proposed, 1), 4),
+                "accept_ewma": (None if self._accept_ewma is None
+                                else round(self._accept_ewma, 4)),
+                "ineligible_slots": self.spec_ineligible_slots,
+                "per_slot_accept_rate": per_slot}
         if self._quant_stats is not None:
             out["quant"] = dict(self._quant_stats)
         if self.prefix is not None:

@@ -48,6 +48,31 @@ draft/accept/residual draws, so each (seed, position, tag) uniform is
 consumed at most once across rounds and the sampled stream is a pure
 function of the request seed — reproducible, and invariant to
 ``spec_k``, tick boundaries, and overlap.
+
+PER-SLOT ELIGIBILITY (ISSUE 13).  Every spec-tick variant takes a
+per-slot ``kcap`` device input — the emitted-count ceiling
+``min(k, remaining budget)`` — and the emit rule becomes
+``m = min(1 + min(a, k-1), kcap)`` per slot.  One short-budget slot no
+longer demotes the whole tick to the plain path: it just emits at most
+its own cap while full-budget slots ride the full k.  Truncation
+preserves the losslessness arguments verbatim: greedy emissions are a
+prefix of the uncapped emission (every token still a target argmax
+over its true prefix), and for sampling the uniforms at positions
+``>= seq + kcap`` never condition any emitted token (``a`` beyond the
+cap cannot change ``m`` or the emitted prefix), so re-drawing those
+positions next tick is sound — the same argument that already covered
+positions beyond a rejection.
+
+MODEL-FREE DRAFTING (ISSUE 13).  `build_hostdraft_tick` is the spec
+tick with the draft phase DELETED: the k proposed tokens arrive as a
+device INPUT (the host's per-request n-gram table proposes them —
+`inference/drafting.py`), so there is no draft model, no draft pools,
+no draft prefill, and the verify chunk + accept/emit tail are reused
+unchanged.  A deterministic proposal is the point mass ``q =
+one_hot(d)``, under which the rejection correction degenerates
+cleanly: accept ``u <= p(d)``, residual ``max(p - one_hot(d), 0)`` =
+``p`` with ``d``'s mass removed — still exactly ``p``-distributed
+output by the standard proof, built in-trace from the token input.
 """
 
 from __future__ import annotations
@@ -55,7 +80,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["accept_and_choose", "build_spec_tick", "build_tp_spec_tick"]
+__all__ = ["accept_and_choose", "build_spec_tick", "build_tp_spec_tick",
+           "build_hostdraft_tick", "build_tp_hostdraft_tick"]
 
 # disjoint PRNG stream tags: fold_in(fold_in(key(seed), TAG), position)
 DRAFT_FOLD = 0x51
@@ -174,12 +200,17 @@ def _draft_phase(eng, dpools, tables, seq_lens, last_tok, do_sample,
 
 
 def _finish(eng, tlogits, dtoks, dprobs, do_sample, temperature, top_k,
-            top_p, seeds, seq_lens):
-    """Shared accept tail of both spec-tick variants: mask inactive
-    rows, advance lengths by the emitted count."""
+            top_p, seeds, seq_lens, kcap):
+    """Shared accept tail of every spec-tick variant: cap the emitted
+    count at the slot's ``kcap`` (per-slot eligibility — a short-budget
+    slot emits at most its own remaining budget while the rest of the
+    batch rides the full k), mask inactive rows, advance lengths by the
+    emitted count."""
     chosen, m, a, new_last = accept_and_choose(
         tlogits, dtoks, dprobs, do_sample, temperature, top_k, top_p,
         seeds, seq_lens)
+    m = jnp.minimum(m, jnp.maximum(kcap.astype(jnp.int32), 1))
+    new_last = jnp.take_along_axis(chosen, (m - 1)[:, None], axis=1)[:, 0]
     active = seq_lens > 0
     counts = jnp.where(active, m, 0).astype(jnp.int32)
     accepts = jnp.where(active, a, 0).astype(jnp.int32)
@@ -199,7 +230,8 @@ def build_spec_tick(eng, k):
     from ..models.kv_cache import PagedChunkView
 
     def tick(param_vals, draft_vals, pools, dpools, tables, seq_lens,
-             last_tok, do_sample, temperature, top_k, top_p, seeds):
+             last_tok, do_sample, temperature, top_k, top_p, seeds,
+             kcap):
         eng._bind_draft(draft_vals)
         dtoks, dprobs, dpools = _draft_phase(
             eng, dpools, tables, seq_lens, last_tok, do_sample,
@@ -223,7 +255,7 @@ def build_spec_tick(eng, k):
                 pos_offset=Tensor._wrap(seq_lens[:, None]))
         pools = [(c.k, c.v) for c in new_views]
         out = _finish(eng, logits_t._value, dtoks, dprobs, do_sample,
-                      temperature, top_k, top_p, seeds, seq_lens)
+                      temperature, top_k, top_p, seeds, seq_lens, kcap)
         return out + (pools, dpools)
 
     return tick
@@ -242,7 +274,8 @@ def build_tp_spec_tick(eng, k):
     meta, bs = eng._tp_meta, eng.bs
 
     def tick(params, draft_vals, pools, dpools, tables, seq_lens,
-             last_tok, do_sample, temperature, top_k, top_p, seeds):
+             last_tok, do_sample, temperature, top_k, top_p, seeds,
+             kcap):
         eng._bind_draft(draft_vals)
         dtoks, dprobs, dpools = _draft_phase(
             eng, dpools, tables, seq_lens, last_tok, do_sample,
@@ -254,7 +287,71 @@ def build_tp_spec_tick(eng, k):
             meta, params, chunk, pools, tables, seq_lens,
             seq_lens[:, None], bs, view_cls=PagedChunkView)
         out = _finish(eng, logits, dtoks, dprobs, do_sample,
-                      temperature, top_k, top_p, seeds, seq_lens)
+                      temperature, top_k, top_p, seeds, seq_lens, kcap)
         return out + (pools, dpools)
+
+    return tick
+
+
+def build_hostdraft_tick(eng, k):
+    """Host-drafted spec tick body: NO draft phase — the k proposed
+    tokens ride in as a device input (``dtoks [B, k]``, proposed by the
+    per-request n-gram table on the host at ~zero cost), the verify
+    chunk and accept/emit tail are the model-draft path's, verbatim.
+    The proposal distribution is the point mass ``one_hot(dtoks)``,
+    under which the rejection test reduces to ``u <= p(d)`` and the
+    residual to ``p`` minus ``d``'s mass (see the module docstring).
+    Returns ``(toks [B,k], counts, accepts, new_lens, new_last,
+    pools)`` — no draft pools to thread."""
+    from ..framework.dygraph import no_grad
+    from ..framework.tensor import Tensor
+    from ..models.kv_cache import PagedChunkView
+
+    def tick(param_vals, pools, tables, seq_lens, last_tok, dtoks,
+             do_sample, temperature, top_k, top_p, seeds, kcap):
+        eng._bind_params(param_vals)
+        chunk = jnp.concatenate([last_tok[:, None], dtoks[:, :k - 1]],
+                                axis=1)
+        views = [PagedChunkView.from_parts(kk, vv, tables, seq_lens,
+                                           eng.bs)
+                 for kk, vv in pools]
+        with no_grad():
+            logits_t, new_views = eng.model.forward_with_cache(
+                Tensor._wrap(chunk), views,
+                pos_offset=Tensor._wrap(seq_lens[:, None]))
+        pools = [(c.k, c.v) for c in new_views]
+        logits = logits_t._value
+        dprobs = jax.nn.one_hot(dtoks, logits.shape[-1],
+                                dtype=jnp.float32)
+        out = _finish(eng, logits, dtoks, dprobs, do_sample,
+                      temperature, top_k, top_p, seeds, seq_lens, kcap)
+        return out + (pools,)
+
+    return tick
+
+
+def build_tp_hostdraft_tick(eng, k):
+    """Tensor-parallel host-drafted tick (runs inside ``shard_map``):
+    the proposed tokens and every scheduler input are replicated
+    (rank-0 broadcast), the verify forward is the sharded
+    `tp.forward_tp` chunk program, and token choice sees the full
+    replicated logits — the TP bit-parity contract, minus the draft
+    model entirely."""
+    from ..models.kv_cache import PagedChunkView
+    from . import tp as _tp
+    meta, bs = eng._tp_meta, eng.bs
+
+    def tick(params, pools, tables, seq_lens, last_tok, dtoks,
+             do_sample, temperature, top_k, top_p, seeds, kcap):
+        chunk = jnp.concatenate([last_tok[:, None], dtoks[:, :k - 1]],
+                                axis=1)
+        logits, pools = _tp.forward_tp(
+            meta, params, chunk, pools, tables, seq_lens,
+            seq_lens[:, None], bs, view_cls=PagedChunkView)
+        dprobs = jax.nn.one_hot(dtoks, logits.shape[-1],
+                                dtype=jnp.float32)
+        out = _finish(eng, logits, dtoks, dprobs, do_sample,
+                      temperature, top_k, top_p, seeds, seq_lens, kcap)
+        return out + (pools,)
 
     return tick

@@ -166,14 +166,15 @@ def _w(leaf):
     """Weight-only quantized leaves (``{"q", "s"}`` pairs installed by
     `inference/quant.quantize_plan`) dequantize IN-TRACE right before
     their matmul — XLA fuses the per-channel scale multiply into the
-    contraction, so device weight residency stays int8.  The scale was
-    computed per channel BEFORE sharding and keeps its reduced axis, so
-    each rank's (q, s) shard dequantizes bit-identically to a slice of
-    the full dequantized matrix — quant composes with the TP bit-parity
-    contract."""
+    contraction, so device weight residency stays the storage format
+    (int8 codes or fp8 e4m3fn — `dequantize` is format-agnostic).  The
+    scale was computed per channel BEFORE sharding and keeps its
+    reduced axis, so each rank's (q, s) shard dequantizes
+    bit-identically to a slice of the full dequantized matrix — either
+    quant mode composes with the TP bit-parity contract."""
     if isinstance(leaf, dict):
-        from ..quantization.weight_only import dequantize_int8
-        return dequantize_int8(leaf["q"], leaf["s"])
+        from ..quantization.weight_only import dequantize
+        return dequantize(leaf["q"], leaf["s"])
     return leaf
 
 

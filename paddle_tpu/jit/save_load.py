@@ -21,6 +21,11 @@ import os
 from typing import List, Optional, Sequence
 
 import jax
+# jax.export is a LAZILY imported submodule: plain `import jax` does
+# not register it, and on builds where the `jax.export` attribute
+# deprecation is accelerated, attribute access raises AttributeError
+# unless the submodule was imported explicitly first
+import jax.export
 import jax.numpy as jnp
 import numpy as np
 

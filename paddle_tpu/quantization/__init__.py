@@ -12,9 +12,10 @@ from .ptq import PTQ
 from .qat import QAT, QuantedLinear
 from .quanters import (FakeQuanterWithAbsMaxObserver, fake_quantize_absmax,
                        quantize_dequantize)
-from .weight_only import dequantize_int8, quantize_absmax_int8
+from .weight_only import (dequantize, dequantize_int8,
+                          quantize_absmax_fp8, quantize_absmax_int8)
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "QuantedLinear", "AbsmaxObserver",
            "FakeQuanterWithAbsMaxObserver", "fake_quantize_absmax",
            "quantize_dequantize", "quantize_absmax_int8",
-           "dequantize_int8"]
+           "quantize_absmax_fp8", "dequantize", "dequantize_int8"]

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for the inference path.
+"""Weight-only int8 / fp8 quantization for the inference path.
 
 Parity seat: the reference's weight-only quantized inference ops
 (`paddle/phi/kernels/fusion/gpu/fused_weight_only_linear_pass` family,
@@ -13,23 +13,43 @@ program as inputs, and `dequantize_int8` runs INSIDE the traced
 program, so XLA fuses the scale multiply into the consumer matmul and
 device weight residency is int8.
 
+Two storage formats share the one contract:
+
+* **int8** — symmetric absmax codes; lowest error for weights whose
+  channel distribution is roughly uniform in magnitude (7 bits of
+  uniform resolution per channel).
+* **fp8 (e4m3fn)** — per-channel absmax scaled into the +-448 finite
+  range, stored as ``float8_e4m3fn``.  Same byte footprint as int8;
+  the 4-bit exponent keeps RELATIVE precision across ~18 octaves, so
+  small-magnitude weights inside a large-absmax channel (exactly where
+  absmax-int8 rounds hardest) survive better, and on fp8-matmul
+  hardware the dequant multiply can fold into the MXU's scaled-fp8
+  path rather than an int->float convert.  Guarded: jax builds without
+  ``jnp.float8_e4m3fn`` raise at quantize time (the serving flag
+  surfaces that as a construction error, never a silent fp32 serve).
+
 The per-channel contract that makes tensor-parallel slicing safe:
 scales keep their reduced axis (``keepdims=True``), so a scale tensor
 has exactly the weight's rank with size 1 on the reduction axis.
-Because every channel is quantized independently, slicing along any
-NON-reduced axis commutes with quantization bit-for-bit:
+Because every channel is quantized independently (int8 rounding and
+the fp8 cast are both elementwise given the channel scale), slicing
+along any NON-reduced axis commutes with quantization bit-for-bit:
 ``quantize(w)[..., s]  ==  quantize(w[..., s])`` — which is why a TP
 plan can quantize first and shard after (inference/quant.py) and still
-be bit-identical to a rank-local quantization.
+be bit-identical to a rank-local quantization, in either format.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["quantize_absmax_int8", "dequantize_int8", "QMAX"]
+__all__ = ["quantize_absmax_int8", "quantize_absmax_fp8", "dequantize",
+           "dequantize_int8", "QMAX", "FP8_MAX", "HAS_FP8"]
 
 QMAX = 127  # symmetric int8: the -128 code is never produced
+FP8_MAX = 448.0             # largest finite float8_e4m3fn value
+_FP8 = getattr(jnp, "float8_e4m3fn", None)
+HAS_FP8 = _FP8 is not None
 
 
 def quantize_absmax_int8(w, axis: int = 0):
@@ -47,7 +67,32 @@ def quantize_absmax_int8(w, axis: int = 0):
     return q, scale
 
 
-def dequantize_int8(q, scale):
+def quantize_absmax_fp8(w, axis: int = 0):
+    """Per-channel absmax fp8 (e4m3fn) over the ``axis`` dimension:
+    each channel is scaled into the +-448 finite range and cast.
+
+    Returns ``(q, scale)`` with the int8 twin's exact shape contract
+    (``q`` fp8 with ``w``'s shape, keepdims ``scale`` in ``w``'s
+    dtype).  The pre-cast clip matters: the e4m3fn conversion does NOT
+    saturate — an out-of-range value becomes NaN, and float division
+    can land ``absmax / scale`` a ULP above 448."""
+    if not HAS_FP8:
+        raise RuntimeError(
+            "this jax build has no jnp.float8_e4m3fn; fp8 weight-only "
+            "quantization is unavailable (use int8)")
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / FP8_MAX, 1).astype(w.dtype)
+    q = jnp.clip(w / scale, -FP8_MAX, FP8_MAX).astype(_FP8)
+    return q, scale
+
+
+def dequantize(q, scale):
     """``q * scale`` back in the scale's (original weight) dtype; traced
-    inside compiled programs so XLA fuses it into the consuming matmul."""
+    inside compiled programs so XLA fuses it into the consuming matmul.
+    Format-agnostic: int8 and fp8 codes dequantize identically."""
     return (q.astype(scale.dtype) * scale)
+
+
+# the historical int8-specific name; the math never was int8-specific
+dequantize_int8 = dequantize

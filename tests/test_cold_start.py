@@ -256,6 +256,47 @@ def test_warmup_grid_spec_quant_zero_compiles(model):
         assert st["warmup"]["programs"] == 11
 
 
+@pytest.mark.slow   # compiles a full warmup grid incl. 3 ladder rungs;
+                    # tier-1 keeps only the legacy-grid pins fast
+def test_warmup_grid_ngram_adaptive_fp8_zero_compiles(model):
+    """ISSUE 13 acceptance: with model-free drafting + the adaptive-k
+    ladder + fp8 weight-only ALL on, the warmup grid enumerates one
+    hostdraft spec program per ladder rung (no draft model anywhere)
+    and post-warmup traffic — including adaptive-k transitions under
+    a repetitive workload — triggers ZERO compile-tracker events."""
+    vocab = model.cfg.vocab_size
+    with flag_guard(serving_warmup=True, serving_pad_buckets="16,32,64"):
+        eng = ServingEngine(model, max_batch=2, max_context=128,
+                            block_size=16, steps_per_tick=2,
+                            spec_decode=True, spec_draft="ngram",
+                            spec_adaptive=True, spec_k_ladder="2,4,8",
+                            quant="fp8")
+        info = eng.warmup()
+        # the 10-program prefix grid + one spec tick per ladder rung
+        assert info["programs"] == 13
+        spec_rungs = [g for g in info["grid"]
+                      if g["program"] == "spec_tick"]
+        assert [g["spec_k"] for g in spec_rungs] == [2, 4, 8]
+        assert all(g["draft"] == "ngram" for g in spec_rungs)
+        before = compile_tracker.total_compiles()
+        reqs = _drive_mixed_traffic(eng, vocab, (12, 20, 40, 60))
+        # a repetitive stream ramps k up the ladder under traffic —
+        # adaptation must step between WARMED programs only
+        rng = np.random.RandomState(13)
+        pat = list(rng.randint(1, vocab, (4,)))
+        r = eng.add_request(Request(np.array(pat * 12),
+                                    max_new_tokens=30))
+        eng.run()
+        assert compile_tracker.total_compiles() == before
+        assert all(len(q.output_ids) == 7 for q in reqs)
+        assert r.done and len(r.output_ids) == 30
+        st = eng.stats()
+        assert st["speculative"]["draft"] == "ngram"
+        assert st["speculative"]["k_switches"] >= 1
+        assert st["quant"]["mode"] == "fp8"
+        assert st["warmup"]["programs"] == 13
+
+
 @pytest.mark.slow   # compiles a second full warmup grid — tier-1's
                     # ~30s margin keeps only the legacy-grid pins fast
 def test_warmup_grid_chunked_zero_compiles(model):

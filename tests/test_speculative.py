@@ -287,17 +287,282 @@ def test_spec_prefix_cache_shared_blocks_stay_immutable(model,
     assert eng.stats()["free_blocks"] == eng.num_blocks
 
 
-def test_budget_tail_falls_back_to_plain_ticks(model, draft_same):
-    """A request whose remaining budget is below spec_k never rides a
-    spec tick (the plain programs serve the tail), and the stream is
-    still the plain engine's."""
-    p1, _, _ = prompts()
-    _, base = _greedy_streams(model, (p1,), (3,))
-    eng, out = _greedy_streams(model, (p1,), (3,),
+@pytest.mark.slow   # four engine builds (~13s); full runs cover it
+def test_per_slot_eligibility_caps_instead_of_demoting(model,
+                                                       draft_same):
+    """ISSUE 13: eligibility is PER SLOT.  A short-budget request rides
+    the spec tick with its own emit cap (`serving.spec_ineligible_slots`
+    counts it) instead of demoting the whole batch to the plain path —
+    and every stream, capped or not, is still bit-identical to the
+    plain engine.  Only a batch where NO slot can absorb 2+ tokens
+    falls back to the plain programs entirely."""
+    from paddle_tpu.observability import metrics as _metrics
+    p1, p2, _ = prompts()
+    _, base = _greedy_streams(model, (p1, p2), (3, 14))
+    _metrics.reset()
+    eng, out = _greedy_streams(model, (p1, p2), (3, 14),
                                draft_model=draft_same, spec_decode=True,
                                spec_k=4)
     assert out == base
-    assert eng.stats()["speculative"]["ticks"] == 0
+    st = eng.stats()["speculative"]
+    # the mixed batch really ran spec ticks (4-budget-tail no longer
+    # demotes) and the short slot was counted capped at least once
+    assert st["ticks"] > 0
+    assert st["ineligible_slots"] > 0
+    snap = _metrics.snapshot()
+    assert snap["serving.spec_ineligible_slots"]["series"][0]["value"] \
+        == st["ineligible_slots"]
+    # a batch with NOTHING to speculate (remaining budget 1 after the
+    # prefill token) still uses the plain path
+    _, base1 = _greedy_streams(model, (p1,), (2,))
+    eng1, out1 = _greedy_streams(model, (p1,), (2,),
+                                 draft_model=draft_same,
+                                 spec_decode=True, spec_k=4)
+    assert out1 == base1
+    assert eng1.stats()["speculative"]["ticks"] == 0
+
+
+# ------------------------------------------------- ISSUE 13: hostdraft
+
+def test_ngram_drafter_proposals():
+    """Host-side proposal table unit pins: periodic continuation,
+    longest-match preference, incremental absorb, the self-match guard,
+    and the head-repeat fallback."""
+    from paddle_tpu.inference.drafting import NGramDraft
+    d = NGramDraft()
+    toks = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+    # longest suffix [4,1,2] recurs at position 3 -> continuation wraps
+    # the period exactly
+    assert d.propose(toks, 6) == [3, 4, 1, 2, 3, 4]
+    # incremental: absorb only the appended tokens, propose again
+    assert d.propose(toks + [3, 4], 4) == [1, 2, 3, 4]
+    assert d.matched == 2 and d.fallbacks == 0
+    # no recurring suffix at all: head-repeat fallback
+    d2 = NGramDraft()
+    assert d2.propose([5, 6, 7], 3) == [7, 7, 7]
+    assert d2.fallbacks == 1
+    # order-1 match: continuation after the PRIOR occurrence, never the
+    # suffix matching itself
+    assert d2.propose([5, 6, 7, 5], 3) == [6, 7, 5]
+    # longest order wins over a shorter, more recent match
+    d3 = NGramDraft()
+    assert d3.propose([9, 1, 2, 3, 8, 1, 2, 9, 1, 2], 2) == [3, 8]
+    with pytest.raises(ValueError, match="min_n"):
+        NGramDraft(max_n=2, min_n=3)
+    # the engine's entry point: propose_stream appends only the new
+    # output tail to an owned history (no per-tick concatenation) and
+    # proposes identically to the list form
+    d4, d5 = NGramDraft(), NGramDraft()
+    prompt, out = [1, 2, 3, 4, 1, 2], []
+    for tok in (3, 4, 1, 2, 3, 4):
+        out.append(tok)
+        assert d4.propose_stream(prompt, out, 4) \
+            == d5.propose(prompt + out, 4)
+    assert d4._toks == prompt + out     # absorbed incrementally
+
+
+@pytest.mark.slow   # two engine builds (~7s); full runs cover it
+def test_hostdraft_greedy_bit_identical(model):
+    """THE tentpole headline: model-free n-gram drafting — no draft
+    model, no draft pools — still emits greedy streams bit-identical
+    to the plain engine, while the spec surface reports the ngram
+    draft kind end-to-end (stats, flight records, lifecycle traces)."""
+    from paddle_tpu.observability import flight_recorder as _flight
+    from paddle_tpu.observability import metrics as _metrics
+    p1, p2, p3 = prompts()
+    _, base = _greedy_streams(model, (p1, p2, p3), (10, 8, 12))
+    _metrics.reset()
+    _flight.default_recorder().clear()
+    eng, out = _greedy_streams(model, (p1, p2, p3), (10, 8, 12),
+                               spec_decode=True, spec_draft="ngram",
+                               spec_k=4)
+    assert out == base
+    st = eng.stats()["speculative"]
+    assert st["draft"] == "ngram" and st["ticks"] > 0
+    assert st["proposed_tokens"] > 0
+    assert eng.dpools is None and eng.draft is None
+    # per-slot accept rates are reported for the final occupants' runs
+    assert all(0.0 <= v <= 1.0
+               for v in st["per_slot_accept_rate"].values())
+    recs = [r for r in _flight.default_recorder().snapshot()["steps"]
+            if r.get("spec")]
+    assert recs and all(r["spec_kind"] == "ngram" for r in recs)
+    done = [r for r in eng.finished if r.trace is not None]
+    assert done and all(r.trace["spec_draft"] == "ngram" for r in done)
+    assert eng.stats()["free_blocks"] == eng.num_blocks
+    assert eng.stats()["reserved"] == 0
+
+
+def test_hostdraft_rejection_correction_is_lossless():
+    """The deterministic-proposal correction: with ``q = one_hot(d)``
+    the accept test is ``u <= p(d)`` and the residual is ``p`` minus
+    ``d``'s mass — emitted tokens must still be EXACTLY p-distributed
+    no matter how the proposals were chosen (here: adversarially, from
+    a fixed wrong-ish token)."""
+    import jax.numpy as jnp
+    from paddle_tpu.inference.speculative import accept_and_choose
+    from paddle_tpu.models.generation import _process_logits
+    rng = np.random.RandomState(5)
+    V, k, N = 24, 2, 4000
+    t_logits = (rng.randn(V) * 2).astype(np.float32)
+    temp, top_k, top_p = 0.8, 12, 0.9
+    filtered = np.asarray(_process_logits(
+        jnp.asarray(t_logits)[None], temp, top_k, top_p))[0]
+    probs = np.exp(filtered - filtered.max())
+    probs = probs / probs.sum()
+    # deterministic proposals: half the slots propose the target's
+    # argmax (plausible n-gram hit), half a low-probability token
+    best = int(np.argmax(probs))
+    worst = int(np.argsort(probs)[len(probs) // 2])
+    dtoks = np.where((np.arange(N) % 2)[:, None] == 0, best,
+                     worst).astype(np.int32)
+    dtoks = np.broadcast_to(dtoks, (N, k)).copy()
+    dprobs = np.zeros((N, k, V), np.float32)
+    np.put_along_axis(dprobs, dtoks[..., None], 1.0, axis=-1)
+    tlog = jnp.asarray(np.tile(t_logits, (N, k + 1, 1)))
+    chosen, m, a, _ = accept_and_choose(
+        tlog, jnp.asarray(dtoks), jnp.asarray(dprobs),
+        jnp.ones((N,), bool), jnp.full((N,), temp, jnp.float32),
+        jnp.full((N,), top_k, jnp.int32), jnp.full((N,), top_p,
+                                                   jnp.float32),
+        jnp.arange(N, dtype=jnp.uint32), jnp.full((N,), 16, jnp.int32))
+    first = np.asarray(chosen)[:, 0]
+    counts = np.bincount(first, minlength=V) / N
+    assert counts[probs == 0].sum() == 0
+    np.testing.assert_allclose(counts, probs, atol=0.05)
+
+
+def test_finish_kcap_pins_per_slot_emit_rule():
+    """Unit pin of the per-slot emit cap: ``m = min(1 + min(a, k-1),
+    kcap)`` and ``new_last`` tracks the capped emission."""
+    import jax.numpy as jnp
+    from paddle_tpu.inference.speculative import _finish
+    B, k, V = 3, 3, 8
+    # all three rows fully accept the draft chain 5, 6, 7
+    tl = np.full((B, k, V), -10.0, np.float32)
+    tl[:, 0, 5] = tl[:, 1, 6] = tl[:, 2, 7] = 0.0
+    dtoks = np.tile(np.array([5, 6, 7], np.int32), (B, 1))
+    toks, counts, accepts, new_lens, new_last = _finish(
+        None, jnp.asarray(tl), jnp.asarray(dtoks),
+        jnp.zeros((B, k, V), jnp.float32), jnp.zeros((B,), bool),
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+        jnp.ones((B,), jnp.float32), jnp.zeros((B,), jnp.uint32),
+        jnp.asarray([4, 4, 0], jnp.int32),       # row 2 inactive
+        jnp.asarray([3, 2, 3], jnp.int32))       # row 1 capped at 2
+    assert list(np.asarray(counts)) == [3, 2, 0]
+    assert list(np.asarray(accepts)) == [3, 3, 0]   # raw accepts uncapped
+    assert list(np.asarray(new_lens)) == [7, 6, 0]
+    assert int(new_last[0]) == 7 and int(new_last[1]) == 6
+    assert int(new_last[2]) == 0                    # inactive masked
+
+
+@pytest.mark.slow   # compile-heavy composition pin; full runs cover it
+def test_hostdraft_sampled_reproducible_and_overlap_invariant(model):
+    """Sampled hostdraft streams are a pure function of the request
+    seed (the accept/residual PRNG streams are position-keyed,
+    proposals are deterministic), and invariant to the overlap flag —
+    ngram ticks never chain, but plain<->spec boundaries shift."""
+    p1, p2, _ = prompts()
+
+    def serve():
+        eng = ServingEngine(model, max_batch=2, max_context=128,
+                            block_size=16, spec_decode=True,
+                            spec_draft="ngram", spec_k=3)
+        g = eng.add_request(Request(p1, max_new_tokens=10))
+        s = eng.add_request(Request(p2, max_new_tokens=10,
+                                    do_sample=True, temperature=0.9,
+                                    top_k=40, seed=7))
+        eng.run()
+        return eng, [list(g.output_ids), list(s.output_ids)]
+
+    with flag_guard(serving_overlap=True):
+        eng, first = serve()
+        assert eng.stats()["speculative"]["ticks"] > 0
+        _, again = serve()
+    assert again == first
+    with flag_guard(serving_overlap=False):
+        _, sync = serve()
+    assert sync == first
+
+
+@pytest.mark.slow   # compiles every ladder rung; full runs cover it
+def test_adaptive_k_transitions_stay_lossless(model):
+    """Adaptive k on a repetitive workload: the controller really
+    steps k across the ladder (up on high acceptance) and the greedy
+    stream remains bit-identical to the plain engine ACROSS the
+    transitions.  On a hostile (random) workload it steps back down."""
+    rng = np.random.RandomState(3)
+    pat = list(rng.randint(1, 1000, (4,)))
+    rep = np.array(pat * 12)
+
+    def serve(**kw):
+        eng = ServingEngine(model, max_batch=2, max_context=256,
+                            block_size=16, **kw)
+        r = eng.add_request(Request(rep, max_new_tokens=40))
+        eng.run()
+        return eng, list(r.output_ids)
+
+    _, base = serve()
+    eng, out = serve(spec_decode=True, spec_draft="ngram",
+                     spec_adaptive=True, spec_k_ladder="2,4,8")
+    assert out == base
+    st = eng.stats()["speculative"]
+    assert st["adaptive"] and st["ladder"] == [2, 4, 8]
+    assert st["k_switches"] >= 1 and st["k_now"] > 2
+    assert st["accept_rate"] > 0.5
+
+
+@pytest.mark.slow   # compiles two ladder rungs of the model-draft
+                    # spec program; full runs cover it
+def test_adaptive_k_steps_for_model_draft_under_overlap(model,
+                                                        draft_same):
+    """Review regression: model-draft spec ticks CHAIN under the
+    default overlap flag and a chained dispatch reuses its
+    predecessor's k — so the overlap gate must force a boundary while
+    a k step is due, or the adaptive controller would be inert exactly
+    when the full-accept draft should ramp it up."""
+    p1, p2, _ = prompts()
+    with flag_guard(serving_overlap=True):
+        eng, out = _greedy_streams(model, (p1, p2), (20, 20),
+                                   draft_model=draft_same,
+                                   spec_decode=True, spec_adaptive=True,
+                                   spec_k_ladder="2,4")
+        _, base = _greedy_streams(model, (p1, p2), (20, 20))
+    assert out == base
+    st = eng.stats()["speculative"]
+    assert st["accept_rate"] == 1.0
+    assert st["k_switches"] >= 1 and st["k_now"] == 4
+
+
+@pytest.mark.slow   # compile-heavy composition pin; full runs cover it
+def test_hostdraft_tp2_greedy_bit_parity(model):
+    """Composition: ngram drafting x tp_degree=2 — proposals replicated
+    (rank-0 broadcast), verify sharded — greedy streams bit-identical
+    to the plain degree-1 engine."""
+    p1, p2, _ = prompts()
+    _, base = _greedy_streams(model, (p1, p2), (8, 8))
+    eng, out = _greedy_streams(model, (p1, p2), (8, 8), tp_degree=2,
+                               spec_decode=True, spec_draft="ngram",
+                               spec_k=3)
+    assert out == base
+    assert eng.stats()["speculative"]["ticks"] > 0
+    assert eng.stats()["tp_degree"] == 2
+
+
+def test_spec_draft_and_ladder_validation(model, draft_same):
+    """ngram is model-free (a draft_model is a usage error), draft
+    kinds are validated, and adaptive ladders reject rungs < 2."""
+    with pytest.raises(ValueError, match="model-free"):
+        ServingEngine(model, max_batch=2, max_context=64, block_size=16,
+                      draft_model=draft_same, spec_decode=True,
+                      spec_draft="ngram")
+    with pytest.raises(ValueError, match="spec_draft"):
+        ServingEngine(model, max_batch=2, max_context=64, block_size=16,
+                      spec_decode=True, spec_draft="suffix")
+    with pytest.raises(ValueError, match="ladder"):
+        ServingEngine(model, max_batch=2, max_context=64, block_size=16,
+                      spec_decode=True, spec_draft="ngram",
+                      spec_adaptive=True, spec_k_ladder="1,4")
 
 
 def test_spec_constructor_validation(model, draft_same):

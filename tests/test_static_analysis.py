@@ -1090,6 +1090,76 @@ class Server:
     assert run_src(tmp_path, {"mod.py": src}, rules=["R009"]) == []
 
 
+def test_r009_per_k_spec_cache_pin(tmp_path):
+    """ISSUE 13 lint satellite: the serving engine's per-k speculative
+    program caches (`_spec_fns[k]` / `_spec_hd_fns[k]`, kind chosen by
+    an init-frozen attribute, builders reading only init-frozen state
+    and their own k argument) are exactly the audited-correct shape —
+    R009 must stay quiet.  The bad twin keys the same cache on a BARE
+    spec flag while the traced body reads the controller-mutated
+    `k_now` — under-keyed (k baked at first trace, silently stale
+    after every adaptive step), and R009 must say so."""
+    good = """\
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self._spec_fns = {}
+        self._spec_hd_fns = {}
+        self.spec_kind = "ngram"        # init-frozen
+        self.spec_ladder = (2, 4, 8)    # init-frozen
+
+    def spec_program(self, k):
+        fn = self._spec_fns.get(k)
+        if fn is not None:
+            return fn
+
+        def tick(x):
+            return x * k                # keyed: k IS the cache key
+
+        fn = self._spec_fns[k] = jax.jit(tick)
+        return fn
+
+    def spec_hd_program(self, k):
+        fn = self._spec_hd_fns.get(k)
+        if fn is not None:
+            return fn
+
+        def tick(x):
+            return x + len(self.spec_ladder)   # init-frozen: covered
+
+        fn = self._spec_hd_fns[k] = jax.jit(tick)
+        return fn
+"""
+    assert run_src(tmp_path, {"mod.py": good}, rules=["R009"]) == []
+    bad = """\
+import jax
+
+
+class Engine:
+    def __init__(self):
+        self._spec_fns = {}
+        self.k_now = 2
+
+    def spec_program(self, spec_on):
+        fn = self._spec_fns.get(spec_on)
+        if fn is not None:
+            return fn
+
+        def tick(x):
+            return x * self.k_now       # mutable: baked at first trace
+
+        fn = self._spec_fns[spec_on] = jax.jit(tick)
+        return fn
+
+    def adapt(self):
+        self.k_now = 4
+"""
+    fs = run_src(tmp_path, {"mod.py": bad}, rules=["R009"])
+    assert len(fs) == 1 and "self.k_now" in fs[0].message
+
+
 R010_BAD_SUBPROCESS = """\
 import subprocess
 import sys
