@@ -86,6 +86,7 @@ _REGRESSION_KEYS = {
     "continuous_batching": ("goodput_under_slo",
                             "long_arrival_tpot_ratio"),
     "analyze": "analyze_files_per_sec",
+    "xray": "xray_overhead_pct",
 }
 
 _ENV_PROBE = {}
@@ -1805,6 +1806,110 @@ def bench_analyze(ctx):
             "findings_total": len(findings),
             "findings_new": len(new),
             "findings_per_rule": per_rule}
+
+
+@harness.register_rung("xray", est_cold_s=120, smoke=True)
+def bench_xray(ctx):
+    """ISSUE 14 rung: the engine X-ray ledger's price and its evidence.
+
+    A warmed serving engine drives the same request workload with
+    sampling OFF vs ON (FLAGS_xray_sample_interval=8 — the documented
+    sampling rate of this rung), interleaved windows so clock drift
+    hits both sides; `xray_overhead_pct` (regression key) is the
+    acceptance gate (<2 on a quiet box; like trace_overhead_pct the
+    schema pin only rejects gross regressions on noisy CI).  The
+    record also carries the ledger itself: programs tracked, sampled
+    dispatches, the top program by device time with its MFU, and the
+    kernel-coverage verdicts for the ROADMAP 5b suspect paths."""
+    import paddle_tpu as paddle
+    from paddle_tpu.flags import flag_guard
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_124m, gpt3_tiny
+    from paddle_tpu.observability import xray as obs_xray
+
+    on_tpu = ctx.on_tpu
+    paddle.seed(0)
+    cfg = gpt3_124m() if on_tpu else gpt3_tiny()
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    # the ledger is process-global and earlier rungs' engines share
+    # some configs: reset so this record's counts/coverage are THIS
+    # rung's evidence (warmup below re-registers + re-attaches cost)
+    obs_xray.reset()
+    # prefix cache ON and ngram spec ON: the grid then includes BOTH
+    # ROADMAP 5b suspects — the suffix-prefill (prefill_cont) program
+    # and the spec verify chunk — for the kernel-coverage audit
+    with flag_guard(serving_pad_buckets="64,128" if on_tpu else "16,32"):
+        eng = ServingEngine(model, max_batch=4,
+                            max_context=1024 if on_tpu else 128,
+                            block_size=64 if on_tpu else 16,
+                            steps_per_tick=4 if on_tpu else 2,
+                            prefix_cache=True, spec_decode=True,
+                            spec_draft="ngram", spec_k=4)
+        eng.warmup()           # AOT path attaches cost_analysis + HLO
+    rng = np.random.RandomState(5)
+    plen = 48 if on_tpu else 12
+    budget = 48 if on_tpu else 9
+
+    def run_batch(n=4):
+        for _ in range(n):
+            eng.add_request(Request(rng.randint(1, cfg.vocab_size,
+                                                (plen,)),
+                                    max_new_tokens=budget))
+        t0 = time.perf_counter()
+        toks0 = eng.tokens_out
+        eng.run()
+        eng.finished.clear()
+        return (eng.tokens_out - toks0) / (time.perf_counter() - t0)
+
+    with flag_guard(xray_sample_interval=0):
+        run_batch()            # settle caches outside the timed windows
+
+    def rate():
+        return max(run_batch() for _ in range(2 if ctx.smoke else 3))
+
+    # co-tenant noise on this box swings single windows +-20%, far
+    # above the overhead under test: measure adjacent on/off PAIRS and
+    # take the quietest pair's delta (noise is strictly additive — the
+    # same min-estimator marginal_step_s uses).  BOTH sides pin the
+    # flag: an ambient FLAGS_xray_sample_interval must not sample the
+    # baseline and read the gate vacuously clean.
+    interval = 8
+    pairs = []
+    for _ in range(3 if ctx.smoke else 4):
+        with flag_guard(xray_sample_interval=interval):
+            on = rate()
+        with flag_guard(xray_sample_interval=0):
+            off = rate()
+        pairs.append((max(0.0, 1 - on / off) * 100, on, off))
+    pct, on, off = min(pairs)
+
+    rep = obs_xray.report()
+    progs = rep["programs"]
+    top = progs[0] if progs else {}
+    cov = rep["kernel_coverage"]
+
+    def dense(prefix):
+        # vacuous truth is not evidence: with no audited rows (AOT
+        # warmup fell back) the verdict must be False, not "dense"
+        rows = [c for c in cov if c["program"].startswith(prefix)]
+        return bool(rows) and all(not c["pallas"] for c in rows)
+    return {"sample_interval": interval,
+            "tokens_per_sec_on": round(on, 1),
+            "tokens_per_sec_off": round(off, 1),
+            "xray_overhead_pct": round(pct, 2),
+            "overhead_pct_windows": [round(p, 2) for p, _, _ in pairs],
+            "programs_tracked": len(progs),
+            "sampled_dispatches": sum(p["samples"] for p in progs),
+            "programs_with_cost": sum(
+                1 for p in progs if p["flops_per_dispatch"]),
+            "top_program": top.get("program"),
+            "top_program_device_frac": top.get("device_time_frac"),
+            "top_program_mfu": top.get("mfu"),
+            "kernel_coverage_programs": len(cov),
+            "pallas_programs": sum(1 for c in cov if c["pallas"]),
+            "suffix_prefill_dense": bool(dense("serving.prefill_cont")),
+            "spec_verify_dense": bool(dense("serving.spec_tick"))}
 
 
 # ====================================================================== main

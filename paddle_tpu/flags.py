@@ -442,6 +442,21 @@ define_flag("metrics_host", "127.0.0.1",
             "bind address of the metrics HTTP endpoint; the loopback "
             "default keeps operational data host-local — widening it is "
             "an explicit operator decision")
+def _xray_flag_changed(value):
+    from .observability import xray as _xray
+    _xray._sync_interval(value)
+
+
+define_flag("xray_sample_interval", 0,
+            "engine X-ray device-time sampling (observability/xray.py): "
+            "every Nth dispatch of each compiled program runs a SYNCED "
+            "timing probe (block_until_ready on the outputs before the "
+            "stop clock) feeding the per-program device-seconds/MFU "
+            "ledger; a due probe forces a real serving tick-loop "
+            "boundary, so the double-buffered overlap path is never "
+            "measured through a chained dispatch.  0 (the default) "
+            "disables sampling — per-program dispatch counting stays on",
+            on_change=_xray_flag_changed)
 define_flag("serving_ttft_slo_ms", 0.0,
             "time-to-first-token SLO in milliseconds; a request whose "
             "TTFT exceeds it counts on serving.slo_violations"

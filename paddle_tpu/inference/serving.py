@@ -128,6 +128,7 @@ from ..framework.tensor import Tensor
 from ..testing import jaxsan as _jaxsan
 from ..observability import compile_tracker as _compile
 from ..observability import export as _export
+from ..observability import xray as _xray
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
 from . import quant as _squant
@@ -337,7 +338,7 @@ class _PendingTick:
     __slots__ = ("active", "k", "toks", "logits", "reqs", "t0",
                  "device_sampling", "overlapped", "step_no", "san",
                  "spec", "counts", "accepts", "new_lens", "new_last",
-                 "chunks", "kcap")
+                 "chunks", "kcap", "ph_sched", "ph_chunk", "ph_dispatch")
 
     def __init__(self, active, k, toks, logits, reqs, t0,
                  device_sampling, step_no, san=None):
@@ -358,6 +359,12 @@ class _PendingTick:
         self.new_last = None
         self.chunks = 0     # prefill chunks run at this tick's boundary
         self.kcap = None    # per-slot emit caps of a spec dispatch
+        # per-tick phase breakdown (ISSUE 14): host seconds spent in
+        # boundary scheduling / chunk-prefill dispatch / tick dispatch,
+        # stamped at dispatch time; harvest/emit measured at harvest
+        self.ph_sched = 0.0
+        self.ph_chunk = 0.0
+        self.ph_dispatch = 0.0
 
 
 def _next_tokens(logits, do_sample, temperature, top_k, top_p, seeds,
@@ -690,6 +697,13 @@ class ServingEngine:
         self.prefill_chunks_total = 0
         self.slo_sheds = 0
         self._chunks_this_boundary = 0
+        self._chunk_s_this_boundary = 0.0
+        # readiness (ISSUE 14 satellite): /healthz answers 503 warmup
+        # until run()/serve_forever() finished warmup and opened
+        # admission — the SSE frontend must not report healthy while
+        # the program grid is still compiling
+        self._ready = False
+        self._t_serve_start: Optional[float] = None
 
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
@@ -1203,17 +1217,29 @@ class ServingEngine:
         Returns (program output, used_aot)."""
         inner = getattr(fn, "__wrapped__", None)
         mark = getattr(fn, "_mark_compiled", None)
+        entry = getattr(fn, "_xray_entry", None)
         if aot and inner is not None and mark is not None \
                 and hasattr(inner, "lower"):
             try:
                 t0 = time.perf_counter()
-                compiled = inner.lower(*args).compile()
-                out = compiled(*args)
+                lowered = inner.lower(*args)
+                compiled = lowered.compile()
+                # the validation run counts as a dispatch too, so every
+                # warmed program is named in the ledger before traffic
+                out = _xray.dispatch(entry, compiled, args, {}) \
+                    if entry is not None else compiled(*args)
                 mark(time.perf_counter() - t0)
+                # static cost + kernel audit: cost_analysis() FLOPs/
+                # bytes and the custom-call scan of the lowered text
+                # (best-effort; never raises)
+                _xray.attach_lowered(entry, lowered)
 
-                def shim(*a, _c=compiled):
+                def shim(*a, _c=compiled, _e=entry):
+                    if _e is not None:
+                        return _xray.dispatch(_e, _c, a, {})
                     return _c(*a)
                 shim.__wrapped__ = inner
+                shim._xray_entry = entry
                 install(shim)
                 return out, True
             except Exception:  # noqa: BLE001 - AOT is an optimization;
@@ -2037,6 +2063,7 @@ class ServingEngine:
         L_pad = self._pad_bucket(n)
         suffix = np.zeros((1, L_pad), np.int32)
         suffix[0, :n] = req.prompt_ids[off:off + n]
+        t_c0 = time.perf_counter() if _metrics.enabled() else None
         try:
             with self._params_for_call() as param_vals:
                 dpref = ((self._draft_vals(), self.pools, self.dpools)
@@ -2055,6 +2082,12 @@ class ServingEngine:
             self._abort_prefill(req)
             _M_REJECTIONS.inc(reason="error")
             raise
+        if t_c0 is not None:
+            # host-side chunk dispatch time (async enqueue; a sampled
+            # chunk program blocks inside the call) — the boundary's
+            # chunk-prefill phase in the tick record
+            # graft-lint: disable=R006
+            self._chunk_s_this_boundary += time.perf_counter() - t_c0
         req._chunk_off = off + n
         req._prefill_chunks += 1
         self.prefill_chunks_total += 1
@@ -2149,8 +2182,19 @@ class ServingEngine:
         dispatch means the returned `_PendingTick.toks` is a device
         handle nothing has blocked on; host seq_lens/tok_pos advance
         NOW so a second dispatch sees the in-flight state."""
+        timed = _metrics.enabled()
+        ph_sched = ph_chunk = 0.0
         if boundary:
+            t_b0 = time.perf_counter() if timed else 0.0
+            self._chunk_s_this_boundary = 0.0
             self._boundary_schedule()
+            if timed:
+                # the boundary's host phases (ISSUE 14): chunk-prefill
+                # dispatch time accumulated by _prefill_chunk_step,
+                # everything else (cancel/shed/admit/evict) = schedule
+                ph_chunk = self._chunk_s_this_boundary
+                ph_sched = max(
+                    0.0, time.perf_counter() - t_b0 - ph_chunk)
         active = self._active_slots()
         if not active:
             return None
@@ -2165,6 +2209,12 @@ class ServingEngine:
             pend = self._dispatch_spec(active, t0, chain)
             pend.chunks = self._chunks_this_boundary
             self._chunks_this_boundary = 0
+            pend.ph_sched, pend.ph_chunk = ph_sched, ph_chunk
+            if timed:
+                # host dispatch phase: enqueue cost by design (the
+                # compute lands in the harvest wait; a sampled program
+                # blocks inside the call) — graft-lint: disable=R006
+                pend.ph_dispatch = time.perf_counter() - t0
             return pend
         k = self._tick_size(active)
         # ensure a physical block exists for every position this tick
@@ -2220,6 +2270,12 @@ class ServingEngine:
                             step_no=self.steps, san=san)
         pend.chunks = self._chunks_this_boundary
         self._chunks_this_boundary = 0
+        pend.ph_sched, pend.ph_chunk = ph_sched, ph_chunk
+        if timed:
+            # host dispatch phase: enqueue cost by design (the compute
+            # lands in the harvest wait; a sampled program blocks
+            # inside the call) — graft-lint: disable=R006
+            pend.ph_dispatch = time.perf_counter() - t0
         return pend
 
     def _spec_eligible(self, active, device_sampling) -> bool:
@@ -2378,11 +2434,16 @@ class ServingEngine:
         under overlap a request may have finished (EOS) while its next
         tick was already in flight; its overrun rows are discarded."""
         k = pend.k
+        timed = _metrics.enabled()
+        t_h0 = time.perf_counter() if timed else 0.0
         with _flight.guard("serving.tick"):
             # first host block on the async result: a decode-execution
             # error (OOM, XlaRuntimeError) surfaces HERE, not at the
             # guarded dispatch — keep the post-mortem dump coverage
             toks = np.asarray(pend.toks)
+        # harvest-wait phase: the block above is where device compute
+        # not yet finished is actually waited for
+        t_wait_end = time.perf_counter() if timed else 0.0
         # the program has materialized: every host buffer fed at dispatch
         # must still hash to its dispatch-time checksum (jaxsan; no-op
         # unless FLAGS_enable_jaxsan)
@@ -2515,13 +2576,34 @@ class ServingEngine:
         if _metrics.enabled():
             # the flight ring keeps the last-K ticks, so a post-mortem
             # dump of a wedged/crashed engine shows what was in flight
+            # per-tick phase breakdown (ISSUE 14): dispatch-time host
+            # phases stamped on the pend + the harvest wait (device) /
+            # emit (host detokenize+stream) split measured here.  The
+            # phases need not sum to wall_s: an overlapped tick's wall
+            # clock starts at the previous harvest, and device compute
+            # overlaps the host phases by design.
+            # `timed` is the gate state at HARVEST ENTRY: a mid-tick
+            # flag flip must not difference against zero stamps
+            ph_wait = (t_wait_end - t_h0) if timed else 0.0
+            ph_emit = (t_done - t_wait_end) if timed else 0.0
             rec = {
                 "timeline": "serving", "step": pend.step_no,
+                "t_unix": round(time.time(), 6),
                 "wall_s": round(dt, 6), "decode_steps": k,
                 "tokens": harvested, "overlap": pend.overlapped,
                 "tokens_per_sec": round(harvested / dt, 1) if dt else 0.0,
                 "active": len(pend.active), "waiting": len(self.waiting),
-                "free_blocks": self._free_capacity()}
+                "free_blocks": self._free_capacity(),
+                "phases": {
+                    "schedule_ms": round(pend.ph_sched * 1e3, 4),
+                    "chunk_prefill_ms": round(pend.ph_chunk * 1e3, 4),
+                    "dispatch_ms": round(pend.ph_dispatch * 1e3, 4),
+                    "harvest_wait_ms": round(ph_wait * 1e3, 4),
+                    "emit_ms": round(ph_emit * 1e3, 4),
+                    "host_ms": round((pend.ph_sched + pend.ph_chunk
+                                      + pend.ph_dispatch + ph_emit)
+                                     * 1e3, 4),
+                    "device_wait_ms": round(ph_wait * 1e3, 4)}}
             if pend.spec:
                 rec["spec"] = True
                 rec["spec_kind"] = self.spec_kind
@@ -2589,6 +2671,13 @@ class ServingEngine:
                     return False
                 if req.max_new_tokens - int(self.tok_pos[slot]) < 1:
                     return False     # per-slot caps need >= 1 headroom
+            # X-ray sampling contract (ISSUE 14): a due synced probe
+            # must land on a REAL boundary — a chained dispatch feeds
+            # the predecessor's device handles, so a probe around it
+            # would time both ticks
+            if _xray.sampling_on() \
+                    and _xray.sample_due(self._spec_fns.get(pend.k)):
+                return False
             return True
         if not pend.device_sampling and any(
                 pend.reqs[s].do_sample for s in pend.active):
@@ -2603,6 +2692,14 @@ class ServingEngine:
                 return False     # eviction boundary needed first
             if req.max_new_tokens - int(self.tok_pos[slot]) < 1:
                 return False     # in-flight tick exhausts the budget
+        if _xray.sampling_on():
+            # same sampling contract as the spec branch: the program a
+            # chained dispatch would run must not be due a synced probe
+            k = self._tick_size(pend.active)
+            nxt = self._decode_fn if (k == 1 and not _flags.get_flag(
+                "serving_device_sampling")) else self._tick_fns.get(k)
+            if _xray.sample_due(nxt):
+                return False
         return True
 
     def run(self) -> List[Request]:
@@ -2618,7 +2715,8 @@ class ServingEngine:
         if self._warmup_info is None \
                 and _flags.get_flag("serving_warmup"):
             self.warmup()          # compile the whole grid BEFORE
-        pend = None                # traffic waits on a program build
+        self._mark_ready()         # traffic waits on a program build
+        pend = None
         while True:
             if pend is None:
                 if not (self.waiting or self.prefilling
@@ -2660,11 +2758,42 @@ class ServingEngine:
         if self._warmup_info is None \
                 and _flags.get_flag("serving_warmup"):
             self.warmup()
+        self._mark_ready()
         while not stop_event.is_set():
             if self.waiting or self.prefilling or self._active_slots():
                 self.step()
             else:
                 time.sleep(idle_s)
+
+    def _mark_ready(self) -> None:
+        """Admission is open and (when configured) warmup has run: the
+        /healthz readiness probe flips from 503 warmup to 200."""
+        if not self._ready:
+            self._ready = True
+            self._t_serve_start = time.monotonic()
+
+    @property
+    def ready(self) -> bool:
+        return self._ready
+
+    def health(self) -> dict:
+        """The /healthz readiness document (observability/http.py): 503
+        `{"ready": false, "reason": "warmup"}` until run()/
+        serve_forever() completed warmup and opened admission, then the
+        warmup / queue-depth / uptime evidence.  Reads only host-side
+        scheduler ints — safe from the endpoint's handler threads."""
+        if not self._ready:
+            return {"ready": False, "reason": "warmup"}
+        running = self.B - len(self.free_slots)
+        doc = {"ready": True, "running": running,
+               "waiting": len(self.waiting),
+               "queue_depth": running + len(self.waiting),
+               "uptime_s": round(
+                   time.monotonic() - self._t_serve_start, 3)}
+        if self._warmup_info is not None:
+            doc["warmup"] = {k: self._warmup_info[k] for k in
+                             ("warmup_s", "programs", "aot_programs")}
+        return doc
 
     def stats(self) -> dict:
         running = self.B - len(self.free_slots)
@@ -2722,6 +2851,13 @@ class ServingEngine:
         if self._warmup_info is not None:
             out["warmup"] = {k: self._warmup_info[k] for k in
                              ("warmup_s", "programs", "aot_programs")}
+        # the engine X-ray ledger (ISSUE 14) — process-wide like the
+        # compile tracker and the latency sketches below
+        xr = _xray.report(top=16)
+        out["xray"] = {"sample_interval": xr["sample_interval"],
+                       "programs_tracked": xr["programs_tracked"],
+                       "total_est_device_s": xr["total_est_device_s"],
+                       "programs": xr["programs"]}
         # p50/p90/p99 straight off the streaming sketches — process-wide
         # (the sketches aggregate every engine in the process, like the
         # /metrics scrape they feed)

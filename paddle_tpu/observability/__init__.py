@@ -48,17 +48,20 @@ import time
 from typing import Optional
 
 from . import metrics  # noqa: F401
+from . import descriptions  # noqa: F401
 from . import flops  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import telemetry  # noqa: F401
 from . import quantiles  # noqa: F401
 from . import compile_tracker  # noqa: F401
+from . import xray  # noqa: F401
 from .metrics import (  # noqa: F401
     counter, gauge, histogram, quantile, snapshot, reset, export_json,
 )
 
 __all__ = ["metrics", "harness", "span", "telemetry", "flight_recorder",
-           "flops", "quantiles", "compile_tracker", "export", "http",
+           "flops", "quantiles", "compile_tracker", "xray", "chrome",
+           "descriptions", "export", "http",
            "counter", "gauge", "histogram", "quantile", "snapshot",
            "reset", "export_json"]
 
@@ -104,7 +107,7 @@ class span:
 def __getattr__(name):
     # leaf modules only bench/test/scrape flows need; kept lazy so
     # `import paddle_tpu` never pays for them
-    if name in ("harness", "export", "http"):
+    if name in ("harness", "export", "http", "chrome"):
         import importlib
         return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

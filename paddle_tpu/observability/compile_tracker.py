@@ -134,16 +134,25 @@ def wrap_first_call(fn: Callable, name: str, signature: Any) -> Callable:
     """Wrap a freshly-jitted program so its FIRST call — where jax pays
     trace + XLA compile — is timed and recorded as a compilation event.
     After that the wrapper is one boolean check per call (against a
-    multi-millisecond compiled step)."""
+    multi-millisecond compiled step) plus the X-ray ledger's dispatch
+    accounting (ISSUE 14): every wrapped program gets a per-program
+    entry the execution ledger counts — and, under
+    ``FLAGS_xray_sample_interval``, sync-samples — against."""
+    from . import xray as _xray
+    entry = _xray.register(name, signature)
     compiled = [False]
 
     def wrapper(*args, **kwargs):
         if compiled[0]:
-            return fn(*args, **kwargs)
+            return _xray.dispatch(entry, fn, args, kwargs)
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         compiled[0] = True
         record_compile(name, signature, time.perf_counter() - t0)
+        # the compile call is a dispatch too (counter AND ledger, so
+        # /metrics always equals the ledger row), but never a timing
+        # sample: trace + XLA compile seconds are not execution time
+        _xray.count(entry)
         return out
 
     def mark_compiled(seconds: float) -> None:
@@ -157,6 +166,7 @@ def wrap_first_call(fn: Callable, name: str, signature: Any) -> Callable:
     wrapper._compile_name = name
     wrapper._compile_signature = signature
     wrapper._mark_compiled = mark_compiled
+    wrapper._xray_entry = entry
     return wrapper
 
 

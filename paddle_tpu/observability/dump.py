@@ -5,6 +5,8 @@
     python -m paddle_tpu.observability.dump --registry  # live registry
     python -m paddle_tpu.observability.dump --prom      # Prometheus text
     python -m paddle_tpu.observability.dump --compile-report
+    python -m paddle_tpu.observability.dump --xray      # X-ray ledger
+    python -m paddle_tpu.observability.dump --chrome    # chrome trace
 
 Prints ONE JSON document on stdout (``--prom`` prints Prometheus text
 exposition instead — the same bytes the /metrics endpoint serves).  Default mode locates the newest
@@ -53,6 +55,17 @@ def main(argv=None) -> int:
     p.add_argument("--compile-report", action="store_true",
                    help="print this process's compile tracker report "
                         "(top compilers, recompile blame) as JSON")
+    p.add_argument("--xray", action="store_true",
+                   help="print this process's engine X-ray report as "
+                        "JSON: per-program dispatches / sampled device "
+                        "seconds / cost-analysis FLOPs / MFU, top "
+                        "programs by cumulative device time, and the "
+                        "HLO kernel-coverage table")
+    p.add_argument("--chrome", action="store_true",
+                   help="convert the located flight dump (newest in "
+                        "--dir, or --path) to chrome://tracing JSON on "
+                        "stdout: the tick timeline with its phase "
+                        "breakdown + one row per request lifecycle")
     p.add_argument("--path", default=None,
                    help="print this exact dump file (skips the search)")
     args = p.parse_args(argv)
@@ -71,6 +84,14 @@ def main(argv=None) -> int:
         from . import compile_tracker
         print(json.dumps(compile_tracker.compile_report(), indent=1))
         return 0
+    if args.xray:
+        from . import xray
+        # like --registry/--compile-report this reads THIS process's
+        # state: drive a serving run first (or read a flight dump's
+        # embedded "xray" section) — a fresh CLI process shows an
+        # empty ledger, which doubles as an import smoke check
+        print(json.dumps(xray.report(), indent=1))
+        return 0
 
     path = args.path
     if path is None:
@@ -85,7 +106,11 @@ def main(argv=None) -> int:
             return 1
     with open(path) as f:
         doc = json.load(f)
-    print(json.dumps(doc, indent=1))
+    if args.chrome:
+        from . import chrome
+        print(json.dumps(chrome.trace_from_flight(doc), indent=1))
+    else:
+        print(json.dumps(doc, indent=1))
     print(f"(from {path})", file=sys.stderr)
     return 0
 

@@ -28,10 +28,16 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import descriptions as _descriptions
 from . import metrics as _metrics
 
 __all__ = ["render_prometheus", "record_request", "recent_requests",
            "clear_requests"]
+
+# the exposition TYPE keyword per registry kind (quantile sketches
+# render as Prometheus summaries); unknown kinds are skipped entirely
+_TYPE_OF = {"counter": "counter", "gauge": "gauge",
+            "histogram": "histogram", "quantile": "summary"}
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -96,24 +102,30 @@ def render_prometheus(registry: Optional[_metrics.Registry] = None) -> str:
         metrics = [registry._metrics[n] for n in sorted(registry._metrics)]
     lines: List[str] = []
     for m in metrics:
+        kind = _TYPE_OF.get(m.kind)
+        if kind is None:
+            continue    # unknown kinds must not emit invalid lines
         series = _series_of(m)
         if not series:
             continue
         name = sanitize_name(m.name)
-        help_line = m.help.replace("\\", "\\\\").replace("\n", "\\n")
-        if m.kind == "counter":
+        # `# HELP` comes from the metric-description registry (explicit
+        # describe() wins, instrument help is the auto-registered
+        # default); a metric with NO description gets a bare `# TYPE`,
+        # never a malformed trailing-space HELP line
+        help_text = _descriptions.lookup(m.name) or m.help
+        if help_text:
+            help_line = help_text.replace("\\", "\\\\") \
+                .replace("\n", "\\n")
             lines.append(f"# HELP {name} {help_line}")
-            lines.append(f"# TYPE {name} counter")
+        lines.append(f"# TYPE {name} {kind}")
+        if m.kind == "counter":
             for labels, v in series:
                 lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(v)}")
         elif m.kind == "gauge":
-            lines.append(f"# HELP {name} {help_line}")
-            lines.append(f"# TYPE {name} gauge")
             for labels, v in series:
                 lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(v)}")
         elif m.kind == "histogram":
-            lines.append(f"# HELP {name} {help_line}")
-            lines.append(f"# TYPE {name} histogram")
             for labels, raw in series:
                 count, total, _mn, _mx, bucket_counts = raw
                 cum = 0
@@ -134,8 +146,6 @@ def render_prometheus(registry: Optional[_metrics.Registry] = None) -> str:
                     f"{name}_count{_fmt_labels(labels)} "
                     f"{_fmt_value(count)}")
         elif m.kind == "quantile":
-            lines.append(f"# HELP {name} {help_line}")
-            lines.append(f"# TYPE {name} summary")
             for labels, snap in series:
                 for q, val in snap["quantiles"]:
                     if val is None:
@@ -148,7 +158,6 @@ def render_prometheus(registry: Optional[_metrics.Registry] = None) -> str:
                              f"{_fmt_value(snap['sum'])}")
                 lines.append(f"{name}_count{_fmt_labels(labels)} "
                              f"{_fmt_value(snap['count'])}")
-        # unknown kinds are skipped rather than emitting invalid lines
     return "\n".join(lines) + ("\n" if lines else "")
 
 

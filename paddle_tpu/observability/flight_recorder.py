@@ -143,15 +143,26 @@ class FlightRecorder:
     def snapshot(self, reason: str = "manual") -> Dict[str, Any]:
         """The full post-mortem document: last-K step records, recent
         events, the first non-finite site, and the metrics registry."""
-        return {"schema": FLIGHT_SCHEMA,
-                "unix_time": round(time.time(), 3),
-                "pid": os.getpid(),
-                "reason": reason,
-                "capacity": self.capacity,
-                "first_nonfinite": self.first_nonfinite,
-                "steps": self.steps(),
-                "events": self.events(),
-                "metrics": _metrics.snapshot()}
+        doc = {"schema": FLIGHT_SCHEMA,
+               "unix_time": round(time.time(), 3),
+               "pid": os.getpid(),
+               "reason": reason,
+               "capacity": self.capacity,
+               "first_nonfinite": self.first_nonfinite,
+               "steps": self.steps(),
+               "events": self.events(),
+               "metrics": _metrics.snapshot()}
+        try:
+            # the engine X-ray ledger (ISSUE 14): a post-mortem of a
+            # wedged/crashed engine should name which programs were
+            # eating the device, not just the last-K ticks
+            from . import xray as _xray
+            rep = _xray.report(top=16)
+            if rep["programs"]:
+                doc["xray"] = rep
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            pass
+        return doc
 
     def dump(self, path: Optional[str] = None,
              reason: str = "manual") -> Dict[str, Any]:

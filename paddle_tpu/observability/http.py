@@ -80,7 +80,20 @@ class _Handler(BaseHTTPRequestHandler):
                 doc = {"ok": True, "pid": os.getpid(),
                        "unix_time": round(time.time(), 3),
                        "metrics_enabled": _metrics.enabled()}
-                self._send(200, "application/json",
+                # readiness (ISSUE 14 satellite): with a serving engine
+                # attached this is a real readiness probe — 503 with
+                # {"ready": false, "reason": "warmup"} until warmup
+                # completed and admission opened, then the engine's
+                # warmup/queue-depth/uptime evidence.  With no engine
+                # (training, metrics-only) it stays the liveness check.
+                eng = current_engine()
+                if eng is not None:
+                    try:
+                        doc.update(eng.health())
+                    except Exception:  # noqa: BLE001 - probe must answer
+                        pass
+                code = 503 if doc.get("ready") is False else 200
+                self._send(code, "application/json",
                            json.dumps(doc).encode())
             elif url.path == "/requests":
                 try:
@@ -260,11 +273,12 @@ _serving_server: Optional[MetricsServer] = None
 
 
 def attach_engine(engine) -> None:
-    """Register the serving engine POST /generate enqueues into.
-    Called by ``ServingEngine.run()``/``serve_forever()``; the LAST
-    attached engine wins (one process, one front door)."""
+    """Register the serving engine POST /generate enqueues into (and
+    /healthz reads readiness from).  Called by ``ServingEngine.run()``/
+    ``serve_forever()``; the LAST attached engine wins (one process,
+    one front door).  ``attach_engine(None)`` detaches (tests)."""
     global _engine_ref
-    _engine_ref = weakref.ref(engine)
+    _engine_ref = weakref.ref(engine) if engine is not None else None
 
 
 def current_engine():

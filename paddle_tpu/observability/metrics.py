@@ -283,7 +283,13 @@ class Registry:
                 return m
             m = cls(name, help, self._lock, **kw)
             self._metrics[name] = m
-            return m
+        # the metric-description registry (ISSUE 14): the exporter's
+        # `# HELP` lines read from one process-wide map, not each
+        # instrument — registered outside the registry lock
+        if help:
+            from . import descriptions as _descriptions
+            _descriptions.default(name, help)
+        return m
 
     def counter(self, name: str, help: str = "") -> Counter:  # noqa: A002
         return self._get_or_create(Counter, name, help)

@@ -443,6 +443,53 @@ def test_analyze_rung_schema():
     assert val["analyze_files"] > 280
 
 
+@pytest.mark.slow   # warms a spec+prefix serving grid and drives ~14
+                    # measurement windows — too heavy for the tier-1
+                    # budget; full runs cover it
+def test_xray_rung_schema():
+    """Pin the ISSUE 14 `xray` rung's record schema: sampling overhead
+    (regression key `xray_overhead_pct`, quietest-pair estimator —
+    acceptance <2 on a quiet box, the pin only rejects gross
+    regressions on noisy CI) plus the ledger evidence — programs
+    tracked with cost, sampled dispatches, the top program by device
+    time, and the kernel-coverage verdicts for the ROADMAP 5b suspect
+    paths (dense on this CPU build)."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_xr", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_xray(ctx)
+    rec = {"rung": "xray", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("xray").smoke
+    assert bench._REGRESSION_KEYS["xray"] == "xray_overhead_pct"
+    assert 0.0 <= val["xray_overhead_pct"] < 25.0
+    assert len(val["overhead_pct_windows"]) >= 3
+    assert val["tokens_per_sec_on"] > 0 and val["tokens_per_sec_off"] > 0
+    # the ledger evidence: the spec+prefix grid (2 ticks + decode + 1
+    # spec rung + 2 prefill + 2 prefill_cont + cow) all tracked, all
+    # with cost_analysis, and real samples taken
+    assert val["programs_tracked"] >= 9
+    assert val["programs_with_cost"] >= 9
+    assert val["sampled_dispatches"] > 0
+    assert val["top_program"]
+    assert val["kernel_coverage_programs"] >= 9
+    # the CPU build lowers nothing to Pallas: both ROADMAP 5b suspects
+    # must be reported dense — evidence, not assumption
+    assert val["pallas_programs"] == 0
+    assert val["suffix_prefill_dense"] is True
+    assert val["spec_verify_dense"] is True
+
+
 def test_fused_optimizer_rung_schema():
     """Pin the round-7 `fused_optimizer` rung's record schema: the
     regression key (`speedup`) and the per-cell dispatch/wall fields the

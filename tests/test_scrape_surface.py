@@ -15,8 +15,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu.observability import (compile_tracker, export, metrics,
-                                      quantiles)
+from paddle_tpu.observability import (compile_tracker, descriptions,
+                                      export, metrics, quantiles)
 from paddle_tpu.observability import http as obs_http
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -138,6 +138,8 @@ lat_hist_bucket{le="1"} 3
 lat_hist_bucket{le="+Inf"} 4
 lat_hist_sum 5.85
 lat_hist_count 4
+# TYPE nohelp_total counter
+nohelp_total 4
 # HELP req_total reqs with "quotes" and \\n
 # TYPE req_total counter
 req_total{path="a\\"b\\\\c\\nd"} 2
@@ -149,13 +151,21 @@ ttft_q{engine="e1",quantile="0.9"} 0.25
 ttft_q{engine="e1",quantile="0.99"} 0.25
 ttft_q_sum{engine="e1"} 0.25
 ttft_q_count{engine="e1"} 1
+# HELP zz_described described via the metric-description registry
+# TYPE zz_described gauge
+zz_described 1
 """
 
 
 def test_prometheus_golden_rendering():
     """Byte-exact exposition: name sanitization (dots -> underscores),
     label escaping, cumulative buckets closed by +Inf, summary quantile
-    lines.  A single sketch observation makes its quantiles exact."""
+    lines, and the ISSUE 14 `# HELP` contract — help comes from the
+    metric-description registry (instrument help auto-registers; an
+    explicit describe() covers help-less instruments), and a metric
+    with NO description gets a bare `# TYPE`, never a trailing-space
+    HELP line.  A single sketch observation makes its quantiles
+    exact."""
     reg = metrics.Registry()
     c = reg.counter("req.total", 'reqs with "quotes" and \n')
     c.inc(2, path='a"b\\c\nd')
@@ -167,7 +177,16 @@ def test_prometheus_golden_rendering():
         h.observe(v)
     q = reg.quantile("ttft.q", "ttft sketch")
     q.observe(0.25, engine="e1")
+    # no help anywhere -> TYPE only; described-not-helped -> HELP from
+    # the registry
+    reg.counter("nohelp.total").inc(4)
+    descriptions.describe("zz.described",
+                          "described via the metric-description registry")
+    reg.gauge("zz.described").set(1)
     assert export.render_prometheus(reg) == GOLDEN
+    # the registry knows every instrument-registered description too
+    assert descriptions.lookup("g.jobs") == "test gauge"
+    assert descriptions.lookup("nohelp.total") is None
 
 
 def test_prometheus_skips_empty_instruments():
@@ -216,6 +235,49 @@ def test_http_endpoint_smoke():
     finally:
         obs_http.stop()
     assert obs_http.current() is None
+
+
+def test_healthz_is_a_readiness_probe():
+    """ISSUE 14 satellite: with a serving engine attached, /healthz is
+    a real readiness probe — 503 `{"ready": false, "reason": "warmup"}`
+    until warmup completes and admission opens, then 200 with the
+    warmup/queue-depth/uptime evidence.  (The SSE frontend previously
+    reported healthy while the program grid was still compiling.)"""
+    class _Stub:
+        def __init__(self):
+            self.doc = {"ready": False, "reason": "warmup"}
+
+        def health(self):
+            return self.doc
+
+    stub = _Stub()
+    srv = obs_http.serve(0)
+    try:
+        obs_http.attach_engine(stub)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read())
+        assert doc["ready"] is False and doc["reason"] == "warmup"
+        assert doc["ok"] is True        # the process itself is alive
+        stub.doc = {"ready": True, "queue_depth": 3, "running": 1,
+                    "waiting": 2, "uptime_s": 1.5,
+                    "warmup": {"warmup_s": 0.2, "programs": 7,
+                               "aot_programs": 7}}
+        r = urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert r.status == 200
+        doc = json.loads(r.read())
+        assert doc["ready"] is True and doc["queue_depth"] == 3
+        assert doc["warmup"]["programs"] == 7
+        assert doc["uptime_s"] == 1.5
+        # detached again: plain liveness answers 200 with no ready key
+        obs_http.attach_engine(None)
+        doc = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=10).read())
+        assert doc["ok"] is True and "ready" not in doc
+    finally:
+        obs_http.attach_engine(None)
+        obs_http.stop()
 
 
 def test_start_from_flags_is_gated():

@@ -45,6 +45,10 @@ def _serve(model, tp, prefix=False):
     return eng, [list(r.output_ids) for r in reqs]
 
 
+@pytest.mark.slow   # 20.8s measured (PR 14 re-budget): compiles three
+                    # TP program sets; bit-parity stays HARD-gated in
+                    # the serving_tp bench rung and the @slow TP2
+                    # composition pins
 def test_tp_degree_2_and_4_bit_identical_to_degree_1(model):
     """THE acceptance test: the same mixed greedy+sampled workload at
     simulated TP degree 2 and 4 reproduces degree 1's streams token for
@@ -83,6 +87,8 @@ def test_tp_weights_and_pools_are_sharded(model):
     assert ln.addressable_shards[0].data.shape == ln.shape
 
 
+@pytest.mark.slow   # 8.8s measured (PR 14 re-budget): TP warmup grid;
+                    # the degree-1 zero-compile pins stay fast
 def test_tp_warmup_grid_zero_postwarmup_compiles(model):
     """TP programs enumerate into the PR 7 warmup grid: after warmup()
     a TP engine serves traffic — including a prefix-cache hit and the
@@ -110,6 +116,9 @@ def test_tp_warmup_grid_zero_postwarmup_compiles(model):
         assert all(len(r.output_ids) == 4 for r in (a, b, c))
 
 
+@pytest.mark.slow   # 7.0s measured (PR 14 re-budget): TP x prefix
+                    # composition; covered by the @slow serving_tp
+                    # schema gate (prefix_hit_speedup + parity)
 def test_tp_prefix_hit_stream_matches_degree_1_miss(model):
     """Compose: a TP-degree-2 engine WITH prefix reuse serves the same
     tokens as a degree-1 engine WITHOUT it."""

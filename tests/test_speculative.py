@@ -63,6 +63,9 @@ def _greedy_streams(model, specs, budgets, **engine_kw):
     return eng, [list(r.output_ids) for r in reqs]
 
 
+@pytest.mark.slow   # 11.3s measured (PR 14 re-budget): the EASY case —
+                    # the rejecting-draft bit-parity pin (the hard
+                    # case) stays in tier-1
 def test_greedy_bit_identical_full_acceptance(model, draft_same):
     """THE losslessness headline: with an (ideal) always-agreeing
     draft, greedy streams match the plain engine token for token, the
