@@ -81,6 +81,7 @@ _REGRESSION_KEYS = {
     "request_trace": "trace_overhead_pct",
     "cold_start": "cold_start_warm_speedup",
     "serving_tp": "prefix_hit_speedup",
+    "serving_restart": "restart_ttft_speedup",
     "spec_decode": ("spec_decode_speedup", "spec_accept_rate",
                     "quant_weight_ratio"),
     "continuous_batching": ("goodput_under_slo",
@@ -1431,6 +1432,94 @@ print("RESULT " + json.dumps(out))
             "prefix_hit_speedup": res["prefix_hit_speedup"],
             "prefix_hits": res["prefix_stats"]["hits"],
             "prefix_blocks_shared": res["prefix_stats"]["blocks_shared"]}
+
+
+@harness.register_rung("serving_restart", est_cold_s=90, smoke=True)
+def bench_serving_restart(ctx):
+    """Crash-only serving rung (ISSUE 15): restart-to-first-token.
+
+    One warm engine serves a shared system prompt, drains and exports
+    its prefix cache (atomic manifest version under a temp root).  Then
+    two fresh engines answer the SAME prompt, both AOT-warmed first so
+    TTFT compares prefill COMPUTE, not compilation (the compile half of
+    restart is the PR 7 persistent-cache story): a COLD engine (no
+    import — full prefill) vs an IMPORT-RESTORED engine (suffix-only
+    prefill over the imported KV blocks).  `restart_ttft_speedup` =
+    median cold TTFT / median restored TTFT; it collapsing toward 1.0
+    means warm restart stopped skipping prefill work.  The rung also
+    asserts the restored stream bit-matches the donor's prefix-hit
+    stream — a restart that changes tokens is a regression no speedup
+    excuses."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import flags as _pflags
+    from paddle_tpu.inference.serving import Request, ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt3_tiny())
+    model.eval()
+    rng = np.random.RandomState(0)
+    # a LONG shared system prompt (the restart-to-first-token
+    # scenario): cold prefill pads to the 256 bucket while the
+    # restored engine prefills only the one-token suffix — on this
+    # tiny CPU model a short prompt would be dispatch-bound and hide
+    # the skipped work
+    sysp = [int(t) for t in rng.randint(1, 1000, (224,))]
+    reps = 5 if ctx.smoke else 9
+    root = tempfile.mkdtemp(prefix="bench_restart_")
+
+    def build(import_dir):
+        with _pflags.flag_guard(serving_prefix_export_dir=import_dir):
+            eng = ServingEngine(model, max_batch=2, max_context=256,
+                                block_size=16, prefix_cache=True)
+        eng.warmup()
+        return eng
+
+    def ttft(eng, suffix, budget=4):
+        req = eng.add_request(Request(sysp + suffix,
+                                      max_new_tokens=budget))
+        eng.run()
+        return req, req.trace["ttft_s"]
+
+    try:
+        donor = build("")
+        ttft(donor, [7])                       # registers the prefix
+        hit_req, _ = ttft(donor, [8])          # the warm prefix-hit path
+        with _pflags.flag_guard(serving_prefix_export_dir=root):
+            drain = donor.drain()
+        export = drain["export"]
+
+        cold_ttfts, restored_ttfts = [], []
+        streams_match = True
+        for i in range(reps):
+            cold = build("")
+            _, t_cold = ttft(cold, [8])
+            restored = build(root)
+            req, t_rest = ttft(restored, [8])
+            cold_ttfts.append(t_cold)
+            restored_ttfts.append(t_rest)
+            streams_match &= req.output_ids == hit_req.output_ids
+        imported = restored.stats()["prefix_cache"]["import"]
+        speedup = float(np.median(cold_ttfts)) \
+            / max(float(np.median(restored_ttfts)), 1e-9)
+        return {
+            "restart_ttft_speedup": round(speedup, 2),
+            "cold_ttft_ms_p50": round(
+                float(np.median(cold_ttfts)) * 1e3, 3),
+            "restored_ttft_ms_p50": round(
+                float(np.median(restored_ttfts)) * 1e3, 3),
+            "restored_stream_bitmatch": bool(streams_match),
+            "export_blocks": export["blocks"],
+            "export_bytes": export["bytes"],
+            "export_s": export["export_s"],
+            "imported_blocks": imported["blocks"],
+            "import_skipped_corrupt": imported["skipped_corrupt"],
+            "reps": reps}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
 
 
 @harness.register_rung("spec_decode", est_cold_s=240, smoke=True)

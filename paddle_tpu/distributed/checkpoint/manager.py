@@ -57,6 +57,7 @@ from .load_state_dict import load_state_dict, read_state_dict
 __all__ = [
     "CheckpointManager", "latest_complete", "all_steps", "verify_version",
     "step_dir", "COMPLETE_SENTINEL", "MANIFEST_SCHEMA",
+    "commit_single_rank",
     "preemption_requested", "request_preemption", "clear_preemption",
 ]
 
@@ -218,6 +219,60 @@ def latest_complete(root: str,
             "ckpt_skip_corrupt", step=step, reason=reason)
         logger.warning("skipping checkpoint %s: %s", path, reason)
     return None
+
+
+def commit_single_rank(root: str, step: int,
+                       write_files: Callable[[str], List[str]],
+                       retries: Optional[int] = None,
+                       backoff: Optional[float] = None) -> str:
+    """The save/commit protocol for a SINGLE-process auxiliary export
+    (the serving prefix-cache persistence — ISSUE 15): ``write_files``
+    populates ``step_<N>.tmp`` (routing opens through the
+    chaos-injectable ``checked_open``) and returns the file names; this
+    helper writes the sha256 manifest, RE-HASHES every file, atomically
+    renames the directory and drops the ``COMPLETE`` sentinel — the
+    exact commit order the multi-rank checkpoint path uses, so
+    :func:`verify_version` / :func:`latest_complete` work unchanged on
+    the read side.  Transient OSErrors retry under the checkpoint
+    backoff flags.  Returns the committed directory path."""
+    from .io_retry import call_with_retries
+    if retries is None:
+        retries = int(_flags.get_flag("ckpt_io_retries"))
+    if backoff is None:
+        backoff = float(_flags.get_flag("ckpt_io_backoff_s"))
+    os.makedirs(root, exist_ok=True)
+    tmp = os.path.join(root, step_dir(step) + ".tmp")
+    final = os.path.join(root, step_dir(step))
+
+    def attempt():
+        # a retry restarts the version from scratch: partial output
+        # from the failed attempt must not survive into the manifest
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        files = list(write_files(tmp))
+        _write_manifest(tmp, 0, step, files)
+
+    call_with_retries(attempt, retries=retries, backoff_s=backoff,
+                      site=f"export.step_{step}", counter=_M_RETRIES)
+    reason = verify_version(tmp, need_sentinel=False)
+    if reason is not None:
+        raise ValueError(
+            f"export validation failed for step {step}: {reason}")
+
+    def do_commit():
+        if os.path.isdir(final):
+            shutil.rmtree(final)  # stale uncommitted leftover
+        os.replace(tmp, final)
+        with checked_open(os.path.join(final, COMPLETE_SENTINEL),
+                          "w") as f:
+            json.dump({"step": int(step), "ranks": 1,
+                       "committed_unix": time.time()}, f)
+
+    call_with_retries(do_commit, retries=retries, backoff_s=backoff,
+                      site=f"export.commit.step_{step}",
+                      counter=_M_RETRIES)
+    return final
 
 
 # ------------------------------------------------------------- tree splits

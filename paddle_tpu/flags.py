@@ -424,6 +424,43 @@ define_flag("serving_http_port", 0,
             "server.  Binds 127.0.0.1 — widening exposure is an "
             "explicit operator decision, like FLAGS_metrics_host")
 
+# Crash-only serving: failure isolation, graceful drain and warm
+# restart from an exported prefix cache (inference/serving.py,
+# inference/prefix_cache.py — ISSUE 15).
+define_flag("serving_tick_timeout_s", 0.0,
+            "serving tick watchdog: seconds the harvest may block on "
+            "the compiled tick's device outputs before the tick is "
+            "FAILED (implicated slots evicted outcome=error, "
+            "serving.tick_errors counted) instead of wedging "
+            "run()/serve_forever() on a hung block_until_ready.  0 "
+            "(the default) waits forever — the historical behavior")
+define_flag("serving_drain_timeout_s", 30.0,
+            "graceful-drain deadline: seconds ServingEngine.drain() "
+            "(SIGTERM under serve_forever, or POST /drain) keeps "
+            "ticking to finish in-flight requests after admission "
+            "closes; stragglers past the deadline are evicted with "
+            "outcome=drained (their partial streams end in an SSE "
+            "error frame)")
+define_flag("serving_prefix_export_dir", "",
+            "prefix-cache persistence root: drain() exports the "
+            "hash-chain index + every referenced block's KV contents "
+            "(draft pools included) as an atomic manifest-checked "
+            "version under this directory, and a NEW engine imports "
+            "the newest valid version at construction (corrupt or "
+            "truncated exports are skipped with "
+            "serving.prefix_import_skipped_corrupt, never loaded) — "
+            "restart-to-first-token on a hot system prompt is then "
+            "warm-cache + warm-compile.  Empty (the default) disables "
+            "both directions")
+define_flag("serving_dispatch_retries", 0,
+            "bounded in-place retries of a serving program dispatch "
+            "that raised a transient RuntimeError/XlaRuntimeError "
+            "(shared io_retry helper, exponential backoff, counted on "
+            "serving.dispatch_retries); exhausted retries surface to "
+            "the tick guard (request failures strike toward poison "
+            "quarantine, tick failures evict the implicated slots).  "
+            "0 (the default) surfaces the first failure")
+
 # Serving decode fast path (inference/serving.py).
 define_flag("serving_device_sampling", True,
             "sample temperature/top-k/top-p INSIDE the compiled decode "

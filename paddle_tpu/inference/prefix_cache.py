@@ -127,6 +127,69 @@ class PrefixCache:
             return 0
         return int(np.count_nonzero(block_rc[self._block_arr] == 1))
 
+    # ------------------------------------------------- persistence (ISSUE 15)
+    def export_state(self) -> dict:
+        """The index as a serializable structure: entries in CHAIN-DEPTH
+        order (every parent precedes its children, so import can rebuild
+        the child counters in one pass) with hex hashes and the
+        exporting engine's physical block ids.  Block ids are only
+        meaningful next to the exported block CONTENTS — the engine's
+        export bundles both and import remaps ids onto freshly
+        allocated blocks."""
+        depth: dict = {}
+        for h, ent in self._entries.items():
+            d, cur = 0, ent.parent
+            while cur is not None:
+                d += 1
+                cur = self._entries[cur].parent
+            depth[h] = d
+        order = sorted(self._entries.items(), key=lambda kv: depth[kv[0]])
+        return {"schema": "paddle_tpu.prefix/v1",
+                "block_size": self.bs,
+                "entries": [{"hash": h.hex(),
+                             "parent": (e.parent.hex()
+                                        if e.parent else None),
+                             "block": e.block} for h, e in order]}
+
+    def import_state(self, state: dict, alloc: Callable[[], Optional[int]],
+                     assign: Callable[[int, int], None]) -> int:
+        """Rebuild an exported index into this (empty) cache.
+
+        ``alloc()`` returns a fresh physical block id — the entry's one
+        index reference, drawn through the engine's ordinary
+        ``_alloc_block`` path — or None when the pool has no room (the
+        import stops; index blocks are reclaimable-on-demand, so a
+        partial import is just a smaller warm set).  ``assign(old, new)``
+        tells the caller to install the exported block ``old``'s KV
+        contents into physical block ``new``.  Entries whose parent was
+        not imported (capacity cut, or a parent the exporter already
+        evicted) are SKIPPED — the chain invariant (no orphan-parent
+        entries) survives any truncation.  Returns entries imported."""
+        if int(state.get("block_size", -1)) != self.bs:
+            raise ValueError(
+                f"prefix export block_size {state.get('block_size')} != "
+                f"engine block_size {self.bs}")
+        n = 0
+        for rec in state["entries"]:
+            parent = (bytes.fromhex(rec["parent"])
+                      if rec.get("parent") else None)
+            if parent is not None and parent not in self._entries:
+                continue
+            h = bytes.fromhex(rec["hash"])
+            if h in self._entries:
+                continue
+            blk = alloc()
+            if blk is None:
+                break
+            ent = _Entry(blk, parent)
+            if parent is not None:
+                self._entries[parent].children += 1
+            self._entries[h] = ent
+            self._block_arr = None
+            assign(int(rec["block"]), blk)
+            n += 1
+        return n
+
     # ------------------------------------------------------------ mutations
     def register(self, prompt_ids: Sequence[int], blocks: Sequence[int],
                  ref: Callable[[int], None],

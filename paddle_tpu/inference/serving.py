@@ -96,6 +96,31 @@ Events token stream (`observability/http.py`), with client disconnect
 and timeout propagating to `Request.cancel()` -> slot eviction and
 block release at the next boundary.
 
+Crash-only serving (ISSUE 15): the tick loop is supervised.  A
+dispatch/harvest exception no longer kills ``run()``/``serve_forever``
+— transient RuntimeError dispatches retry in place
+(``FLAGS_serving_dispatch_retries``, the shared io_retry backoff), an
+admission-stage failure strikes the REQUEST (two strikes — its program
+raised, or its prefill logits went non-finite under the flight-recorder
+watchdog — and it is rejected ``reason=poisoned`` instead of re-crashing
+every boundary), and an unattributable tick failure evicts exactly the
+implicated slots ``outcome=error`` with every block released through
+the single ``_alloc/_ref/_release_block`` path (blocksan stays green)
+while the other slots' streams continue bit-identically.  A harvest
+that never materializes (hung ``block_until_ready``) is caught by the
+tick watchdog (``FLAGS_serving_tick_timeout_s``) and failed like any
+other tick error.  ``drain()`` (SIGTERM under ``serve_forever``, or
+``POST /drain``) is the graceful half: admission closes (healthz 503
+``draining``), in-flight requests finish up to
+``FLAGS_serving_drain_timeout_s``, the waiting queue is cancelled with
+SSE error frames, the block ledger is blocksan-verified empty-running,
+and the prefix cache exports its hash-chain index + block contents
+through the PR 5 atomic-manifest machinery into
+``FLAGS_serving_prefix_export_dir`` — which a NEW engine imports at
+construction (corrupt exports skipped with a counter, never loaded), so
+restart-to-first-token on a hot system prompt is warm-cache (+
+warm-compile via the persistent compilation cache).
+
 Cold start (ISSUE 7): the set of programs the engine can EVER dispatch
 is small and enumerable — one tick program per {steps_per_tick, 1-step
 tail} (greedy and sampled share it: sampling params are device inputs
@@ -112,7 +137,10 @@ tracker records ZERO events once ``run()`` admits traffic.
 
 from __future__ import annotations
 
+import json
 import math
+import os
+import threading
 from collections import deque
 from contextlib import contextmanager
 from typing import List, Optional
@@ -125,6 +153,7 @@ import numpy as np
 
 from .. import flags as _flags
 from ..framework.tensor import Tensor
+from ..testing import chaos as _chaos
 from ..testing import jaxsan as _jaxsan
 from ..observability import compile_tracker as _compile
 from ..observability import export as _export
@@ -134,7 +163,7 @@ from ..observability import metrics as _metrics
 from . import quant as _squant
 from .prefix_cache import PrefixCache
 
-__all__ = ["Request", "ServingEngine"]
+__all__ = ["Request", "ServingEngine", "TickTimeout", "NonFiniteLogits"]
 
 _M_ADMISSIONS = _metrics.counter(
     "serving.admissions", "requests admitted into a decode slot")
@@ -204,6 +233,34 @@ _M_SLO_SHEDS = _metrics.counter(
     "shedding (FLAGS_serving_slo_shed: live TTFT/TPOT p99 over target "
     "AND queue depth over the watermark); every shed also counts on "
     "serving.rejections{reason=slo_shed}")
+_M_TICK_ERRORS = _metrics.counter(
+    "serving.tick_errors", "tick-loop failures absorbed by the crash-"
+    "only guard (ISSUE 15): a dispatch/harvest exception or a tick-"
+    "watchdog timeout that evicted the implicated slots (outcome="
+    "error) or struck an admission-stage request instead of killing "
+    "run()/serve_forever")
+_M_POISONED = _metrics.counter(
+    "serving.poisoned_requests", "requests quarantined after two "
+    "admission-stage strikes (program raised, or prefill logits non-"
+    "finite under the NaN watchdog): rejected reason=poisoned instead "
+    "of re-crashing every scheduler boundary")
+_M_DISPATCH_RETRIES = _metrics.counter(
+    "serving.dispatch_retries", "transient serving-program dispatch "
+    "failures retried in place (FLAGS_serving_dispatch_retries, "
+    "labelled site=); only exhausted retries reach the tick guard")
+_M_PREFIX_IMPORT = _metrics.counter(
+    "serving.prefix_import_blocks", "physical KV blocks restored from "
+    "a drain-time prefix-cache export at engine construction "
+    "(FLAGS_serving_prefix_export_dir): each was re-pinned through the "
+    "ordinary _alloc/_ref path and is index-evictable under pressure")
+_M_PREFIX_IMPORT_SKIP = _metrics.counter(
+    "serving.prefix_import_skipped_corrupt", "prefix-cache export "
+    "versions SKIPPED at import, by reason=corrupt (manifest/sentinel/"
+    "sha256 validation failed — truncation or bit rot) | mismatch "
+    "(index readable but from an incompatible engine: different "
+    "model/pool geometry or quant mode) | unreadable (payload failed "
+    "to parse despite a valid manifest); a skipped version is never "
+    "loaded — import falls back to the next older one")
 
 # --- request lifecycle tracing (ISSUE 6): every request's
 # enqueue -> admit (queue wait) -> prefill -> first token -> per-tick
@@ -235,6 +292,20 @@ _M_RUNNING = _metrics.gauge(
     "serving.running", "batch slots currently holding a request")
 _M_WAITING = _metrics.gauge(
     "serving.waiting", "requests queued for admission")
+
+
+class TickTimeout(RuntimeError):
+    """The harvest of a compiled tick did not materialize within
+    ``FLAGS_serving_tick_timeout_s`` — a hung device program.  Raised
+    inside the tick loop and absorbed by the crash-only guard (the
+    implicated slots are evicted ``outcome=error``)."""
+
+
+class NonFiniteLogits(RuntimeError):
+    """A request's host-visible logits went NaN/Inf (flight-recorder
+    watchdog probe).  At admission this is a poison strike: the request
+    retries once from the back of the queue, then is quarantined
+    ``reason=poisoned``."""
 
 
 class Request:
@@ -275,6 +346,14 @@ class Request:
         self.priority = int(priority)
         self.cancelled = False
         self.shed = False             # rejected by SLO load shedding
+        # terminal outcome for the SSE frontend (ISSUE 15): "finished",
+        # "cancelled", or an engine-ended reason ("error", "poisoned",
+        # "slo_shed", "drained", ...) that becomes the stream's terminal
+        # `event: error` frame; None while the request is live
+        self.outcome: Optional[str] = None
+        # admission-stage poison strikes (program raised / logits went
+        # non-finite); at _POISON_STRIKES the request is quarantined
+        self._strikes = 0
         # chunked-prefill admission state (engine-owned; the table row
         # lives HERE — shadowing self.tables — until the last chunk
         # lands, so in-flight decode ticks see an all-zero row and
@@ -387,6 +466,20 @@ def _next_tokens(logits, do_sample, temperature, top_k, top_p, seeds,
         return jnp.where(do_sample, samp, greedy)
 
     return jax.lax.cond(jnp.any(do_sample), drawn, lambda: greedy)
+
+
+class _RetryCounter:
+    """io_retry counter adapter: every transient-dispatch retry counts
+    on the engine AND the process registry."""
+
+    __slots__ = ("_engine",)
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def inc(self, **labels):
+        self._engine.dispatch_retries += 1
+        _M_DISPATCH_RETRIES.inc(**labels)
 
 
 def _bucket(n: int, minimum: int) -> int:
@@ -704,6 +797,28 @@ class ServingEngine:
         # the program grid is still compiling
         self._ready = False
         self._t_serve_start: Optional[float] = None
+        # --- crash-only lifecycle (ISSUE 15): drain state + tick-error
+        # accounting.  `_drain_requested` is a bare bool store, safe
+        # from signal handlers and the POST /drain handler threads;
+        # the engine loop turns it into an actual drain() at its next
+        # boundary.
+        self._draining = False
+        self._drain_requested = False
+        self._drain_info: Optional[dict] = None
+        self.tick_errors = 0
+        self.poisoned_requests = 0
+        self.dispatch_retries = 0
+        # warm restart: import the newest valid prefix-cache export
+        # (hash-chain index + block KV contents) a draining predecessor
+        # left under FLAGS_serving_prefix_export_dir — entries re-pin
+        # fresh blocks through _alloc_block, corrupt versions are
+        # skipped with a counter, and a hot system prompt's first
+        # admission is then a suffix-only prefill
+        self._prefix_import_info: Optional[dict] = None
+        if self.prefix is not None:
+            export_dir = str(_flags.get_flag("serving_prefix_export_dir"))
+            if export_dir:
+                self._import_prefix_cache(export_dir)
 
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
@@ -1451,6 +1566,15 @@ class ServingEngine:
     def add_request(self, req: Request):
         L = len(req.prompt_ids)
         traced = _metrics.enabled()
+        if self._draining or self._drain_requested:
+            # admission is CLOSED while draining: new traffic belongs
+            # on another replica (healthz already answers 503 draining)
+            _M_REJECTIONS.inc(reason="draining")
+            if traced:
+                self._reject_trace(req, "draining")
+            raise ValueError(
+                "engine is draining: admission closed (retry against "
+                "another replica)")
         if L + req.max_new_tokens > self.max_context:
             _M_REJECTIONS.inc(reason="over_context")
             if traced:
@@ -1482,6 +1606,7 @@ class ServingEngine:
     def _reject_trace(self, req: Request, reason: str) -> None:
         """Rejections are lifecycle endpoints too: a scraper reading
         /requests sees WHY traffic bounced, not just that it did."""
+        req.outcome = reason
         rec = {"rid": req.rid, "outcome": f"rejected:{reason}",
                "prompt_len": len(req.prompt_ids),
                "max_new_tokens": req.max_new_tokens}
@@ -1520,6 +1645,211 @@ class ServingEngine:
             self.free_blocks.append(blk)
             return True
         return False
+
+    # ------------------------------------- failure isolation (ISSUE 15)
+    _POISON_STRIKES = 2
+    _DISPATCH_BACKOFF_S = 0.05
+
+    def _dispatch_call(self, site: str, call):
+        """Run one compiled-program dispatch through the chaos site hook
+        and the bounded transient-retry policy
+        (``FLAGS_serving_dispatch_retries``): a RuntimeError (the
+        XlaRuntimeError family) retries in place with the shared
+        io_retry exponential backoff before surfacing to the tick
+        guard.  With the flag at 0 (default) and no chaos armed this is
+        one dict check + the call."""
+        def attempt():
+            _chaos.inject(site)
+            return call()
+
+        retries = int(_flags.get_flag("serving_dispatch_retries"))
+        if retries <= 0:
+            return attempt()
+        from ..distributed.checkpoint.io_retry import call_with_retries
+        return call_with_retries(
+            attempt, retries=retries, backoff_s=self._DISPATCH_BACKOFF_S,
+            site=site, retry_on=(RuntimeError, OSError),
+            counter=_RetryCounter(self))
+
+    def _screen_row(self, row, slot: int, req: Request) -> np.ndarray:
+        """Host-materialize a prefill logits row and screen it.
+
+        Chaos may corrupt the armed (slot, rid)'s row in place (the
+        NaN-forward injection); with the flight-recorder NaN watchdog
+        enabled the row is then probed and a non-finite value raises
+        :class:`NonFiniteLogits` — BEFORE prefix registration, so a NaN
+        prompt can never poison the shared index, and before any token
+        is emitted, so the strike/requeue path replays nothing.  With
+        the watchdog off (default) the row is materialized exactly as
+        `_finish_admission` always did and never reduced."""
+        row_np = np.asarray(row)
+        if _chaos.nan_payload("serving.prefill", slot=slot, rid=req.rid):
+            row_np = np.full_like(row_np, np.nan)
+        if _flight.enabled() and not _flight.check_finite(
+                float(np.sum(row_np)), site="serving.prefill.logits"):
+            raise NonFiniteLogits(
+                f"prefill logits non-finite for rid={req.rid}")
+        return row_np
+
+    def _screen_decode_logits(self, pend):
+        """Host-materialize the host-sampling decode tick's logits and
+        screen the active rows (chaos NaN injection + watchdog probe).
+        Returns ``(logits ndarray or None, {slot: error})``.  Gated the
+        same way as `_screen_row`: with the watchdog off and no chaos
+        armed, nothing is materialized beyond what the sampler itself
+        would have pulled."""
+        if not _flight.enabled() and not _chaos.active_faults():
+            return None, {}
+        logits_np = np.array(np.asarray(pend.logits))
+        bad: dict = {}
+        for slot in pend.active:
+            req = pend.reqs[slot]
+            if req is None or req.done:
+                continue
+            if _chaos.nan_payload("serving.decode", slot=slot,
+                                  rid=req.rid):
+                logits_np[slot] = np.nan
+            if _flight.enabled() and not _flight.check_finite(
+                    float(np.sum(logits_np[slot])),
+                    site="serving.decode.logits"):
+                bad[slot] = "non-finite decode logits"
+        return logits_np, bad
+
+    def _materialize(self, handle):
+        """Block on a tick's device outputs, under the tick watchdog:
+        with ``FLAGS_serving_tick_timeout_s`` > 0 the wait runs on a
+        helper thread and a harvest that does not materialize in time
+        raises :class:`TickTimeout` (the guard then fails the tick)
+        instead of wedging the loop on a hung device program."""
+        timeout = float(_flags.get_flag("serving_tick_timeout_s"))
+        if timeout <= 0:
+            _chaos.maybe_delay("serving.harvest")
+            return np.asarray(handle)
+        box: dict = {}
+
+        def work():
+            try:
+                _chaos.maybe_delay("serving.harvest")
+                box["out"] = np.asarray(handle)
+            except BaseException as e:  # noqa: BLE001 - forwarded below
+                box["exc"] = e
+
+        t = threading.Thread(target=work, name="serving-harvest",
+                             daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise TickTimeout(
+                f"tick harvest did not materialize within "
+                f"FLAGS_serving_tick_timeout_s={timeout}s — device "
+                "program hung or wedged")
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    def _error_evict(self, slot: int, error: str) -> None:
+        """Terminal error for one RUNNING slot: trace outcome=error,
+        evict (blocks released through the single accounting path),
+        close the SSE stream with an error frame."""
+        req = self.slot_req[slot]
+        if req is None:
+            return
+        _flight.default_recorder().record_event(
+            "slot_error", slot=slot, rid=req.rid, error=error[:200])
+        if req._prefilling:
+            self._abort_prefill(req, outcome="error")
+            return
+        self._terminal_trace(req, "error")
+        self._evict(slot)
+        req._stream_push(None)
+
+    def _strike(self, req: Request, error: str) -> None:
+        """One admission-stage poison strike: the request's own program
+        raised (prefill dispatch) or its prefill logits went
+        non-finite.  First strike re-queues it at the BACK of the
+        waiting queue (one more chance — transient-looking failures
+        already consumed the in-place retries); at ``_POISON_STRIKES``
+        it is quarantined: rejected ``reason=poisoned`` so it stops
+        re-crashing every scheduler boundary."""
+        req._strikes += 1
+        if req._strikes >= self._POISON_STRIKES or req.cancelled \
+                or self._draining:
+            self.poisoned_requests += 1
+            _M_POISONED.inc()
+            _M_REJECTIONS.inc(reason="poisoned")
+            req.outcome = "poisoned"
+            if _metrics.enabled():
+                self._reject_trace(req, "poisoned")
+            _flight.default_recorder().record_event(
+                "poison_quarantine", rid=req.rid, strikes=req._strikes,
+                error=error[:200])
+            self.finished.append(req)
+            req._stream_push(None)
+        else:
+            self.waiting.append(req)
+        self._update_pressure()
+
+    def _abandon(self, pend) -> None:
+        """Consume an in-flight tick that will never be harvested (the
+        tick-failure path): block BRIEFLY so its device writes finish
+        before the implicated blocks are released for reallocation;
+        errors and a still-running program past the grace period are
+        swallowed — the slots are being evicted anyway."""
+        try:
+            h = pend.toks
+            t = threading.Thread(
+                target=lambda: jax.block_until_ready(h), daemon=True)
+            t.start()
+            t.join(1.0)
+        except Exception:  # noqa: BLE001 - best-effort drain
+            pass
+
+    def _absorb_failure(self, exc: BaseException, pends) -> bool:
+        """The crash-only tick guard's decision point.  Returns True
+        when the failure was absorbed (the loop continues), False when
+        it must propagate (sanitizer findings stay loud — a swallowed
+        JaxsanError would defeat the sanitizer).
+
+        A failure tagged with ``_serving_req`` (admission-stage: the
+        request's own prefill/chunk program raised, or its logits went
+        non-finite) strikes THAT request — the rest of the batch never
+        notices.  Anything else is a tick-level failure: the in-flight
+        ticks are abandoned and exactly the slots they covered are
+        evicted ``outcome=error`` (attribution is program-granular —
+        a whole-batch tick program names no slot)."""
+        if isinstance(exc, _jaxsan.JaxsanError):
+            return False
+        self.tick_errors += 1
+        _M_TICK_ERRORS.inc()
+        err = f"{type(exc).__name__}: {exc}"[:200]
+        req = getattr(exc, "_serving_req", None)
+        _flight.default_recorder().record_event(
+            "tick_error", error=err,
+            scope="request" if req is not None else "tick",
+            rid=getattr(req, "rid", None))
+        if req is not None:
+            self._strike(req, err)
+            return True
+        slots = set()
+        for p in pends:
+            if p is None:
+                continue
+            self._abandon(p)
+            slots.update(p.active)
+        if not slots:
+            slots = set(s for s in range(self.B)
+                        if self.slot_req[s] is not None)
+        for slot in sorted(slots):
+            r = self.slot_req[slot]
+            if r is None:
+                continue
+            if r.done:
+                self._evict(slot)
+            else:
+                self._error_evict(slot, err)
+        self._last_harvest_t = None
+        self._update_occupancy()
+        return True
 
     def _try_admit(self) -> bool:
         if not self.waiting or not self.free_slots:
@@ -1652,11 +1982,14 @@ class ServingEngine:
                     suffix[0, :Ls] = req.prompt_ids[cached_len:]
                     # private table-row copy: same R002 aliasing contract
                     # as the full-prefill call below
-                    out = self._prefill_cont_program(L_pad_s)(
-                        param_vals, *dpref,
-                        jnp.asarray(self.tables[slot:slot + 1].copy()),
-                        jnp.asarray(suffix), jnp.int32(Ls),
-                        jnp.int32(cached_len))
+                    out = self._dispatch_call(
+                        "serving.prefill.dispatch",
+                        lambda: self._prefill_cont_program(L_pad_s)(
+                            param_vals, *dpref,
+                            jnp.asarray(
+                                self.tables[slot:slot + 1].copy()),
+                            jnp.asarray(suffix), jnp.int32(Ls),
+                            jnp.int32(cached_len)))
                 else:
                     prompt = np.zeros((1, L_pad), np.int32)
                     prompt[0, :L] = req.prompt_ids
@@ -1666,20 +1999,27 @@ class ServingEngine:
                     # pad-block release below mutate self.tables before
                     # np.asarray(row) syncs — an in-flight prefill would
                     # read the mutated block ids
-                    out = self._prefill_program(L_pad)(
-                        param_vals, *dpref,
-                        jnp.asarray(self.tables[slot:slot + 1].copy()),
-                        jnp.asarray(prompt), jnp.int32(L))
+                    out = self._dispatch_call(
+                        "serving.prefill.dispatch",
+                        lambda: self._prefill_program(L_pad)(
+                            param_vals, *dpref,
+                            jnp.asarray(
+                                self.tables[slot:slot + 1].copy()),
+                            jnp.asarray(prompt), jnp.int32(L)))
                 if self.spec_model:
                     row, self.pools, self.dpools = out
                 else:
                     row, self.pools = out
-        except BaseException:
+                # host-sync + NaN screen BEFORE the prefix registers
+                # anything (a poisoned prompt must not enter the index)
+                row = self._screen_row(row, slot, req)
+        except BaseException as e:
             # admission failed mid-flight: undo every host-side draw so
             # nothing leaks (references dropped — shared blocks survive
             # their other holders — slot freed, growth reservation
             # returned); the request is dropped from the queue and the
-            # error propagates to the caller
+            # error propagates, tagged with the request so the tick
+            # guard can strike/quarantine it instead of dying
             for col in range(self.nb_per_seq):
                 if self.tables[slot, col]:
                     self._release_block(int(self.tables[slot, col]))
@@ -1690,6 +2030,10 @@ class ServingEngine:
             self.reserved -= growth
             req._growth_left = 0
             _M_REJECTIONS.inc(reason="error")
+            try:
+                e._serving_req = req
+            except Exception:   # exotic exception types without a dict
+                pass
             raise
         if cow_src is not None:
             self._release_block(cow_src)   # copy dispatched; pin over
@@ -1797,6 +2141,7 @@ class ServingEngine:
         if (req.eos_token_id is not None and tok == req.eos_token_id) or \
                 len(req.output_ids) >= req.max_new_tokens:
             req.done = True
+            req.outcome = "finished"
             req._stream_push(None)      # close the SSE token stream
             # _t_first may lag _t_enqueue if the metrics gate flipped
             # between enqueue and admission; trace only complete timelines
@@ -2070,17 +2415,29 @@ class ServingEngine:
                          if self.spec_model else (self.pools,))
                 # private row copy: same R002 aliasing contract as the
                 # monolithic prefill's table-row argument
-                out = self._prefill_cont_program(L_pad)(
-                    param_vals, *dpref,
-                    jnp.asarray(req._chunk_row[None, :].copy()),
-                    jnp.asarray(suffix), jnp.int32(n), jnp.int32(off))
+                out = self._dispatch_call(
+                    "serving.prefill.dispatch",
+                    lambda: self._prefill_cont_program(L_pad)(
+                        param_vals, *dpref,
+                        jnp.asarray(req._chunk_row[None, :].copy()),
+                        jnp.asarray(suffix), jnp.int32(n),
+                        jnp.int32(off)))
             if self.spec_model:
                 row, self.pools, self.dpools = out
             else:
                 row, self.pools = out
-        except BaseException:
+            if req._chunk_off + n >= L:
+                # last chunk: host-sync + NaN screen before the shadow
+                # row installs and the prefix registers (same contract
+                # as the monolithic path's _screen_row placement)
+                row = self._screen_row(row, slot, req)
+        except BaseException as e:
             self._abort_prefill(req)
             _M_REJECTIONS.inc(reason="error")
+            try:
+                e._serving_req = req
+            except Exception:
+                pass
             raise
         if t_c0 is not None:
             # host-side chunk dispatch time (async enqueue; a sampled
@@ -2149,8 +2506,11 @@ class ServingEngine:
         self._update_occupancy()
 
     def _terminal_trace(self, req, outcome: str) -> None:
-        """Non-finish lifecycle endpoints (cancellations) get a trace
-        record too, metrics-gated like everything else."""
+        """Non-finish lifecycle endpoints (cancellations, errors,
+        drains) get a trace record too, metrics-gated like everything
+        else; the outcome itself is stamped unconditionally — the SSE
+        terminal frame needs it regardless of the metrics gate."""
+        req.outcome = outcome
         if not _metrics.enabled():
             return
         rec = {"rid": req.rid, "outcome": outcome,
@@ -2165,12 +2525,31 @@ class ServingEngine:
         """One SYNCHRONOUS scheduler tick: run the boundary schedule
         (evict finished, spend the admission/chunk budget), run one
         compiled decode tick over the current mix and harvest it.
-        Returns True while work remains."""
+        Returns True while work remains.  UNGUARDED — exceptions
+        propagate to the caller; the serve loops wrap it (or their own
+        cycles) in the crash-only guard."""
         pend = self._dispatch_tick(boundary=True)
         if pend is None:
             return bool(self.waiting or self.prefilling)
         self._harvest_tick(pend)
         return True
+
+    def _guarded_step(self) -> bool:
+        """`step()` under the crash-only guard: a dispatch/harvest
+        failure is absorbed by `_absorb_failure` (request strike or
+        implicated-slot eviction) and the loop stays alive; only
+        sanitizer findings (JaxsanError) still propagate."""
+        pend = None
+        try:
+            pend = self._dispatch_tick(boundary=True)
+            if pend is None:
+                return bool(self.waiting or self.prefilling)
+            self._harvest_tick(pend)
+            return True
+        except Exception as e:  # noqa: BLE001 - the guard's whole job
+            if not self._absorb_failure(e, (pend,)):
+                raise
+            return True
 
     def _dispatch_tick(self, boundary: bool = True, chain=None):
         """Launch one compiled decode tick and return it IN FLIGHT.
@@ -2246,20 +2625,24 @@ class ServingEngine:
             if not device_sampling and k == 1:
                 # host-sampling fallback: the k=1 program returns the
                 # logits the per-row host sampler needs
-                greedy, logits, self.pools = self._decode_program()(
-                    param_vals, self.pools, dev(self.tables),
-                    dev(self.seq_lens), last)
+                greedy, logits, self.pools = self._dispatch_call(
+                    "serving.tick.dispatch",
+                    lambda: self._decode_program()(
+                        param_vals, self.pools, dev(self.tables),
+                        dev(self.seq_lens), last))
                 toks = greedy[:, None]
             else:
                 # the one k-step tick program; with sampling off the
                 # demotion guarantees no sampled row is active, the
                 # all-False mask takes the greedy cond branch
-                toks, self.pools = self._tick_program(k)(
-                    param_vals, self.pools, dev(self.tables),
-                    dev(self.seq_lens), last,
-                    dev(self.samp_do), dev(self.samp_temp),
-                    dev(self.samp_topk), dev(self.samp_topp),
-                    dev(self.samp_seed), dev(self.tok_pos))
+                toks, self.pools = self._dispatch_call(
+                    "serving.tick.dispatch",
+                    lambda: self._tick_program(k)(
+                        param_vals, self.pools, dev(self.tables),
+                        dev(self.seq_lens), last,
+                        dev(self.samp_do), dev(self.samp_temp),
+                        dev(self.samp_topk), dev(self.samp_topp),
+                        dev(self.samp_seed), dev(self.tok_pos)))
         self.steps += k
         for slot in active:
             self.seq_lens[slot] += k
@@ -2390,10 +2773,12 @@ class ServingEngine:
                 _flight.guard("serving.tick"):
             if self.spec_model:
                 toks, counts, accepts, new_lens, new_last, self.pools, \
-                    self.dpools = self._spec_program(k)(
-                        param_vals, self._draft_vals(), self.pools,
-                        self.dpools, dev(self.tables), lens_in, last_in,
-                        *samp, dev(kcap))
+                    self.dpools = self._dispatch_call(
+                        "serving.tick.dispatch",
+                        lambda: self._spec_program(k)(
+                            param_vals, self._draft_vals(), self.pools,
+                            self.dpools, dev(self.tables), lens_in,
+                            last_in, *samp, dev(kcap)))
                 self.steps += k + 1      # k draft forwards + one verify
             else:
                 # host-side n-gram proposals (near-zero cost; the whole
@@ -2408,9 +2793,12 @@ class ServingEngine:
                     dtoks[slot] = req._drafter.propose_stream(
                         req.prompt_ids, req.output_ids, k)
                 toks, counts, accepts, new_lens, new_last, self.pools \
-                    = self._spec_hd_program(k)(
-                        param_vals, self.pools, dev(self.tables),
-                        lens_in, last_in, dev(dtoks), *samp, dev(kcap))
+                    = self._dispatch_call(
+                        "serving.tick.dispatch",
+                        lambda: self._spec_hd_program(k)(
+                            param_vals, self.pools, dev(self.tables),
+                            lens_in, last_in, dev(dtoks), *samp,
+                            dev(kcap)))
                 self.steps += 1          # one chunk verify forward
         for slot in active:
             self.seq_lens[slot] += int(kcap[slot])
@@ -2439,8 +2827,11 @@ class ServingEngine:
         with _flight.guard("serving.tick"):
             # first host block on the async result: a decode-execution
             # error (OOM, XlaRuntimeError) surfaces HERE, not at the
-            # guarded dispatch — keep the post-mortem dump coverage
-            toks = np.asarray(pend.toks)
+            # guarded dispatch — keep the post-mortem dump coverage.
+            # The tick watchdog (FLAGS_serving_tick_timeout_s) bounds
+            # this block: a hung device program raises TickTimeout
+            # instead of wedging the loop forever.
+            toks = self._materialize(pend.toks)
         # harvest-wait phase: the block above is where device compute
         # not yet finished is actually waited for
         t_wait_end = time.perf_counter() if timed else 0.0
@@ -2449,6 +2840,13 @@ class ServingEngine:
         # unless FLAGS_enable_jaxsan)
         _jaxsan.verify(pend.san)
         logits_np = None
+        bad_slots: dict = {}
+        if not pend.spec and pend.logits is not None:
+            # host-sampling decode path: the per-row logits are host-
+            # visible, so NaN attribution is PER SLOT here — an armed
+            # chaos injection or a real non-finite forward implicates
+            # exactly one row (evicted outcome=error after the loop)
+            logits_np, bad_slots = self._screen_decode_logits(pend)
         toks_before = self.tokens_out
         sampled = 0
         spec_accepted = 0
@@ -2518,6 +2916,10 @@ class ServingEngine:
                 req = pend.reqs[slot]
                 if req.done:
                     continue     # whole row is EOS overrun
+                if slot in bad_slots:
+                    continue     # non-finite row: no tokens emitted;
+                                 # the slot is evicted outcome=error
+                                 # at the end of this harvest
                 n_before = len(req.output_ids)
                 harvested_by.append((req, n_before))
                 req._ticks += 1
@@ -2612,6 +3014,12 @@ class ServingEngine:
             if pend.chunks:
                 rec["prefill_chunks"] = pend.chunks
             _flight.default_recorder().record_step(rec)
+        # failure isolation (ISSUE 15): rows whose logits screened
+        # non-finite are evicted HERE — outcome=error, blocks released
+        # through the single accounting path — and every other slot's
+        # stream is untouched (their tokens were already emitted above)
+        for slot, err in bad_slots.items():
+            self._error_evict(slot, err)
         # blocksan boundary reconciliation: the harvest is the one point
         # where no admission is mid-flight and every transient pin has
         # resolved — ledger vs tables/shadow rows/index, free-list
@@ -2722,16 +3130,27 @@ class ServingEngine:
                 if not (self.waiting or self.prefilling
                         or self._active_slots()):
                     break
-                pend = self._dispatch_tick(boundary=True)
+                try:
+                    pend = self._dispatch_tick(boundary=True)
+                except Exception as e:  # noqa: BLE001 - crash-only guard
+                    if not self._absorb_failure(e, ()):
+                        raise
+                    continue
                 if pend is None:
                     continue     # waiting on evictions, as before
             nxt = None
-            if self._can_overlap(pend):
-                nxt = self._dispatch_tick(boundary=False, chain=pend)
-                if nxt is not None:
-                    nxt.overlapped = True
-                    _M_OVERLAP.inc()
-            self._harvest_tick(pend)
+            try:
+                if self._can_overlap(pend):
+                    nxt = self._dispatch_tick(boundary=False, chain=pend)
+                    if nxt is not None:
+                        nxt.overlapped = True
+                        _M_OVERLAP.inc()
+                self._harvest_tick(pend)
+            except Exception as e:  # noqa: BLE001 - crash-only guard
+                if not self._absorb_failure(e, (pend, nxt)):
+                    raise
+                pend = None
+                continue
             pend = nxt
         # final eviction sweep
         for slot in list(range(self.B)):
@@ -2750,20 +3169,304 @@ class ServingEngine:
         this loop ticks while work exists and naps otherwise.  Runs the
         SYNCHRONOUS step cycle: a latency-facing frontend wants
         admissions (and cancellations) at every boundary, not deferred
-        behind an overlapped tick."""
+        behind an overlapped tick.
+
+        Crash-only (ISSUE 15): every step runs under the tick guard —
+        one request's failure never kills the loop — and SIGTERM (main
+        thread only) or ``POST /drain`` flips `request_drain()`, which
+        this loop turns into a graceful `drain()` and a clean return."""
+        import signal as _signal
         from ..observability import http as _http
         _http.start_from_flags()
         _http.attach_engine(self)
         _http.start_serving_from_flags()
-        if self._warmup_info is None \
-                and _flags.get_flag("serving_warmup"):
-            self.warmup()
-        self._mark_ready()
-        while not stop_event.is_set():
-            if self.waiting or self.prefilling or self._active_slots():
-                self.step()
+        old_handler = None
+        try:
+            old_handler = _signal.signal(
+                _signal.SIGTERM,
+                lambda signum, frame: self.request_drain())
+        except ValueError:
+            pass    # not the main thread: POST /drain still works
+        try:
+            if self._warmup_info is None \
+                    and _flags.get_flag("serving_warmup"):
+                self.warmup()
+            self._mark_ready()
+            while not stop_event.is_set():
+                if self._drain_requested and not self._draining:
+                    self.drain()
+                    return
+                if self.waiting or self.prefilling \
+                        or self._active_slots():
+                    self._guarded_step()
+                else:
+                    time.sleep(idle_s)
+        finally:
+            if old_handler is not None:
+                try:
+                    _signal.signal(_signal.SIGTERM, old_handler)
+                except ValueError:
+                    pass
+
+    # -------------------------------------- graceful drain (ISSUE 15)
+    def request_drain(self) -> None:
+        """Ask the engine to drain at its next boundary.  A bare bool
+        store — safe from signal handlers and the POST /drain handler
+        threads.  Admission closes immediately (`add_request` rejects,
+        /healthz answers 503 draining); the engine loop performs the
+        actual drain."""
+        self._drain_requested = True
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Graceful drain: flip admission off, cancel the waiting queue
+        (``outcome=drained`` — their SSE streams end in an error
+        frame), keep ticking under the crash-only guard until every
+        in-flight request finishes or ``deadline_s``
+        (``FLAGS_serving_drain_timeout_s``) expires, evict stragglers
+        ``outcome=drained``, blocksan-verify the emptied ledger, then
+        export the prefix cache when ``FLAGS_serving_prefix_export_dir``
+        is set.  Idempotent per engine; returns (and stashes for
+        ``stats()``/``health()``) the drain report."""
+        if self._drain_info is not None:
+            return self._drain_info
+        if deadline_s is None:
+            deadline_s = float(_flags.get_flag("serving_drain_timeout_s"))
+        self._drain_requested = True
+        self._draining = True
+        t0 = time.monotonic()
+        _flight.default_recorder().record_event(
+            "drain_start", waiting=len(self.waiting),
+            running=self.B - len(self.free_slots))
+        # the waiting queue was never admitted: hand it back NOW with a
+        # terminal reason the client can retry on (another replica owns
+        # the retry — this engine is going away)
+        cancelled = 0
+        for r in list(self.waiting):
+            self._terminal_trace(r, "drained")
+            self.finished.append(r)
+            r._stream_push(None)
+            cancelled += 1
+        self.waiting.clear()
+        self._update_pressure()
+        # finish in-flight work (chunked prefills included: their
+        # prompts already consumed compute) up to the deadline
+        deadline = t0 + max(float(deadline_s), 0.0)
+        while (self.prefilling or self._active_slots()) \
+                and time.monotonic() < deadline:
+            self._guarded_step()
+        # deadline stragglers: evict with outcome=drained (their
+        # partial streams end in an SSE error frame, blocks released)
+        evicted = 0
+        for slot in list(range(self.B)):
+            req = self.slot_req[slot]
+            if req is None:
+                continue
+            if req._prefilling:
+                self._abort_prefill(req, outcome="drained")
+                evicted += 1
+            elif req.done:
+                self._evict(slot)
             else:
-                time.sleep(idle_s)
+                self._terminal_trace(req, "drained")
+                self._evict(slot)
+                req._stream_push(None)
+                evicted += 1
+        # drain-complete invariant: the ledger must reconcile to
+        # empty-running — every block free or held only by the prefix
+        # index (no-op unless blocksan is armed)
+        _jaxsan.blocksan_verify(self)
+        export = None
+        export_dir = str(_flags.get_flag("serving_prefix_export_dir"))
+        if self.prefix is not None and export_dir:
+            try:
+                export = self.export_prefix_cache(export_dir)
+            except Exception as e:  # noqa: BLE001 - drain must finish
+                export = {"error": f"{type(e).__name__}: {e}"[:200]}
+                _flight.default_recorder().record_event(
+                    "prefix_export_failed", error=export["error"])
+        self._drain_info = {
+            "drained_s": round(time.monotonic() - t0, 4),
+            "deadline_s": float(deadline_s),
+            "cancelled_waiting": cancelled,
+            "evicted_running": evicted,
+            "export": export}
+        _flight.default_recorder().record_event(
+            "drain_complete", **{k: v for k, v in
+                                 self._drain_info.items()
+                                 if k != "export"})
+        return self._drain_info
+
+    # ---------------------------- prefix-cache persistence (ISSUE 15)
+    def _prefix_fingerprint(self) -> dict:
+        """What an export's KV contents are a pure function of (besides
+        the prompt tokens): pool geometry + dtype + quant mode + the
+        draft-pool layout.  Import refuses a mismatch (reason=mismatch)
+        — loading another geometry's bytes would be silent garbage.
+        Weight EQUALITY is deliberately not fingerprinted (documented:
+        restarting with different weights under the same config is the
+        operator's contract, exactly like the persistent compile
+        cache)."""
+        cfg = self.model.cfg
+        fp = {"num_layers": int(cfg.num_layers), "nh": self.nh,
+              "hd": self.hd, "block_size": self.bs,
+              "vocab_size": int(cfg.vocab_size),
+              "dtype": str(np.dtype(
+                  np.asarray(self.pools[0][0]).dtype)),
+              "quant": self.quant_mode,
+              "draft": bool(self.spec_model)}
+        if self.spec_model:
+            dcfg = self.draft.cfg
+            fp["draft_layers"] = int(dcfg.num_layers)
+            fp["draft_nh"] = int(dcfg.num_heads)
+            fp["draft_hd"] = int(dcfg.hidden_size // dcfg.num_heads)
+        return fp
+
+    def export_prefix_cache(self, root: str) -> dict:
+        """Serialize the prefix-cache index + every referenced block's
+        KV contents (ALL layer pools, draft pools included) as an
+        atomic, integrity-checked version under ``root`` — the PR 5
+        manifest machinery: ``step_<N>.tmp`` -> sha256 manifest ->
+        re-hash -> rename -> ``COMPLETE`` sentinel — so a reader can
+        NEVER observe a torn export.  The gather is one device->host
+        pool copy + numpy slicing (no compiled gather programs: export
+        runs post-warmup and must not add program signatures)."""
+        from ..distributed.checkpoint import manager as _ckpt
+        if self.prefix is None:
+            raise ValueError("prefix cache is disabled on this engine")
+        t0 = time.perf_counter()
+        index = self.prefix.export_state()
+        blocks = sorted({e["block"] for e in index["entries"]})
+        ids = np.asarray(blocks, np.int64)
+        arrays = {"block_ids": ids}
+        for li, (kk, vv) in enumerate(self.pools):
+            arrays[f"k{li}"] = np.asarray(kk)[:, ids]
+            arrays[f"v{li}"] = np.asarray(vv)[:, ids]
+        if self.dpools is not None:
+            for li, (kk, vv) in enumerate(self.dpools):
+                arrays[f"dk{li}"] = np.asarray(kk)[:, ids]
+                arrays[f"dv{li}"] = np.asarray(vv)[:, ids]
+        index["meta"] = self._prefix_fingerprint()
+        step = max(_ckpt.all_steps(root), default=0) + 1
+
+        def write(tmp):
+            with _chaos.checked_open(
+                    os.path.join(tmp, "prefix_index.json"), "w") as f:
+                json.dump(index, f)
+            with _chaos.checked_open(
+                    os.path.join(tmp, "prefix_blocks.npz"), "wb") as f:
+                np.savez(f, **arrays)
+            return ["prefix_index.json", "prefix_blocks.npz"]
+
+        path = _ckpt.commit_single_rank(root, step, write)
+        nbytes = sum(a.nbytes for a in arrays.values())
+        info = {"step": step, "path": path,
+                "entries": len(index["entries"]),
+                "blocks": len(blocks), "bytes": int(nbytes),
+                "export_s": round(time.perf_counter() - t0, 4)}
+        _flight.default_recorder().record_event("prefix_export", **info)
+        return info
+
+    def _import_prefix_cache(self, root: str) -> None:
+        """Construction-time warm restart: walk export versions newest
+        first, skip anything that fails manifest validation or does not
+        match this engine's fingerprint (counted on
+        ``serving.prefix_import_skipped_corrupt`` + a flight event —
+        NEVER loaded), and rebuild the index from the first valid one:
+        every entry re-pins a freshly allocated block through
+        ``_alloc_block`` (rc==1 ≡ one index reference; blocksan's
+        ledger sees every draw) and the exported KV bytes are installed
+        into the zero-initialized pools with plain numpy + one
+        device_put per pool array."""
+        from ..distributed.checkpoint import manager as _ckpt
+        skipped = 0
+        for step in reversed(_ckpt.all_steps(root)):
+            path = os.path.join(root, _ckpt.step_dir(step))
+            reason = _ckpt.verify_version(path)
+            if reason is not None:
+                skipped += 1
+                _M_PREFIX_IMPORT_SKIP.inc(reason="corrupt")
+                _flight.default_recorder().record_event(
+                    "prefix_import_skip", step=step, reason=reason)
+                continue
+            try:
+                with open(os.path.join(path, "prefix_index.json")) as f:
+                    index = json.load(f)
+                if index.get("meta") != self._prefix_fingerprint():
+                    skipped += 1
+                    _M_PREFIX_IMPORT_SKIP.inc(reason="mismatch")
+                    _flight.default_recorder().record_event(
+                        "prefix_import_skip", step=step,
+                        reason="engine fingerprint mismatch")
+                    continue
+                n = self._install_prefix_export(path, index)
+            except Exception as e:  # noqa: BLE001 - restart must not die
+                skipped += 1
+                _M_PREFIX_IMPORT_SKIP.inc(reason="unreadable")
+                _flight.default_recorder().record_event(
+                    "prefix_import_skip", step=step,
+                    reason=f"{type(e).__name__}: {e}"[:200])
+                continue
+            self._prefix_import_info = {
+                "step": step, "blocks": n, "skipped_corrupt": skipped}
+            if n:
+                _M_PREFIX_IMPORT.inc(n)
+            _flight.default_recorder().record_event(
+                "prefix_import", step=step, blocks=n, skipped=skipped)
+            # checksum the imported (registered-immutable) blocks as
+            # ground truth — no-op unless blocksan is armed
+            _jaxsan.blocksan_snapshot(self)
+            return
+        if skipped:
+            self._prefix_import_info = {
+                "step": None, "blocks": 0, "skipped_corrupt": skipped}
+
+    def _install_prefix_export(self, path: str, index: dict) -> int:
+        """Rebuild index entries + pool contents from one validated
+        export version.  Returns blocks imported."""
+        data = np.load(os.path.join(path, "prefix_blocks.npz"),
+                       allow_pickle=False)
+        old_ids = [int(b) for b in data["block_ids"]]
+        pos = {b: i for i, b in enumerate(old_ids)}
+        mapping: dict = {}
+
+        def alloc():
+            if not self.free_blocks:
+                return None
+            return self._alloc_block()
+
+        def assign(old, new):
+            mapping[old] = new
+
+        n = self.prefix.import_state(index, alloc, assign)
+        if not mapping:
+            return 0
+
+        def install(pools, prefix, sharded):
+            out = []
+            for li, (kk, vv) in enumerate(pools):
+                hk = np.zeros(kk.shape, np.asarray(kk).dtype)
+                hv = np.zeros(vv.shape, hk.dtype)
+                src_k = data[f"{prefix}k{li}"]
+                src_v = data[f"{prefix}v{li}"]
+                for old, new in mapping.items():
+                    hk[:, new] = src_k[:, pos[old]]
+                    hv[:, new] = src_v[:, pos[old]]
+                jk, jv = jnp.asarray(hk), jnp.asarray(hv)
+                if self._tp_mesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    from . import tp as _tp
+                    spec = _tp.pool_spec() if sharded else PartitionSpec()
+                    jk = jax.device_put(
+                        jk, NamedSharding(self._tp_mesh, spec))
+                    jv = jax.device_put(
+                        jv, NamedSharding(self._tp_mesh, spec))
+                out.append((jk, jv))
+            return out
+
+        self.pools = install(self.pools, "", sharded=True)
+        if self.dpools is not None:
+            self.dpools = install(self.dpools, "d", sharded=False)
+        return n
 
     def _mark_ready(self) -> None:
         """Admission is open and (when configured) warmup has run: the
@@ -2779,11 +3482,22 @@ class ServingEngine:
     def health(self) -> dict:
         """The /healthz readiness document (observability/http.py): 503
         `{"ready": false, "reason": "warmup"}` until run()/
-        serve_forever() completed warmup and opened admission, then the
+        serve_forever() completed warmup and opened admission, 503
+        `{"ready": false, "reason": "draining"}` (with live
+        in-flight/waiting counts) once a drain was requested, then the
         warmup / queue-depth / uptime evidence.  Reads only host-side
         scheduler ints — safe from the endpoint's handler threads."""
         if not self._ready:
             return {"ready": False, "reason": "warmup"}
+        if self._draining or self._drain_requested:
+            running = self.B - len(self.free_slots)
+            doc = {"ready": False, "reason": "draining",
+                   "in_flight": running, "waiting": len(self.waiting),
+                   "prefilling": len(self.prefilling)}
+            if self._drain_info is not None:
+                doc["drained"] = True
+                doc["drained_s"] = self._drain_info["drained_s"]
+            return doc
         running = self.B - len(self.free_slots)
         doc = {"ready": True, "running": running,
                "waiting": len(self.waiting),
@@ -2816,7 +3530,13 @@ class ServingEngine:
                "prefill_chunk": self.chunk,
                "prefilling": len(self.prefilling),
                "prefill_chunks": self.prefill_chunks_total,
-               "slo_sheds": self.slo_sheds}
+               "slo_sheds": self.slo_sheds,
+               "tick_errors": self.tick_errors,
+               "poisoned_requests": self.poisoned_requests,
+               "dispatch_retries": self.dispatch_retries,
+               "draining": bool(self._draining or self._drain_requested)}
+        if self._drain_info is not None:
+            out["drain"] = dict(self._drain_info)
         if self.spec:
             per_slot = {
                 slot: round(r._spec_accepted / r._spec_proposed, 4)
@@ -2848,6 +3568,9 @@ class ServingEngine:
                 "blocks_shared": self.prefix.blocks_shared,
                 "evictions": self.prefix.evictions,
                 "reclaimable_blocks": reclaimable}
+            if self._prefix_import_info is not None:
+                out["prefix_cache"]["import"] = \
+                    dict(self._prefix_import_info)
         if self._warmup_info is not None:
             out["warmup"] = {k: self._warmup_info[k] for k in
                              ("warmup_s", "programs", "aot_programs")}

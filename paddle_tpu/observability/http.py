@@ -20,12 +20,23 @@ Endpoints:
   ``timeout_s``) enqueues a request into the :func:`attach_engine`'d
   serving engine and answers a Server-Sent Events token stream —
   ``data: {"token": id}`` per emitted token, a terminal ``event: done``
-  with the full output, ``event: error`` on timeout/shed.  The handler
-  thread never touches device state: it enqueues, then drains the
-  request's token queue fed by the engine loop's harvests.  A client
-  disconnect (the keepalive ping write fails) or ``timeout_s`` expiry
-  calls ``Request.cancel()``, which the engine's next scheduler
-  boundary turns into slot eviction + block release.
+  with the full output for finished/cancelled requests, and (ISSUE 15)
+  a terminal ``event: error`` frame ``data: {"rid", "reason",
+  "output_ids"}`` when the request ends
+  ``outcome=error|poisoned|slo_shed|drained`` — a stream never just
+  closes silently.  The handler thread never touches device state: it
+  enqueues, then drains the request's token queue fed by the engine
+  loop's harvests.  A client disconnect (the keepalive ping write
+  fails) or ``timeout_s`` expiry calls ``Request.cancel()``, which the
+  engine's next scheduler boundary turns into slot eviction + block
+  release.
+* ``POST /drain`` — graceful-drain trigger (ISSUE 15): flips the
+  attached engine's drain request flag (the `serve_forever` loop picks
+  it up at its next boundary: admission closes, /healthz answers 503
+  ``{"reason": "draining"}``, in-flight requests finish up to
+  ``FLAGS_serving_drain_timeout_s``, the waiting queue is cancelled
+  with SSE error frames, and the prefix cache exports).  Answers 202
+  immediately — the drain itself runs on the engine loop thread.
 
 Security: binds ``FLAGS_metrics_host`` (default ``127.0.0.1`` — the
 endpoint exposes operational data, so exposure beyond the host must be
@@ -114,13 +125,28 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 - http.server API
         try:
             url = urlparse(self.path)
-            if url.path != "/generate":
+            if url.path == "/generate":
+                self._generate()
+            elif url.path == "/drain":
+                self._drain()
+            else:
                 self._send(404, "text/plain; charset=utf-8",
-                           b"not found; POST endpoint: /generate\n")
-                return
-            self._generate()
+                           b"not found; POST endpoints: /generate "
+                           b"/drain\n")
         except (BrokenPipeError, ConnectionResetError):
             pass  # client hung up; _generate already propagated cancel
+
+    def _drain(self) -> None:
+        eng = current_engine()
+        if eng is None:
+            self._send(503, "application/json",
+                       b'{"error": "no serving engine attached"}')
+            return
+        eng.request_drain()
+        self._send(202, "application/json", json.dumps(
+            {"draining": True,
+             "running": eng.B - len(eng.free_slots),
+             "waiting": len(eng.waiting)}).encode())
 
     def _sse(self, payload: dict, event: Optional[str] = None) -> None:
         head = f"event: {event}\n" if event else ""
@@ -188,12 +214,22 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.flush()
                     continue
                 if tok is None:         # terminal sentinel
-                    outcome = ("finished" if req.done else
-                               "rejected:slo_shed" if req.shed else
-                               "cancelled")
-                    self._sse({"rid": req.rid, "outcome": outcome,
-                               "output_ids": list(req.output_ids)},
-                              event="done")
+                    outcome = req.outcome or (
+                        "finished" if req.done else
+                        "slo_shed" if req.shed else "cancelled")
+                    if outcome in ("error", "poisoned", "slo_shed",
+                                   "drained"):
+                        # the engine ended the stream, not the client:
+                        # a terminal error frame names WHY instead of
+                        # silently closing (ISSUE 15 contract — format
+                        # pinned in test_continuous_batching)
+                        self._sse({"rid": req.rid, "reason": outcome,
+                                   "output_ids": list(req.output_ids)},
+                                  event="error")
+                    else:
+                        self._sse({"rid": req.rid, "outcome": outcome,
+                                   "output_ids": list(req.output_ids)},
+                                  event="done")
                     return
                 self._sse({"token": int(tok), "n": i})
                 i += 1

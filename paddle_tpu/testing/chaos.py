@@ -14,9 +14,26 @@ production cost is nil.  Tests arm faults through context managers:
 * :func:`fail_at` — the Nth :func:`inject(site)` call raises (transient
   dataset / network error at an arbitrary instrumented site).
 * :func:`flip_bytes` / :func:`truncate_file` — immediate post-write
-  corruption of a file on disk (bit rot / torn tail).
+  corruption of a file on disk (bit rot / torn tail; also the
+  export-file corruption lever for the serving prefix-cache restart
+  path — the manifest re-hash must catch either).
 * :func:`run_to_step_and_kill` — spawn a subprocess and deliver a signal
   the moment it prints ``STEP <n>`` (kill-at-step-N for resume tests).
+
+Serving chaos (ISSUE 15) rides the same site pattern:
+
+* :func:`fail_at` on the serving dispatch sites
+  (``serving.prefill.dispatch`` / ``serving.tick.dispatch``) injects a
+  dispatch failure on the Nth program call.
+* :func:`nan_logits` — arm non-finite logits for specific slots and/or
+  request ids; the engine consults :func:`nan_payload` at the points it
+  holds host logits (prefill row, host-sampling decode rows) and
+  corrupts the armed rows, simulating a NaN-producing forward the
+  flight-recorder watchdog then detects.
+* :func:`delay_at` / :func:`maybe_delay` — stall an instrumented site
+  (``serving.harvest``) for a fixed number of seconds: the
+  deterministic "hung block_until_ready" the tick watchdog
+  (``FLAGS_serving_tick_timeout_s``) must catch.
 
 Everything is counted: each armed fault records how often it fired so a
 test can assert the injection actually happened.
@@ -29,6 +46,7 @@ import os
 import signal
 import subprocess
 import threading
+import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -36,6 +54,7 @@ __all__ = [
     "checked_open", "inject", "active_faults",
     "truncate_writes", "fail_open", "fail_at",
     "flip_bytes", "truncate_file", "run_to_step_and_kill",
+    "nan_logits", "nan_payload", "delay_at", "maybe_delay",
 ]
 
 _lock = threading.Lock()
@@ -67,10 +86,13 @@ class Fault:
 
 _open_faults: List[Fault] = []
 _site_faults: Dict[str, Fault] = {}
+_nan_faults: List[Fault] = []
+_delay_faults: Dict[str, Fault] = {}
 
 
 def active_faults() -> int:
-    return len(_open_faults) + len(_site_faults)
+    return (len(_open_faults) + len(_site_faults) + len(_nan_faults)
+            + len(_delay_faults))
 
 
 class _TruncatingFile:
@@ -208,6 +230,74 @@ def fail_at(site: str, on_calls: Optional[Sequence[int]] = None,
     finally:
         with _lock:
             _site_faults.pop(site, None)
+
+
+@contextmanager
+def nan_logits(site: str = "", slots: Sequence[int] = (),
+               rids: Sequence[int] = (),
+               on_calls: Optional[Sequence[int]] = None):
+    """Arm non-finite logits for the given slots and/or request ids at
+    ``site`` ('' matches every site).  The engine's host-logits screens
+    call :func:`nan_payload` and corrupt a matching row in place — the
+    deterministic stand-in for a NaN-producing forward."""
+    fault = Fault("nan", site, 0, on_calls)
+    fault.slots = set(int(s) for s in slots)
+    fault.rids = set(int(r) for r in rids)
+    with _lock:
+        _nan_faults.append(fault)
+    try:
+        yield fault
+    finally:
+        with _lock:
+            _nan_faults.remove(fault)
+
+
+def nan_payload(site: str, slot: Optional[int] = None,
+                rid: Optional[int] = None) -> bool:
+    """Should the caller's host logits row for (slot, rid) go
+    non-finite?  One truthiness check when nothing is armed."""
+    if not _nan_faults:
+        return False
+    with _lock:
+        for fault in _nan_faults:
+            if fault.match and fault.match != site:
+                continue
+            if (slot in fault.slots) or (rid in fault.rids):
+                if fault.should_fire():
+                    return True
+    return False
+
+
+@contextmanager
+def delay_at(site: str, seconds: float,
+             on_calls: Optional[Sequence[int]] = None):
+    """Arm a wall-clock stall at an instrumented :func:`maybe_delay`
+    site (e.g. ``serving.harvest``) — the deterministic hung-device
+    injection the serving tick watchdog must detect."""
+    fault = Fault("delay", site, 0, on_calls)
+    fault.seconds = float(seconds)
+    with _lock:
+        if site in _delay_faults:
+            raise RuntimeError(f"chaos: delay site {site!r} already armed")
+        _delay_faults[site] = fault
+    try:
+        yield fault
+    finally:
+        with _lock:
+            _delay_faults.pop(site, None)
+
+
+def maybe_delay(site: str) -> None:
+    """Sleep at an instrumented site if a delay fault is armed (a plain
+    dict truthiness check otherwise)."""
+    if not _delay_faults:
+        return
+    with _lock:
+        fault = _delay_faults.get(site)
+        fire = fault is not None and fault.should_fire()
+        seconds = fault.seconds if fire else 0.0
+    if fire:
+        time.sleep(seconds)
 
 
 def flip_bytes(path: str, offset: int, count: int = 1,

@@ -277,6 +277,43 @@ def test_serving_tp_rung_schema():
     assert val["ttft_p50_ms_tp1"] > 0 and val["ttft_p50_ms_tp2"] > 0
 
 
+@pytest.mark.slow   # warms ~a dozen engine grids (donor + cold/restored
+                    # per rep) — too heavy for the tier-1 budget
+def test_serving_restart_rung_schema():
+    """Pin the ISSUE 15 `serving_restart` rung's record schema: one
+    donor engine drains + exports its prefix cache, then cold vs
+    import-restored engines answer the same shared-system-prompt
+    request — `restart_ttft_speedup` (regression key) with the
+    restored stream BIT-matching the donor's prefix-hit path."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_restart", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_serving_restart(ctx)
+    rec = {"rung": "serving_restart", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("serving_restart").smoke
+    assert bench._REGRESSION_KEYS["serving_restart"] == \
+        "restart_ttft_speedup"
+    # the two acceptance claims: a warm restart really skips prefill
+    # work, and it NEVER changes tokens
+    assert val["restored_stream_bitmatch"] is True
+    assert val["restart_ttft_speedup"] > 1.0
+    assert val["imported_blocks"] == val["export_blocks"] > 0
+    assert val["import_skipped_corrupt"] == 0
+    assert val["cold_ttft_ms_p50"] > val["restored_ttft_ms_p50"] > 0
+    assert val["export_bytes"] > 0 and val["export_s"] >= 0
+
+
 @pytest.mark.slow   # the subprocess compiles ~nine engine configs —
                     # too heavy for the tier-1 budget; full runs cover it
 def test_spec_decode_rung_schema():

@@ -408,6 +408,79 @@ def test_serving_http_flag_gate():
         assert obs_http.start_serving_from_flags() is None
 
 
+def test_sse_terminal_error_frame_format(model):
+    """ISSUE 15 satellite pin: a stream the ENGINE ends (outcome=
+    error|poisoned|slo_shed|drained) closes with a terminal
+    ``event: error`` frame — exactly ``{"rid", "reason",
+    "output_ids"}`` — instead of silently closing; a stream that
+    finishes keeps the ``event: done`` frame.  Driven through a drain:
+    request A (admitted) finishes in-flight with `done`, request B
+    (waiting behind A's slot) is cancelled ``reason=drained``; POST
+    /drain answers 202 and /healthz flips to 503 draining."""
+    import http.client
+
+    eng = ServingEngine(model, max_batch=1, max_context=64, block_size=16)
+    stop = threading.Event()
+    obs_http.attach_engine(eng)
+    srv = obs_http.MetricsServer(0, "127.0.0.1")
+    t = threading.Thread(target=eng.serve_forever, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        conn_a = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                            timeout=60)
+        conn_a.request("POST", "/generate", body=json.dumps(
+            {"prompt_ids": [1, 2, 3], "max_new_tokens": 24}),
+            headers={"Content-Type": "application/json"})
+        resp_a = conn_a.getresponse()
+        assert resp_a.status == 200
+        events_a = _sse_events(resp_a)
+        first = next(d for ev, d in events_a if ev is None)
+        assert "token" in first          # A is admitted and streaming
+        conn_b = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                            timeout=60)
+        conn_b.request("POST", "/generate", body=json.dumps(
+            {"prompt_ids": [4, 5, 6], "max_new_tokens": 4}),
+            headers={"Content-Type": "application/json"})
+        resp_b = conn_b.getresponse()
+        assert resp_b.status == 200      # enqueued behind A's slot
+        conn_d = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                            timeout=60)
+        conn_d.request("POST", "/drain")
+        resp_d = conn_d.getresponse()
+        assert resp_d.status == 202
+        assert json.loads(resp_d.read())["draining"] is True
+        conn_d.close()
+        # B never admitted: terminal error frame, format pinned
+        ev_b, frame_b = next((e, d) for e, d in _sse_events(resp_b)
+                             if e is not None)
+        conn_b.close()
+        assert ev_b == "error"
+        assert frame_b == {"rid": frame_b["rid"], "reason": "drained",
+                           "output_ids": []}
+        assert set(frame_b) == {"rid", "reason", "output_ids"}
+        # A finishes in-flight inside the drain deadline: done frame
+        done_a = next(d for ev, d in events_a if ev == "done")
+        conn_a.close()
+        assert done_a["outcome"] == "finished"
+        assert len(done_a["output_ids"]) == 24
+        # the drained engine reports 503 draining on /healthz
+        conn_h = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                            timeout=60)
+        conn_h.request("GET", "/healthz")
+        resp_h = conn_h.getresponse()
+        doc = json.loads(resp_h.read())
+        conn_h.close()
+        assert resp_h.status == 503 and doc["reason"] == "draining"
+        t.join(timeout=30)               # drain() returns the loop
+        assert not t.is_alive()
+        assert eng.stats()["free_blocks"] == eng.num_blocks
+    finally:
+        stop.set()
+        obs_http.attach_engine(None)
+        srv.close()
+
+
 # ----------------------------------------------- heavy composition pins
 
 @pytest.mark.slow   # compiles a TP program grid — full runs cover it
