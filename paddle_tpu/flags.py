@@ -509,3 +509,23 @@ define_flag("serving_overlap",  True,
             "forward) BEFORE harvesting/detokenizing tick t, overlapping "
             "device compute with host admission/harvest work; 0 keeps "
             "the synchronous dispatch-then-harvest loop")
+define_flag("fleet_affinity_tokens", 64,
+            "prefix length (tokens) the fleet router hashes for replica "
+            "affinity — the blake2b chain hash of the prompt's first "
+            "fleet_affinity_tokens tokens (the engine prefix cache's "
+            "first-block hash when this matches the engine block_size), "
+            "rendezvous-hashed over the ready replicas so shared-prefix "
+            "traffic lands on the replica whose KV already holds it")
+define_flag("fleet_ttft_budget_ms", 0.0,
+            "router-side admission budget: a request whose PREDICTED "
+            "time-to-first-token (queue-position model over the "
+            "replica's /healthz ttft_evidence) exceeds this on every "
+            "ready replica is shed at the router with 429 before any "
+            "engine queues it.  0 disables predictive shedding")
+define_flag("fleet_poll_interval_s", 0.25,
+            "fleet router health-poll cadence: how often each replica's "
+            "/healthz readiness + queue depth + TTFT evidence is "
+            "refreshed on the router's poller thread")
+define_flag("fleet_router_port", 0,
+            "fleet router bind port for `flight route` (127.0.0.1 only "
+            "— the route accepts work); 0 binds an ephemeral port")

@@ -1,0 +1,132 @@
+"""flight — the serving fleet operator CLI.
+
+Subcommands:
+
+``route``
+    Start a :class:`~paddle_tpu.inference.fleet.FleetRouter` in front
+    of running engine replicas and serve until SIGINT::
+
+        python -m paddle_tpu.flight route \\
+            --replica r0=127.0.0.1:8101 --replica r1=127.0.0.1:8102 \\
+            --port 8100 --ttft-budget-ms 500
+
+    ``--demo N`` instead spins up N in-process tiny-model replicas
+    (CPU, loopback) behind the router — the simulated fleet the tests
+    and the ``fleet`` bench rung use, handy for poking the HTTP surface
+    without real deployments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict
+
+
+def _parse_replicas(vals) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for i, v in enumerate(vals or ()):
+        name, eq, addr = v.partition("=")
+        if not eq:
+            name, addr = f"r{i}", v
+        if ":" not in addr:
+            raise SystemExit(f"--replica wants [name=]host:port, got {v!r}")
+        out[name] = addr
+    return out
+
+
+def _demo_fleet(n: int, tmp_root: str, **router_kw):
+    from .framework import random as _random
+    from .inference.fleet import Fleet
+    from .inference.serving import ServingEngine
+    from .models.gpt import GPTForCausalLM, gpt3_tiny
+
+    def factory(export_dir: str) -> ServingEngine:
+        # one model instance PER replica (same seed, identical
+        # weights): concurrent engines must not share a model object —
+        # see inference/fleet/replica.py
+        _random.seed(0)
+        model = GPTForCausalLM(gpt3_tiny())
+        model.eval()
+        return ServingEngine(model, max_batch=2, max_context=64,
+                             block_size=16,
+                             prefix_export_dir=export_dir)
+
+    return Fleet.build(factory, n, tmp_root, **router_kw)
+
+
+def cmd_route(args: argparse.Namespace) -> int:
+    from .inference.fleet import FleetRouter
+
+    fleet = None
+    if args.demo:
+        import tempfile
+        root = tempfile.mkdtemp(prefix="flight-demo-")
+        print(f"starting {args.demo} in-process demo replicas "
+              f"(export root {root}) ...", flush=True)
+        fleet = _demo_fleet(args.demo, root, port=args.port,
+                            affinity_tokens=args.affinity_tokens,
+                            ttft_budget_ms=args.ttft_budget_ms,
+                            poll_interval_s=args.poll_interval_s)
+        router = fleet.router
+    else:
+        replicas = _parse_replicas(args.replica)
+        if not replicas:
+            raise SystemExit("route needs --replica [name=]host:port "
+                             "(repeatable) or --demo N")
+        router = FleetRouter(
+            replicas, port=args.port,
+            affinity_tokens=args.affinity_tokens,
+            ttft_budget_ms=args.ttft_budget_ms,
+            poll_interval_s=args.poll_interval_s)
+    print(f"fleet router listening on {router.url}", flush=True)
+    print(json.dumps(router.describe(), indent=2), flush=True)
+    try:
+        while True:
+            time.sleep(10.0)
+            stats = router.stats()
+            if stats["routed"]:
+                print(json.dumps(stats), flush=True)
+    except KeyboardInterrupt:
+        print("\nshutting down ...", flush=True)
+    finally:
+        if fleet is not None:
+            fleet.close()
+        else:
+            router.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.flight",
+        description="serving fleet operator CLI")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("route", help="run a prefix-affinity fleet router")
+    r.add_argument("--replica", action="append", metavar="[NAME=]HOST:PORT",
+                   help="engine replica frontend (repeatable)")
+    r.add_argument("--port", type=int, default=None,
+                   help="router port (default FLAGS_fleet_router_port; "
+                        "0 = ephemeral)")
+    r.add_argument("--affinity-tokens", type=int, default=None,
+                   help="prompt tokens hashed into the affinity key "
+                        "(default FLAGS_fleet_affinity_tokens)")
+    r.add_argument("--ttft-budget-ms", type=float, default=None,
+                   help="shed 429 when every replica predicts TTFT over "
+                        "this budget (0 disables; default "
+                        "FLAGS_fleet_ttft_budget_ms)")
+    r.add_argument("--poll-interval-s", type=float, default=None,
+                   help="healthz poll cadence (default "
+                        "FLAGS_fleet_poll_interval_s)")
+    r.add_argument("--demo", type=int, default=0, metavar="N",
+                   help="spin up N in-process tiny-model replicas instead "
+                        "of external --replica targets")
+    r.set_defaults(fn=cmd_route)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,9 +141,19 @@ def next_key():
 
 
 def seed(value: int):
-    """Reset the global generator, like paddle.seed."""
+    """Reset the global generator, like paddle.seed.
+
+    Also seeds the global numpy RNG: the DataLoader samplers
+    (``io.RandomSampler`` / ``io.WeightedRandomSampler``) draw their
+    shuffle permutations from it, and the hapi resume machinery
+    snapshots/restores that same global state for bit-identical
+    mid-epoch continuation — so ``paddle.seed`` must pin it or batch
+    order (and anything gated on it, like marginal accuracy
+    assertions) differs between otherwise identical processes."""
     global _global_source
     _global_source = StatefulKeySource(int(value))
+    import numpy as np
+    np.random.seed(int(value) & 0xFFFFFFFF)
     return _global_source
 
 

@@ -1,0 +1,36 @@
+"""Replica fleet (ISSUE 16): the layer above one serving engine.
+
+PR 15 made a single engine crash-only — drain exports the prefix-cache
+KV as an atomic bundle, a fresh engine imports it warm.  This package
+turns that transport primitive into the standard production topology:
+
+* :mod:`.router` — an HTTP router process load-balancing
+  ``POST /generate`` (SSE streaming passthrough) across N engine
+  replicas by blake2b **prefix-hash affinity** (the same chain hash the
+  engines' prefix caches key on, rendezvous-hashed over the ready
+  replicas), consuming each replica's ``/healthz`` readiness + queue
+  depth + TTFT evidence, and shedding by **predicted** TTFT from a
+  queue-position model instead of waiting for an observed SLO breach.
+* :mod:`.replica` — one engine behind its own loopback frontend, plus
+  the :class:`~.replica.Fleet` orchestration: **rolling restart**
+  (cordon -> drain -> export -> restart -> import -> uncordon, one
+  replica at a time while the router reroutes) with zero dropped
+  requests.
+* :mod:`.handoff` — disaggregated prefill/decode: a prefill engine
+  fills KV blocks, hands the block table + per-layer KV bytes to a
+  decode engine via the export-bundle format; adoption is a refcount
+  transfer (export-side :meth:`release_exported_prefix`, import-side
+  ``_alloc_block`` re-pins) checked by blocksan on both sides —
+  graft-lint R011 makes the pairing structural.
+
+Simulated multi-engine first: in-process replicas behind real HTTP on
+loopback — the same wire surface a multi-host fleet speaks, minus the
+network.  CLI: ``python -m paddle_tpu.flight route`` (README quickstart).
+"""
+
+from .handoff import DisaggregatedPair, hand_off  # noqa: F401
+from .replica import Fleet, Replica  # noqa: F401
+from .router import FleetRouter, affinity_key, predict_ttft_s  # noqa: F401
+
+__all__ = ["FleetRouter", "affinity_key", "predict_ttft_s",
+           "Replica", "Fleet", "hand_off", "DisaggregatedPair"]

@@ -1,0 +1,121 @@
+"""Disaggregated prefill/decode handoff (ISSUE 16 tentpole b).
+
+Prefill and decode want different hardware shapes: prefill is one big
+compute-bound pass, decode is thousands of tiny bandwidth-bound ticks.
+Running them on separate engine pools lets each pool batch its own kind
+of work — but only if the KV the prefill engine just produced can move
+to a decode engine without recompute.
+
+:func:`hand_off` is that move, built entirely from the PR 15 export
+bundle: the prefill engine serializes its prefix-cache index + block
+KV (atomic, integrity-checked versions), the decode engine imports the
+newest valid version and re-pins every entry through its own
+``_alloc_block``.  Ownership is a **refcount transfer**, not a copy
+that leaves two owners: the export side calls
+:meth:`~...inference.serving.ServingEngine.release_exported_prefix` so
+the serialized blocks return to its free pool, and blocksan verifies
+the ledger on BOTH sides.  graft-lint rule R011 makes that pairing
+structural — an export+import site that skips the release or the
+verification fails lint, not production.
+
+:class:`DisaggregatedPair` is the minimal two-pool topology: prefill
+engine fills blocks (a 1-token generation caches the whole prompt),
+the bundle moves, and the decode engine's own prefix hit turns the
+"re-prefill" into a suffix-only step over already-adopted KV.  The
+tier-1 test asserts the disaggregated greedy stream bit-matches the
+single-engine stream.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+from ...observability import flight_recorder as _flight
+from ...testing import jaxsan as _jaxsan
+from ..serving import Request
+
+__all__ = ["hand_off", "DisaggregatedPair"]
+
+
+def hand_off(src, dst, root: str) -> dict:
+    """Move prefix-cache KV ownership ``src`` -> ``dst`` via an export
+    bundle under ``root``.  Returns a report:
+
+    ``{"exported": {...}, "released_blocks": n, "imported": {...}}``
+
+    The three legs are ordered so no moment has zero owners of live
+    bytes and no steady state has two: export serializes while src
+    still owns the blocks; release drops src's index pins (blocks a
+    running src request still references stay put — releasing them
+    would free KV under a live slot); import re-pins everything in
+    dst's own refcount ledger.  blocksan verifies both sides.
+    """
+    exported = src.export_prefix_cache(root)
+    released = src.release_exported_prefix()
+    dst._import_prefix_cache(root)
+    _jaxsan.blocksan_verify(dst)
+    report = {
+        "exported": exported,
+        "released_blocks": int(released),
+        "imported": dict(dst._prefix_import_info or {}),
+    }
+    _flight.default_recorder().record_event(
+        "prefix_handoff",
+        blocks=int(exported.get("blocks", 0)),
+        released=int(released))
+    return report
+
+
+class DisaggregatedPair:
+    """A prefill engine + a decode engine joined by :func:`hand_off`.
+
+    Both engines must be built from the same weights/config (the import
+    fingerprint rejects mismatches).  ``root`` holds the handoff
+    bundles; each :meth:`generate` writes a fresh export version under
+    it and the decode side imports the newest."""
+
+    def __init__(self, prefill_engine, decode_engine, root: str):
+        self.prefill = prefill_engine
+        self.decode = decode_engine
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.handoffs = 0
+        self.last_report: Optional[dict] = None
+
+    @staticmethod
+    def _run(engine, req: Request, timeout_s: float = 120.0) -> None:
+        engine.add_request(req)
+        deadline = time.monotonic() + timeout_s
+        while not req.done:
+            if not engine.step():
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("disaggregated request timed out")
+
+    def generate(self, prompt_ids, max_new_tokens: int = 32,
+                 **req_kw) -> List[int]:
+        """Prefill on one engine, decode on the other.
+
+        The prefill leg is a ``max_new_tokens=1`` generation: admission
+        runs the full-prompt prefill, caches every complete block in
+        the prefix cache, and stops.  After the handoff the decode
+        engine's admission sees a prefix hit over the adopted blocks,
+        prefills only the uncached suffix, and decodes the stream.
+        Returns the decode engine's ``output_ids`` (greedy streams
+        bit-match the single-engine run)."""
+        ids = [int(t) for t in prompt_ids]
+        pre = Request(ids, max_new_tokens=1, **req_kw)
+        self._run(self.prefill, pre)
+        if pre.outcome not in (None, "finished"):
+            raise RuntimeError(
+                f"prefill leg ended '{pre.outcome}' (rid={pre.rid})")
+        self.last_report = hand_off(self.prefill, self.decode, self.root)
+        self.handoffs += 1
+        dec = Request(ids, max_new_tokens=max_new_tokens, **req_kw)
+        self._run(self.decode, dec)
+        if dec.outcome not in (None, "finished"):
+            raise RuntimeError(
+                f"decode leg ended '{dec.outcome}' (rid={dec.rid})")
+        return list(dec.output_ids)

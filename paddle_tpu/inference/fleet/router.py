@@ -1,0 +1,477 @@
+"""Prefix-affinity fleet router (ISSUE 16 tentpole a).
+
+One stdlib HTTP daemon in front of N engine replicas:
+
+* ``POST /generate`` — routed by **prefix-hash affinity**: the blake2b
+  chain hash of the prompt's first ``FLAGS_fleet_affinity_tokens``
+  tokens (:func:`affinity_key` — the SAME hash the engines' prefix
+  caches chain, so when it matches the engine block size the key IS the
+  first-block hash), rendezvous-hashed over the replicas.  Shared-prefix
+  traffic therefore lands on the replica whose KV pool already holds
+  that prefix; when a replica drains or dies the rendezvous order
+  reroutes ONLY its share, and routes it back after restart.  The
+  response is a byte-faithful SSE passthrough — the router never parses
+  the token stream, it pumps bytes and propagates disconnects both ways.
+* shedding by **predicted TTFT**: each replica's ``/healthz`` carries
+  queue depth + ``ttft_evidence`` (admission rate, recent median TTFT —
+  serving.py keeps these always-on).  :func:`predict_ttft_s` turns that
+  into the TTFT a request would see if routed there NOW (queue-position
+  model: position/admission-rate + base).  With
+  ``FLAGS_fleet_ttft_budget_ms`` set, a request every ready replica
+  predicts over budget is answered 429 at the router — before any
+  engine queues it into a certain SLO violation.  This replaces the
+  observed-breach shedding of PR 11 at the fleet layer: by the time a
+  p99 sketch shows the breach, the queue that caused it is already
+  serving violations.
+* failover: a connect/dispatch failure on the chosen replica (chaos
+  site ``fleet.proxy.connect``) marks it down and retries the next
+  candidate in rendezvous order — the zero-dropped-requests mechanic
+  the rolling-restart drill (replica.py) leans on.
+* ``GET /healthz`` (router's own: ready iff any replica is) and
+  ``GET /fleet`` (routing table: per-replica readiness, queue depth,
+  predicted TTFT, cordon state, route counts).
+
+The router holds no device state and no tokens — it is restartable at
+any moment and horizontally dumb on purpose; all KV locality lives in
+the affinity function.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ... import flags as _flags
+from ...observability import metrics as _metrics
+from ...testing import chaos as _chaos
+from ..prefix_cache import _chain
+
+__all__ = ["FleetRouter", "affinity_key", "predict_ttft_s",
+           "rendezvous_order"]
+
+_M_ROUTED = _metrics.counter(
+    "fleet.router.requests", "requests proxied to a replica, by "
+    "replica=<name>")
+_M_AFFINITY = _metrics.counter(
+    "fleet.router.affinity", "affinity routing outcomes: outcome=hit "
+    "(request landed on its rendezvous home replica) or outcome="
+    "fallback (home not ready / over budget — rerouted)")
+_M_SHEDS = _metrics.counter(
+    "fleet.router.sheds", "requests shed 429 at the router because "
+    "every ready replica's PREDICTED TTFT (queue-position model) "
+    "exceeded FLAGS_fleet_ttft_budget_ms")
+_M_FAILOVERS = _metrics.counter(
+    "fleet.router.failovers", "proxy attempts that failed over to the "
+    "next replica in rendezvous order (connect failure or 503)")
+_M_UNROUTABLE = _metrics.counter(
+    "fleet.router.unroutable", "requests answered 503: no ready "
+    "replica accepted the proxy attempt")
+
+
+def affinity_key(prompt_ids: Sequence[int],
+                 affinity_tokens: Optional[int] = None) -> bytes:
+    """The prompt's routing key: blake2b chain hash (prefix_cache's
+    ``_chain``, empty parent) of its first ``affinity_tokens`` tokens —
+    prompts sharing that prefix share the key, and when
+    ``affinity_tokens`` equals the engine block size the key is
+    bit-identical to the prefix cache's first-block hash."""
+    if affinity_tokens is None:
+        affinity_tokens = int(_flags.get_flag("fleet_affinity_tokens"))
+    return _chain(b"", list(prompt_ids[:max(int(affinity_tokens), 1)]))
+
+
+def rendezvous_order(key: bytes, names: Sequence[str]) -> List[str]:
+    """Highest-random-weight order of ``names`` for ``key``: stable
+    under membership change (a leaving replica reroutes ONLY its own
+    keys; everyone else's affinity survives), no ring state."""
+    def weight(name: str) -> Tuple[bytes, str]:
+        h = hashlib.blake2b(key, digest_size=8)
+        h.update(name.encode())
+        return (h.digest(), name)
+    return sorted(names, key=weight, reverse=True)
+
+
+def predict_ttft_s(doc: dict) -> float:
+    """Queue-position TTFT model over one replica's /healthz document:
+    the TTFT a request routed there NOW should see.
+
+    ``position`` requests must admit first (everything waiting, plus
+    one slot-holder finishing when no slot is free); each costs
+    ``1/admit_rate`` seconds of queue wait at the replica's recent
+    admission rate, then the request itself pays the recent median
+    TTFT.  With no rate evidence each queued request is costed at one
+    base TTFT.  A cold replica (no evidence at all) predicts ~0 — the
+    shed gate never starves an idle fleet."""
+    ev = doc.get("ttft_evidence") or {}
+    base = float(ev.get("ttft_p50_s") or 0.0)
+    rate = float(ev.get("admit_rate_per_s") or 0.0)
+    position = int(doc.get("waiting", 0) or 0)
+    if int(doc.get("free_slots", 1) or 0) <= 0:
+        position += 1
+    queue_wait = position / rate if rate > 0 else position * base
+    return base + queue_wait
+
+
+class _ReplicaState:
+    """The router's last-polled view of one replica."""
+
+    __slots__ = ("name", "host", "port", "doc", "ready", "cordoned",
+                 "last_poll", "last_err", "routed")
+
+    def __init__(self, name: str, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.name = name
+        self.host = host or "127.0.0.1"
+        self.port = int(port)
+        self.doc: dict = {}
+        self.ready = False
+        self.cordoned = False
+        self.last_poll = 0.0
+        self.last_err: Optional[str] = None
+        self.routed = 0
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def view(self) -> dict:
+        return {"addr": self.addr, "ready": self.ready,
+                "cordoned": self.cordoned, "routed": self.routed,
+                "queue_depth": int(self.doc.get("queue_depth", 0) or 0),
+                "predicted_ttft_ms": round(
+                    predict_ttft_s(self.doc) * 1e3, 3),
+                "last_err": self.last_err}
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "paddle_tpu_fleet/1.0"
+    # self.server.router is the owning FleetRouter
+
+    def log_message(self, format, *args):  # noqa: A002 - http.server API
+        pass
+
+    def _send(self, code: int, body: dict) -> None:
+        raw = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        try:
+            router = self.server.router
+            if self.path.startswith("/healthz"):
+                doc = router.healthz()
+                self._send(200 if doc["ready"] else 503, doc)
+            elif self.path.startswith("/fleet"):
+                self._send(200, router.describe())
+            else:
+                self._send(404, {"error": "endpoints: /healthz /fleet"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        try:
+            if self.path.startswith("/generate"):
+                self.server.router._route_generate(self)
+            else:
+                self._send(404, {"error": "POST endpoints: /generate"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class FleetRouter:
+    """The fleet front door.  ``replicas`` maps name -> ``host:port``
+    of an engine replica frontend (observability/http.py surface);
+    ``port=0`` binds an ephemeral loopback port (tests).  A background
+    poller refreshes every replica's /healthz at
+    ``FLAGS_fleet_poll_interval_s``; routing reads the cached view and
+    proxy failures update it inline (a dead replica is routed around
+    immediately, not at the next poll tick)."""
+
+    def __init__(self, replicas: Dict[str, str], port: Optional[int] = None,
+                 affinity_tokens: Optional[int] = None,
+                 ttft_budget_ms: Optional[float] = None,
+                 poll_interval_s: Optional[float] = None,
+                 proxy_timeout_s: float = 30.0,
+                 retry_window_s: float = 5.0):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.affinity_tokens = int(
+            affinity_tokens if affinity_tokens is not None
+            else _flags.get_flag("fleet_affinity_tokens"))
+        self.ttft_budget_ms = float(
+            ttft_budget_ms if ttft_budget_ms is not None
+            else _flags.get_flag("fleet_ttft_budget_ms"))
+        self.poll_interval_s = float(
+            poll_interval_s if poll_interval_s is not None
+            else _flags.get_flag("fleet_poll_interval_s"))
+        self.proxy_timeout_s = float(proxy_timeout_s)
+        self.retry_window_s = float(retry_window_s)
+        self._lock = threading.Lock()
+        self._states = {name: _ReplicaState(name, addr)
+                        for name, addr in replicas.items()}
+        # host-side route accounting (always on, unlike the metrics
+        # registry): the acceptance affinity-hit-rate gate reads these
+        self.routed = 0
+        self.affinity_hits = 0
+        self.fallbacks = 0
+        self.sheds = 0
+        self.failovers = 0
+        self.unroutable = 0
+        self._closed = threading.Event()
+        self.poll_all()
+        if port is None:
+            port = int(_flags.get_flag("fleet_router_port"))
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _RouterHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.router = self
+        self.port = int(self._httpd.server_address[1])
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-router",
+            daemon=True)
+        self._serve_thread.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="fleet-router-poll", daemon=True)
+        self._poll_thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def close(self) -> None:
+        self._closed.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._serve_thread.join(timeout=5)
+        self._poll_thread.join(timeout=5)
+
+    # ------------------------------------------------------- health view
+    def _poll_loop(self) -> None:
+        while not self._closed.wait(self.poll_interval_s):
+            self.poll_all()
+
+    def poll_all(self) -> None:
+        for name in list(self._states):
+            self.poll_once(name)
+
+    def poll_once(self, name: str) -> dict:
+        """Refresh one replica's /healthz view.  A refused/failed probe
+        marks the replica not-ready (routed around) — never raises."""
+        st = self._states[name]
+        doc: dict = {}
+        err: Optional[str] = None
+        try:
+            conn = http.client.HTTPConnection(st.host, st.port,
+                                              timeout=2.0)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                doc = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except (OSError, ValueError) as e:
+            err = f"{type(e).__name__}: {e}"[:120]
+        with self._lock:
+            st.doc = doc
+            st.ready = bool(doc.get("ready"))
+            st.last_err = err
+            st.last_poll = time.monotonic()
+        return doc
+
+    def cordon(self, name: str) -> None:
+        """Stop routing NEW requests to ``name`` (rolling restart takes
+        the replica out BEFORE draining it — no window where the router
+        races the healthz flip)."""
+        with self._lock:
+            self._states[name].cordoned = True
+
+    def uncordon(self, name: str) -> None:
+        with self._lock:
+            self._states[name].cordoned = False
+
+    def healthz(self) -> dict:
+        with self._lock:
+            views = {n: s.view() for n, s in self._states.items()}
+        return {"ok": True, "router": True,
+                "ready": any(v["ready"] and not v["cordoned"]
+                             for v in views.values()),
+                "replicas": views}
+
+    def describe(self) -> dict:
+        doc = self.healthz()
+        doc["stats"] = self.stats()
+        return doc
+
+    def stats(self) -> dict:
+        with self._lock:
+            per = {n: s.routed for n, s in self._states.items()}
+        return {"routed": self.routed, "affinity_hits": self.affinity_hits,
+                "fallbacks": self.fallbacks, "sheds": self.sheds,
+                "failovers": self.failovers, "unroutable": self.unroutable,
+                "affinity_hit_rate": round(
+                    self.affinity_hits / self.routed, 4)
+                if self.routed else None,
+                "per_replica": per}
+
+    # ---------------------------------------------------------- routing
+    def plan(self, prompt_ids: Sequence[int]) -> dict:
+        """The routing decision, sans proxying (unit-testable): the
+        rendezvous home, the try-order over ready+uncordoned replicas
+        (budget-violating candidates dropped when a budget is set), and
+        the per-candidate predicted TTFT.
+
+        The health view is a PREFERENCE, not a verdict: when it says
+        nobody is ready (a poll can time out under load and mark a
+        perfectly alive replica down), the plan degrades to every
+        uncordoned replica in rendezvous order and lets the proxy
+        attempt decide — answering 503 off a stale view would drop
+        requests a replica could serve.  Predictions (and therefore the
+        shed gate) only apply to the ready view; a degraded plan never
+        sheds."""
+        key = affinity_key(prompt_ids, self.affinity_tokens)
+        with self._lock:
+            home_order = rendezvous_order(key, list(self._states))
+            ready = [n for n in home_order
+                     if self._states[n].ready
+                     and not self._states[n].cordoned]
+            uncordoned = [n for n in home_order
+                          if not self._states[n].cordoned]
+            predicted = {n: predict_ttft_s(self._states[n].doc)
+                         for n in ready}
+        home = home_order[0]
+        order = ready
+        shed = False
+        degraded = False
+        if self.ttft_budget_ms > 0 and ready:
+            budget_s = self.ttft_budget_ms / 1e3
+            order = [n for n in ready if predicted[n] <= budget_s]
+            shed = not order
+        if not order and not shed and uncordoned:
+            order = uncordoned
+            degraded = True
+        return {"key": key.hex(), "home": home, "order": order,
+                "ready": ready, "shed": shed, "degraded": degraded,
+                "predicted_ttft_ms": {
+                    n: round(p * 1e3, 3) for n, p in predicted.items()}}
+
+    def _route_generate(self, handler: _RouterHandler) -> None:
+        try:
+            n = int(handler.headers.get("Content-Length") or 0)
+            body = handler.rfile.read(n)
+            prompt_ids = [int(t)
+                          for t in json.loads(body or b"{}")["prompt_ids"]]
+        except (KeyError, TypeError, ValueError) as e:
+            handler._send(400, {"error": f"bad request body: {e!r}"})
+            return
+        plan = self.plan(prompt_ids)
+        if plan["shed"]:
+            self.sheds += 1
+            _M_SHEDS.inc()
+            handler._send(429, {
+                "error": "shed", "reason": "predicted_ttft",
+                "budget_ms": self.ttft_budget_ms,
+                "predicted_ttft_ms": plan["predicted_ttft_ms"]})
+            return
+        # A failed pass over the plan is retried (fresh poll, fresh
+        # plan) within a bounded window before answering 503: mid-
+        # rolling-restart every candidate can be TRANSIENTLY unusable
+        # for a beat (one draining, the next chaos-marked down) and
+        # giving up on that beat drops a request a replica would have
+        # served a poll later.  Shed is never retried — over-budget is
+        # a verdict, not a transient.
+        deadline = time.monotonic() + self.retry_window_s
+        first_pass = True
+        while True:
+            for i, name in enumerate(plan["order"]):
+                st = self._states[name]
+                if i or not first_pass:
+                    self.failovers += 1
+                    _M_FAILOVERS.inc()
+                got = self._proxy_begin(st, body)
+                if got is None:
+                    continue
+                # account BEFORE relaying: the replica has accepted the
+                # request, and a client that finishes reading the stream
+                # must observe the updated stats (the relay can outrun a
+                # post-relay increment)
+                self.routed += 1
+                st.routed += 1
+                _M_ROUTED.inc(replica=name)
+                if name == plan["home"]:
+                    self.affinity_hits += 1
+                    _M_AFFINITY.inc(outcome="hit")
+                else:
+                    self.fallbacks += 1
+                    _M_AFFINITY.inc(outcome="fallback")
+                self._relay(handler, *got)
+                return
+            if time.monotonic() >= deadline:
+                break
+            first_pass = False
+            time.sleep(min(0.05, self.poll_interval_s))
+            self.poll_all()
+            plan = self.plan(prompt_ids)
+        self.unroutable += 1
+        _M_UNROUTABLE.inc()
+        handler._send(503, {"error": "no replica accepted the request",
+                            "tried": plan["order"]})
+
+    def _proxy_begin(self, st: _ReplicaState, body: bytes):
+        """One proxy attempt up to the response line: POST the original
+        body to the replica.  Returns ``(conn, resp)`` once the replica
+        has ACCEPTED the request (any status but 503 — a replica's own
+        400 is authoritative: the request reached an engine); None on a
+        pre-response failure or a 503 (draining/warming — candidate
+        unusable, caller fails over), marking the replica down inline."""
+        conn = None
+        try:
+            _chaos.inject("fleet.proxy.connect")
+            conn = http.client.HTTPConnection(
+                st.host, st.port, timeout=self.proxy_timeout_s)
+            conn.request("POST", "/generate", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+        except OSError as e:
+            if conn is not None:
+                conn.close()
+            with self._lock:
+                st.ready = False
+                st.last_err = f"{type(e).__name__}: {e}"[:120]
+            return None
+        if resp.status == 503:      # draining/warming: next candidate
+            conn.close()
+            with self._lock:
+                st.ready = False
+            return None
+        return conn, resp
+
+    def _relay(self, handler: _RouterHandler, conn, resp) -> None:
+        """Pump the accepted response through byte-for-byte (SSE
+        passthrough — chunks forwarded as they arrive, flushed
+        immediately)."""
+        try:
+            handler.send_response(resp.status)
+            for h in ("Content-Type", "Cache-Control", "Content-Length"):
+                v = resp.headers.get(h)
+                if v is not None:
+                    handler.send_header(h, v)
+            handler.send_header("Connection", "close")
+            handler.end_headers()
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    break
+                handler.wfile.write(chunk)
+                handler.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass    # client hung up; closing upstream propagates cancel
+        finally:
+            conn.close()

@@ -369,6 +369,9 @@ class Request:
         # lifecycle trace timestamps (perf_counter; stamped only while
         # FLAGS_enable_metrics is on — None means "not traced")
         self._t_enqueue: Optional[float] = None
+        # always-on twin of _t_enqueue for the fleet router's TTFT
+        # evidence (/healthz) — NOT part of the tracing surface
+        self._t_enqueue_ev: Optional[float] = None
         self._t_admit: Optional[float] = None
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
@@ -510,7 +513,8 @@ class ServingEngine:
                  spec_adaptive: Optional[bool] = None,
                  spec_k_ladder=None,
                  quant: Optional[str] = None,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 prefix_export_dir: Optional[str] = None):
         # steps_per_tick > 1 compiles a k-step lax.scan per tick so one
         # host round trip harvests k tokens per slot (the tunnel's RTT
         # otherwise caps serving at ~1/RTT steps); admissions join at
@@ -805,6 +809,13 @@ class ServingEngine:
         self._draining = False
         self._drain_requested = False
         self._drain_info: Optional[dict] = None
+        # --- router evidence (ISSUE 16): always-on (independent of the
+        # metrics gate) recent admission timestamps + TTFTs.  /healthz
+        # ships rate + median so the fleet router's queue-position
+        # model can PREDICT a new request's TTFT instead of waiting
+        # for an observed SLO breach.  Host-side floats only.
+        self._admit_times: deque = deque(maxlen=64)
+        self._ttft_recent: deque = deque(maxlen=64)
         self.tick_errors = 0
         self.poisoned_requests = 0
         self.dispatch_retries = 0
@@ -814,11 +825,16 @@ class ServingEngine:
         # fresh blocks through _alloc_block, corrupt versions are
         # skipped with a counter, and a hot system prompt's first
         # admission is then a suffix-only prefill
+        # per-engine override of FLAGS_serving_prefix_export_dir: an
+        # in-process replica fleet (inference/fleet/) gives each engine
+        # its own export/import root, which a process-global flag
+        # cannot express
+        self._export_dir = str(
+            prefix_export_dir if prefix_export_dir is not None
+            else _flags.get_flag("serving_prefix_export_dir"))
         self._prefix_import_info: Optional[dict] = None
-        if self.prefix is not None:
-            export_dir = str(_flags.get_flag("serving_prefix_export_dir"))
-            if export_dir:
-                self._import_prefix_cache(export_dir)
+        if self.prefix is not None and self._export_dir:
+            self._import_prefix_cache(self._export_dir)
 
     # ------------------------------------------------------------ programs
     def _views(self, pools, tables, seq_lens):
@@ -1597,8 +1613,14 @@ class ServingEngine:
                 f"request needs {worst} blocks worst-case but the pool "
                 f"has {self.num_blocks}; raise num_blocks or lower "
                 "max_new_tokens")
+        # two enqueue stamps, deliberately separate: `_t_enqueue` stays
+        # metrics-gated (tracing off really does zero TRACING work —
+        # pinned), while `_t_enqueue_ev` is the always-on router
+        # evidence the /healthz TTFT predictor reads even on engines
+        # running with metrics disabled
         if traced:
             req._t_enqueue = time.perf_counter()
+        req._t_enqueue_ev = time.perf_counter()
         self.waiting.append(req)
         self._update_pressure()
         return req
@@ -2094,6 +2116,15 @@ class ServingEngine:
                 slo = _flags.get_flag("serving_ttft_slo_ms")
                 if slo > 0 and ttft * 1e3 > slo:
                     _M_SLO.inc(metric="ttft")
+        # router evidence (always on, unlike the metrics-gated sketches
+        # above): the /healthz TTFT predictor needs admission rate and
+        # recent TTFTs even on engines running with metrics disabled
+        t_now = req._t_first if req._t_first is not None \
+            else time.perf_counter()
+        self._admit_times.append(t_now)
+        t_enq = getattr(req, "_t_enqueue_ev", None)
+        if t_enq is not None:
+            self._ttft_recent.append(t_now - t_enq)
         req.output_ids.append(first)
         req._stream_push(first)
         req.slot = slot
@@ -3276,7 +3307,7 @@ class ServingEngine:
         # index (no-op unless blocksan is armed)
         _jaxsan.blocksan_verify(self)
         export = None
-        export_dir = str(_flags.get_flag("serving_prefix_export_dir"))
+        export_dir = self._export_dir
         if self.prefix is not None and export_dir:
             try:
                 export = self.export_prefix_cache(export_dir)
@@ -3365,6 +3396,25 @@ class ServingEngine:
                 "export_s": round(time.perf_counter() - t0, 4)}
         _flight.default_recorder().record_event("prefix_export", **info)
         return info
+
+    def release_exported_prefix(self) -> int:
+        """Export-side half of a KV handoff (inference/fleet/handoff.py):
+        drop every index-only prefix entry so the blocks just serialized
+        by :meth:`export_prefix_cache` return to the free pool — the
+        importing engine now owns that KV, adopted through its own
+        ``_alloc_block`` refcounts.  Entries whose block a running
+        request still references are kept (releasing them frees
+        nothing).  Returns blocks freed; graft-lint R011 requires every
+        export+import pairing to call this on the export side."""
+        if self.prefix is None:
+            return 0
+        freed = self.prefix.evict(
+            self.num_blocks, self._release_block,
+            lambda b: int(self.block_rc[b]) == 1)
+        _jaxsan.blocksan_verify(self)
+        _flight.default_recorder().record_event(
+            "prefix_handoff_release", blocks=freed)
+        return freed
 
     def _import_prefix_cache(self, root: str) -> None:
         """Construction-time warm restart: walk export versions newest
@@ -3502,12 +3552,36 @@ class ServingEngine:
         doc = {"ready": True, "running": running,
                "waiting": len(self.waiting),
                "queue_depth": running + len(self.waiting),
+               "slots": self.B,
+               "free_slots": len(self.free_slots),
+               "prefilling": len(self.prefilling),
                "uptime_s": round(
                    time.monotonic() - self._t_serve_start, 3)}
+        # queue-position TTFT evidence for the fleet router's shed
+        # predictor (inference/fleet/router.py): recent admission rate
+        # plus median observed TTFT.  Always-on host floats, not the
+        # metrics-gated sketches.
+        doc["ttft_evidence"] = self._ttft_evidence()
         if self._warmup_info is not None:
             doc["warmup"] = {k: self._warmup_info[k] for k in
                              ("warmup_s", "programs", "aot_programs")}
         return doc
+
+    def _ttft_evidence(self) -> dict:
+        """Admission-rate + recent-TTFT summary for /healthz: the two
+        numbers a queue-position model needs to predict the TTFT a
+        request would see if routed here now."""
+        ev = {"admit_rate_per_s": 0.0, "ttft_p50_s": 0.0,
+              "samples": len(self._ttft_recent)}
+        times = list(self._admit_times)
+        if len(times) >= 2:
+            span = times[-1] - times[0]
+            if span > 0:
+                ev["admit_rate_per_s"] = round((len(times) - 1) / span, 4)
+        if self._ttft_recent:
+            srt = sorted(self._ttft_recent)
+            ev["ttft_p50_s"] = round(srt[len(srt) // 2], 6)
+        return ev
 
     def stats(self) -> dict:
         running = self.B - len(self.free_slots)

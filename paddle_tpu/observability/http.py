@@ -71,6 +71,15 @@ __all__ = ["MetricsServer", "serve", "start_from_flags", "stop",
 class _Handler(BaseHTTPRequestHandler):
     server_version = "paddle_tpu_metrics/1.0"
 
+    def _engine(self):
+        """The serving engine THIS server fronts: the per-server binding
+        (``MetricsServer(engine=...)`` — one frontend per replica in an
+        in-process fleet) wins over the process-global
+        :func:`attach_engine` registration."""
+        ref = getattr(self.server, "_engine_ref", None)
+        eng = ref() if ref is not None else None
+        return eng if eng is not None else current_engine()
+
     def _send(self, code: int, content_type: str, body: bytes) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
@@ -97,7 +106,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # completed and admission opened, then the engine's
                 # warmup/queue-depth/uptime evidence.  With no engine
                 # (training, metrics-only) it stays the liveness check.
-                eng = current_engine()
+                eng = self._engine()
                 if eng is not None:
                     try:
                         doc.update(eng.health())
@@ -137,7 +146,7 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # client hung up; _generate already propagated cancel
 
     def _drain(self) -> None:
-        eng = current_engine()
+        eng = self._engine()
         if eng is None:
             self._send(503, "application/json",
                        b'{"error": "no serving engine attached"}')
@@ -155,7 +164,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     def _generate(self) -> None:
-        eng = current_engine()
+        eng = self._engine()
         if eng is None:
             self._send(503, "application/json",
                        b'{"error": "no serving engine attached"}')
@@ -185,7 +194,17 @@ class _Handler(BaseHTTPRequestHandler):
         req._stream_q = _queue.Queue()
         try:
             eng.add_request(req)
-        except ValueError as e:   # over_context / capacity rejection
+        except ValueError as e:
+            if eng._draining or eng._drain_requested:
+                # NOT the client's fault: this replica is going away.
+                # 503 (not 400) so a fleet router fails the request over
+                # to the next replica instead of relaying a dead end —
+                # the zero-dropped-requests half of a rolling restart.
+                self._send(503, "application/json", json.dumps(
+                    {"error": str(e), "reason": "draining",
+                     "rid": req.rid}).encode())
+                return
+            # over_context / capacity rejection: authoritative
             self._send(400, "application/json", json.dumps(
                 {"error": str(e), "rid": req.rid}).encode())
             return
@@ -242,10 +261,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 class MetricsServer:
     """One running scrape endpoint; ``port`` is the BOUND port (useful
-    when constructed with port 0)."""
+    when constructed with port 0).  ``engine`` binds a specific serving
+    engine to THIS server's /generate, /drain and /healthz routes
+    (weakly, like :func:`attach_engine`) — the per-replica frontend an
+    in-process fleet needs, where the process-global attachment can
+    only name one engine."""
 
-    def __init__(self, port: int, host: str = "127.0.0.1"):
+    def __init__(self, port: int, host: str = "127.0.0.1", engine=None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd._engine_ref = (
+            weakref.ref(engine) if engine is not None else None)
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])
@@ -257,6 +282,13 @@ class MetricsServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    def bind_engine(self, engine) -> None:
+        """Swap the engine behind this server's routes.  A fleet replica
+        keeps ONE frontend for its whole life — the port is the router's
+        stable address — while restarts replace the engine behind it."""
+        self._httpd._engine_ref = (
+            weakref.ref(engine) if engine is not None else None)
 
     def close(self) -> None:
         self._httpd.shutdown()

@@ -66,13 +66,24 @@ def _interpret_default() -> bool:
 
 def _resolve_interpret(interpret, rate):
     """The generic pallas interpreter has no lowering for the TPU PRNG
-    primitives; dropout kernels in interpret mode (CPU CI) run under the
-    TPU-semantics interpreter instead."""
+    primitives; when this jax build ships the TPU-semantics interpreter
+    (``pltpu.InterpretParams``), dropout kernels in interpret mode (CPU
+    CI) run under it.  Older builds don't have it — those fall through
+    to the generic interpreter and the kernels switch to the hash-based
+    mask (see :func:`_dropout_keep`)."""
     if interpret is None:
         interpret = _interpret_default()
-    if interpret is True and rate > 0.0 and _HAS_PLTPU:
+    if (interpret is True and rate > 0.0 and _HAS_PLTPU
+            and hasattr(pltpu, "InterpretParams")):
         return pltpu.InterpretParams()
     return interpret
+
+
+def _native_prng(interpret) -> bool:
+    """True when the TPU PRNG primitives can run: native TPU, or the
+    TPU-semantics interpreter.  ``interpret is True`` is the generic
+    interpreter, which has no lowering for them."""
+    return interpret is not True
 
 
 def supported(q_shape, k_shape=None, dtype=None) -> bool:
@@ -102,14 +113,41 @@ def _block_seed(seed, bh, qi, ki):
                               + qi * jnp.int32(1 << 10) + ki)
 
 
-def _keep_mask(shape, rate):
-    """Regenerate the dropout keep-mask for the current block; the caller
-    must have seeded the PRNG with this block's coordinates."""
-    bits = pltpu.prng_random_bits(shape)
-    # keep with probability (1 - rate): compare against a threshold on the
-    # uint32 line; bitcast keeps the comparison unsigned
-    if bits.dtype != jnp.uint32:
-        bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+def _hash_bits(shape, seed_word):
+    """Per-element uint32 stream as a pure function of (seed word,
+    element coordinates): coordinate-mixed lowbias32 finalizer.  No
+    PRNG state, so it lowers everywhere the VPU ops do — the dropout
+    fallback for the generic pallas interpreter, which has no lowering
+    for ``pltpu.prng_random_bits``."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    sw = jax.lax.bitcast_convert_type(
+        jnp.asarray(seed_word, jnp.int32), jnp.uint32)
+    x = (rows * jnp.uint32(0x0001_0193)
+         + cols + sw * jnp.uint32(0x9E37_79B9))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB_352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846C_A68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _dropout_keep(shape, rate, seed_word, native_prng):
+    """Regenerate the dropout keep-mask for the current block.  Both
+    paths are pure functions of (seed_word, coords), so forward and
+    backward kernels redraw bit-identical masks.  ``native_prng``
+    selects the hardware PRNG (TPU / TPU-semantics interpreter) vs the
+    hash stream (generic interpreter)."""
+    if native_prng:
+        pltpu.prng_seed(seed_word)
+        bits = pltpu.prng_random_bits(shape)
+        # bitcast keeps the threshold comparison unsigned
+        if bits.dtype != jnp.uint32:
+            bits = jax.lax.bitcast_convert_type(bits, jnp.uint32)
+    else:
+        bits = _hash_bits(shape, seed_word)
+    # keep with probability (1 - rate): threshold on the uint32 line
     thresh = jnp.uint32((1.0 - rate) * 4294967295.0)
     return bits < thresh
 
@@ -120,7 +158,8 @@ def _keep_mask(shape, rate):
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
                 o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, bq, bk, nk, offset, rate, has_mask):
+                *, scale, causal, bq, bk, nk, offset, rate, has_mask,
+                native_prng):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -170,8 +209,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref,
         l_new = l_scr[:, 0] * alpha + jnp.sum(p, axis=1)
         v = v_ref[:, :]                        # [bk, hd]
         if rate > 0.0:
-            pltpu.prng_seed(_block_seed(seed_ref[0], bh, qi, ki))
-            keep = _keep_mask((bq, bk), rate)
+            keep = _dropout_keep((bq, bk), rate,
+                                 _block_seed(seed_ref[0], bh, qi, ki),
+                                 native_prng)
             p_v = jnp.where(keep, p / (1.0 - rate), 0.0)
         else:
             p_v = p
@@ -249,7 +289,8 @@ def flash_attention_fwd(q, k, v, causal=False, interpret=None,
 
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              bq=bq, bk=bk, nk=nk, offset=Sk - Sq,
-                             rate=rate, has_mask=has_mask)
+                             rate=rate, has_mask=has_mask,
+                             native_prng=_native_prng(interpret))
     grid = (B * nh, nq, nk)
 
     def qmap(bh, qi, ki, *_):
@@ -298,7 +339,8 @@ def flash_attention_fwd(q, k, v, causal=False, interpret=None,
 
 def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                    mask_ref, dq_ref, dq_scr,
-                   *, scale, causal, bq, bk, nk, offset, rate, has_mask):
+                   *, scale, causal, bq, bk, nk, offset, rate, has_mask,
+                   native_prng):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -344,8 +386,9 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [bq, bk]
         if rate > 0.0:
-            pltpu.prng_seed(_block_seed(seed_ref[0], bh, qi, ki))
-            keep = _keep_mask((bq, bk), rate)
+            keep = _dropout_keep((bq, bk), rate,
+                                 _block_seed(seed_ref[0], bh, qi, ki),
+                                 native_prng)
             dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
@@ -359,7 +402,8 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                     mask_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, causal, bq, bk, nq, offset, rate, has_mask):
+                    *, scale, causal, bq, bk, nq, offset, rate, has_mask,
+                    native_prng):
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -405,8 +449,9 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
             # seeded by LOGICAL block coords (bh, qi, ki) — this kernel's
             # grid iterates (bh, ki, qi) but must regenerate the exact
             # bits the forward drew for the (qi, ki) tile
-            pltpu.prng_seed(_block_seed(seed_ref[0], bh, qi, ki))
-            keep = _keep_mask((bq, bk), rate)
+            keep = _dropout_keep((bq, bk), rate,
+                                 _block_seed(seed_ref[0], bh, qi, ki),
+                                 native_prng)
             p_v = jnp.where(keep, p / (1.0 - rate), 0.0)
         else:
             keep = None
@@ -481,7 +526,8 @@ def _flash_bwd(causal, interpret, kv_mask_shape, rate, res, g,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nk=nk, offset=Sk - Sq, rate=rate,
-                          has_mask=has_mask),
+                          has_mask=has_mask,
+                          native_prng=_native_prng(interpret)),
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((B, nh, Sq, hd), q.dtype),
         interpret=interpret,
@@ -524,7 +570,8 @@ def _flash_bwd(causal, interpret, kv_mask_shape, rate, res, g,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, offset=Sk - Sq, rate=rate,
-                          has_mask=has_mask),
+                          has_mask=has_mask,
+                          native_prng=_native_prng(interpret)),
         grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((B, nh, Sk, hd), k.dtype),

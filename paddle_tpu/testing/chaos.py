@@ -35,6 +35,15 @@ Serving chaos (ISSUE 15) rides the same site pattern:
   deterministic "hung block_until_ready" the tick watchdog
   (``FLAGS_serving_tick_timeout_s``) must catch.
 
+Fleet chaos (ISSUE 16) adds the router's proxy leg:
+
+* :func:`fail_at` on ``fleet.proxy.connect`` makes the router's Nth
+  upstream POST fail before any bytes reach the replica — the
+  connect-level outage the failover path (retry the next replica in
+  rendezvous order) must absorb with zero dropped requests, which is
+  exactly what the rolling-restart gate in tests/test_fleet.py injects
+  mid-drill.
+
 Everything is counted: each armed fault records how often it fired so a
 test can assert the injection actually happened.
 """

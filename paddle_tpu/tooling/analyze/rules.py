@@ -741,9 +741,62 @@ class UnsyncedTiming(Rule):
         return False
 
 
+# =========================================================== R011
+class UnpairedKVHandoff(Rule):
+    """A KV handoff — a scope that both exports a prefix cache
+    (`export_prefix_cache`) and imports one (`_import_prefix_cache`) —
+    without the ownership-transfer pair: the export side must
+    `release_exported_prefix` (the serialized blocks return to the
+    source engine's free pool; otherwise the KV has TWO owners and the
+    source pool leaks until eviction pressure) and the import side must
+    be `blocksan_verify`-checked (the adopted blocks re-pinned through
+    the destination's refcount ledger).  Export alone (drain) and
+    import alone (warm construction) are fine — only the handoff shape,
+    where ownership MOVES, needs the pairing.  See
+    inference/fleet/handoff.py for the canonical site."""
+
+    id = "R011"
+    name = "unpaired-kv-handoff"
+
+    _EXPORT = "export_prefix_cache"
+    _IMPORT = "_import_prefix_cache"
+    _RELEASE = "release_exported_prefix"
+    _VERIFY = "blocksan_verify"
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in sf.scopes():
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            calls: Dict[str, ast.Call] = {}
+            for n in sf.scope_walk(scope):
+                if isinstance(n, ast.Call):
+                    seg = callee_segment(n.func)
+                    if seg in (self._EXPORT, self._IMPORT,
+                               self._RELEASE, self._VERIFY):
+                        calls.setdefault(seg, n)
+            if self._EXPORT not in calls or self._IMPORT not in calls:
+                continue
+            missing = [m for m in (self._RELEASE, self._VERIFY)
+                       if m not in calls]
+            if missing:
+                out.append(self.finding(
+                    sf, calls[self._EXPORT],
+                    f"KV handoff in `{sf.qualname(scope) or '<lambda>'}` "
+                    f"(calls both `{self._EXPORT}` and `{self._IMPORT}`) "
+                    f"without {' / '.join(f'`{m}`' for m in missing)}: "
+                    "ownership must TRANSFER — release the exported "
+                    "blocks on the source engine and blocksan-verify the "
+                    "adopting side, or the KV ends up with two owners "
+                    "(source pool leak) / an unchecked refcount ledger"))
+        return out
+
+
 RULES: List[Rule] = [
     HostSyncInTracedCode(), AliasUnsafeDeviceInput(), UseAfterDonate(),
     TraceTimeFlagRead(), LockOrderInversion(), UnsyncedTiming(),
+    UnpairedKVHandoff(),
 ]
 
 # the interprocedural rule set (R007-R010) registers itself here; the

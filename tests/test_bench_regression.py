@@ -204,6 +204,7 @@ def test_request_trace_rung_schema():
     assert 0.0 <= val["trace_overhead_pct"] < 25.0
 
 
+@pytest.mark.slow  # 17s measured: full cold-start rung in-process; joins the other rung-schema drills
 def test_cold_start_rung_schema():
     """Pin the ISSUE 7 `cold_start` rung's record schema: two
     subprocesses sharing a cache dir time first-program-ready cold vs
@@ -312,6 +313,46 @@ def test_serving_restart_rung_schema():
     assert val["import_skipped_corrupt"] == 0
     assert val["cold_ttft_ms_p50"] > val["restored_ttft_ms_p50"] > 0
     assert val["export_bytes"] > 0 and val["export_s"] >= 0
+
+
+@pytest.mark.slow   # three replicas warm + a live rolling restart —
+                    # too heavy for the tier-1 budget; full runs cover it
+def test_fleet_rung_schema():
+    """Pin the ISSUE 16 `fleet` rung's record schema: 3 in-process
+    replicas behind the prefix-affinity router under concurrent
+    shared-prefix traffic, a rolling restart mid-run —
+    `goodput_during_restart_ratio` (regression key) with zero dropped
+    requests and the affinity hit-rate alongside."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_fleet", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_fleet(ctx)
+    rec = {"rung": "fleet", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("fleet").smoke
+    assert bench._REGRESSION_KEYS["fleet"] == \
+        "goodput_during_restart_ratio"
+    # the acceptance claims: the fleet keeps serving through the drill
+    # (every replica really restarted) and drops NOTHING
+    assert val["requests_dropped"] == 0
+    assert val["replicas_restarted"] == 3
+    assert val["goodput_during_restart_ratio"] > 0
+    assert val["steady_goodput_rps"] > 0
+    assert val["restart_goodput_rps"] > 0
+    assert val["rolling_restart_s"] > 0
+    assert val["requests_completed"] > 0
+    assert val["affinity_hit_rate"] > 0.9
+    assert val["failovers"] >= 0
 
 
 @pytest.mark.slow   # the subprocess compiles ~nine engine configs —
@@ -437,6 +478,7 @@ def test_multi_key_regression_check_labels_secondary_keys(tmp_path):
     assert "spec_decode" in rep["regressed"]
 
 
+@pytest.mark.slow  # 6s measured: runs graft-lint over the whole tree; test_static_analysis keeps the fast tier-1 ratchet gate
 def test_analyze_rung_schema():
     """Pin the ISSUE 8/12 `analyze` rung's record schema: graft-lint
     wall seconds + per-rule findings over the grown TEN-rule set and
@@ -470,11 +512,12 @@ def test_analyze_rung_schema():
     assert val["findings_new"] == 0
     assert val["findings_total"] >= 0
     assert isinstance(val["findings_per_rule"], dict)
-    # ISSUE 12: all ten rules report (zero-filled — a rule silently
-    # dropping out of the run would otherwise look like a clean rule)
-    assert val["rules"] == 10
+    # ISSUE 12 (+R011 in ISSUE 16): every registered rule reports
+    # (zero-filled — a rule silently dropping out of the run would
+    # otherwise look like a clean rule)
+    assert val["rules"] == 11
     assert sorted(val["findings_per_rule"]) == [
-        f"R{i:03d}" for i in range(1, 11)]
+        f"R{i:03d}" for i in range(1, 12)]
     # the grown rule set still sees the WHOLE default tree, tests
     # included (the R010 surface) — well over the package alone
     assert val["analyze_files"] > 280
@@ -527,6 +570,7 @@ def test_xray_rung_schema():
     assert val["spec_verify_dense"] is True
 
 
+@pytest.mark.slow  # 5s measured: compiles the fused-optimizer step; joins the other rung-schema drills
 def test_fused_optimizer_rung_schema():
     """Pin the round-7 `fused_optimizer` rung's record schema: the
     regression key (`speedup`) and the per-cell dispatch/wall fields the

@@ -335,6 +335,7 @@ def test_warmup_grid_chunked_zero_compiles(model):
         assert eng.stats()["prefill_chunks"] > 0
 
 
+@pytest.mark.slow  # 6s measured: warms both sampling variants; test_warmup_grid_zero_compiles keeps the fast zero-compile pin
 def test_warmup_covers_both_sampling_variants(model):
     """The grid always includes the host-sampling decode program AND
     the device-sampling tick: FLAGS_serving_device_sampling is read

@@ -406,6 +406,7 @@ def _params_npz(path):
         return [z[k] for k in z.files]
 
 
+@pytest.mark.slow  # 11s measured: subprocess spawn + two training runs; in-process resume parity stays fast above
 def test_subprocess_kill_at_step_resume_bit_exact(tmp_path):
     """THE acceptance test: SIGKILL the child at step 3 of 6 (periodic
     checkpoints every 2 steps), relaunch the same command with
@@ -434,6 +435,7 @@ def test_subprocess_kill_at_step_resume_bit_exact(tmp_path):
         np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow  # 11s measured: subprocess spawn + signal delivery; the in-process emergency-checkpoint path stays fast above
 def test_subprocess_sigterm_takes_emergency_checkpoint(tmp_path):
     """SIGTERM (the preemption notice): the child finishes the in-flight
     step, writes an emergency checkpoint and exits 0; the relaunch

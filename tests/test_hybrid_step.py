@@ -56,6 +56,7 @@ def _run_parity(cfg, n_devices, steps=3):
     assert serial[-1] < serial[0]  # it actually trains
 
 
+@pytest.mark.slow  # 62s measured: the pp2*dp2*mp2+sp+zero composition drill; each axis keeps its own fast parity test (test_distributed, test_interleaved_pipeline, test_sequence_parallel, test_zero)
 def test_hybrid_pp2_dp2_mp2_sp_zero():
     _run_parity(HybridConfig(), 8)
 

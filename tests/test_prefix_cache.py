@@ -142,6 +142,7 @@ def test_eviction_skips_entries_shared_with_running_requests(model):
     assert pc.evictions == 1
 
 
+@pytest.mark.slow  # 7s measured: wall-clock speedup assertion needs a quiet box; block-reuse accounting keeps the fast hit pin
 def test_hit_prefill_visibly_faster_in_request_traces(model):
     """ISSUE 9 acceptance: TTFT for hit-requests measurably below
     miss-requests, read from the PR 6 lifecycle traces.  Programs are

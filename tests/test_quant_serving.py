@@ -92,6 +92,7 @@ def _streams(model, ps, budget=6, **kw):
     return eng, [list(r.output_ids) for r in reqs]
 
 
+@pytest.mark.slow  # 11s measured: compiles fp8 and fp32 engines back to back; quantization error-bound unit tests stay fast
 def test_quant_parity_bounded(model):
     """The parity-bounded acceptance: logit deviation under a budget,
     and greedy token streams identical on the smoke prompts (an

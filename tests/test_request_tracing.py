@@ -159,6 +159,7 @@ def test_tracing_off_does_zero_work(model):
     assert metrics.get("serving.ttft_seconds").count() == 0
 
 
+@pytest.mark.slow  # 12s measured: forces a shape-change recompile on a second engine; trace schema + ttft/tpot pins stay fast
 def test_recompile_blame_names_the_changed_dim(model):
     """Same callable, changed shape: the compile tracker's recompile
     event names exactly what changed (the ISSUE 6 acceptance check)."""

@@ -135,6 +135,7 @@ def test_admission_respects_capacity(model):
     assert big.done and small.done
 
 
+@pytest.mark.slow  # 12s measured: compiles the sampling tick variant; test_three_staggered_requests_one_program keeps the fast multi-request pin
 def test_sampling_requests_mix_with_greedy(model):
     """Per-slot sampling params are device inputs: a sampling request and
     a greedy request share the same compiled step."""
@@ -152,6 +153,7 @@ def test_sampling_requests_mix_with_greedy(model):
     assert len(s.output_ids) == 6
 
 
+@pytest.mark.slow  # 12s measured: mixed prefill/decode tick compile; the staggered-requests fast pin covers one-program batching
 def test_mixed_ticks_no_demotion_and_reproducible(model):
     """On-device sampling keeps a mixed greedy+sampled batch on the FULL
     k-step tick (no k=1 demotion), the sampled stream is reproducible
@@ -244,6 +246,7 @@ def test_device_sampler_matches_host_distribution():
     np.testing.assert_allclose(counts, probs, atol=0.05)
 
 
+@pytest.mark.slow  # 10s measured: runs the engine twice (overlap on/off); xray's forced-boundary sampling parity stays fast
 def test_overlap_matches_synchronous(model):
     """The double-buffered tick loop (FLAGS_serving_overlap) produces
     token-for-token the same streams as the synchronous loop, greedy and

@@ -162,6 +162,7 @@ def test_tp_validation_errors(model):
         build_plan(NotAGPT(), 2)
 
 
+@pytest.mark.slow  # 7s measured: constructs a second (tp) engine; plan-shape and flag-validation tests stay fast
 def test_tp_flag_routes_engine_construction(model):
     with flag_guard(serving_tp_degree=2):
         eng = ServingEngine(model, max_batch=2, max_context=64,

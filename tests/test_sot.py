@@ -80,6 +80,7 @@ def test_bool_in_expression():
 
 # ----------------------------------------------------------- int/item burns
 
+@pytest.mark.slow  # 9s measured: int() burn triggers a per-iteration retrace loop; the other sot fallback burns stay fast
 def test_range_over_tensor_bound():
     """ref test_builtin_range.py::test_range_9 — `range(int(tensor))`:
     the bound burns into the unrolled program and guards re-specialize

@@ -96,6 +96,7 @@ def test_greedy_bit_identical_full_acceptance(model, draft_same):
     assert eng.stats()["reserved"] == 0
 
 
+@pytest.mark.slow  # 16s measured: adversarial-draft bit-parity compiles a second draft model; the accepting-draft twin keeps the fast bit-parity pin
 def test_greedy_bit_identical_under_rejecting_draft(model, draft_reject):
     """Losslessness must NOT depend on the draft being any good: an
     unrelated draft rejects nearly everything and the streams are

@@ -401,6 +401,54 @@ def test_r006_input_side_conversion_is_not_a_sync(tmp_path):
                    rules=["R006"]) == []
 
 
+R011_BAD = """\
+def move_kv(src, dst, root):
+    src.export_prefix_cache(root)
+    dst._import_prefix_cache(root)
+"""
+
+R011_GOOD = """\
+from paddle_tpu.testing import jaxsan as _jaxsan
+
+
+def move_kv(src, dst, root):
+    src.export_prefix_cache(root)
+    src.release_exported_prefix()
+    dst._import_prefix_cache(root)
+    _jaxsan.blocksan_verify(dst)
+
+
+def drain_only(engine, root):      # export alone (drain) is fine
+    return engine.export_prefix_cache(root)
+
+
+def warm_start(engine, root):      # import alone (construction) is fine
+    engine._import_prefix_cache(root)
+"""
+
+
+def test_r011_catches_unpaired_handoff(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R011_BAD}, rules=["R011"])
+    assert len(fs) == 1 and fs[0].line == 2
+    assert "release_exported_prefix" in fs[0].message
+    assert "blocksan_verify" in fs[0].message
+
+
+def test_r011_release_without_verify_still_flags(tmp_path):
+    src = R011_BAD.replace(
+        "    dst._import_prefix_cache(root)",
+        "    src.release_exported_prefix()\n"
+        "    dst._import_prefix_cache(root)")
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R011"])
+    assert len(fs) == 1
+    assert "blocksan_verify" in fs[0].message
+    assert "release_exported_prefix" not in fs[0].message.split("without")[1]
+
+
+def test_r011_paired_handoff_and_lone_legs_are_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R011_GOOD}, rules=["R011"]) == []
+
+
 # ===================================================== suppressions
 
 def test_inline_suppression_same_line(tmp_path):
