@@ -89,6 +89,7 @@ _REGRESSION_KEYS = {
                             "long_arrival_tpot_ratio"),
     "analyze": "analyze_files_per_sec",
     "xray": "xray_overhead_pct",
+    "fleet_telescope": "fleet_trace_overhead_pct",
 }
 
 _ENV_PROBE = {}
@@ -1635,6 +1636,128 @@ def bench_fleet(ctx):
             "failovers": st["failovers"],
             "replicas_restarted": sum(
                 1 for r in fleet.replicas if r.restarts)}
+    finally:
+        fleet.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+@harness.register_rung("fleet_telescope", est_cold_s=240, smoke=True)
+def bench_fleet_telescope(ctx):
+    """Fleet-telescope rung (ISSUE 17): what the cross-process tracing
+    and metrics federation COST, and proof they see the whole fleet.
+
+    Three in-process tiny-model replicas behind the router (the
+    bench_fleet topology, no restart drill) serve shared-prefix
+    traffic.  ``fleet_trace_overhead_pct`` compares completed-stream
+    throughput with trace propagation ON (router mints ids, records
+    plan/proxy spans, forwards the header; engines tag their records)
+    vs OFF, measured over adjacent on/off PAIRS with the quietest
+    pair's delta winning (co-tenant noise is strictly additive — the
+    same min-estimator the xray rung uses).  The telescope facts ride
+    along: the federated ``/fleet/metrics`` scrape, the fleet latency
+    aggregate, and the multi-process ``fleet_trace`` merge over the
+    run's real flight dumps (shared trace ids across processes,
+    clock-synced replica rows)."""
+    import shutil
+    import tempfile
+    from http.client import HTTPConnection
+
+    import paddle_tpu as paddle
+    from paddle_tpu.flags import flag_guard
+    from paddle_tpu.inference.fleet import Fleet
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt3_tiny
+    from paddle_tpu.observability import tracing as obs_tracing
+
+    def factory(export_dir):
+        # one model instance PER replica (inference/fleet/replica.py)
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt3_tiny())
+        m.eval()
+        return ServingEngine(m, max_batch=2, max_context=64,
+                             block_size=16, num_blocks=32,
+                             prefix_cache=True,
+                             prefix_export_dir=export_dir)
+
+    rng = np.random.RandomState(7)
+    prefixes = [list(rng.randint(1, 1000, (16,))) for _ in range(3)]
+
+    def post(port, ids):
+        conn = HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request(
+                "POST", "/generate",
+                body=json.dumps({"prompt_ids": [int(t) for t in ids],
+                                 "max_new_tokens": 2}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            return resp.status == 200 and b"event: done" in body
+        finally:
+            conn.close()
+
+    root = tempfile.mkdtemp(prefix="bench_fleet_telescope_")
+    fleet = Fleet.build(factory, 3, root, poll_interval_s=0.1,
+                        affinity_tokens=16, metrics_interval_s=0.2)
+    n_reqs = 6 if ctx.smoke else 12
+    try:
+        for p in prefixes:          # warm wave: compiles + prefix homes
+            post(fleet.router.port, p + [1])
+
+        def rate():
+            done = 0
+            t0 = time.perf_counter()
+            for i in range(n_reqs):
+                ids = prefixes[i % len(prefixes)] + [i % 997 + 1]
+                done += bool(post(fleet.router.port, ids))
+            return done / (time.perf_counter() - t0)
+
+        pairs = []
+        for _ in range(2 if ctx.smoke else 3):
+            with flag_guard(fleet_trace=True):
+                on = rate()
+            with flag_guard(fleet_trace=False):
+                off = rate()
+            pairs.append((max(0.0, 1 - on / off) * 100, on, off))
+        pct, on, off = min(pairs)
+
+        # federated scrape + fleet latency aggregate
+        fleet.router.poll_metrics_all()
+        conn = HTTPConnection("127.0.0.1", fleet.router.port, timeout=10)
+        conn.request("GET", "/fleet/metrics")
+        scrape = conn.getresponse().read().decode()
+        conn.close()
+        fleet_doc = fleet.router.describe()
+        lat = fleet_doc.get("fleet_latency", {})
+
+        # multi-process timeline merge over the run's REAL flight dumps
+        dump_paths = fleet.dump_flight(os.path.join(root, "trace"))
+        docs = [json.load(open(p)) for p in dump_paths]
+        trace = obs_tracing.fleet_trace(docs)
+        other = trace["otherData"]
+        # a trace id minted at the router must appear in >1 process's
+        # records — the single-timeline acceptance fact
+        per_proc_ids = [set(obs_tracing._collect_trace_ids(d))
+                        for d in docs]
+        shared = [t for t in other["trace_ids"]
+                  if sum(t in s for s in per_proc_ids) >= 2]
+        return {
+            "fleet_trace_overhead_pct": round(pct, 2),
+            "streams_per_sec_on": round(on, 3),
+            "streams_per_sec_off": round(off, 3),
+            "overhead_pct_windows": [round(p, 2) for p, _, _ in pairs],
+            "fleet_metric_lines": sum(
+                1 for ln in scrape.splitlines()
+                if ln.startswith("fleet_")),
+            "fleet_ttft_p99_ms": round(
+                lat.get("ttft", {}).get("p99_s", 0.0) * 1e3, 3),
+            "trace_processes": len(other["processes"]),
+            "trace_ids_merged": len(other["trace_ids"]),
+            "trace_ids_cross_process": len(shared),
+            "clock_synced_replicas": sum(
+                1 for p in other["processes"]
+                if p["clock_offset_s"] != 0.0),
+            "trace_events": len(trace["traceEvents"])}
     finally:
         fleet.close()
         shutil.rmtree(root, ignore_errors=True)

@@ -529,3 +529,54 @@ define_flag("fleet_poll_interval_s", 0.25,
 define_flag("fleet_router_port", 0,
             "fleet router bind port for `flight route` (127.0.0.1 only "
             "— the route accepts work); 0 binds an ephemeral port")
+define_flag("serving_chunks_per_tick_auto", False,
+            "tune the chunked-prefill chunks-per-tick budget at tick "
+            "boundaries from the live tick-level TPOT sketch against "
+            "FLAGS_serving_tpot_slo_ms: running p90 over the SLO spends "
+            "fewer chunk programs per boundary, under half of it spends "
+            "more, always within [1, "
+            "FLAGS_serving_prefill_chunks_per_tick].  Only the budget "
+            "moves — the program grid and warmup signatures are fixed "
+            "at construction.  Off (the default) keeps the static flag "
+            "budget; inert without a TPOT SLO")
+define_flag("fleet_trace", True,
+            "distributed trace propagation (observability/tracing.py): "
+            "the fleet router mints a trace id per /generate, forwards "
+            "it as the X-Graft-Trace header, and records router-side "
+            "queue/plan/proxy spans; replicas thread it into Request so "
+            "lifecycle, flight and handoff records share one trace_id "
+            "across processes.  0 stops minting/forwarding (explicit "
+            "client headers still parse)")
+define_flag("fleet_metrics_interval_s", 0.0,
+            "fleet metrics federation cadence: every interval the "
+            "router polls each replica's /metrics/snapshot (mergeable "
+            "counters + DDSketch states + engine telemetry), re-exports "
+            "the merged view as fleet_* series on GET /fleet/metrics, "
+            "and feeds the SLO burn-rate monitor.  0 (the default) "
+            "disables the federation poller; GET /fleet/metrics then "
+            "federates once on demand")
+define_flag("fleet_slo_burn_cordon", False,
+            "auto-cordon a replica whose SLO error-budget burn rate "
+            "exceeds fleet_burn_threshold in BOTH the fast and slow "
+            "windows (bad events: always-on TTFT-SLO violations + "
+            "error/poisoned outcomes from the federated telemetry); "
+            "un-cordons when the fast window cools below 1x.  A cordon "
+            "is a routing preference, not a verdict — if every replica "
+            "is cordoned the degraded plan still routes (PR 16 "
+            "contract).  Requires the federation poller "
+            "(fleet_metrics_interval_s > 0)")
+define_flag("fleet_burn_fast_window_s", 60.0,
+            "fast window of the SLO burn-rate monitor: catches an "
+            "acute error spike within about a minute")
+define_flag("fleet_burn_slow_window_s", 600.0,
+            "slow window of the SLO burn-rate monitor: keeps a brief "
+            "blip from flapping the cordon — both windows must burn "
+            "over threshold to cordon")
+define_flag("fleet_burn_threshold", 2.0,
+            "burn-rate multiple that trips the cordon: 1.0 spends the "
+            "error budget exactly at the sustainable rate, 2.0 spends "
+            "it twice as fast")
+define_flag("fleet_error_budget", 0.05,
+            "SLO error budget as a bad-event fraction (bad = TTFT-SLO "
+            "violations + error/poisoned outcomes over total terminal "
+            "events): the denominator of the burn rate")

@@ -23,6 +23,16 @@ turns that transport primitive into the standard production topology:
   ``_alloc_block`` re-pins) checked by blocksan on both sides —
   graft-lint R011 makes the pairing structural.
 
+PR 17 adds the **fleet telescope** on top: the router mints a trace id
+per ``/generate`` and forwards ``X-Graft-Trace`` so one request can be
+followed across processes (``dump --fleet-trace`` merges the per-process
+flight dumps into one clock-aligned chrome timeline); the federation
+poller merges replica ``/metrics/snapshot`` documents (counters sum,
+DDSketch buckets add) into the ``fleet_*`` scrape at ``/fleet/metrics``;
+and a multi-window SLO burn-rate monitor can auto-cordon a burning
+replica (``FLAGS_fleet_slo_burn_cordon``) — still a preference, never a
+verdict: never the last replica, manual cordons win.
+
 Simulated multi-engine first: in-process replicas behind real HTTP on
 loopback — the same wire surface a multi-host fleet speaks, minus the
 network.  CLI: ``python -m paddle_tpu.flight route`` (README quickstart).

@@ -33,13 +33,15 @@ import time
 from typing import List, Optional
 
 from ...observability import flight_recorder as _flight
+from ...observability import tracing as _tracing
 from ...testing import jaxsan as _jaxsan
 from ..serving import Request
 
 __all__ = ["hand_off", "DisaggregatedPair"]
 
 
-def hand_off(src, dst, root: str) -> dict:
+def hand_off(src, dst, root: str, trace_id: Optional[str] = None,
+             parent_span: Optional[str] = None) -> dict:
     """Move prefix-cache KV ownership ``src`` -> ``dst`` via an export
     bundle under ``root``.  Returns a report:
 
@@ -51,9 +53,25 @@ def hand_off(src, dst, root: str) -> dict:
     running src request still references stay put — releasing them
     would free KV under a live slot); import re-pins everything in
     dst's own refcount ledger.  blocksan verifies both sides.
+
+    ``trace_id``/``parent_span`` thread the caller's trace context so
+    the export leg (on src's flight recorder) and the import leg (on
+    dst's) land in the same ``dump --fleet-trace`` timeline as the
+    request that triggered the move.
     """
+    ctx = {}
+    if trace_id:
+        ctx["trace_id"] = trace_id
+        if parent_span:
+            ctx["parent_span"] = parent_span
+    t0 = time.time()
     exported = src.export_prefix_cache(root)
     released = src.release_exported_prefix()
+    t1 = time.time()
+    src._flightrec().record_span(
+        "handoff_export", "handoff", t0, t1,
+        blocks=int(exported.get("blocks", 0)),
+        released=int(released), **ctx)
     dst._import_prefix_cache(root)
     _jaxsan.blocksan_verify(dst)
     report = {
@@ -61,10 +79,15 @@ def hand_off(src, dst, root: str) -> dict:
         "released_blocks": int(released),
         "imported": dict(dst._prefix_import_info or {}),
     }
+    if trace_id:
+        report["trace_id"] = trace_id
+    dst._flightrec().record_span(
+        "handoff_import", "handoff", t1, time.time(),
+        blocks=int(report["imported"].get("blocks", 0) or 0), **ctx)
     _flight.default_recorder().record_event(
         "prefix_handoff",
         blocks=int(exported.get("blocks", 0)),
-        released=int(released))
+        released=int(released), **ctx)
     return report
 
 
@@ -106,14 +129,22 @@ class DisaggregatedPair:
         Returns the decode engine's ``output_ids`` (greedy streams
         bit-match the single-engine run)."""
         ids = [int(t) for t in prompt_ids]
-        pre = Request(ids, max_new_tokens=1, **req_kw)
+        # One trace id covers all three legs (prefill, handoff, decode)
+        # so the fleet trace shows the whole disaggregated lifecycle as
+        # a single distributed request.
+        trace_id = req_kw.pop("trace_id", None) or _tracing.mint_trace_id()
+        span = _tracing.new_span_id()
+        pre = Request(ids, max_new_tokens=1, trace_id=trace_id,
+                      parent_span=span, **req_kw)
         self._run(self.prefill, pre)
         if pre.outcome not in (None, "finished"):
             raise RuntimeError(
                 f"prefill leg ended '{pre.outcome}' (rid={pre.rid})")
-        self.last_report = hand_off(self.prefill, self.decode, self.root)
+        self.last_report = hand_off(self.prefill, self.decode, self.root,
+                                    trace_id=trace_id, parent_span=span)
         self.handoffs += 1
-        dec = Request(ids, max_new_tokens=max_new_tokens, **req_kw)
+        dec = Request(ids, max_new_tokens=max_new_tokens,
+                      trace_id=trace_id, parent_span=span, **req_kw)
         self._run(self.decode, dec)
         if dec.outcome not in (None, "finished"):
             raise RuntimeError(

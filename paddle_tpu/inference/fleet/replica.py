@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ...observability import flight_recorder as _flight
 from ...observability import http as _http
 
 __all__ = ["Replica", "Fleet"]
@@ -56,6 +57,13 @@ class Replica:
         self._stop: Optional[threading.Event] = None
         self._thread: Optional[threading.Thread] = None
         self.restarts = 0
+        # Replica-local flight recorder: in a real fleet each process has
+        # its own default recorder; in-process replicas need one per
+        # engine so ``dump --fleet-trace`` sees per-replica timelines
+        # instead of one interleaved mess.  Survives restarts — the
+        # recorder is the replica's history, not the engine's.
+        self.flight = _flight.FlightRecorder()
+        self.flight.record_event("replica_meta", replica=name)
 
     @property
     def addr(self) -> str:
@@ -70,6 +78,7 @@ class Replica:
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError(f"replica {self.name} already running")
         self.engine = self._factory()
+        self.engine._flight_rec = self.flight
         if self.server is None:
             self.server = _http.MetricsServer(0, "127.0.0.1",
                                               engine=self.engine)
@@ -122,6 +131,16 @@ class Replica:
                 "import": dict(self.engine._prefix_import_info or {}),
                 "restart_s": round(time.monotonic() - t0, 3)}
 
+    def dump_flight(self, path: str) -> str:
+        """Write this replica's flight snapshot (steps + events, incl.
+        its span records) as JSON to ``path`` for ``dump --fleet-trace``
+        merging.  Returns the path."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.flight.snapshot(reason="fleet_trace"), f)
+        return path
+
     def stop(self) -> None:
         """Hard stop: kill the loop and close the frontend socket."""
         if self._stop is not None:
@@ -159,6 +178,8 @@ class Fleet:
                           lambda root=root: engine_factory(root))
             rep.start(wait_ready_s=wait_ready_s)
             replicas.append(rep)
+        router_kw.setdefault("flight_recorder",
+                             _flight.FlightRecorder())
         router = FleetRouter({r.name: r.addr for r in replicas},
                              **router_kw)
         return cls(replicas, router)
@@ -200,6 +221,24 @@ class Fleet:
             if eng is None or (not eng.waiting and not eng.prefilling):
                 return
             time.sleep(0.02)
+
+    def dump_flight(self, root: str) -> List[str]:
+        """Write one flight dump per fleet process under ``root`` —
+        router first (the ``dump --fleet-trace`` operand order puts the
+        timebase owner at pid 1), then each replica.  Returns the paths,
+        in that order."""
+        import json
+        import os
+
+        os.makedirs(root, exist_ok=True)
+        paths = [os.path.join(root, "flight_router.json")]
+        with open(paths[0], "w") as f:
+            json.dump(self.router._flightrec().snapshot(
+                reason="fleet_trace"), f)
+        for rep in self.replicas:
+            paths.append(rep.dump_flight(
+                os.path.join(root, f"flight_{rep.name}.json")))
+        return paths
 
     def close(self) -> None:
         self.router.close()

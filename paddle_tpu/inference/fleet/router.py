@@ -47,7 +47,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ... import flags as _flags
+from ...observability import federation as _federation
+from ...observability import flight_recorder as _flight
 from ...observability import metrics as _metrics
+from ...observability import tracing as _tracing
 from ...testing import chaos as _chaos
 from ..prefix_cache import _chain
 
@@ -71,6 +74,15 @@ _M_FAILOVERS = _metrics.counter(
 _M_UNROUTABLE = _metrics.counter(
     "fleet.router.unroutable", "requests answered 503: no ready "
     "replica accepted the proxy attempt")
+_M_SLO_BURN = _metrics.gauge(
+    "fleet.slo_burn", "per-replica SLO error-budget burn rate over the "
+    "FAST window (fleet_burn_fast_window_s), by replica=<name>: bad-"
+    "event fraction (TTFT-SLO violations + error/poisoned outcomes) "
+    "divided by fleet_error_budget — 1.0 spends the budget exactly at "
+    "the sustainable rate")
+_M_FED_POLLS = _metrics.counter(
+    "fleet.federation.polls", "metrics-federation snapshot polls, by "
+    "outcome=ok|error")
 
 
 def affinity_key(prompt_ids: Sequence[int],
@@ -106,10 +118,26 @@ def predict_ttft_s(doc: dict) -> float:
     admission rate, then the request itself pays the recent median
     TTFT.  With no rate evidence each queued request is costed at one
     base TTFT.  A cold replica (no evidence at all) predicts ~0 — the
-    shed gate never starves an idle fleet."""
+    shed gate never starves an idle fleet.
+
+    The observed admission rate alone is a trap under a load swing: it
+    reflects the RECENT past, not what the decode loop can drain.  When
+    the replica ships live TPOT evidence (``tpot_p50_s`` +
+    ``avg_tokens_out``, ISSUE 17) the rate is capped by the decode
+    capacity ``slots / (avg_tokens_out * tpot)`` — slots turn over one
+    request per ``avg_tokens_out * tpot`` seconds, so a stale-high
+    admission rate can no longer hide a deep queue behind an
+    optimistic drain projection.  Without TPOT evidence the model is
+    bit-identical to the PR 16 behavior."""
     ev = doc.get("ttft_evidence") or {}
     base = float(ev.get("ttft_p50_s") or 0.0)
     rate = float(ev.get("admit_rate_per_s") or 0.0)
+    tpot = float(ev.get("tpot_p50_s") or 0.0)
+    avg_out = float(ev.get("avg_tokens_out") or 0.0)
+    slots = int(doc.get("slots", 0) or 0)
+    if tpot > 0 and avg_out > 0 and slots > 0:
+        capacity = slots / (avg_out * tpot)
+        rate = min(rate, capacity) if rate > 0 else capacity
     position = int(doc.get("waiting", 0) or 0)
     if int(doc.get("free_slots", 1) or 0) <= 0:
         position += 1
@@ -121,7 +149,8 @@ class _ReplicaState:
     """The router's last-polled view of one replica."""
 
     __slots__ = ("name", "host", "port", "doc", "ready", "cordoned",
-                 "last_poll", "last_err", "routed")
+                 "last_poll", "last_err", "routed", "snapshot", "clock",
+                 "auto_cordoned", "burn_fast", "burn_slow")
 
     def __init__(self, name: str, addr: str):
         host, _, port = addr.rpartition(":")
@@ -134,18 +163,34 @@ class _ReplicaState:
         self.last_poll = 0.0
         self.last_err: Optional[str] = None
         self.routed = 0
+        # fleet telescope state (ISSUE 17): last federation snapshot,
+        # the clock-offset estimate from /healthz round-trips, and the
+        # burn monitor's readout / auto-cordon marker
+        self.snapshot: Optional[dict] = None
+        self.clock = _tracing.ClockSync()
+        self.auto_cordoned = False
+        self.burn_fast: Optional[float] = None
+        self.burn_slow: Optional[float] = None
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
 
     def view(self) -> dict:
-        return {"addr": self.addr, "ready": self.ready,
-                "cordoned": self.cordoned, "routed": self.routed,
-                "queue_depth": int(self.doc.get("queue_depth", 0) or 0),
-                "predicted_ttft_ms": round(
-                    predict_ttft_s(self.doc) * 1e3, 3),
-                "last_err": self.last_err}
+        out = {"addr": self.addr, "ready": self.ready,
+               "cordoned": self.cordoned, "routed": self.routed,
+               "queue_depth": int(self.doc.get("queue_depth", 0) or 0),
+               "predicted_ttft_ms": round(
+                   predict_ttft_s(self.doc) * 1e3, 3),
+               "last_err": self.last_err}
+        if self.auto_cordoned:
+            out["auto_cordoned"] = True
+        if self.burn_fast is not None or self.burn_slow is not None:
+            out["slo_burn"] = {"fast": self.burn_fast,
+                               "slow": self.burn_slow}
+        if self.clock.offset_s is not None:
+            out["clock"] = self.clock.view()
+        return out
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -169,10 +214,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
             if self.path.startswith("/healthz"):
                 doc = router.healthz()
                 self._send(200 if doc["ready"] else 503, doc)
+            elif self.path.startswith("/fleet/metrics"):
+                # before the /fleet prefix match: the federated fleet_*
+                # view in Prometheus text exposition (ISSUE 17)
+                raw = router.fleet_metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
             elif self.path.startswith("/fleet"):
                 self._send(200, router.describe())
             else:
-                self._send(404, {"error": "endpoints: /healthz /fleet"})
+                self._send(404, {"error": "endpoints: /healthz /fleet "
+                                          "/fleet/metrics"})
         except (BrokenPipeError, ConnectionResetError):
             pass
 
@@ -200,7 +257,10 @@ class FleetRouter:
                  ttft_budget_ms: Optional[float] = None,
                  poll_interval_s: Optional[float] = None,
                  proxy_timeout_s: float = 30.0,
-                 retry_window_s: float = 5.0):
+                 retry_window_s: float = 5.0,
+                 metrics_interval_s: Optional[float] = None,
+                 flight_recorder: Optional[
+                     "_flight.FlightRecorder"] = None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.affinity_tokens = int(
@@ -212,6 +272,9 @@ class FleetRouter:
         self.poll_interval_s = float(
             poll_interval_s if poll_interval_s is not None
             else _flags.get_flag("fleet_poll_interval_s"))
+        self.metrics_interval_s = float(
+            metrics_interval_s if metrics_interval_s is not None
+            else _flags.get_flag("fleet_metrics_interval_s"))
         self.proxy_timeout_s = float(proxy_timeout_s)
         self.retry_window_s = float(retry_window_s)
         self._lock = threading.Lock()
@@ -225,6 +288,22 @@ class FleetRouter:
         self.sheds = 0
         self.failovers = 0
         self.unroutable = 0
+        # fleet telescope (ISSUE 17): per-router flight recorder (an
+        # in-process fleet must not interleave router spans into the
+        # replicas' rings), the federated registry, the burn monitor
+        self._flight = flight_recorder
+        self._fed_lock = threading.Lock()
+        self._fed_registry: Optional[_metrics.Registry] = None
+        self._fed_time = 0.0
+        self._last_metrics_poll = 0.0
+        self._burn = _federation.BurnRateMonitor(
+            fast_window_s=float(
+                _flags.get_flag("fleet_burn_fast_window_s")),
+            slow_window_s=float(
+                _flags.get_flag("fleet_burn_slow_window_s")),
+            threshold=float(_flags.get_flag("fleet_burn_threshold")),
+            error_budget=float(_flags.get_flag("fleet_error_budget")))
+        self._flightrec().record_event("replica_meta", replica="router")
         self._closed = threading.Event()
         self.poll_all()
         if port is None:
@@ -253,10 +332,18 @@ class FleetRouter:
         self._serve_thread.join(timeout=5)
         self._poll_thread.join(timeout=5)
 
+    def _flightrec(self) -> "_flight.FlightRecorder":
+        rec = self._flight
+        return rec if rec is not None else _flight.default_recorder()
+
     # ------------------------------------------------------- health view
     def _poll_loop(self) -> None:
         while not self._closed.wait(self.poll_interval_s):
             self.poll_all()
+            if self.metrics_interval_s > 0 and (
+                    time.monotonic() - self._last_metrics_poll
+                    >= self.metrics_interval_s):
+                self.poll_metrics_all()
 
     def poll_all(self) -> None:
         for name in list(self._states):
@@ -264,10 +351,16 @@ class FleetRouter:
 
     def poll_once(self, name: str) -> dict:
         """Refresh one replica's /healthz view.  A refused/failed probe
-        marks the replica not-ready (routed around) — never raises."""
+        marks the replica not-ready (routed around) — never raises.
+        The round-trip doubles as a clock-offset sample: the reply's
+        ``unix_time`` against the local send/receive times updates the
+        replica's min-RTT :class:`..observability.tracing.ClockSync`
+        estimate (error bound rtt/2) the fleet-trace merge aligns
+        timelines with."""
         st = self._states[name]
         doc: dict = {}
         err: Optional[str] = None
+        t0 = time.time()
         try:
             conn = http.client.HTTPConnection(st.host, st.port,
                                               timeout=2.0)
@@ -279,23 +372,137 @@ class FleetRouter:
                 conn.close()
         except (OSError, ValueError) as e:
             err = f"{type(e).__name__}: {e}"[:120]
+        t1 = time.time()
+        improved = False
+        if err is None and doc.get("unix_time"):
+            try:
+                improved = st.clock.update(
+                    t0, float(doc["unix_time"]), t1)
+            except (TypeError, ValueError):
+                pass
         with self._lock:
             st.doc = doc
             st.ready = bool(doc.get("ready"))
             st.last_err = err
             st.last_poll = time.monotonic()
+        if improved:
+            self._flightrec().record_event(
+                "clock_sync", replica=name, **st.clock.view())
         return doc
+
+    # ------------------------------------- metrics federation (ISSUE 17)
+    def poll_metrics_once(self, name: str) -> Optional[dict]:
+        """Fetch one replica's /metrics/snapshot (mergeable registry
+        state + engine telemetry).  Never raises; a failed poll keeps
+        the previous snapshot (stale beats absent for the merge)."""
+        st = self._states[name]
+        try:
+            conn = http.client.HTTPConnection(st.host, st.port,
+                                              timeout=2.0)
+            try:
+                conn.request("GET", "/metrics/snapshot")
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    raise ValueError(f"status {resp.status}")
+                doc = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+        except (OSError, ValueError):
+            _M_FED_POLLS.inc(outcome="error")
+            return None
+        _M_FED_POLLS.inc(outcome="ok")
+        with self._lock:
+            st.snapshot = doc
+        return doc
+
+    def poll_metrics_all(self) -> None:
+        """One federation sweep: refresh every replica's snapshot,
+        rebuild the merged fleet registry, feed the burn monitor and
+        apply the auto-cordon policy."""
+        self._last_metrics_poll = time.monotonic()
+        for name in list(self._states):
+            self.poll_metrics_once(name)
+        with self._lock:
+            snaps = {n: s.snapshot for n, s in self._states.items()
+                     if s.snapshot is not None}
+        merged = _federation.merge_snapshots(snaps)
+        with self._fed_lock:
+            self._fed_registry = merged
+            self._fed_time = time.monotonic()
+        self._update_burn(snaps)
+
+    def _update_burn(self, snaps: Dict[str, dict]) -> None:
+        """Feed the burn monitor from each snapshot's engine telemetry
+        (good = finished requests, bad = TTFT-SLO violations + error/
+        poisoned outcomes) and apply the cordon policy."""
+        for name, snap in snaps.items():
+            eng = (snap or {}).get("engine") or {}
+            outcomes = eng.get("outcomes") or {}
+            bad = (float(outcomes.get("error", 0))
+                   + float(outcomes.get("poisoned", 0))
+                   + float(eng.get("slo_violations_ttft", 0)))
+            good = float(eng.get("finished", 0))
+            self._burn.observe(name, good=good, bad=bad)
+            st = self._states[name]
+            st.burn_fast = self._burn.burn(name, self._burn.fast_window_s)
+            st.burn_slow = self._burn.burn(name, self._burn.slow_window_s)
+            if st.burn_fast is not None:
+                _M_SLO_BURN.set(round(st.burn_fast, 4), replica=name)
+        if not bool(_flags.get_flag("fleet_slo_burn_cordon")):
+            return
+        for name in list(snaps):
+            st = self._states[name]
+            if not st.cordoned and self._burn.burning(name):
+                with self._lock:
+                    # never cordon the LAST uncordoned replica: the
+                    # cordon is a preference, and an all-cordoned fleet
+                    # only survives via the degraded plan — prefer
+                    # keeping one normal candidate
+                    others = [s for s in self._states.values()
+                              if s is not st and not s.cordoned]
+                    if not others:
+                        continue
+                    st.cordoned = True
+                    st.auto_cordoned = True
+                self._flightrec().record_event(
+                    "slo_cordon", replica=name,
+                    fast_burn=st.burn_fast, slow_burn=st.burn_slow)
+            elif st.auto_cordoned and self._burn.recovered(name):
+                with self._lock:
+                    st.cordoned = False
+                    st.auto_cordoned = False
+                self._flightrec().record_event(
+                    "slo_uncordon", replica=name,
+                    fast_burn=st.burn_fast)
+
+    def fleet_metrics_text(self) -> str:
+        """The federated fleet_* view as Prometheus text.  With the
+        federation poller off (fleet_metrics_interval_s == 0) this
+        federates once on demand — a scrape always answers."""
+        with self._fed_lock:
+            reg = self._fed_registry
+        if reg is None:
+            self.poll_metrics_all()
+            with self._fed_lock:
+                reg = self._fed_registry
+        if reg is None:
+            return ""
+        return _federation.render_fleet(reg)
 
     def cordon(self, name: str) -> None:
         """Stop routing NEW requests to ``name`` (rolling restart takes
         the replica out BEFORE draining it — no window where the router
-        races the healthz flip)."""
+        races the healthz flip).  A manual cordon clears the
+        auto-cordon marker: the burn monitor no longer owns (and will
+        not auto-lift) this cordon."""
         with self._lock:
             self._states[name].cordoned = True
+            self._states[name].auto_cordoned = False
 
     def uncordon(self, name: str) -> None:
         with self._lock:
             self._states[name].cordoned = False
+            self._states[name].auto_cordoned = False
 
     def healthz(self) -> dict:
         with self._lock:
@@ -308,6 +515,15 @@ class FleetRouter:
     def describe(self) -> dict:
         doc = self.healthz()
         doc["stats"] = self.stats()
+        # fleet-aggregate latency view from the federated sketches
+        # (present once a federation sweep has run) + the burn readout
+        with self._fed_lock:
+            reg = self._fed_registry
+        if reg is not None:
+            doc["fleet_latency"] = _federation.fleet_latency(reg)
+        burn = self._burn.view()
+        if burn:
+            doc["slo_burn"] = burn
         return doc
 
     def stats(self) -> dict:
@@ -371,7 +587,27 @@ class FleetRouter:
         except (KeyError, TypeError, ValueError) as e:
             handler._send(400, {"error": f"bad request body: {e!r}"})
             return
+        # distributed trace (ISSUE 17): adopt the client's trace id or
+        # mint one, then forward `<trace_id>-<router_span>` so the
+        # replica's Request joins the same trace with the router hop as
+        # its parent span.  Flag off: forward a client header verbatim
+        # (explicit context still propagates), mint nothing.
+        client_header = handler.headers.get(_tracing.TRACE_HEADER)
+        trace_id, _ = _tracing.parse_header(client_header)
+        trace_header = client_header if trace_id else None
+        router_span = None
+        if bool(_flags.get_flag("fleet_trace")):
+            if trace_id is None:
+                trace_id = _tracing.mint_trace_id()
+            router_span = _tracing.new_span_id()
+            trace_header = _tracing.format_header(trace_id, router_span)
+        t_route0 = time.time()
         plan = self.plan(prompt_ids)
+        if router_span is not None:
+            self._flightrec().record_span(
+                "plan", "router", t_route0, time.time(),
+                trace_id=trace_id, span=router_span, home=plan["home"],
+                degraded=plan["degraded"])
         if plan["shed"]:
             self.sheds += 1
             _M_SHEDS.inc()
@@ -395,7 +631,7 @@ class FleetRouter:
                 if i or not first_pass:
                     self.failovers += 1
                     _M_FAILOVERS.inc()
-                got = self._proxy_begin(st, body)
+                got = self._proxy_begin(st, body, trace_header)
                 if got is None:
                     continue
                 # account BEFORE relaying: the replica has accepted the
@@ -411,7 +647,13 @@ class FleetRouter:
                 else:
                     self.fallbacks += 1
                     _M_AFFINITY.inc(outcome="fallback")
+                t_proxy0 = time.time()
                 self._relay(handler, *got)
+                if router_span is not None:
+                    self._flightrec().record_span(
+                        "proxy", "router", t_proxy0, time.time(),
+                        trace_id=trace_id, span=router_span,
+                        replica=name)
                 return
             if time.monotonic() >= deadline:
                 break
@@ -424,20 +666,25 @@ class FleetRouter:
         handler._send(503, {"error": "no replica accepted the request",
                             "tried": plan["order"]})
 
-    def _proxy_begin(self, st: _ReplicaState, body: bytes):
+    def _proxy_begin(self, st: _ReplicaState, body: bytes,
+                     trace_header: Optional[str] = None):
         """One proxy attempt up to the response line: POST the original
-        body to the replica.  Returns ``(conn, resp)`` once the replica
-        has ACCEPTED the request (any status but 503 — a replica's own
-        400 is authoritative: the request reached an engine); None on a
-        pre-response failure or a 503 (draining/warming — candidate
-        unusable, caller fails over), marking the replica down inline."""
+        body to the replica, forwarding the trace context header so the
+        replica's records join the router's trace.  Returns
+        ``(conn, resp)`` once the replica has ACCEPTED the request (any
+        status but 503 — a replica's own 400 is authoritative: the
+        request reached an engine); None on a pre-response failure or a
+        503 (draining/warming — candidate unusable, caller fails over),
+        marking the replica down inline."""
         conn = None
         try:
             _chaos.inject("fleet.proxy.connect")
             conn = http.client.HTTPConnection(
                 st.host, st.port, timeout=self.proxy_timeout_s)
-            conn.request("POST", "/generate", body=body,
-                         headers={"Content-Type": "application/json"})
+            headers = {"Content-Type": "application/json"}
+            if trace_header:
+                headers[_tracing.TRACE_HEADER] = trace_header
+            conn.request("POST", "/generate", body=body, headers=headers)
             resp = conn.getresponse()
         except OSError as e:
             if conn is not None:

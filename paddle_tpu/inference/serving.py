@@ -143,7 +143,7 @@ import os
 import threading
 from collections import deque
 from contextlib import contextmanager
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import time
 
@@ -160,6 +160,7 @@ from ..observability import export as _export
 from ..observability import xray as _xray
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _metrics
+from ..observability import quantiles as _quantiles
 from . import quant as _squant
 from .prefix_cache import PrefixCache
 
@@ -292,6 +293,11 @@ _M_RUNNING = _metrics.gauge(
     "serving.running", "batch slots currently holding a request")
 _M_WAITING = _metrics.gauge(
     "serving.waiting", "requests queued for admission")
+_M_OUTCOMES = _metrics.counter(
+    "serving.request_outcomes", "terminal request outcomes, by outcome= "
+    "finished | cancelled | error | poisoned | drained | slo_shed | "
+    "rejected:<reason>; the fleet federation sums these per replica and "
+    "the SLO burn-rate monitor reads error|poisoned as budget burn")
 
 
 class TickTimeout(RuntimeError):
@@ -317,7 +323,9 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
-                 seed: Optional[int] = None, priority: int = 0):
+                 seed: Optional[int] = None, priority: int = 0,
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None):
         Request._counter += 1
         self.rid = Request._counter
         self.prompt_ids = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
@@ -382,7 +390,23 @@ class Request:
         self._drafter = None      # per-request n-gram table (spec_draft=
                                   # ngram; created lazily at first spec
                                   # dispatch)
+        # distributed trace context (ISSUE 17): minted by the fleet
+        # router (X-Graft-Trace header) or the caller; threaded into
+        # every lifecycle / flight record this request produces so the
+        # fleet-trace merge can follow it across processes
+        self.trace_id: Optional[str] = trace_id
+        self.parent_span: Optional[str] = parent_span
         self.trace: Optional[dict] = None   # final record, set at finish
+
+    def _trace_ctx(self) -> dict:
+        """``{trace_id, parent_span}`` when traced, else ``{}`` — the
+        splat that tags a lifecycle record with this request's trace."""
+        if self.trace_id is None:
+            return {}
+        ctx = {"trace_id": self.trace_id}
+        if self.parent_span is not None:
+            ctx["parent_span"] = self.parent_span
+        return ctx
 
     def cancel(self) -> None:
         """Ask the engine to drop this request at its next scheduler
@@ -819,6 +843,23 @@ class ServingEngine:
         self.tick_errors = 0
         self.poisoned_requests = 0
         self.dispatch_retries = 0
+        # --- fleet telemetry evidence (ISSUE 17): always-on, host-side
+        # floats only — the federation snapshot and the router's SLO
+        # burn-rate monitor read these even with the metrics gate off.
+        # _ev_tpot is a tick-level sketch (one harvest gap imputed to
+        # the k tokens it yielded), NOT per-request timing: the
+        # "tracing off = zero per-request work" pin stays intact.
+        self._ev_outcomes: Dict[str, int] = {}
+        self._ev_tpot = _quantiles.QuantileSketch()
+        self._ev_slo_viol = 0
+        self._ev_finished = 0
+        self._ev_finished_tokens = 0
+        # per-engine flight recorder (fleet replicas run several engines
+        # in one process; None = the module-global default recorder)
+        self._flight_rec = None
+        # live chunks_per_tick controller state (ISSUE 17 satellite:
+        # FLAGS_serving_chunks_per_tick_auto); None until first consult
+        self._chunk_budget_now: Optional[int] = None
         # warm restart: import the newest valid prefix-cache export
         # (hash-chain index + block KV contents) a draining predecessor
         # left under FLAGS_serving_prefix_export_dir — entries re-pin
@@ -1586,6 +1627,7 @@ class ServingEngine:
             # admission is CLOSED while draining: new traffic belongs
             # on another replica (healthz already answers 503 draining)
             _M_REJECTIONS.inc(reason="draining")
+            self._ev_note("rejected:draining")
             if traced:
                 self._reject_trace(req, "draining")
             raise ValueError(
@@ -1593,6 +1635,7 @@ class ServingEngine:
                 "another replica)")
         if L + req.max_new_tokens > self.max_context:
             _M_REJECTIONS.inc(reason="over_context")
+            self._ev_note("rejected:over_context")
             if traced:
                 self._reject_trace(req, "over_context")
             raise ValueError(
@@ -1607,6 +1650,7 @@ class ServingEngine:
             - self._blocks_for(L))
         if worst > self.num_blocks:
             _M_REJECTIONS.inc(reason="capacity")
+            self._ev_note("rejected:capacity")
             if traced:
                 self._reject_trace(req, "capacity")
             raise ValueError(
@@ -1631,10 +1675,24 @@ class ServingEngine:
         req.outcome = reason
         rec = {"rid": req.rid, "outcome": f"rejected:{reason}",
                "prompt_len": len(req.prompt_ids),
-               "max_new_tokens": req.max_new_tokens}
+               "max_new_tokens": req.max_new_tokens,
+               **req._trace_ctx()}
         req.trace = rec
-        _flight.default_recorder().record_event("request", **rec)
+        self._flightrec().record_event("request", **rec)
         _export.record_request(rec)
+
+    def _flightrec(self) -> "_flight.FlightRecorder":
+        """This engine's flight recorder: the injected per-engine one
+        (fleet replicas — several engines in one process must not
+        interleave their rings) or the module-global default."""
+        rec = self._flight_rec
+        return rec if rec is not None else _flight.default_recorder()
+
+    def _ev_note(self, outcome: str) -> None:
+        """Always-on terminal-outcome tally (fleet federation + SLO
+        burn-rate evidence); the metrics twin feeds the scrape."""
+        self._ev_outcomes[outcome] = self._ev_outcomes.get(outcome, 0) + 1
+        _M_OUTCOMES.inc(outcome=outcome)
 
     def _blocks_for(self, n_tokens: int) -> int:
         return math.ceil(n_tokens / self.bs)
@@ -1776,7 +1834,7 @@ class ServingEngine:
         req = self.slot_req[slot]
         if req is None:
             return
-        _flight.default_recorder().record_event(
+        self._flightrec().record_event(
             "slot_error", slot=slot, rid=req.rid, error=error[:200])
         if req._prefilling:
             self._abort_prefill(req, outcome="error")
@@ -1802,7 +1860,7 @@ class ServingEngine:
             req.outcome = "poisoned"
             if _metrics.enabled():
                 self._reject_trace(req, "poisoned")
-            _flight.default_recorder().record_event(
+            self._flightrec().record_event(
                 "poison_quarantine", rid=req.rid, strikes=req._strikes,
                 error=error[:200])
             self.finished.append(req)
@@ -1845,7 +1903,7 @@ class ServingEngine:
         _M_TICK_ERRORS.inc()
         err = f"{type(exc).__name__}: {exc}"[:200]
         req = getattr(exc, "_serving_req", None)
-        _flight.default_recorder().record_event(
+        self._flightrec().record_event(
             "tick_error", error=err,
             scope="request" if req is not None else "tick",
             rid=getattr(req, "rid", None))
@@ -2124,7 +2182,14 @@ class ServingEngine:
         self._admit_times.append(t_now)
         t_enq = getattr(req, "_t_enqueue_ev", None)
         if t_enq is not None:
-            self._ttft_recent.append(t_now - t_enq)
+            ttft_ev = t_now - t_enq
+            self._ttft_recent.append(ttft_ev)
+            # always-on TTFT-SLO violation tally: the fleet burn-rate
+            # monitor's "bad event" input (the metrics-gated twin above
+            # feeds the scrape counter)
+            slo_ev = _flags.get_flag("serving_ttft_slo_ms")
+            if slo_ev > 0 and ttft_ev * 1e3 > slo_ev:
+                self._ev_slo_viol += 1
         req.output_ids.append(first)
         req._stream_push(first)
         req.slot = slot
@@ -2173,6 +2238,9 @@ class ServingEngine:
                 len(req.output_ids) >= req.max_new_tokens:
             req.done = True
             req.outcome = "finished"
+            self._ev_note("finished")
+            self._ev_finished += 1
+            self._ev_finished_tokens += len(req.output_ids)
             req._stream_push(None)      # close the SSE token stream
             # _t_first may lag _t_enqueue if the metrics gate flipped
             # between enqueue and admission; trace only complete timelines
@@ -2198,13 +2266,14 @@ class ServingEngine:
                                     / max(n_out - 1, 1), 6),
                "e2e_s": round(e2e, 6),
                "prefix_blocks": req._prefix_blocks,
-               "prefill_chunks": req._prefill_chunks}
+               "prefill_chunks": req._prefill_chunks,
+               **req._trace_ctx()}
         if self.spec:
             rec["spec_accept_rate"] = round(
                 req._spec_accepted / max(req._spec_proposed, 1), 4)
             rec["spec_draft"] = self.spec_kind
         req.trace = rec
-        _flight.default_recorder().record_event("request", **rec)
+        self._flightrec().record_event("request", **rec)
         _export.record_request(rec)
 
     def _evict(self, slot: int):
@@ -2287,6 +2356,8 @@ class ServingEngine:
         self._evict_done()
         budget = max(1, int(_flags.get_flag(
             "serving_prefill_chunks_per_tick")))
+        if _flags.get_flag("serving_chunks_per_tick_auto"):
+            budget = self._auto_chunk_budget(budget)
         spent = 0
         while spent < budget:
             if self.prefilling:
@@ -2302,6 +2373,32 @@ class ServingEngine:
             # chunk, dispatched by the next loop pass, does
             if not self._try_admit():
                 break
+
+    def _auto_chunk_budget(self, max_budget: int) -> int:
+        """Live chunks-per-tick controller (ISSUE 17 satellite,
+        FLAGS_serving_chunks_per_tick_auto): walk the budget one step at
+        a time inside [1, FLAGS_serving_prefill_chunks_per_tick] from
+        the always-on tick-level TPOT sketch against the TPOT SLO.
+        Running p90 over target -> spend fewer chunk programs per
+        boundary (decode gaps shrink); p90 under half the target ->
+        spend more (prompts absorb faster).  No SLO or too little
+        evidence: hold.  Only the BUDGET moves — which chunk programs
+        exist is fixed at construction, so the warmup grid and program
+        signatures never change."""
+        cur = self._chunk_budget_now
+        if cur is None:
+            cur = max_budget
+        cur = min(cur, max_budget)          # flag lowered at runtime
+        target_ms = float(_flags.get_flag("serving_tpot_slo_ms"))
+        if target_ms > 0 and self._ev_tpot.count >= 16:
+            p90 = self._ev_tpot.quantile(0.9)
+            if p90 is not None:
+                if p90 * 1e3 > target_ms:
+                    cur = max(1, cur - 1)
+                elif p90 * 1e3 < 0.5 * target_ms:
+                    cur = min(max_budget, cur + 1)
+        self._chunk_budget_now = cur
+        return cur
 
     def _evict_done(self) -> None:
         for slot in list(range(self.B)):
@@ -2482,7 +2579,7 @@ class ServingEngine:
         self._chunks_this_boundary += 1
         _M_PREFILL_CHUNKS.inc()
         if _metrics.enabled():
-            _flight.default_recorder().record_event(
+            self._flightrec().record_event(
                 "prefill_chunk", rid=req.rid, slot=slot, start=off,
                 tokens=n, done=req._chunk_off >= L)
         if req._chunk_off >= L:
@@ -2542,14 +2639,16 @@ class ServingEngine:
         else; the outcome itself is stamped unconditionally — the SSE
         terminal frame needs it regardless of the metrics gate."""
         req.outcome = outcome
+        self._ev_note(outcome)
         if not _metrics.enabled():
             return
         rec = {"rid": req.rid, "outcome": outcome,
                "prompt_len": len(req.prompt_ids),
                "max_new_tokens": req.max_new_tokens,
-               "tokens_out": len(req.output_ids)}
+               "tokens_out": len(req.output_ids),
+               **req._trace_ctx()}
         req.trace = rec
-        _flight.default_recorder().record_event("request", **rec)
+        self._flightrec().record_event("request", **rec)
         _export.record_request(rec)
 
     def step(self) -> bool:
@@ -2983,6 +3082,12 @@ class ServingEngine:
         self._last_harvest_t = t_done
         dt = t_done - t_from
         harvested = self.tokens_out - toks_before
+        if harvested > 0 and dt > 0:
+            # always-on tick-level TPOT evidence for the fleet telescope
+            # (one harvest gap imputed to the k tokens it yielded) —
+            # deliberately NOT per-request timing, so the "metrics off
+            # = zero per-request tracing work" pin stays intact
+            self._ev_tpot.add(dt / max(k, 1), weight=harvested)
         if _metrics.enabled():
             # per-token inter-token latency (TPOT): tokens arrive k at a
             # time, so each of this harvest's tokens is imputed an equal
@@ -3044,7 +3149,11 @@ class ServingEngine:
                 rec["spec_accepted"] = spec_accepted
             if pend.chunks:
                 rec["prefill_chunks"] = pend.chunks
-            _flight.default_recorder().record_step(rec)
+            tids = sorted({r.trace_id for r, _ in harvested_by
+                           if r.trace_id})
+            if tids:
+                rec["trace_ids"] = tids
+            self._flightrec().record_step(rec)
         # failure isolation (ISSUE 15): rows whose logits screened
         # non-finite are evicted HERE — outcome=error, blocks released
         # through the single accounting path — and every other slot's
@@ -3265,7 +3374,7 @@ class ServingEngine:
         self._drain_requested = True
         self._draining = True
         t0 = time.monotonic()
-        _flight.default_recorder().record_event(
+        self._flightrec().record_event(
             "drain_start", waiting=len(self.waiting),
             running=self.B - len(self.free_slots))
         # the waiting queue was never admitted: hand it back NOW with a
@@ -3313,7 +3422,7 @@ class ServingEngine:
                 export = self.export_prefix_cache(export_dir)
             except Exception as e:  # noqa: BLE001 - drain must finish
                 export = {"error": f"{type(e).__name__}: {e}"[:200]}
-                _flight.default_recorder().record_event(
+                self._flightrec().record_event(
                     "prefix_export_failed", error=export["error"])
         self._drain_info = {
             "drained_s": round(time.monotonic() - t0, 4),
@@ -3321,7 +3430,7 @@ class ServingEngine:
             "cancelled_waiting": cancelled,
             "evicted_running": evicted,
             "export": export}
-        _flight.default_recorder().record_event(
+        self._flightrec().record_event(
             "drain_complete", **{k: v for k, v in
                                  self._drain_info.items()
                                  if k != "export"})
@@ -3394,7 +3503,7 @@ class ServingEngine:
                 "entries": len(index["entries"]),
                 "blocks": len(blocks), "bytes": int(nbytes),
                 "export_s": round(time.perf_counter() - t0, 4)}
-        _flight.default_recorder().record_event("prefix_export", **info)
+        self._flightrec().record_event("prefix_export", **info)
         return info
 
     def release_exported_prefix(self) -> int:
@@ -3412,7 +3521,7 @@ class ServingEngine:
             self.num_blocks, self._release_block,
             lambda b: int(self.block_rc[b]) == 1)
         _jaxsan.blocksan_verify(self)
-        _flight.default_recorder().record_event(
+        self._flightrec().record_event(
             "prefix_handoff_release", blocks=freed)
         return freed
 
@@ -3435,7 +3544,7 @@ class ServingEngine:
             if reason is not None:
                 skipped += 1
                 _M_PREFIX_IMPORT_SKIP.inc(reason="corrupt")
-                _flight.default_recorder().record_event(
+                self._flightrec().record_event(
                     "prefix_import_skip", step=step, reason=reason)
                 continue
             try:
@@ -3444,7 +3553,7 @@ class ServingEngine:
                 if index.get("meta") != self._prefix_fingerprint():
                     skipped += 1
                     _M_PREFIX_IMPORT_SKIP.inc(reason="mismatch")
-                    _flight.default_recorder().record_event(
+                    self._flightrec().record_event(
                         "prefix_import_skip", step=step,
                         reason="engine fingerprint mismatch")
                     continue
@@ -3452,7 +3561,7 @@ class ServingEngine:
             except Exception as e:  # noqa: BLE001 - restart must not die
                 skipped += 1
                 _M_PREFIX_IMPORT_SKIP.inc(reason="unreadable")
-                _flight.default_recorder().record_event(
+                self._flightrec().record_event(
                     "prefix_import_skip", step=step,
                     reason=f"{type(e).__name__}: {e}"[:200])
                 continue
@@ -3460,7 +3569,7 @@ class ServingEngine:
                 "step": step, "blocks": n, "skipped_corrupt": skipped}
             if n:
                 _M_PREFIX_IMPORT.inc(n)
-            _flight.default_recorder().record_event(
+            self._flightrec().record_event(
                 "prefix_import", step=step, blocks=n, skipped=skipped)
             # checksum the imported (registered-immutable) blocks as
             # ground truth — no-op unless blocksan is armed
@@ -3581,7 +3690,27 @@ class ServingEngine:
         if self._ttft_recent:
             srt = sorted(self._ttft_recent)
             ev["ttft_p50_s"] = round(srt[len(srt) // 2], 6)
+        # live decode-capacity evidence (ISSUE 17): median tick-level
+        # TPOT + mean finished length let the router cap a stale
+        # admission rate by what the decode loop can actually drain
+        if self._ev_tpot.count > 0:
+            ev["tpot_p50_s"] = round(self._ev_tpot.quantile(0.5), 6)
+        if self._ev_finished > 0:
+            ev["avg_tokens_out"] = round(
+                self._ev_finished_tokens / self._ev_finished, 3)
         return ev
+
+    def telemetry_snapshot(self) -> dict:
+        """Always-on engine evidence for the fleet federation poll
+        (``/metrics/snapshot``): terminal-outcome tallies, the TTFT-SLO
+        violation count, and the tick-level TPOT sketch state.  Host
+        floats/ints only — independent of FLAGS_enable_metrics."""
+        return {"outcomes": dict(self._ev_outcomes),
+                "slo_violations_ttft": self._ev_slo_viol,
+                "finished": self._ev_finished,
+                "finished_tokens": self._ev_finished_tokens,
+                "tpot_sketch": self._ev_tpot.to_state(),
+                "ttft_evidence": self._ttft_evidence()}
 
     def stats(self) -> dict:
         running = self.B - len(self.free_slots)

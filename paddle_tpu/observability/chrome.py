@@ -21,74 +21,85 @@ Rows (tids under one "serving" process group):
   request span with queue-wait / prefill / decode children, plus an
   instant marker per prefill chunk event — a request's life is
   trace-viewable end to end against the ticks that served it.
+* **spans** — one row per span category for explicit ``kind="span"``
+  flight events (router plan/proxy, handoff export/import — ISSUE 17);
+  each slice keeps its ``trace_id`` in args so chrome's search
+  highlights a request's full cross-process path.
 
 Timestamps are wall-clock unix seconds scaled to microseconds, so tick
-and request rows share one timeline.  Records missing their timing
-fields (metrics gate off at record time, pre-ISSUE-14 dumps without
-``t_unix``) are skipped, not guessed.
+and request rows share one timeline.  For multi-process fleet merges
+(:func:`..tracing.fleet_trace`) callers pass a distinct ``pid`` per
+process, a ``process_name`` metadata label, and a ``clock_offset_s``
+shift that re-expresses this process's timestamps in the merge's common
+(router) timebase.  Records missing their timing fields (metrics gate
+off at record time, pre-ISSUE-14 dumps without ``t_unix``) are skipped,
+not guessed.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 __all__ = ["trace_from_flight"]
 
 _TICK_TID = 0
 
 
-def _x(name: str, cat: str, start_s: float, dur_s: float, tid: int,
-       args: Dict[str, Any] = None) -> Dict[str, Any]:
-    ev = {"name": name, "cat": cat, "ph": "X",
-          "ts": round(start_s * 1e6, 3),
-          "dur": round(max(dur_s, 0.0) * 1e6, 3),
-          "pid": 1, "tid": tid}
-    if args:
-        ev["args"] = args
-    return ev
-
-
-def _thread_name(tid: int, name: str) -> Dict[str, Any]:
-    return {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
-            "args": {"name": name}}
-
-
-def _tick_events(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
-    end = float(rec["t_unix"])
-    wall = float(rec.get("wall_s", 0.0))
-    start = end - wall
-    args = {k: rec[k] for k in ("tokens", "active", "decode_steps",
-                                "overlap", "spec_k", "spec_kind",
-                                "prefill_chunks") if k in rec}
-    out = [_x(f"tick {rec.get('step')}", "tick", start, wall,
-              _TICK_TID, args)]
-    ph = rec.get("phases")
-    if not ph:
-        return out
-    ms = lambda k: float(ph.get(k, 0.0)) / 1e3  # noqa: E731
-    # dispatch-time phases from the start, in their real order
-    t = start
-    for key, label in (("schedule_ms", "schedule"),
-                       ("chunk_prefill_ms", "chunk_prefill"),
-                       ("dispatch_ms", "dispatch")):
-        d = ms(key)
-        if d > 0:
-            out.append(_x(label, "phase", t, d, _TICK_TID))
-            t += d
-    # harvest phases back from the end (the overlap gap sits between)
-    emit, wait = ms("emit_ms"), ms("harvest_wait_ms")
-    if wait > 0:
-        out.append(_x("harvest_wait", "phase",
-                      max(end - emit - wait, t), wait, _TICK_TID))
-    if emit > 0:
-        out.append(_x("emit", "phase", max(end - emit, t), emit,
-                      _TICK_TID))
-    return out
-
-
-def trace_from_flight(doc: Dict[str, Any]) -> Dict[str, Any]:
+def trace_from_flight(doc: Dict[str, Any], *, pid: int = 1,
+                      clock_offset_s: float = 0.0,
+                      process_name: Optional[str] = None) -> Dict[str, Any]:
     """A flight-recorder document -> chrome://tracing JSON object."""
+
+    def _x(name: str, cat: str, start_s: float, dur_s: float, tid: int,
+           args: Dict[str, Any] = None) -> Dict[str, Any]:
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": round((start_s + clock_offset_s) * 1e6, 3),
+              "dur": round(max(dur_s, 0.0) * 1e6, 3),
+              "pid": pid, "tid": tid}
+        if args:
+            ev["args"] = args
+        return ev
+
+    def _thread_name(tid: int, name: str) -> Dict[str, Any]:
+        return {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": name}}
+
+    def _tick_events(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+        end = float(rec["t_unix"])
+        wall = float(rec.get("wall_s", 0.0))
+        start = end - wall
+        args = {k: rec[k] for k in ("tokens", "active", "decode_steps",
+                                    "overlap", "spec_k", "spec_kind",
+                                    "prefill_chunks") if k in rec}
+        out = [_x(f"tick {rec.get('step')}", "tick", start, wall,
+                  _TICK_TID, args)]
+        ph = rec.get("phases")
+        if not ph:
+            return out
+        ms = lambda k: float(ph.get(k, 0.0)) / 1e3  # noqa: E731
+        # dispatch-time phases from the start, in their real order
+        t = start
+        for key, label in (("schedule_ms", "schedule"),
+                           ("chunk_prefill_ms", "chunk_prefill"),
+                           ("dispatch_ms", "dispatch")):
+            d = ms(key)
+            if d > 0:
+                out.append(_x(label, "phase", t, d, _TICK_TID))
+                t += d
+        # harvest phases back from the end (the overlap gap sits between)
+        emit, wait = ms("emit_ms"), ms("harvest_wait_ms")
+        if wait > 0:
+            out.append(_x("harvest_wait", "phase",
+                          max(end - emit - wait, t), wait, _TICK_TID))
+        if emit > 0:
+            out.append(_x("emit", "phase", max(end - emit, t), emit,
+                          _TICK_TID))
+        return out
+
     events: List[Dict[str, Any]] = [_thread_name(_TICK_TID, "ticks")]
+    if process_name:
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": process_name}})
     for rec in doc.get("steps", []) or []:
         if rec.get("timeline") == "serving" and "t_unix" in rec:
             events.extend(_tick_events(rec))
@@ -120,7 +131,8 @@ def trace_from_flight(doc: Dict[str, Any]) -> Dict[str, Any]:
                          {k: e[k] for k in ("prompt_len", "tokens_out",
                                             "ticks", "prefix_blocks",
                                             "prefill_chunks",
-                                            "spec_accept_rate")
+                                            "spec_accept_rate",
+                                            "trace_id", "parent_span")
                           if k in e}))
         if qwait > 0:
             events.append(_x("queue_wait", "lifecycle", enq, qwait, tid))
@@ -133,10 +145,30 @@ def trace_from_flight(doc: Dict[str, Any]) -> Dict[str, Any]:
             continue
         events.append({
             "name": f"chunk@{e.get('start')}", "cat": "lifecycle",
-            "ph": "i", "ts": round(float(e["unix_time"]) * 1e6, 3),
-            "pid": 1, "tid": tid_of(e.get("rid")), "s": "t",
+            "ph": "i",
+            "ts": round((float(e["unix_time"]) + clock_offset_s) * 1e6, 3),
+            "pid": pid, "tid": tid_of(e.get("rid")), "s": "t",
             "args": {k: e[k] for k in ("tokens", "slot", "done")
                      if k in e}})
+    # explicit span events (ISSUE 17): one row per span category, each
+    # slice carrying its trace context in args
+    span_tids: Dict[str, int] = {}
+    for e in flight_events:
+        if e.get("kind") != "span" or "start_s" not in e \
+                or "end_s" not in e:
+            continue
+        cat = str(e.get("cat", "span"))
+        tid = span_tids.get(cat)
+        if tid is None:
+            tid = span_tids[cat] = 1000 + len(span_tids)
+            events.append(_thread_name(tid, cat))
+        start = float(e["start_s"])
+        dur = max(float(e["end_s"]) - start, 0.0)
+        args = {k: e[k] for k in e
+                if k not in ("kind", "cat", "name", "start_s", "end_s",
+                             "dur_s", "unix_time")}
+        events.append(_x(str(e.get("name", "span")), cat, start, dur,
+                         tid, args or None))
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"schema": "paddle_tpu.chrome_trace/v1",
                           "source": doc.get("schema"),

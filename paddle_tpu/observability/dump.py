@@ -7,6 +7,8 @@
     python -m paddle_tpu.observability.dump --compile-report
     python -m paddle_tpu.observability.dump --xray      # X-ray ledger
     python -m paddle_tpu.observability.dump --chrome    # chrome trace
+    python -m paddle_tpu.observability.dump --fleet-trace d0 d1 d2
+                                        # merged multi-process timeline
 
 Prints ONE JSON document on stdout (``--prom`` prints Prometheus text
 exposition instead — the same bytes the /metrics endpoint serves).  Default mode locates the newest
@@ -66,9 +68,38 @@ def main(argv=None) -> int:
                         "--dir, or --path) to chrome://tracing JSON on "
                         "stdout: the tick timeline with its phase "
                         "breakdown + one row per request lifecycle")
+    p.add_argument("--fleet-trace", nargs="+", default=None,
+                   metavar="DIR_OR_FILE",
+                   help="merge one flight dump per fleet process "
+                        "(router first, then replicas; each operand is "
+                        "a dump file or a directory searched like --dir) "
+                        "into ONE chrome://tracing JSON on stdout — "
+                        "replica clocks are aligned to the router's via "
+                        "the recorded clock_sync offsets")
     p.add_argument("--path", default=None,
                    help="print this exact dump file (skips the search)")
     args = p.parse_args(argv)
+
+    if args.fleet_trace:
+        from . import tracing
+        docs = []
+        for operand in args.fleet_trace:
+            path = operand
+            if os.path.isdir(operand):
+                path = find_latest_dump(operand)
+                if path is None:
+                    print(f"no flight_*.json dump found in {operand!r}",
+                          file=sys.stderr)
+                    return 1
+            elif not os.path.exists(path):
+                print(f"no such dump file or directory: {operand!r}",
+                      file=sys.stderr)
+                return 1
+            with open(path) as f:
+                docs.append(json.load(f))
+            print(f"(from {path})", file=sys.stderr)
+        print(json.dumps(tracing.fleet_trace(docs), indent=1))
+        return 0
 
     if args.registry:
         from . import metrics

@@ -93,9 +93,12 @@ def _series_of(metric) -> List[Tuple[Dict[str, Any], Any]]:
         return [(dict(k), v) for k, v in items]
 
 
-def render_prometheus(registry: Optional[_metrics.Registry] = None) -> str:
+def render_prometheus(registry: Optional[_metrics.Registry] = None,
+                      name_prefix: str = "") -> str:
     """The registry in text exposition format.  Instruments with no
-    recorded series are omitted (same contract as ``snapshot()``)."""
+    recorded series are omitted (same contract as ``snapshot()``).
+    ``name_prefix`` is prepended to every sanitized metric name — the
+    fleet federation renders its merged registry as ``fleet_*``."""
     if registry is None:
         registry = _metrics._default
     with registry._lock:
@@ -108,7 +111,7 @@ def render_prometheus(registry: Optional[_metrics.Registry] = None) -> str:
         series = _series_of(m)
         if not series:
             continue
-        name = sanitize_name(m.name)
+        name = sanitize_name(name_prefix + m.name)
         # `# HELP` comes from the metric-description registry (explicit
         # describe() wins, instrument help is the auto-registered
         # default); a metric with NO description gets a bare `# TYPE`,

@@ -1,0 +1,294 @@
+"""Fleet-wide metrics federation + SLO burn-rate evidence (ISSUE 17).
+
+Each replica process keeps its own metrics registry (PR 6); the router
+needs one fleet view.  The wire contract is a **mergeable snapshot**:
+
+* counters ship as label-set -> value maps and merge by summation;
+* gauges ship the same way but do NOT sum (a queue depth per replica is
+  meaningful, a fleet sum of last-writer-wins gauges is not) — the merge
+  re-labels every gauge series with ``replica=<name>``;
+* quantile instruments ship their full DDSketch bucket state
+  (:meth:`..quantiles.QuantileSketch.to_state`) and merge by bucket
+  addition, which preserves the 1% rank-error bound — the property the
+  PR 6 sketch was chosen for;
+* histograms are intentionally NOT federated (fixed-bucket cumulative
+  counts carry no mergeable rank bound; the quantile sketches cover the
+  latency surface).
+
+:func:`local_snapshot` is what a replica serves at ``/metrics/snapshot``;
+:func:`merge_snapshots` folds named snapshots into a private
+:class:`..metrics.Registry` (written under each metric's lock,
+bypassing the ``FLAGS_enable_metrics`` write gate — the merge must work
+even in a process that keeps its own instrumentation off);
+:func:`render_fleet` renders that registry as ``fleet_*`` Prometheus
+text; :func:`fleet_latency` pulls the headline TTFT/TPOT/e2e
+p50/p99 aggregates out of the merged serving sketches.
+
+:class:`BurnRateMonitor` turns the federated per-replica error evidence
+into multi-window error-budget burn rates (the SRE-workbook alerting
+shape): a replica is *burning* when BOTH its fast and slow windows burn
+the error budget faster than ``threshold``x, and *recovered* when the
+fast window drops back under 1x.  The router uses this to auto-cordon —
+a preference, not a verdict, per the PR 16 degraded-plan contract.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import export as _export
+from . import metrics as _metrics
+from .quantiles import QuantileSketch
+
+__all__ = ["SNAPSHOT_SCHEMA", "registry_state", "local_snapshot",
+           "merge_snapshots", "render_fleet", "fleet_latency",
+           "BurnRateMonitor"]
+
+SNAPSHOT_SCHEMA = "paddle_tpu.metrics_snapshot/v1"
+
+
+def _key_to_wire(key: Tuple[Tuple[str, str], ...]) -> List[List[str]]:
+    return [[str(k), str(v)] for k, v in key]
+
+
+def _key_from_wire(wire) -> Tuple[Tuple[str, str], ...]:
+    return tuple((str(k), str(v)) for k, v in wire)
+
+
+def registry_state(registry: Optional[_metrics.Registry] = None
+                   ) -> Dict[str, Any]:
+    """The registry's mergeable wire state: per metric, its kind, help
+    and every series (counters/gauges as numbers, quantiles as sketch
+    states).  Histograms are skipped — see the module docstring."""
+    if registry is None:
+        registry = _metrics._default
+    with registry._lock:
+        metrics = [registry._metrics[n] for n in sorted(registry._metrics)]
+    out: Dict[str, Any] = {}
+    for m in metrics:
+        if m.kind not in ("counter", "gauge", "quantile"):
+            continue
+        with m._lock:
+            items = list(m._series.items())
+        if not items:
+            continue
+        series = []
+        for key, val in items:
+            if m.kind == "quantile":
+                series.append({"labels": _key_to_wire(key),
+                               "sketch": val.to_state()})
+            else:
+                series.append({"labels": _key_to_wire(key),
+                               "value": float(val)})
+        out[m.name] = {"kind": m.kind, "help": m.help, "series": series}
+    return out
+
+
+def local_snapshot(engine=None) -> Dict[str, Any]:
+    """What a replica serves at ``/metrics/snapshot``: the mergeable
+    registry state plus the engine's always-on telemetry evidence."""
+    doc = {"schema": SNAPSHOT_SCHEMA,
+           "unix_time": round(time.time(), 3),
+           "pid": os.getpid(),
+           "registry": registry_state()}
+    if engine is not None:
+        try:
+            doc["engine"] = engine.telemetry_snapshot()
+        except Exception:  # noqa: BLE001 - evidence is best-effort
+            pass
+    return doc
+
+
+def _write_series(metric, key: Tuple[Tuple[str, str], ...], value) -> None:
+    """Install a merged series directly (bypasses the module-global
+    ``_ENABLED`` write gate — the fleet view must exist even when this
+    process's own instrumentation is off)."""
+    with metric._lock:
+        metric._series[key] = value
+
+
+def merge_snapshots(snapshots: Dict[str, Dict[str, Any]]
+                    ) -> _metrics.Registry:
+    """Fold ``{replica_name: snapshot_doc}`` into a private registry.
+
+    Counters sum across replicas per label set; quantile sketches merge
+    by bucket addition; gauges keep one series per replica, re-labeled
+    ``replica=<name>``.  Malformed snapshot entries are skipped — one
+    sick replica must not take down the fleet scrape."""
+    reg = _metrics.Registry()
+    sums: Dict[Tuple[str, Tuple], float] = {}
+    sketches: Dict[Tuple[str, Tuple], QuantileSketch] = {}
+    for replica in sorted(snapshots):
+        doc = snapshots[replica] or {}
+        state = doc.get("registry") or {}
+        for name in sorted(state):
+            meta = state[name] or {}
+            kind = meta.get("kind")
+            if kind not in ("counter", "gauge", "quantile"):
+                continue
+            help_text = str(meta.get("help") or "")
+            try:
+                if kind == "counter":
+                    metric = reg.counter(name, help_text)
+                elif kind == "gauge":
+                    metric = reg.gauge(name, help_text)
+                else:
+                    metric = reg.quantile(name, help_text)
+            except ValueError:   # kind collision across replicas
+                continue
+            for ser in meta.get("series") or []:
+                try:
+                    key = _key_from_wire(ser.get("labels") or [])
+                    if kind == "gauge":
+                        key = tuple(sorted(
+                            dict(key, replica=str(replica)).items()))
+                        _write_series(metric, key,
+                                      float(ser.get("value", 0.0)))
+                    elif kind == "counter":
+                        slot = (name, key)
+                        sums[slot] = sums.get(slot, 0.0) \
+                            + float(ser.get("value", 0.0))
+                        _write_series(metric, key, sums[slot])
+                    else:
+                        sk = QuantileSketch.from_state(
+                            ser.get("sketch") or {})
+                        slot = (name, key)
+                        cur = sketches.get(slot)
+                        if cur is None:
+                            sketches[slot] = sk
+                        else:
+                            cur.merge(sk)
+                        _write_series(metric, key, sketches[slot])
+                except Exception:  # noqa: BLE001 - skip sick series
+                    continue
+    return reg
+
+
+def render_fleet(registry: _metrics.Registry) -> str:
+    """The merged registry as ``fleet_*`` Prometheus text."""
+    return _export.render_prometheus(registry, name_prefix="fleet_")
+
+
+_LATENCY_METRICS = {"ttft": "serving.ttft_seconds",
+                    "tpot": "serving.tpot_seconds",
+                    "e2e": "serving.e2e_seconds"}
+
+
+def fleet_latency(registry: _metrics.Registry) -> Dict[str, Any]:
+    """Headline fleet latency aggregates from the merged sketches:
+    ``{ttft: {p50_s, p99_s, count}, tpot: ..., e2e: ...}`` — series
+    across label sets of one metric are merged for the headline."""
+    out: Dict[str, Any] = {}
+    for short, name in _LATENCY_METRICS.items():
+        m = registry.get(name)
+        if m is None or m.kind != "quantile":
+            continue
+        with m._lock:
+            sketches = list(m._series.values())
+        if not sketches:
+            continue
+        total = QuantileSketch(sketches[0].alpha)
+        for sk in sketches:
+            total.merge(sk)
+        if total.count <= 0:
+            continue
+        out[short] = {"p50_s": total.quantile(0.5),
+                      "p99_s": total.quantile(0.99),
+                      "mean_s": total.mean,
+                      "count": total.count}
+    return out
+
+
+# ------------------------------------------------------ burn-rate monitor
+
+
+class BurnRateMonitor:
+    """Multi-window error-budget burn per replica.
+
+    Feed cumulative ``(good, bad)`` event counts per replica (bad =
+    TTFT-SLO violations + ``error``/``poisoned`` outcomes from the
+    federated engine evidence); :meth:`burn` reports the burn rate over
+    a trailing window — the window's bad fraction divided by the error
+    budget, so burn 1.0 spends the budget exactly at the sustainable
+    rate.  :meth:`burning` requires BOTH windows hot (the fast window
+    catches the spike, the slow window keeps blips from flapping the
+    cordon); :meth:`recovered` needs only the fast window cool, so a
+    healed replica comes back quickly.  ``now`` parameters make the
+    windowed math testable without sleeping.
+    """
+
+    def __init__(self, fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0,
+                 threshold: float = 2.0,
+                 error_budget: float = 0.05):
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.threshold = float(threshold)
+        self.error_budget = max(float(error_budget), 1e-9)
+        self._samples: Dict[str, deque] = {}
+
+    def observe(self, replica: str, good: float, bad: float,
+                now: Optional[float] = None) -> None:
+        """Record one poll of CUMULATIVE good/bad counts for a replica."""
+        t = time.time() if now is None else float(now)
+        q = self._samples.setdefault(str(replica), deque())
+        q.append((t, float(good), float(bad)))
+        horizon = t - max(self.slow_window_s, self.fast_window_s) - 1.0
+        while len(q) > 2 and q[1][0] <= horizon:
+            q.popleft()
+
+    def _window_rate(self, q, window_s: float, now: float
+                     ) -> Optional[float]:
+        """Bad fraction of events inside the trailing window, or None
+        when the window has no new events (no evidence, no burn)."""
+        cutoff = now - window_s
+        base = None
+        for t, good, bad in q:
+            if t <= cutoff:
+                base = (good, bad)
+            else:
+                break
+        if base is None:
+            base = (q[0][1], q[0][2])
+        t_last, good_last, bad_last = q[-1]
+        dg = good_last - base[0]
+        db = bad_last - base[1]
+        total = dg + db
+        if total <= 0:
+            return None
+        return max(db, 0.0) / total
+
+    def burn(self, replica: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """Burn rate over the trailing window: bad-fraction divided by
+        the error budget (None without evidence in the window)."""
+        q = self._samples.get(str(replica))
+        if not q:
+            return None
+        t = time.time() if now is None else float(now)
+        rate = self._window_rate(q, window_s, t)
+        if rate is None:
+            return None
+        return rate / self.error_budget
+
+    def burning(self, replica: str, now: Optional[float] = None) -> bool:
+        fast = self.burn(replica, self.fast_window_s, now)
+        slow = self.burn(replica, self.slow_window_s, now)
+        return (fast is not None and fast >= self.threshold
+                and slow is not None and slow >= self.threshold)
+
+    def recovered(self, replica: str, now: Optional[float] = None) -> bool:
+        fast = self.burn(replica, self.fast_window_s, now)
+        return fast is not None and fast < 1.0
+
+    def view(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Per-replica burn readout for ``/fleet`` and the gauges."""
+        out: Dict[str, Any] = {}
+        for name in sorted(self._samples):
+            out[name] = {
+                "fast_burn": self.burn(name, self.fast_window_s, now),
+                "slow_burn": self.burn(name, self.slow_window_s, now)}
+        return out

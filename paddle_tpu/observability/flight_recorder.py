@@ -109,6 +109,18 @@ class FlightRecorder:
             self._events.append(dict(info, kind=kind,
                                      unix_time=round(time.time(), 3)))
 
+    def record_span(self, name: str, cat: str, start_s: float,
+                    end_s: float, **info) -> None:
+        """One completed span as a ``kind="span"`` event: explicit
+        start/end unix seconds (the chrome exporter renders these as
+        slices on a per-``cat`` row), plus any trace context — the
+        fleet-tracing building block (ISSUE 17)."""
+        self.record_event("span", name=name, cat=cat,
+                          start_s=round(float(start_s), 6),
+                          end_s=round(float(end_s), 6),
+                          dur_s=round(float(end_s) - float(start_s), 6),
+                          **info)
+
     def note_nonfinite(self, site: str, step: Optional[int] = None,
                        value: Optional[float] = None) -> bool:
         """Record a non-finite observation; only the FIRST one per run is

@@ -95,6 +95,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200,
                            "text/plain; version=0.0.4; charset=utf-8",
                            body)
+            elif url.path == "/metrics/snapshot":
+                # mergeable registry state + engine telemetry for the
+                # fleet federation poll (ISSUE 17): counters/gauges as
+                # numbers, quantile instruments as DDSketch bucket
+                # states the router merges by bucket addition
+                from . import federation as _federation
+                doc = _federation.local_snapshot(engine=self._engine())
+                self._send(200, "application/json",
+                           json.dumps(doc, default=repr).encode())
             elif url.path == "/healthz":
                 import os
                 doc = {"ok": True, "pid": os.getpid(),
@@ -178,6 +187,13 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": f"bad request body: {e!r}"}).encode())
             return
         from ..inference.serving import Request
+        from . import tracing as _tracing
+        # distributed trace context (ISSUE 17): the fleet router (or
+        # any client) ships `X-Graft-Trace: <trace_id>-<span_id>`; the
+        # id threads into the Request so every lifecycle/flight record
+        # this replica writes joins the cross-process trace
+        trace_id, parent_span = _tracing.parse_header(
+            self.headers.get(_tracing.TRACE_HEADER))
         req = Request(
             prompt_ids,
             max_new_tokens=int(body.get("max_new_tokens", 32)),
@@ -187,7 +203,8 @@ class _Handler(BaseHTTPRequestHandler):
             top_k=int(body.get("top_k", 0)),
             top_p=float(body.get("top_p", 1.0)),
             seed=body.get("seed"),
-            priority=int(body.get("priority", 0)))
+            priority=int(body.get("priority", 0)),
+            trace_id=trace_id, parent_span=parent_span)
         timeout_s = float(body.get("timeout_s", 120.0))
         # the stream queue must exist BEFORE enqueue: the engine thread
         # may emit the first token between add_request and our loop

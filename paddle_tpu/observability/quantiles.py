@@ -136,6 +136,37 @@ class QuantileSketch:
     def mean(self) -> Optional[float]:
         return (self.sum / self.count) if self.count else None
 
+    # ------------------------------------------------- wire serialization
+    def to_state(self) -> Dict[str, object]:
+        """JSON-able wire form for cross-process merge (fleet federation).
+
+        Bucket indices become string keys (JSON objects can't have int
+        keys); ``from_state(to_state())`` round-trips exactly, so merging
+        shipped states preserves the rank-error bound."""
+        empty = self.count <= 0
+        return {"alpha": self.alpha, "max_bins": self.max_bins,
+                "bins": {str(k): w for k, w in self._bins.items()},
+                "zeros": self._zeros, "count": self.count, "sum": self.sum,
+                "min": None if empty else self.min,
+                "max": None if empty else self.max}
+
+    @classmethod
+    def from_state(cls, state: Dict[str, object]) -> "QuantileSketch":
+        """Rebuild a sketch from :meth:`to_state` output."""
+        sk = cls(alpha=float(state.get("alpha", 0.01)),
+                 max_bins=int(state.get("max_bins", 2048)))
+        for k, w in dict(state.get("bins") or {}).items():
+            sk._bins[int(k)] = float(w)
+        sk._zeros = float(state.get("zeros", 0.0))
+        sk.count = float(state.get("count", 0.0))
+        sk.sum = float(state.get("sum", 0.0))
+        mn, mx = state.get("min"), state.get("max")
+        sk.min = math.inf if mn is None else float(mn)
+        sk.max = -math.inf if mx is None else float(mx)
+        while len(sk._bins) > sk.max_bins:
+            sk._collapse()
+        return sk
+
     def to_dict(self, quantiles: Sequence[float] = DEFAULT_QUANTILES
                 ) -> Dict[str, object]:
         empty = self.count <= 0

@@ -793,10 +793,74 @@ class UnpairedKVHandoff(Rule):
         return out
 
 
+# =========================================================== R012
+class UnpropagatedTraceContext(Rule):
+    """A scope that handles distributed trace context — it mentions the
+    ``X-Graft-Trace`` header literal or constructs a serving `Request`
+    — and then crosses a process/engine boundary (an HTTP
+    ``conn.request(...)`` or a ``hand_off(...)``) WITHOUT threading any
+    trace context into that boundary call.  A hop that drops the trace
+    id splits the fleet timeline: `dump --fleet-trace` renders the
+    downstream spans as an orphan trace, and the whole point of the
+    telescope — one request, one timeline, every process — is lost.
+    Boundary calls whose source text carries a trace argument (a
+    ``trace_id=``/header kwarg, a ``_trace``-named variable, the
+    TRACE_HEADER constant) pass.  Scopes with no boundary call, or no
+    trace source, are fine — only the shape where context is IN HAND
+    and then dropped at the hop is flagged.  See
+    inference/fleet/handoff.py for the canonical compliant site."""
+
+    id = "R012"
+    name = "unpropagated-trace-context"
+
+    _HEADER = "X-Graft-Trace"
+    _REQUEST = "Request"
+    _SINKS = ("request", "hand_off")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in sf.scopes():
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            has_source = False
+            sinks: List[ast.Call] = []
+            for n in sf.scope_walk(scope):
+                if isinstance(n, ast.Constant) and n.value == self._HEADER:
+                    has_source = True
+                elif isinstance(n, ast.Call):
+                    seg = callee_segment(n.func)
+                    if seg == self._REQUEST:
+                        has_source = True
+                    elif seg in self._SINKS:
+                        sinks.append(n)
+            if not has_source:
+                continue
+            for call in sinks:
+                try:
+                    text = ast.unparse(call)
+                except Exception:  # pragma: no cover - malformed node
+                    continue
+                if "trace" in text.lower():
+                    continue
+                seg = callee_segment(call.func)
+                out.append(self.finding(
+                    sf, call,
+                    f"`{sf.qualname(scope) or '<lambda>'}` holds trace "
+                    f"context (the `{self._HEADER}` header or a serving "
+                    f"`Request`) but its `{seg}(...)` boundary call "
+                    "carries none of it: thread the trace id through "
+                    "the hop (forward the header / pass `trace_id=`) or "
+                    "the downstream spans render as an orphan trace in "
+                    "`dump --fleet-trace`"))
+                break
+        return out
+
+
 RULES: List[Rule] = [
     HostSyncInTracedCode(), AliasUnsafeDeviceInput(), UseAfterDonate(),
     TraceTimeFlagRead(), LockOrderInversion(), UnsyncedTiming(),
-    UnpairedKVHandoff(),
+    UnpairedKVHandoff(), UnpropagatedTraceContext(),
 ]
 
 # the interprocedural rule set (R007-R010) registers itself here; the

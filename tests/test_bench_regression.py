@@ -355,6 +355,48 @@ def test_fleet_rung_schema():
     assert val["failovers"] >= 0
 
 
+@pytest.mark.slow   # three replicas warm behind the router — too heavy
+                    # for the tier-1 budget; full runs cover it
+def test_fleet_telescope_rung_schema():
+    """Pin the ISSUE 17 `fleet_telescope` rung's record schema: 3
+    in-process replicas behind the router, trace propagation toggled
+    over paired windows (`fleet_trace_overhead_pct` is the regression
+    key), a federated /fleet/metrics scrape, and the multi-process
+    fleet_trace merge over the run's real flight dumps."""
+    import importlib.util
+    import os
+    from types import SimpleNamespace
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_module_fleet_telescope", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_fleet_telescope(ctx)
+    rec = {"rung": "fleet_telescope", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert harness.get_rung("fleet_telescope").smoke
+    assert bench._REGRESSION_KEYS["fleet_telescope"] == \
+        "fleet_trace_overhead_pct"
+    # the acceptance claims: the telescope sees the whole fleet (one
+    # trace id spans >1 process, every process row merged, the
+    # federated scrape renders) and costs little
+    assert val["trace_processes"] == 4            # router + 3 replicas
+    assert val["trace_ids_cross_process"] >= 1
+    assert val["trace_ids_merged"] >= 1
+    assert val["trace_events"] > 0
+    assert val["fleet_metric_lines"] > 0
+    assert val["fleet_ttft_p99_ms"] > 0
+    assert val["streams_per_sec_on"] > 0
+    assert val["streams_per_sec_off"] > 0
+    assert val["fleet_trace_overhead_pct"] < 50.0
+    assert len(val["overhead_pct_windows"]) >= 2
+
+
 @pytest.mark.slow   # the subprocess compiles ~nine engine configs —
                     # too heavy for the tier-1 budget; full runs cover it
 def test_spec_decode_rung_schema():

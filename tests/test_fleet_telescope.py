@@ -1,0 +1,825 @@
+"""Fleet telescope (ISSUE 17): cross-process distributed tracing,
+fleet-wide metrics federation, and SLO burn-rate driven cordoning.
+
+Fast layers — pure math (trace header grammar, ClockSync min-RTT
+filter, DDSketch wire state + merge-vs-union rank error, burn-rate
+windowed math with injected clocks), stub replicas (trace header
+propagation through the router proxy, /fleet/metrics Prometheus
+rendering against a hand-merged sketch, auto-cordon + recovery off
+crafted /metrics/snapshot documents), and synthetic flight dumps
+(fleet_trace multi-process merge + the `dump --fleet-trace` CLI).
+The @slow layer is the burn-rate chaos drill: concurrent /generate
+traffic stays 200 while the burn monitor cordons the burning replica
+and lifts the cordon after recovery — zero dropped streams.
+"""
+
+import io
+import json
+import threading
+import time
+from contextlib import redirect_stderr, redirect_stdout
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from paddle_tpu.flags import flag_guard, get_flag
+from paddle_tpu.inference.fleet import FleetRouter, hand_off
+from paddle_tpu.inference.fleet.router import predict_ttft_s
+from paddle_tpu.inference.serving import Request, ServingEngine
+from paddle_tpu.observability import dump as _dump
+from paddle_tpu.observability import federation as _federation
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import http as _http
+from paddle_tpu.observability import metrics as _metrics
+from paddle_tpu.observability import tracing as _tracing
+from paddle_tpu.observability.quantiles import QuantileSketch
+
+SSE_PAYLOAD = (b'data: {"token": 7, "n": 0}\n\n'
+               b'event: done\n'
+               b'data: {"rid": 1, "outcome": "finished", '
+               b'"output_ids": [7]}\n\n')
+
+READY_DOC = {"ready": True, "running": 0, "waiting": 0, "queue_depth": 0,
+             "slots": 2, "free_slots": 2, "prefilling": 0,
+             "ttft_evidence": {"admit_rate_per_s": 0.0,
+                               "ttft_p50_s": 0.0, "samples": 0}}
+
+
+class _TelescopeHandler(BaseHTTPRequestHandler):
+    """Stub replica frontend: per-path canned GET docs, POST /generate
+    records (headers, body) and replays a fixed SSE stream."""
+
+    def log_message(self, format, *args):  # noqa: A002
+        pass
+
+    def _reply(self, code, ctype, body):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        for prefix in ("/metrics/snapshot", "/healthz"):
+            if self.path.startswith(prefix):
+                doc = self.server.docs.get(prefix)
+                if doc is None:
+                    self._reply(404, "application/json", b"{}")
+                    return
+                code = 200
+                if prefix == "/healthz" and not doc.get("ready"):
+                    code = 503
+                self._reply(code, "application/json",
+                            json.dumps(doc).encode())
+                return
+        self._reply(404, "application/json", b"{}")
+
+    def do_POST(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length") or 0)
+        self.server.posts.append((dict(self.headers), self.rfile.read(n)))
+        self._reply(200, "text/event-stream", SSE_PAYLOAD)
+
+
+class _StubReplica:
+    def __init__(self, healthz=None, snapshot=None):
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                          _TelescopeHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.docs = {"/healthz": dict(healthz or READY_DOC)}
+        if snapshot is not None:
+            self._httpd.docs["/metrics/snapshot"] = snapshot
+        self._httpd.posts = []
+        self.port = self._httpd.server_address[1]
+        self._t = threading.Thread(target=self._httpd.serve_forever,
+                                   daemon=True)
+        self._t.start()
+
+    @property
+    def addr(self):
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def posts(self):
+        return self._httpd.posts
+
+    def set_snapshot(self, doc):
+        self._httpd.docs["/metrics/snapshot"] = doc
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._t.join(timeout=5)
+
+
+def _post_generate(port, prompt_ids, headers=None):
+    conn = HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        conn.request("POST", "/generate",
+                     body=json.dumps({"prompt_ids": list(prompt_ids)}),
+                     headers=h)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _snapshot_doc(outcomes=None, slo_viol=0, finished=0,
+                  finished_tokens=0, registry=None):
+    return {"schema": _federation.SNAPSHOT_SCHEMA,
+            "unix_time": round(time.time(), 3), "pid": 1,
+            "registry": registry or {},
+            "engine": {"outcomes": dict(outcomes or {}),
+                       "slo_violations_ttft": slo_viol,
+                       "finished": finished,
+                       "finished_tokens": finished_tokens,
+                       "tpot_sketch": QuantileSketch().to_state(),
+                       "ttft_evidence": {}}}
+
+
+# ================================================ trace context grammar
+
+def test_trace_header_mint_format_parse_roundtrip():
+    t = _tracing.mint_trace_id()
+    s = _tracing.new_span_id()
+    assert len(t) == 16 and len(s) == 8
+    assert _tracing.parse_header(_tracing.format_header(t, s)) == (t, s)
+    assert _tracing.parse_header(_tracing.format_header(t)) == (t, None)
+    # independent mints never collide in practice (and must differ here)
+    assert _tracing.mint_trace_id() != t
+
+
+def test_trace_header_malformed_inputs_never_raise():
+    assert _tracing.parse_header(None) == (None, None)
+    assert _tracing.parse_header("") == (None, None)
+    assert _tracing.parse_header("zzzz") == (None, None)
+    assert _tracing.parse_header("1234") == (None, None)    # trace too short
+    # good trace, junk span: keep the trace, drop the span
+    assert _tracing.parse_header("a" * 16 + "-XYZ") == ("a" * 16, None)
+    # case/whitespace normalize
+    assert _tracing.parse_header("  " + "A" * 16 + "-" + "B" * 8 + " ") \
+        == ("a" * 16, "b" * 8)
+
+
+def test_clock_sync_keeps_min_rtt_sample():
+    cs = _tracing.ClockSync()
+    assert cs.offset_s is None
+    # rtt 0.2s, server 5s ahead of the midpoint
+    assert cs.update(10.0, 15.1, 10.2) is True
+    assert cs.offset_s == pytest.approx(5.0)
+    assert cs.err_s == pytest.approx(0.1)
+    # larger rtt: rejected, estimate unchanged
+    assert cs.update(20.0, 99.0, 21.0) is False
+    assert cs.offset_s == pytest.approx(5.0)
+    # tighter rtt wins even with a different offset
+    assert cs.update(30.0, 34.99, 30.02) is True
+    assert cs.err_s == pytest.approx(0.01)
+    assert cs.rtt_s == pytest.approx(0.02)
+    # negative rtt (clock step mid-probe) is discarded
+    assert cs.update(50.0, 55.0, 49.9) is False
+
+
+# ================================================= sketch wire state
+
+def test_sketch_state_roundtrip_and_merge_matches_union():
+    import random
+    rng = random.Random(17)
+    a, b, union = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for i in range(4000):
+        v = rng.lognormvariate(0.0, 1.5)
+        (a if i % 2 else b).add(v)
+        union.add(v)
+    # wire round-trip is exact
+    back = QuantileSketch.from_state(a.to_state())
+    assert back.count == a.count
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert back.quantile(q) == pytest.approx(a.quantile(q))
+    # merge of independently-shipped states == union within the 1%
+    # relative rank-error bound the DDSketch alpha guarantees
+    merged = QuantileSketch.from_state(a.to_state())
+    merged.merge(QuantileSketch.from_state(b.to_state()))
+    assert merged.count == union.count
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert merged.quantile(q) == \
+            pytest.approx(union.quantile(q), rel=0.021)
+
+
+def test_empty_sketch_state_roundtrip():
+    back = QuantileSketch.from_state(QuantileSketch().to_state())
+    assert back.count == 0 and back.quantile(0.5) is None
+
+
+# ================================================ federation merge
+
+def _wire_counter(value, **labels):
+    return {"kind": "counter", "help": "h",
+            "series": [{"labels": [[k, v] for k, v in labels.items()],
+                        "value": value}]}
+
+
+def _wire_gauge(value, **labels):
+    return {"kind": "gauge", "help": "h",
+            "series": [{"labels": [[k, v] for k, v in labels.items()],
+                        "value": value}]}
+
+
+def _wire_sketch(sk, **labels):
+    return {"kind": "quantile", "help": "h",
+            "series": [{"labels": [[k, v] for k, v in labels.items()],
+                        "sketch": sk.to_state()}]}
+
+
+def test_merge_sums_counters_and_relabels_gauges():
+    snaps = {
+        "r0": {"registry": {
+            "serving.requests": _wire_counter(3.0, outcome="finished"),
+            "serving.queue_depth": _wire_gauge(2.0)}},
+        "r1": {"registry": {
+            "serving.requests": _wire_counter(4.0, outcome="finished"),
+            "serving.queue_depth": _wire_gauge(7.0)}},
+    }
+    reg = _federation.merge_snapshots(snaps)
+    c = reg.get("serving.requests")
+    assert c.kind == "counter"
+    assert c._series[(("outcome", "finished"),)] == pytest.approx(7.0)
+    g = reg.get("serving.queue_depth")
+    assert g._series[(("replica", "r0"),)] == pytest.approx(2.0)
+    assert g._series[(("replica", "r1"),)] == pytest.approx(7.0)
+
+
+def test_merge_sketches_by_bucket_addition():
+    a, b, union = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for i in range(1, 501):
+        v = i / 1000.0
+        (a if i % 2 else b).add(v)
+        union.add(v)
+    snaps = {"r0": {"registry": {"serving.ttft_seconds": _wire_sketch(a)}},
+             "r1": {"registry": {"serving.ttft_seconds": _wire_sketch(b)}}}
+    reg = _federation.merge_snapshots(snaps)
+    lat = _federation.fleet_latency(reg)
+    assert lat["ttft"]["count"] == union.count
+    assert lat["ttft"]["p99_s"] == \
+        pytest.approx(union.quantile(0.99), rel=0.021)
+    assert lat["ttft"]["p50_s"] == \
+        pytest.approx(union.quantile(0.5), rel=0.021)
+
+
+def test_merge_skips_malformed_entries_and_kind_collisions():
+    # replicas merge in sorted-name order: the first registration of a
+    # metric fixes its kind, a later replica shipping the same name as a
+    # DIFFERENT kind is skipped (one sick replica can't flip the fleet
+    # view), and malformed series entries are dropped individually
+    snaps = {
+        "a_sick": {"registry": {
+            "m.a": {"kind": "counter", "help": "h",
+                    "series": [{"labels": "garbage", "value": 1.0}]},
+            "m.b": None}},
+        "b_ok": {"registry": {"m.a": _wire_counter(2.0)}},
+        "c_collide": {"registry": {"m.a": _wire_gauge(9.0)}},
+    }
+    reg = _federation.merge_snapshots(snaps)
+    m = reg.get("m.a")
+    assert m.kind == "counter" and m._series[()] == pytest.approx(2.0)
+
+
+def test_fleet_rendering_prefix_and_label_escaping():
+    nasty = 'he said "hi"\\\n'
+    snaps = {"r0": {"registry": {
+        "serving.requests": _wire_counter(1.0, outcome=nasty),
+        "serving.queue_depth": _wire_gauge(3.0)}}}
+    text = _federation.render_fleet(_federation.merge_snapshots(snaps))
+    assert "fleet_serving_requests" in text
+    assert 'fleet_serving_queue_depth{replica="r0"} 3' in text
+    # escaping: backslash, quote and newline all escaped in label values
+    assert '\\"hi\\"' in text and "\\\\" in text and "\\n" in text
+    assert "\nhe said" not in text     # the raw newline never leaks
+    # every non-comment line parses as `name{...} value`
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert line.startswith("fleet_")
+        assert line.rsplit(" ", 1)[1]
+
+
+def test_local_snapshot_shape_and_engine_evidence():
+    fake = SimpleNamespace(telemetry_snapshot=lambda: {"finished": 5})
+    doc = _federation.local_snapshot(engine=fake)
+    assert doc["schema"] == _federation.SNAPSHOT_SCHEMA
+    assert doc["engine"] == {"finished": 5}
+    assert isinstance(doc["registry"], dict)
+    # a sick engine is dropped, not fatal
+    def boom():
+        raise RuntimeError("x")
+    doc = _federation.local_snapshot(
+        engine=SimpleNamespace(telemetry_snapshot=boom))
+    assert "engine" not in doc
+
+
+# ================================================ burn-rate monitor
+
+def test_burn_rate_windowed_math():
+    mon = _federation.BurnRateMonitor(fast_window_s=60, slow_window_s=600,
+                                      threshold=2.0, error_budget=0.05)
+    t0 = 1000.0
+    mon.observe("r0", good=100, bad=0, now=t0)
+    # 20% bad over the last 30s: burn = 0.2 / 0.05 = 4x in BOTH windows
+    mon.observe("r0", good=180, bad=20, now=t0 + 30)
+    assert mon.burn("r0", 60, now=t0 + 30) == pytest.approx(4.0)
+    assert mon.burn("r0", 600, now=t0 + 30) == pytest.approx(4.0)
+    assert mon.burning("r0", now=t0 + 30)
+    # clean traffic afterwards: the fast window cools first
+    mon.observe("r0", good=400, bad=20, now=t0 + 120)
+    assert mon.burn("r0", 60, now=t0 + 120) == pytest.approx(0.0)
+    assert mon.recovered("r0", now=t0 + 120)
+    # ... while the slow window still remembers the spike
+    assert mon.burn("r0", 600, now=t0 + 120) > 1.0
+    assert not mon.burning("r0", now=t0 + 120)
+
+
+def test_burn_rate_no_evidence_is_none_not_zero():
+    mon = _federation.BurnRateMonitor()
+    assert mon.burn("ghost", 60) is None
+    assert not mon.burning("ghost") and not mon.recovered("ghost")
+    mon.observe("r0", good=10, bad=0, now=1000.0)
+    # no NEW events inside the window -> None (no evidence, no verdict)
+    assert mon.burn("r0", 60, now=2000.0) is None
+    view = mon.view(now=1000.0)
+    assert set(view) == {"r0"}
+
+
+def test_burn_rate_fast_spike_alone_does_not_cordon():
+    # the slow window is the flap-guard: a 10s blip after a long clean
+    # history burns the fast window but not the slow one
+    mon = _federation.BurnRateMonitor(fast_window_s=60, slow_window_s=600,
+                                      threshold=2.0, error_budget=0.05)
+    t0 = 0.0
+    mon.observe("r0", good=0, bad=0, now=t0)
+    mon.observe("r0", good=5000, bad=0, now=t0 + 540)
+    mon.observe("r0", good=5010, bad=10, now=t0 + 600)
+    assert mon.burn("r0", 60, now=t0 + 600) >= 2.0
+    assert mon.burn("r0", 600, now=t0 + 600) < 2.0
+    assert not mon.burning("r0", now=t0 + 600)
+
+
+# ====================================== predicted TTFT with live TPOT
+
+def test_predict_ttft_tpot_capacity_caps_stale_admit_rate():
+    # stale-high admission rate claims 50 admits/s; live decode evidence
+    # says 2 slots each busy for avg 10 tokens * 0.1 s/token = 2 req/s
+    stale = {"waiting": 10, "free_slots": 0, "slots": 2,
+             "ttft_evidence": {"admit_rate_per_s": 50.0,
+                               "ttft_p50_s": 0.1}}
+    optimistic = predict_ttft_s(stale)
+    with_tpot = dict(stale, ttft_evidence=dict(
+        stale["ttft_evidence"], tpot_p50_s=0.1, avg_tokens_out=10.0))
+    realistic = predict_ttft_s(with_tpot)
+    # 11 positions / 2 req/s + base, vs 11/50 + base
+    assert realistic == pytest.approx(0.1 + 11 / 2.0)
+    assert optimistic == pytest.approx(0.1 + 11 / 50.0)
+    assert realistic > optimistic * 5
+    # capacity also substitutes when there is no admit rate at all
+    no_rate = dict(with_tpot, ttft_evidence=dict(
+        with_tpot["ttft_evidence"], admit_rate_per_s=0.0))
+    assert predict_ttft_s(no_rate) == pytest.approx(0.1 + 11 / 2.0)
+    # and without TPOT evidence the PR 16 model is untouched
+    assert predict_ttft_s({"waiting": 3, "free_slots": 1,
+                           "ttft_evidence": {"ttft_p50_s": 0.5}}) \
+        == pytest.approx(2.0)
+
+
+# =============================================== router trace threading
+
+def test_router_mints_trace_and_forwards_header():
+    stub = _StubReplica()
+    router = FleetRouter({"r0": stub.addr}, port=0, poll_interval_s=30.0)
+    try:
+        status, body = _post_generate(router.port, [1, 2, 3])
+        assert status == 200 and body == SSE_PAYLOAD
+        headers, _ = stub.posts[0]
+        trace_id, span = _tracing.parse_header(
+            headers.get(_tracing.TRACE_HEADER))
+        assert trace_id is not None and span is not None
+        # the router's own flight recorder carries the matching spans
+        spans = [e for e in router._flightrec().events()
+                 if e.get("kind") == "span"
+                 and e.get("trace_id") == trace_id]
+        assert {e["name"] for e in spans} == {"plan", "proxy"}
+        assert all(e["span"] == span for e in spans)
+    finally:
+        router.close()
+        stub.close()
+
+
+def test_router_adopts_client_trace_id():
+    stub = _StubReplica()
+    router = FleetRouter({"r0": stub.addr}, port=0, poll_interval_s=30.0)
+    try:
+        mine = "feedc0de" * 2
+        status, _ = _post_generate(
+            router.port, [4, 5],
+            headers={_tracing.TRACE_HEADER: mine})
+        assert status == 200
+        headers, _ = stub.posts[0]
+        got_trace, got_span = _tracing.parse_header(
+            headers[_tracing.TRACE_HEADER])
+        assert got_trace == mine          # adopted, not re-minted
+        assert got_span is not None       # router hop appended its span
+    finally:
+        router.close()
+        stub.close()
+
+
+def test_router_flag_off_forwards_client_header_verbatim():
+    stub = _StubReplica()
+    with flag_guard(fleet_trace=False):
+        router = FleetRouter({"r0": stub.addr}, port=0,
+                             poll_interval_s=30.0)
+        try:
+            status, _ = _post_generate(router.port, [1])
+            assert status == 200
+            headers, _ = stub.posts[0]
+            assert _tracing.TRACE_HEADER not in headers    # minted nothing
+            mine = "ab" * 8
+            _post_generate(router.port, [1],
+                           headers={_tracing.TRACE_HEADER: mine})
+            headers, _ = stub.posts[1]
+            assert headers[_tracing.TRACE_HEADER] == mine  # verbatim
+        finally:
+            router.close()
+    stub.close()
+
+
+# ============================================== fleet metrics endpoint
+
+def test_fleet_metrics_endpoint_renders_federated_view():
+    sk = QuantileSketch()
+    for i in range(1, 101):
+        sk.add(i / 100.0)
+    snap0 = _snapshot_doc(finished=3, registry={
+        "serving.requests": _wire_counter(3.0, outcome="finished"),
+        "serving.ttft_seconds": _wire_sketch(sk)})
+    snap1 = _snapshot_doc(finished=4, registry={
+        "serving.requests": _wire_counter(4.0, outcome="finished")})
+    stubs = [_StubReplica(snapshot=snap0), _StubReplica(snapshot=snap1)]
+    router = FleetRouter({"r0": stubs[0].addr, "r1": stubs[1].addr},
+                         port=0, poll_interval_s=30.0)
+    try:
+        conn = HTTPConnection("127.0.0.1", router.port, timeout=10)
+        conn.request("GET", "/fleet/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        conn.close()
+        assert 'fleet_serving_requests{outcome="finished"} 7' in text
+        # the federated p99 equals the sketch's own p99 (one replica
+        # shipped the sketch, so federation must preserve it exactly)
+        doc = router.describe()
+        assert doc["fleet_latency"]["ttft"]["p99_s"] == \
+            pytest.approx(sk.quantile(0.99))
+        assert doc["fleet_latency"]["ttft"]["count"] == 100
+    finally:
+        router.close()
+        for s in stubs:
+            s.close()
+
+
+class _FakeEngine:      # MetricsServer holds its engine by weakref
+    def telemetry_snapshot(self):
+        return {"finished": 9}
+
+
+def test_metrics_snapshot_endpoint_serves_engine_evidence():
+    fake = _FakeEngine()
+    server = _http.MetricsServer(0, "127.0.0.1", engine=fake)
+    try:
+        conn = HTTPConnection("127.0.0.1", server.port, timeout=10)
+        conn.request("GET", "/metrics/snapshot")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        doc = json.loads(resp.read())
+        conn.close()
+        assert doc["schema"] == _federation.SNAPSHOT_SCHEMA
+        assert doc["engine"]["finished"] == 9
+        assert isinstance(doc["registry"], dict)
+    finally:
+        server.close()
+
+
+# ============================================ burn-driven auto-cordon
+
+def test_router_auto_cordons_burning_replica_and_lifts_on_recovery():
+    stubs = [_StubReplica(snapshot=_snapshot_doc(finished=100)),
+             _StubReplica(snapshot=_snapshot_doc(finished=100))]
+    with flag_guard(fleet_slo_burn_cordon=True,
+                    fleet_burn_fast_window_s=60.0,
+                    fleet_burn_slow_window_s=600.0):
+        router = FleetRouter({"r0": stubs[0].addr, "r1": stubs[1].addr},
+                             port=0, poll_interval_s=30.0)
+        try:
+            router.poll_metrics_all()           # baseline sample
+            # r0 starts burning: 50 bad vs 50 good since baseline
+            stubs[0].set_snapshot(_snapshot_doc(
+                outcomes={"error": 40, "poisoned": 10}, finished=150))
+            stubs[1].set_snapshot(_snapshot_doc(finished=200))
+            router.poll_metrics_all()
+            view = router.describe()["replicas"]
+            assert view["r0"]["cordoned"] and view["r0"]["auto_cordoned"]
+            assert not view["r1"]["cordoned"]
+            assert view["r0"]["slo_burn"]["fast"] >= 2.0
+            kinds = [e["kind"] for e in router._flightrec().events()]
+            assert "slo_cordon" in kinds
+            # traffic keeps flowing around the cordon
+            status, _ = _post_generate(router.port, [1, 2, 3])
+            assert status == 200
+            assert len(stubs[1].posts) == 1 and not stubs[0].posts
+            # r0 heals: clean events dominate the window again (all the
+            # samples sit inside the fast window, so its baseline is the
+            # first sample — recovery needs the bad FRACTION since then
+            # back under the error budget)
+            stubs[0].set_snapshot(_snapshot_doc(
+                outcomes={"error": 40, "poisoned": 10}, finished=1500))
+            router.poll_metrics_all()
+            view = router.describe()["replicas"]
+            assert not view["r0"]["cordoned"]
+            assert "auto_cordoned" not in view["r0"]
+            kinds = [e["kind"] for e in router._flightrec().events()]
+            assert "slo_uncordon" in kinds
+        finally:
+            router.close()
+    for s in stubs:
+        s.close()
+
+
+def test_burn_cordon_never_takes_the_last_replica():
+    stub = _StubReplica(snapshot=_snapshot_doc(finished=10))
+    with flag_guard(fleet_slo_burn_cordon=True):
+        router = FleetRouter({"r0": stub.addr}, port=0,
+                             poll_interval_s=30.0)
+        try:
+            router.poll_metrics_all()
+            stub.set_snapshot(_snapshot_doc(
+                outcomes={"error": 90}, finished=20))
+            router.poll_metrics_all()
+            view = router.describe()["replicas"]["r0"]
+            assert not view["cordoned"]          # preference, not verdict
+            assert view["slo_burn"]["fast"] >= 2.0
+        finally:
+            router.close()
+    stub.close()
+
+
+def test_manual_cordon_wins_over_burn_monitor():
+    stub = _StubReplica(snapshot=_snapshot_doc(finished=10))
+    router = FleetRouter({"r0": stub.addr, "r1": stub.addr}, port=0,
+                         poll_interval_s=30.0)
+    try:
+        router.cordon("r0")
+        # a manual cordon is never auto-lifted: the recovery path only
+        # touches auto_cordoned cordons
+        with flag_guard(fleet_slo_burn_cordon=True):
+            router.poll_metrics_all()
+            stub.set_snapshot(_snapshot_doc(finished=1000))
+            router.poll_metrics_all()
+        assert router.describe()["replicas"]["r0"]["cordoned"]
+    finally:
+        router.close()
+        stub.close()
+
+
+# ============================================== fleet timeline merge
+
+def _router_flight_doc():
+    rec = _flight.FlightRecorder()
+    rec.record_event("replica_meta", replica="router")
+    # router measured r0's clock 100s ahead (offset_s = replica - router)
+    rec.record_event("clock_sync", replica="r0", offset_s=100.0,
+                     err_s=0.001, rtt_s=0.002)
+    rec.record_event("clock_sync", replica="r0", offset_s=90.0,
+                     err_s=0.5, rtt_s=1.0)     # worse bound: ignored
+    rec.record_span("plan", "router", 1000.0, 1000.01,
+                    trace_id="a" * 16, span="b" * 8, home="r0",
+                    degraded=False)
+    rec.record_span("proxy", "router", 1000.01, 1000.5,
+                    trace_id="a" * 16, span="b" * 8, replica="r0")
+    return rec.snapshot(reason="test")
+
+
+def _replica_flight_doc():
+    rec = _flight.FlightRecorder()
+    rec.record_event("replica_meta", replica="r0")
+    # replica timestamps are in ITS clock: 100s ahead of the router
+    rec.record_span("handoff_export", "handoff", 1100.1, 1100.2,
+                    blocks=2, trace_id="a" * 16)
+    rec.record_event("request", rid=1, outcome="finished", e2e_s=0.4,
+                     queue_wait_s=0.0, prefill_s=0.1, ttft_s=0.1,
+                     tokens_out=2, trace_id="a" * 16)
+    return rec.snapshot(reason="test")
+
+
+def test_fleet_trace_merges_processes_and_aligns_clocks():
+    doc = _tracing.fleet_trace([_router_flight_doc(),
+                                _replica_flight_doc()])
+    other = doc["otherData"]
+    assert other["schema"] == "paddle_tpu.fleet_trace/v1"
+    assert [p["name"] for p in other["processes"]] == ["router", "r0"]
+    assert other["processes"][1]["clock_offset_s"] == pytest.approx(100.0)
+    assert other["trace_ids"] == ["a" * 16]
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {1, 2}
+    by_name = {e["name"]: e for e in evs if e.get("ph") == "X"}
+    # the replica's export span lands ~0.09s after the router's proxy
+    # span START despite its raw timestamp being 100s in the future
+    assert by_name["handoff_export"]["pid"] == 2
+    assert by_name["handoff_export"]["ts"] == pytest.approx(
+        (1100.1 - 100.0) * 1e6, abs=1.0)
+    assert by_name["proxy"]["ts"] == pytest.approx(1000.01 * 1e6, abs=1.0)
+    # both processes carry the shared trace id in span args
+    assert by_name["handoff_export"]["args"]["trace_id"] == "a" * 16
+    assert by_name["plan"]["args"]["trace_id"] == "a" * 16
+    # process_name metadata rows exist for both pids
+    meta = [e for e in evs if e.get("name") == "process_name"]
+    assert {e["args"]["name"] for e in meta} == {"router", "r0"}
+
+
+def test_dump_fleet_trace_cli(tmp_path):
+    d0, d1 = tmp_path / "router", tmp_path / "r0"
+    d0.mkdir(), d1.mkdir()
+    (d0 / "flight_0001.json").write_text(json.dumps(_router_flight_doc()))
+    (d1 / "flight_0001.json").write_text(json.dumps(_replica_flight_doc()))
+    out, err = io.StringIO(), io.StringIO()
+    with redirect_stdout(out), redirect_stderr(err):
+        rc = _dump.main(["--fleet-trace", str(d0), str(d1)])
+    assert rc == 0
+    doc = json.loads(out.getvalue())
+    assert doc["otherData"]["schema"] == "paddle_tpu.fleet_trace/v1"
+    assert doc["otherData"]["trace_ids"] == ["a" * 16]
+    assert err.getvalue().count("(from ") == 2
+    # a missing operand directory fails loudly with exit 1
+    with redirect_stdout(io.StringIO()), redirect_stderr(io.StringIO()):
+        assert _dump.main(["--fleet-trace", str(tmp_path / "ghost")]) == 1
+
+
+# ======================================== handoff trace propagation
+
+class _FakeRec:
+    def __init__(self):
+        self.spans = []
+
+    def record_span(self, name, cat, start_s, end_s, **info):
+        self.spans.append(dict(info, name=name, cat=cat))
+
+    def record_event(self, kind, **info):
+        pass
+
+
+def test_hand_off_threads_trace_into_both_sides(tmp_path):
+    src_rec, dst_rec = _FakeRec(), _FakeRec()
+    src = SimpleNamespace(
+        export_prefix_cache=lambda root: {"blocks": 2},
+        release_exported_prefix=lambda: 2,
+        _flightrec=lambda: src_rec)
+    dst = SimpleNamespace(
+        _import_prefix_cache=lambda root: None,
+        _blocksan=None,
+        _prefix_import_info={"blocks": 2},
+        _flightrec=lambda: dst_rec)
+    report = hand_off(src, dst, str(tmp_path), trace_id="c" * 16,
+                      parent_span="d" * 8)
+    assert report["trace_id"] == "c" * 16
+    assert report["released_blocks"] == 2
+    (exp,) = src_rec.spans
+    (imp,) = dst_rec.spans
+    assert exp["name"] == "handoff_export" and exp["cat"] == "handoff"
+    assert imp["name"] == "handoff_import" and imp["cat"] == "handoff"
+    assert exp["trace_id"] == imp["trace_id"] == "c" * 16
+    assert exp["parent_span"] == imp["parent_span"] == "d" * 8
+    # without context the spans still record, just untagged
+    report = hand_off(src, dst, str(tmp_path))
+    assert "trace_id" not in report
+    assert "trace_id" not in src_rec.spans[-1]
+
+
+# ======================================= auto chunks-per-tick budget
+
+def _chunk_self(tpot_values=()):
+    sk = QuantileSketch()
+    for v in tpot_values:
+        sk.add(v)
+    return SimpleNamespace(_chunk_budget_now=None, _ev_tpot=sk)
+
+
+def test_auto_chunk_budget_holds_without_slo_or_evidence():
+    auto = ServingEngine._auto_chunk_budget
+    with flag_guard(serving_tpot_slo_ms=0.0):
+        assert auto(_chunk_self([0.1] * 100), 4) == 4    # no SLO: hold
+    with flag_guard(serving_tpot_slo_ms=50.0):
+        assert auto(_chunk_self([0.1] * 8), 4) == 4      # <16 samples
+
+
+def test_auto_chunk_budget_walks_toward_the_slo():
+    auto = ServingEngine._auto_chunk_budget
+    with flag_guard(serving_tpot_slo_ms=50.0):
+        # p90 of 100ms >> 50ms target: shrink one step per call, floor 1
+        s = _chunk_self([0.1] * 32)
+        assert auto(s, 4) == 3
+        assert auto(s, 4) == 2
+        assert auto(s, 4) == 1
+        assert auto(s, 4) == 1
+        # p90 of 10ms << half the target: grow back, capped at max
+        fast = _chunk_self([0.01] * 32)
+        fast._chunk_budget_now = 1
+        assert auto(fast, 4) == 2
+        assert auto(fast, 4) == 3
+        assert auto(fast, 4) == 4
+        assert auto(fast, 4) == 4
+        # in the comfort band (between 0.5x and 1x target): hold
+        mid = _chunk_self([0.04] * 32)
+        mid._chunk_budget_now = 2
+        assert auto(mid, 4) == 2
+        # a lowered flag clamps a remembered higher budget
+        s2 = _chunk_self([0.04] * 32)
+        s2._chunk_budget_now = 4
+        assert auto(s2, 2) == 2
+
+
+def test_auto_chunk_flag_defaults():
+    assert get_flag("serving_chunks_per_tick_auto") is False
+    assert get_flag("fleet_trace") is True
+    assert get_flag("fleet_metrics_interval_s") == 0.0
+    assert get_flag("fleet_slo_burn_cordon") is False
+
+
+# ==================================== @slow burn-rate chaos drill
+
+@pytest.mark.slow
+def test_burn_cordon_drill_zero_dropped_streams():
+    """The acceptance drill: concurrent /generate traffic through the
+    router while one replica's federated evidence starts burning, gets
+    auto-cordoned, heals, and is un-cordoned — every stream answers 200
+    throughout (zero dropped)."""
+    stubs = [_StubReplica(snapshot=_snapshot_doc(finished=100))
+             for _ in range(3)]
+    results = []
+    stop = threading.Event()
+
+    def pound():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                status, _ = _post_generate(router.port, [i % 7, 3, 5])
+                results.append(status)
+            except OSError:
+                results.append(-1)
+            time.sleep(0.005)
+
+    with flag_guard(fleet_slo_burn_cordon=True,
+                    fleet_metrics_interval_s=0.05):
+        router = FleetRouter({f"r{i}": s.addr
+                              for i, s in enumerate(stubs)},
+                             port=0, poll_interval_s=0.05)
+        try:
+            # baseline federation sweep FIRST: the burn math needs a
+            # clean cumulative sample to delta against — injecting the
+            # failure before the first sweep would make the burning
+            # counts the baseline (no delta, no burn)
+            router.poll_metrics_all()
+            threads = [threading.Thread(target=pound, daemon=True)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            # phase 1: r0 burns; wait for the auto-cordon
+            stubs[0].set_snapshot(_snapshot_doc(
+                outcomes={"error": 50}, finished=150))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if router.describe()["replicas"]["r0"].get(
+                        "auto_cordoned"):
+                    break
+                time.sleep(0.02)
+            view = router.describe()["replicas"]["r0"]
+            assert view["cordoned"] and view["auto_cordoned"]
+            # phase 2: r0 heals; wait for the cordon to lift
+            stubs[0].set_snapshot(_snapshot_doc(
+                outcomes={"error": 50}, finished=1500))
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if not router.describe()["replicas"]["r0"]["cordoned"]:
+                    break
+                time.sleep(0.02)
+            assert not router.describe()["replicas"]["r0"]["cordoned"]
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        finally:
+            stop.set()
+            router.close()
+    for s in stubs:
+        s.close()
+    assert results and all(s == 200 for s in results)
+    kinds = [e["kind"] for e in router._flightrec().events()]
+    assert "slo_cordon" in kinds and "slo_uncordon" in kinds

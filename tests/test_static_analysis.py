@@ -449,6 +449,70 @@ def test_r011_paired_handoff_and_lone_legs_are_clean(tmp_path):
     assert run_src(tmp_path, {"mod.py": R011_GOOD}, rules=["R011"]) == []
 
 
+R012_BAD = """\
+import http.client
+
+
+def proxy(addr, body):
+    headers = {"X-Graft-Trace": "deadbeef"}
+    conn = http.client.HTTPConnection(addr)
+    conn.request("POST", "/generate", body=body)
+    return conn.getresponse()
+
+
+def disagg(pair, src, dst, root, ids):
+    req = Request(ids, max_new_tokens=1)
+    src.add_request(req)
+    hand_off(src, dst, root)
+"""
+
+R012_GOOD = """\
+import http.client
+
+
+def proxy(addr, body, trace_header):
+    trace_headers = {"X-Graft-Trace": trace_header}
+    conn = http.client.HTTPConnection(addr)
+    conn.request("POST", "/generate", body=body, headers=trace_headers)
+    return conn.getresponse()
+
+
+def disagg(pair, src, dst, root, ids, trace_id):
+    req = Request(ids, max_new_tokens=1, trace_id=trace_id)
+    src.add_request(req)
+    hand_off(src, dst, root, trace_id=trace_id)
+
+
+def no_context(addr, body):        # no trace source in scope: fine
+    conn = http.client.HTTPConnection(addr)
+    conn.request("POST", "/healthz", body=body)
+    return conn.getresponse()
+"""
+
+
+def test_r012_catches_dropped_trace_context(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R012_BAD}, rules=["R012"])
+    assert len(fs) == 2
+    assert {f.symbol for f in fs} == {"proxy", "disagg"}
+    proxy = next(f for f in fs if f.symbol == "proxy")
+    assert proxy.line == 7          # the conn.request sink, not the header
+    assert "orphan trace" in proxy.message
+
+
+def test_r012_propagated_and_contextless_scopes_are_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R012_GOOD}, rules=["R012"]) == []
+
+
+def test_r012_header_kwarg_counts_as_propagation(tmp_path):
+    # forwarding via a headers dict whose NAME carries "trace" passes
+    src = R012_BAD.replace(
+        'conn.request("POST", "/generate", body=body)',
+        'conn.request("POST", "/generate", body=body, '
+        "headers=trace_headers)")
+    fs = run_src(tmp_path, {"mod.py": src}, rules=["R012"])
+    assert {f.symbol for f in fs} == {"disagg"}
+
+
 # ===================================================== suppressions
 
 def test_inline_suppression_same_line(tmp_path):
