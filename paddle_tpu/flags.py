@@ -213,7 +213,8 @@ define_flag("flight_recorder_steps", 64,
             on_change=_flight_capacity_changed)
 define_flag("flight_dump_dir", "",
             "directory automatic flight-recorder dumps are written to "
-            "(empty = current working directory)")
+            "(empty = ./flight_dumps, created on demand — never the "
+            "repo/CWD root)")
 
 # Training-step fast path (optimizer/fused.py, hapi/model.py, io).
 define_flag("fused_optimizer", True,
@@ -452,6 +453,28 @@ define_flag("serving_prefix_export_dir", "",
             "restart-to-first-token on a hot system prompt is then "
             "warm-cache + warm-compile.  Empty (the default) disables "
             "both directions")
+# Paged Pallas kernels for the X-ray suspects (ops/pallas_paged.py,
+# ops/pallas_moe.py, models/kv_cache.py — ISSUE 18).  Snapshotted at
+# engine/layer construction (graft-lint R004: never read under trace).
+define_flag("serving_pallas_prefill", True,
+            "run suffix/chunked prefill attention (prefill_cont — both "
+            "the prefix-hit suffix write and ladder-bucket chunks) "
+            "through the chunked paged-prefill Pallas kernel "
+            "(PagedChunkKernelView) instead of the dense linearized-"
+            "table gather; interpret-mode fallback off-TPU, greedy "
+            "streams stay bit-identical either way")
+define_flag("serving_pallas_verify", True,
+            "run the spec-decode verify chunk (spec_tick's k candidate "
+            "positions) through the paged spec-verify Pallas kernel "
+            "(PagedVerifyKernelView) instead of gathering the whole "
+            "pool; interpret-mode fallback off-TPU, accept/reject "
+            "decisions stay bit-identical either way")
+define_flag("moe_fused_dispatch", True,
+            "route MoE token dispatch/combine through the fused "
+            "capacity-bucketed one-pass path (ops/pallas_moe.py) "
+            "instead of the dense (tokens, experts, capacity) one-hot "
+            "einsums; gate outputs and gradients stay bit-close to the "
+            "dense reference")
 define_flag("serving_dispatch_retries", 0,
             "bounded in-place retries of a serving program dispatch "
             "that raised a transient RuntimeError/XlaRuntimeError "

@@ -53,6 +53,12 @@ class BaseGate(Layer):
     combine: float (T, E, C) — differentiable mixing weights.
     dispatch_mask: float 0/1 (T, E, C) — which buffer slot a token fills.
     aux_loss: scalar Tensor (0 when the gate defines none).
+
+    Gates derived from NaiveGate additionally expose
+    ``forward_indices(x)`` — the same routing decision in index form
+    (per token/choice expert id, buffer slot, keep mask, renormalized
+    weight) for the fused one-pass dispatch of `ops/pallas_moe.py`,
+    skipping the dense (T, E, C) tensors entirely.
     """
 
     def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
@@ -125,15 +131,82 @@ class NaiveGate(BaseGate):
         mean_gate = paddle.mean(gates, axis=0)
         return combine, dispatch, frac, mean_gate
 
-    def forward(self, x):
+    def _route_indices(self, gates, cap, second_keep=None):
+        """The SAME routing decision as :meth:`_route`, in index form.
+
+        Per token and routing choice: expert id (the top-k index),
+        buffer slot (running position inside that expert, offset by
+        higher-priority choices), keep mask (0 past capacity / when
+        second_keep drops the choice) and the gate weight
+        ``gates[t, eid] * keep`` renormalized over the kept choices —
+        exactly the nonzero entries of the dense ``combine`` tensor.
+        Returns (eid, slot, keep, w, frac, mean_gate); eid/slot (T, k)
+        int, keep/w (T, k) float.
+        """
+        E = self.tot_expert
+        _, idx = paddle.topk(gates, k=self.top_k, axis=-1)  # (T, k)
+        taken = None
+        slots, keeps, ws = [], [], []
+        frac = None
+        for i in range(self.top_k):
+            m = _one_hot_f(idx[:, i], E)                       # (T, E)
+            if i == 0:
+                frac = paddle.mean(m, axis=0)
+            if i == 1 and second_keep is not None:
+                m = m * paddle.unsqueeze(second_keep, -1)
+            pos = _positions_in_expert(m, taken)               # (T, E)
+            keep_e = paddle.cast(pos < float(cap), "float32")
+            m_kept = m * keep_e
+            # m is one-hot over E, so the row sums pick this choice's
+            # expert column (0 where second_keep dropped the choice)
+            slot_i = paddle.cast(paddle.sum(pos * m, axis=1), "int64")
+            slots.append(paddle.clip(slot_i, 0, cap - 1))
+            keeps.append(paddle.sum(m_kept, axis=1))           # (T,)
+            ws.append(paddle.sum(gates * m_kept, axis=1))      # (T,)
+            counts = paddle.sum(m, axis=0)                     # incl. drops
+            taken = counts if taken is None else taken + counts
+        slot = paddle.stack(slots, axis=1)
+        keep = paddle.stack(keeps, axis=1)
+        w = paddle.stack(ws, axis=1)
+        # renormalize the kept top-k weights per token (GShard practice;
+        # same denom as the dense path's sum over the combine tensor)
+        denom = paddle.clip(paddle.sum(w, axis=1, keepdim=True), min=1e-9)
+        w = w / denom
+        mean_gate = paddle.mean(gates, axis=0)
+        return idx, slot, keep, w, frac, mean_gate
+
+    def _prepare(self, x):
+        """Gate probabilities + routing capacity (+ optional per-token
+        0/1 drop mask for the 2nd choice).  The hook subclasses override
+        instead of forward, so both the dense and the index-form paths
+        share one definition of the routing decision."""
         T = x.shape[0]
         cap = capacity(T, self.tot_expert, self.top_k, self.capacity_factor,
                        self.min_capacity)
         gates = F.softmax(self.gate(x), axis=-1)
-        combine, dispatch, _, _ = self._route(gates, cap)
-        aux = paddle.zeros([], dtype="float32")
+        return gates, cap, None
+
+    def _aux(self, frac, mean_gate):
+        return paddle.zeros([], dtype="float32")
+
+    def forward(self, x):
+        gates, cap, second_keep = self._prepare(x)
+        combine, dispatch, frac, mean_gate = self._route(
+            gates, cap, second_keep)
+        aux = self._aux(frac, mean_gate)
         self.set_loss(aux)
         return combine, dispatch, aux
+
+    def forward_indices(self, x):
+        """Index-form routing for the fused dispatch: returns
+        (eid, slot, keep, w, cap, aux) — see :meth:`_route_indices`.
+        Sets the aux loss exactly as :meth:`forward` does."""
+        gates, cap, second_keep = self._prepare(x)
+        eid, slot, keep, w, frac, mean_gate = self._route_indices(
+            gates, cap, second_keep)
+        aux = self._aux(frac, mean_gate)
+        self.set_loss(aux)
+        return eid, slot, keep, w, cap, aux
 
 
 class SwitchGate(NaiveGate):
@@ -148,15 +221,8 @@ class SwitchGate(NaiveGate):
         super().__init__(d_model, num_expert, world_size, 1,
                          capacity_factor, min_capacity)
 
-    def forward(self, x):
-        T = x.shape[0]
-        cap = capacity(T, self.tot_expert, 1, self.capacity_factor,
-                       self.min_capacity)
-        gates = F.softmax(self.gate(x), axis=-1)
-        combine, dispatch, frac, mean_gate = self._route(gates, cap)
-        aux = paddle.sum(frac * mean_gate) * float(self.tot_expert)
-        self.set_loss(aux)
-        return combine, dispatch, aux
+    def _aux(self, frac, mean_gate):
+        return paddle.sum(frac * mean_gate) * float(self.tot_expert)
 
 
 class GShardGate(NaiveGate):
@@ -173,7 +239,7 @@ class GShardGate(NaiveGate):
         self._cap_train, self._cap_eval = capacity
         self.random_routing = random_routing
 
-    def forward(self, x):
+    def _prepare(self, x):
         T = x.shape[0]
         factor = self._cap_train if self.training else self._cap_eval
         # factor is already in tokens/E units (includes the top-2)
@@ -187,8 +253,7 @@ class GShardGate(NaiveGate):
             g2 = paddle.topk(gates, k=2, axis=-1)[0][:, 1]
             second_keep = paddle.cast(
                 2.0 * g2 > paddle.rand([T], dtype="float32"), "float32")
-        combine, dispatch, frac, mean_gate = self._route(
-            gates, cap, second_keep)
-        aux = paddle.sum(frac * mean_gate) * float(self.tot_expert)
-        self.set_loss(aux)
-        return combine, dispatch, aux
+        return gates, cap, second_keep
+
+    def _aux(self, frac, mean_gate):
+        return paddle.sum(frac * mean_gate) * float(self.tot_expert)

@@ -13,6 +13,17 @@ weights (E,M,H)/(E,H,M) so the MXU sees large matmuls; when the stacked
 expert dim is sharded over an `ep` mesh axis, GSPMD lowers the dispatch
 einsum to the same all-to-all the reference codes by hand — and it rides
 ICI inside a jit program instead of going through host NCCL calls.
+
+Fused dispatch (ISSUE 18, default on): the dense dispatch/combine
+einsums contract against (T, E, C) one-hot tensors — ``T*E*C*M`` FLOPs
+for what is a gather of ``T*k`` rows.  With ``FLAGS_moe_fused_dispatch``
+the layer takes the gate's index-form routing (`forward_indices`) and
+runs the one-pass Pallas dispatch/combine kernels of
+`ops/pallas_moe.py` instead; the dense einsum path stays as the oracle
+and the fallback when pallas is unavailable.  The flag is snapshotted
+at layer construction (R004: no flag reads inside traced fns).
+:func:`audit_dispatch` lowers the active data plane into the X-ray
+kernel-coverage ledger — the MoE analogue of the serving warmup audit.
 """
 
 from __future__ import annotations
@@ -23,10 +34,12 @@ from typing import Optional
 import paddle_tpu as paddle
 from paddle_tpu.nn.layer.layers import Layer
 import paddle_tpu.nn.functional as F
+from paddle_tpu import flags as _flags
+from paddle_tpu.ops import pallas_kernels as _pk
 
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 
-__all__ = ["ExpertMLP", "MoELayer"]
+__all__ = ["ExpertMLP", "MoELayer", "audit_dispatch"]
 
 
 class ExpertMLP(Layer):
@@ -113,15 +126,84 @@ class MoELayer(Layer):
                 raise ValueError(f"unknown gate {gate!r}")
         self.gate = gate
         self.l_aux = None
+        # snapshot (R004): the fused data plane is chosen at construction,
+        # never inside a traced forward
+        self._fused = (bool(_flags.get_flag("moe_fused_dispatch"))
+                       and _pk.moe_fused_available()
+                       and hasattr(self.gate, "forward_indices"))
 
     def forward(self, x):
         """x: (..., d_model); routing flattens all leading dims to tokens."""
         orig_shape = x.shape
         d_model = orig_shape[-1]
         xt = paddle.reshape(x, [-1, d_model])                  # (T, M)
-        combine, dispatch, aux = self.gate(xt)                 # (T,E,C) x2
-        self.l_aux = aux
-        expert_in = paddle.einsum("tec,tm->ecm", dispatch, xt)
-        expert_out = self.experts(expert_in)                   # (E, C, M)
-        out = paddle.einsum("tec,ecm->tm", combine, expert_out)
+        if self._fused:
+            out = self._forward_fused(xt)
+        else:
+            combine, dispatch, aux = self.gate(xt)             # (T,E,C) x2
+            self.l_aux = aux
+            expert_in = paddle.einsum("tec,tm->ecm", dispatch, xt)
+            expert_out = self.experts(expert_in)               # (E, C, M)
+            out = paddle.einsum("tec,ecm->tm", combine, expert_out)
         return paddle.reshape(out, orig_shape)
+
+    def _forward_fused(self, xt):
+        """One-pass routing: the gate's index-form decision drives the
+        Pallas dispatch/combine kernels — no (T, E, C) tensors."""
+        eid, slot, keep, w, cap, aux = self.gate.forward_indices(xt)
+        self.l_aux = aux
+        E = self.gate.tot_expert
+        flat, inv = _pk.moe_routing_indices(eid, slot, keep, E, cap)
+        rows = _pk.moe_dispatch(xt, inv)                       # (E*C, M)
+        expert_in = paddle.reshape(rows, [E, cap, xt.shape[1]])
+        expert_out = self.experts(expert_in)                   # (E, C, M)
+        return _pk.moe_combine(
+            paddle.reshape(expert_out, [E * cap, xt.shape[1]]), w, flat)
+
+
+def audit_dispatch(layer: MoELayer, num_tokens: int = 64):
+    """Register + audit the layer's dispatch/combine program in the
+    X-ray kernel-coverage ledger (`xray.kernel_coverage`), the MoE
+    analogue of the serving warmup audit: lower a jit of the ACTIVE
+    data plane — fused kernels or dense einsums, per the layer's
+    snapshot — over abstract (num_tokens, d_model) routing shapes,
+    capturing trace-time kernel claims.  Returns the audit row's
+    program key."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.observability import xray as _xray
+    from paddle_tpu.ops import pallas_moe as _pm
+    from .gate import capacity as _capacity
+
+    gate = layer.gate
+    E = gate.tot_expert
+    k = gate.top_k
+    M = layer.experts.d_model
+    T = int(num_tokens)
+    cap = _capacity(T, E, k, getattr(gate, "capacity_factor", 1.25),
+                    getattr(gate, "min_capacity", 4))
+    fused = layer._fused
+
+    if fused:
+        def prog(x, inv, w, flat):
+            rows = _pm.moe_dispatch(x, inv)
+            return _pm.moe_combine(rows, w, flat)
+        shapes = (jax.ShapeDtypeStruct((T, M), jnp.float32),
+                  jax.ShapeDtypeStruct((E * cap,), jnp.int32),
+                  jax.ShapeDtypeStruct((T, k), jnp.float32),
+                  jax.ShapeDtypeStruct((T, k), jnp.int32))
+    else:
+        def prog(x, dispatch, combine):
+            expert_in = jnp.einsum("tec,tm->ecm", dispatch, x)
+            return jnp.einsum("tec,ecm->tm", combine, expert_in)
+        shapes = (jax.ShapeDtypeStruct((T, M), jnp.float32),
+                  jax.ShapeDtypeStruct((T, E, cap), jnp.float32),
+                  jax.ShapeDtypeStruct((T, E, cap), jnp.float32))
+
+    entry = _xray.register(
+        "moe.dispatch", (("T", T), ("E", E), ("C", cap), ("M", M),
+                         ("k", k), ("fused", fused)))
+    with _xray.capture_kernel_claims() as claims:
+        lowered = jax.jit(prog).lower(*shapes)
+    _xray.attach_lowered(entry, lowered, claims)
+    return entry.key

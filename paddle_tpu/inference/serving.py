@@ -815,6 +815,22 @@ class ServingEngine:
         # finishes the oldest before starting the next: chunk budget
         # spent round-robin would inflate EVERY waiting TTFT)
         self.prefilling: deque = deque()
+        # --- paged Pallas kernel selection (ISSUE 18): which chunk-view
+        # class the suffix/chunked-prefill and spec-verify programs
+        # attend through.  Snapshotted here like the pad ladder — the
+        # flags must never be read under trace (graft-lint R004), and a
+        # running engine's compiled grid must not shift under it.
+        from ..models.kv_cache import (PagedChunkKernelView,
+                                       PagedChunkView,
+                                       PagedVerifyKernelView)
+        self._chunk_view_cls = (
+            PagedChunkKernelView
+            if _flags.get_flag("serving_pallas_prefill")
+            else PagedChunkView)
+        self._verify_view_cls = (
+            PagedVerifyKernelView
+            if _flags.get_flag("serving_pallas_verify")
+            else PagedChunkView)
         self.prefill_chunks_total = 0
         self.slo_sheds = 0
         self._chunks_this_boundary = 0
@@ -1140,12 +1156,12 @@ class ServingEngine:
         hit; the shared blocks already hold the prefix's draft KV from
         the admission that registered them)."""
         from ..framework.dygraph import no_grad
-        from ..models.kv_cache import PagedChunkView, PagedKVCache
+        from ..models.kv_cache import PagedKVCache
         if start is None:
             lens, cls, off = jnp.zeros((1,), jnp.int32), PagedKVCache, 0
         else:
-            lens, cls, off = jnp.reshape(start, (1,)), PagedChunkView, \
-                Tensor._wrap(start)
+            lens, cls, off = jnp.reshape(start, (1,)), \
+                self._chunk_view_cls, Tensor._wrap(start)
         dviews = [cls.from_parts(kk, vv, table_row, lens, self.bs)
                   for kk, vv in dpools]
         with no_grad():
@@ -1202,7 +1218,7 @@ class ServingEngine:
         fn = self._prefill_cont_fns.get(L_pad)
         if fn is not None:
             return fn
-        from ..models.kv_cache import PagedChunkView
+        chunk_view_cls = self._chunk_view_cls
 
         if self._tp_mesh is not None:
             from jax.sharding import PartitionSpec as _P
@@ -1213,7 +1229,7 @@ class ServingEngine:
                 lens = jnp.reshape(start, (1,))
                 logits, pools = _tp.forward_tp(
                     meta, params, suffix, pools, table_row, lens, start,
-                    bs, view_cls=PagedChunkView)
+                    bs, view_cls=chunk_view_cls)
                 row = jax.lax.dynamic_index_in_dim(
                     logits[0], true_len - 1, axis=0, keepdims=False)
                 return row, pools
@@ -1247,7 +1263,7 @@ class ServingEngine:
         def cont(param_vals, pools, table_row, suffix, true_len, start):
             self._bind_params(param_vals)
             lens = jnp.reshape(start, (1,))
-            views = [PagedChunkView.from_parts(kk, vv, table_row, lens,
+            views = [chunk_view_cls.from_parts(kk, vv, table_row, lens,
                                                self.bs)
                      for kk, vv in pools]
             with no_grad():
@@ -1394,7 +1410,13 @@ class ServingEngine:
                 and hasattr(inner, "lower"):
             try:
                 t0 = time.perf_counter()
-                lowered = inner.lower(*args)
+                # the claims capture collects trace-time claim_kernel
+                # calls from the Pallas wrappers: interpret-mode kernels
+                # leave no custom-call marker in the lowered text, so
+                # this is the only evidence channel the coverage audit
+                # has for them
+                with _xray.capture_kernel_claims() as claims:
+                    lowered = inner.lower(*args)
                 compiled = lowered.compile()
                 # the validation run counts as a dispatch too, so every
                 # warmed program is named in the ledger before traffic
@@ -1402,9 +1424,10 @@ class ServingEngine:
                     if entry is not None else compiled(*args)
                 mark(time.perf_counter() - t0)
                 # static cost + kernel audit: cost_analysis() FLOPs/
-                # bytes and the custom-call scan of the lowered text
-                # (best-effort; never raises)
-                _xray.attach_lowered(entry, lowered)
+                # bytes, the custom-call scan of the lowered text, and
+                # the trace-time kernel claims (best-effort; never
+                # raises)
+                _xray.attach_lowered(entry, lowered, claims)
 
                 def shim(*a, _c=compiled, _e=entry):
                     if _e is not None:

@@ -221,13 +221,15 @@ def _finish(eng, tlogits, dtoks, dprobs, do_sample, temperature, top_k,
 
 def build_spec_tick(eng, k):
     """Degree-1 spec tick body: draft scan -> one k-token chunk verify
-    forward through `PagedChunkView` -> accept/choose.  Returns
+    forward through the engine's verify view (the paged spec-verify
+    Pallas kernel by default; `PagedChunkView` dense when
+    FLAGS_serving_pallas_verify is off) -> accept/choose.  Returns
     ``(toks [B,k], counts, accepts, new_lens, new_last, pools,
     dpools)`` — the lens/last outputs are the device carry an
     overlapped next tick chains on."""
     from ..framework.dygraph import no_grad
     from ..framework.tensor import Tensor
-    from ..models.kv_cache import PagedChunkView
+    verify_view_cls = eng._verify_view_cls
 
     def tick(param_vals, draft_vals, pools, dpools, tables, seq_lens,
              last_tok, do_sample, temperature, top_k, top_p, seeds,
@@ -246,8 +248,8 @@ def build_spec_tick(eng, k):
         # other positions' logits bit-identical either way.
         chunk = jnp.concatenate([last_tok[:, None], dtoks[:, :k - 1]],
                                 axis=1)
-        views = [PagedChunkView.from_parts(kk, vv, tables, seq_lens,
-                                           eng.bs)
+        views = [verify_view_cls.from_parts(kk, vv, tables, seq_lens,
+                                            eng.bs)
                  for kk, vv in pools]
         with no_grad():
             logits_t, new_views = eng.model.forward_with_cache(
@@ -266,12 +268,12 @@ def build_tp_spec_tick(eng, k):
     draft phase is REPLICATED — every rank computes the full draft
     forward on its full copy of the (small) draft weights and pools —
     while the verify forward is the sharded `tp.forward_tp` program
-    over `PagedChunkView`, so the expensive model scores the chunk at
-    1/tp weights per rank.  Token choice sees the full replicated
+    over the engine's verify view, so the expensive model scores the
+    chunk at 1/tp weights per rank.  Token choice sees the full replicated
     logits, keeping the TP bit-parity contract."""
-    from ..models.kv_cache import PagedChunkView
     from . import tp as _tp
     meta, bs = eng._tp_meta, eng.bs
+    verify_view_cls = eng._verify_view_cls
 
     def tick(params, draft_vals, pools, dpools, tables, seq_lens,
              last_tok, do_sample, temperature, top_k, top_p, seeds,
@@ -285,7 +287,7 @@ def build_tp_spec_tick(eng, k):
                                 axis=1)
         logits, pools = _tp.forward_tp(
             meta, params, chunk, pools, tables, seq_lens,
-            seq_lens[:, None], bs, view_cls=PagedChunkView)
+            seq_lens[:, None], bs, view_cls=verify_view_cls)
         out = _finish(eng, logits, dtoks, dprobs, do_sample,
                       temperature, top_k, top_p, seeds, seq_lens, kcap)
         return out + (pools, dpools)
@@ -305,15 +307,15 @@ def build_hostdraft_tick(eng, k):
     pools)`` — no draft pools to thread."""
     from ..framework.dygraph import no_grad
     from ..framework.tensor import Tensor
-    from ..models.kv_cache import PagedChunkView
+    verify_view_cls = eng._verify_view_cls
 
     def tick(param_vals, pools, tables, seq_lens, last_tok, dtoks,
              do_sample, temperature, top_k, top_p, seeds, kcap):
         eng._bind_params(param_vals)
         chunk = jnp.concatenate([last_tok[:, None], dtoks[:, :k - 1]],
                                 axis=1)
-        views = [PagedChunkView.from_parts(kk, vv, tables, seq_lens,
-                                           eng.bs)
+        views = [verify_view_cls.from_parts(kk, vv, tables, seq_lens,
+                                            eng.bs)
                  for kk, vv in pools]
         with no_grad():
             logits_t, new_views = eng.model.forward_with_cache(
@@ -337,9 +339,9 @@ def build_tp_hostdraft_tick(eng, k):
     `tp.forward_tp` chunk program, and token choice sees the full
     replicated logits — the TP bit-parity contract, minus the draft
     model entirely."""
-    from ..models.kv_cache import PagedChunkView
     from . import tp as _tp
     meta, bs = eng._tp_meta, eng.bs
+    verify_view_cls = eng._verify_view_cls
 
     def tick(params, pools, tables, seq_lens, last_tok, dtoks,
              do_sample, temperature, top_k, top_p, seeds, kcap):
@@ -347,7 +349,7 @@ def build_tp_hostdraft_tick(eng, k):
                                 axis=1)
         logits, pools = _tp.forward_tp(
             meta, params, chunk, pools, tables, seq_lens,
-            seq_lens[:, None], bs, view_cls=PagedChunkView)
+            seq_lens[:, None], bs, view_cls=verify_view_cls)
         dprobs = jax.nn.one_hot(dtoks, logits.shape[-1],
                                 dtype=jnp.float32)
         out = _finish(eng, logits, dtoks, dprobs, do_sample,

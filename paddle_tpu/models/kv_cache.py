@@ -26,7 +26,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["StaticKVCache", "PagedKVCache", "PagedChunkView"]
+__all__ = ["StaticKVCache", "PagedKVCache", "PagedChunkView",
+           "PagedChunkKernelView", "PagedVerifyKernelView"]
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -205,7 +206,14 @@ class PagedChunkView(PagedKVCache):
     def update_and_attend(self, q, k, v):
         if q.shape[1] == 1:
             return super().update_and_attend(q, k, v)
-        B, s, nh, hd = q.shape
+        new, pos = self._write_chunk(q, k, v)
+        return new, self._attend_chunk(q, new, pos)
+
+    def _write_chunk(self, q, k, v):
+        """Scatter the chunk through the block table at absolute
+        positions ``seq_lens + j``; returns (advanced view, pos[B, s])."""
+        nh = q.shape[2]
+        s = q.shape[1]
         if k.shape[2] != nh:
             if nh % k.shape[2]:
                 raise ValueError(
@@ -224,17 +232,23 @@ class PagedChunkView(PagedKVCache):
         # read of the LAST column, which would corrupt a real block)
         blk = jnp.where(cols < nb, blk, 0)
         slot = (pos % self.bs).astype(jnp.int32)
-        new = PagedChunkView.__new__(PagedChunkView)
+        cls = type(self)
+        new = cls.__new__(cls)
         new.bs, new.tables = self.bs, self.tables
         new.k = self.k.at[:, blk, slot].set(
             jnp.transpose(k.astype(self.k.dtype), (2, 0, 1, 3)))
         new.v = self.v.at[:, blk, slot].set(
             jnp.transpose(v.astype(self.v.dtype), (2, 0, 1, 3)))
         new.seq_lens = self.seq_lens + s
-        # linearize the table (cached prefix + just-written chunk) and
-        # attend with the offset causal mask: query at absolute position
-        # p sees keys 0..p — all real written positions for real queries
-        # (padded chunk rows attend garbage and are discarded upstream)
+        return new, pos
+
+    def _attend_chunk(self, q, new, pos):
+        """Linearize the table (cached prefix + just-written chunk) and
+        attend with the offset causal mask: query at absolute position
+        p sees keys 0..p — all real written positions for real queries
+        (padded chunk rows attend garbage and are discarded upstream)."""
+        B, s, nh, hd = q.shape
+        nb = self.tables.shape[1]
         k_lin = jnp.take(new.k, self.tables, axis=1)   # [nh, B, nb, bs, hd]
         v_lin = jnp.take(new.v, self.tables, axis=1)
         k_lin = k_lin.reshape(nh, B, nb * self.bs, hd)
@@ -245,9 +259,35 @@ class PagedChunkView(PagedKVCache):
         mask = kpos[None, :] <= pos[:, :, None]        # [B, s, K]
         logits = jnp.where(mask[:, None], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bhqk,hbkd->bqhd", probs,
-                         v_lin.astype(jnp.float32)).astype(q.dtype)
-        return new, out
+        return jnp.einsum("bhqk,hbkd->bqhd", probs,
+                          v_lin.astype(jnp.float32)).astype(q.dtype)
+
+
+class PagedChunkKernelView(PagedChunkView):
+    """`PagedChunkView` with the dense linearized-table attend replaced
+    by the chunked paged-prefill Pallas kernel
+    (`ops/pallas_paged.paged_chunk_attention`).  The write path — GQA
+    head repeat, table-routed scatter, pad-block overflow — is inherited
+    unchanged, so the two views differ only in how the attend lowers.
+    Selected by the serving engine when `FLAGS_serving_pallas_prefill`
+    is on (snapshotted at engine init, never read under trace)."""
+
+    def _attend_chunk(self, q, new, pos):
+        from ..ops import pallas_paged
+        return pallas_paged.paged_chunk_attention(
+            q, new.k, new.v, self.tables, self.seq_lens)
+
+
+class PagedVerifyKernelView(PagedChunkKernelView):
+    """Spec-verify twin of `PagedChunkKernelView`: same kernel contract
+    (the k candidate positions are an offset-causal chunk), but a
+    distinct entry point so the verify program carries its own audit
+    claim and its own flag (`FLAGS_serving_pallas_verify`)."""
+
+    def _attend_chunk(self, q, new, pos):
+        from ..ops import pallas_paged
+        return pallas_paged.paged_verify_attention(
+            q, new.k, new.v, self.tables, self.seq_lens)
 
 
 def _dense_causal(q, k, v):

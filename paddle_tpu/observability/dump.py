@@ -129,7 +129,8 @@ def main(argv=None) -> int:
         directory = args.dir
         if directory is None:
             from .. import flags as _flags
-            directory = str(_flags.get_flag("flight_dump_dir")) or "."
+            directory = str(_flags.get_flag("flight_dump_dir")) \
+                or "flight_dumps"
         path = find_latest_dump(directory)
         if path is None:
             print(f"no flight_*.json dump found in {directory!r}",

@@ -219,8 +219,10 @@ def last_dump_path() -> Optional[str]:
 
 def _auto_dump(rec: FlightRecorder, reason: str) -> Optional[str]:
     """Unattended dump (watchdog trip / unhandled exception): writes into
-    FLAGS_flight_dump_dir (cwd when empty), never raises."""
-    directory = str(_flag("flight_dump_dir", "")) or "."
+    FLAGS_flight_dump_dir (``./flight_dumps``, created on demand, when
+    empty — never the CWD root, which in a repo checkout litters
+    untracked files), never raises."""
+    directory = str(_flag("flight_dump_dir", "")) or "flight_dumps"
     rec.dump_count += 1
     tag = "".join(c if c.isalnum() or c in "-_" else "_"
                   for c in reason)[:48]

@@ -36,7 +36,8 @@ from . import metrics as _metrics
 
 __all__ = ["Rung", "register_rung", "rung_names", "get_rung",
            "probe_backend", "run_rung", "run", "select",
-           "validate_record", "regression_check", "SCHEMA"]
+           "validate_record", "regression_check", "SCHEMA",
+           "BackendUnavailable"]
 
 SCHEMA = "paddle_tpu.bench/v1"
 
@@ -116,9 +117,19 @@ _BACKEND_INIT_MARKERS = ("make_c_api_client", "Unable to initialize backend",
                          "to connect")
 
 
+class BackendUnavailable(RuntimeError):
+    """Raise from INSIDE a rung body when the backend/toolchain the
+    rung measures is absent — e.g. a jax build without Pallas for the
+    kernel rungs: the record degrades to ``ok: false,
+    reason: "backend_unavailable"`` exactly like the probe-gated
+    TPU-only rungs, instead of counting as a code error (rc=1)."""
+
+
 def is_backend_init_error(e: BaseException) -> bool:
     """True when an exception is a backend/PJRT initialization failure
     rather than a bug inside the rung."""
+    if isinstance(e, BackendUnavailable):
+        return True
     if type(e).__name__ not in _BACKEND_INIT_TYPES:
         return False
     msg = str(e)

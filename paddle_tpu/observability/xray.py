@@ -40,13 +40,16 @@ fraction-of-total-device-time, achieved FLOP/s and MFU against the
 :func:`.flops.peak_flops` table.
 
 The kernel-coverage audit (:func:`kernel_coverage`) reports, per
-audited program, whether ANY Pallas custom call survived lowering —
-the ROADMAP item 5b question.  On this CPU build the paged/flash
-kernels fall back to dense jnp, so suffix prefill
-(``serving.prefill_cont``) and the spec verify chunk
-(``serving.spec_tick``) correctly report the dense ``PagedChunkView``
-gather; on TPU the same audit shows which paths lower to
-``tpu_custom_call``.
+audited program, whether the hot path runs a Pallas kernel — and HOW
+it knows.  Two evidence channels: the custom-call scan of the lowered
+HLO (``via: "custom_call"`` — the TPU case), and trace-time **kernel
+claims** (``via: "interpret"``): interpret-mode ``pallas_call`` lowers
+to a plain ``stablehlo.while`` with no custom-call marker, so each
+kernel wrapper calls :func:`claim_kernel` while tracing and the
+warmup's AOT path brackets ``lower()`` with
+:func:`capture_kernel_claims` to collect them.  A program with neither
+channel reporting a kernel carries the explicit dense-gather note
+(ROADMAP 5b suspects: suffix prefill, spec verify, MoE dispatch).
 
 Readout everywhere the repo already exports: the
 ``xray.program_dispatches_total`` / ``xray.program_device_seconds_total``
@@ -57,6 +60,7 @@ counters and per-program ``xray.program_mfu`` gauges on ``/metrics``,
 
 from __future__ import annotations
 
+import contextlib
 import re
 import threading
 import time
@@ -69,7 +73,8 @@ from . import metrics as _metrics
 
 __all__ = ["ProgramEntry", "register", "dispatch", "sample_due",
            "sampling_on", "sample_interval", "attach_lowered", "get",
-           "ledger", "kernel_coverage", "report", "reset", "key_for"]
+           "ledger", "kernel_coverage", "report", "reset", "key_for",
+           "claim_kernel", "capture_kernel_claims"]
 
 _M_DISPATCHES = _metrics.counter(
     "xray.program_dispatches_total", "compiled-program dispatches by the "
@@ -129,7 +134,7 @@ class ProgramEntry:
     __slots__ = ("key", "name", "label_key", "dispatches", "samples",
                  "sampled_seconds", "min_s", "max_s", "flops",
                  "bytes_accessed", "audited", "custom_calls",
-                 "custom_call_targets", "pallas")
+                 "custom_call_targets", "pallas", "kernel_claims")
 
     def __init__(self, key: str, name: str):
         self.key = key
@@ -150,6 +155,7 @@ class ProgramEntry:
         self.custom_calls = 0
         self.custom_call_targets: tuple = ()
         self.pallas = False
+        self.kernel_claims: tuple = ()  # trace-time (name, mode) pairs
 
 
 def key_for(name: str, signature: Any = None) -> str:
@@ -242,12 +248,50 @@ def sample_due(fn) -> bool:
             and (entry.dispatches + 1) % iv == 0)
 
 
-def attach_lowered(entry: Optional[ProgramEntry], lowered) -> None:
+# Trace-time kernel-claims channel: interpret-mode pallas_call leaves
+# no custom-call marker in the lowered text (it executes as a
+# stablehlo.while), so kernel wrappers record their presence while
+# tracing instead.  Thread-local so concurrent warmups don't cross.
+_claims_tls = threading.local()
+
+
+@contextlib.contextmanager
+def capture_kernel_claims():
+    """Collect :func:`claim_kernel` calls made while tracing inside the
+    block; yields the (name, mode) list.  Nestable: the inner capture
+    shadows the outer for its extent."""
+    prev = getattr(_claims_tls, "claims", None)
+    _claims_tls.claims = []
+    try:
+        yield _claims_tls.claims
+    finally:
+        _claims_tls.claims = prev
+
+
+def claim_kernel(name: str, mode: str) -> None:
+    """Record that a Pallas kernel was emitted into the program being
+    traced (``mode``: "interpret" or "custom_call").  No-op unless a
+    :func:`capture_kernel_claims` block is active on this thread."""
+    claims = getattr(_claims_tls, "claims", None)
+    if claims is not None:
+        claims.append((str(name), str(mode)))
+
+
+def attach_lowered(entry: Optional[ProgramEntry], lowered,
+                   claims=None) -> None:
     """Best-effort static cost + kernel info from a jax ``Lowered``
-    (the serving warmup's AOT path calls this per grid program).  Never
-    raises: an analysis-less backend must not fail warmup."""
+    (the serving warmup's AOT path calls this per grid program), plus
+    any trace-time kernel ``claims`` captured around the lower().
+    Never raises: an analysis-less backend must not fail warmup."""
     if entry is None or lowered is None:
         return
+    if claims is not None:
+        # dedupe, preserve first-seen order.  An EMPTY captured list
+        # overwrites too: entries are process-global, and a program
+        # re-lowered with the kernels flagged off must drop the claims
+        # of an earlier build (the audit reports the build, not history)
+        entry.kernel_claims = tuple(dict.fromkeys(
+            (str(n), str(m)) for n, m in claims))
     try:
         cost = lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -337,10 +381,12 @@ _PATHS = (
     ("serving.decode", "host-sampling decode"),
     ("serving.cow", "copy-on-write block copy"),
     ("optimizer.fused_step", "fused optimizer step"),
+    ("moe.dispatch", "moe dispatch/combine"),
 )
 # ROADMAP item 5b names these as the paths suspected of running the
-# dense PagedChunkView gather instead of the paged/flash Pallas kernels
-_KERNEL_SUSPECTS = ("serving.prefill_cont", "serving.spec_tick")
+# dense gather/scatter instead of the paged/flash/MoE Pallas kernels
+_KERNEL_SUSPECTS = ("serving.prefill_cont", "serving.spec_tick",
+                    "moe.dispatch")
 
 
 def _path_label(name: str) -> str:
@@ -351,25 +397,41 @@ def _path_label(name: str) -> str:
 
 
 def kernel_coverage() -> List[Dict[str, Any]]:
-    """The HLO kernel-coverage audit: one row per AUDITED program
-    (attach_lowered saw its lowered text), reporting whether any Pallas
-    custom call survived lowering.  The ROADMAP 5b suspects (suffix
-    prefill, spec verify) carry an explicit dense-gather note when no
-    kernel was found — evidence, not inference."""
+    """The kernel-coverage audit: one row per AUDITED program
+    (attach_lowered saw its lowered text), reporting whether the hot
+    path runs a Pallas kernel and via which evidence channel —
+    ``"custom_call"`` (the HLO scan found the Mosaic call; the TPU
+    case) or ``"interpret"`` (a trace-time claim; interpret-mode
+    pallas_call leaves no HLO marker).  The ROADMAP 5b suspects (suffix
+    prefill, spec verify, MoE dispatch) carry an explicit dense-gather
+    note when NEITHER channel reports a kernel — evidence, not
+    inference."""
     with _lock:
         entries = [e for e in _entries.values() if e.audited]
     rows = []
     for e in sorted(entries, key=lambda e: e.key):
+        claimed = e.kernel_claims
+        kernel = e.pallas or bool(claimed)
+        if e.pallas:
+            via = "custom_call"
+        elif claimed:
+            # all claims in one program share the lowering mode
+            via = claimed[0][1]
+        else:
+            via = None
         row = {"program": e.key,
                "path": _path_label(e.name),
                "pallas": e.pallas,
+               "kernel": kernel,
+               "via": via,
+               "kernels": sorted({n for n, _ in claimed}),
                "custom_calls": e.custom_calls,
                "targets": list(e.custom_call_targets)}
-        if not e.pallas and any(e.name == s or e.name.startswith(s)
-                                for s in _KERNEL_SUSPECTS):
-            row["note"] = ("dense PagedChunkView gather — no Pallas "
-                           "custom call in the lowered HLO on this "
-                           "build (ROADMAP 5b suspect)")
+        if not kernel and any(e.name == s or e.name.startswith(s)
+                              for s in _KERNEL_SUSPECTS):
+            row["note"] = ("dense gather — no Pallas custom call in "
+                           "the lowered HLO and no trace-time kernel "
+                           "claim on this build (ROADMAP 5b suspect)")
         rows.append(row)
     return rows
 

@@ -1,11 +1,11 @@
-"""Pallas TPU kernel dispatch (flash attention).
+"""Pallas TPU kernel dispatch (flash attention, fused MoE routing).
 
 Role of the reference's hand-fused CUDA kernels
 (`phi/kernels/gpu/flash_attn_kernel.cu`, `fusion/gpu/` fused ops): ops XLA
 won't fuse optimally get hand-written TPU kernels.  The actual kernels live
-in `pallas_flash.py`; this module gates applicability and registers the
-dispatched op so the eager tape engine differentiates through the kernel's
-custom VJP.
+in `pallas_flash.py` / `pallas_moe.py`; this module gates applicability and
+registers the dispatched ops so the eager tape engine differentiates
+through each kernel's custom VJP.
 
 Gating: the kernel path is taken on a real TPU backend with supported
 shapes (seqs divisible by their blocks, head_dim in {64, 128, 256}, q
@@ -30,8 +30,14 @@ try:
 except ImportError:  # pragma: no cover - jax build without pallas
     pallas_flash = None
 
+try:
+    from . import pallas_moe
+except ImportError:  # pragma: no cover - jax build without pallas
+    pallas_moe = None
+
 __all__ = ["flash_attention", "flash_attention_available",
-           "as_kv_padding_mask"]
+           "as_kv_padding_mask", "moe_fused_available",
+           "moe_routing_indices", "moe_dispatch", "moe_combine"]
 
 
 @functools.cache
@@ -110,3 +116,52 @@ def flash_attention(q, k, v, causal=False, dropout_p=0.0, kv_mask=None):
     return _d("flash_attention", (q, k, v, kv_mask, seed),
               {"causal": bool(causal), "dropout_rate": float(dropout_p),
                "mask_shape": mask_shape})
+
+
+# ------------------------------------------------------- fused MoE routing
+# The dense (T,E,C) einsum dispatch/combine of the MoE layer replaced by
+# the one-pass index-form kernels of `pallas_moe.py` (ISSUE 18).  Unlike
+# flash attention these run everywhere pallas imports — interpret mode on
+# CPU (row moves, not matmuls, so interpret is not the liability it is
+# for attention grids) and Mosaic on TPU.
+
+def moe_fused_available() -> bool:
+    """The fused routing data plane can run (pallas imports; on CPU the
+    kernels run in interpret mode)."""
+    return pallas_moe is not None and \
+        getattr(pallas_moe, "pltpu", None) is not None
+
+
+if pallas_moe is not None:
+    register_op(
+        "moe_routing_indices",
+        lambda eid, slot, keep, *, num_experts, capacity:
+            pallas_moe.routing_indices(eid, slot, keep,
+                                       num_experts, capacity))
+    register_op("moe_dispatch",
+                lambda x, inv: pallas_moe.moe_dispatch(x, inv),
+                tags=("fused", "pallas"))
+    register_op("moe_combine",
+                lambda rows, w, flat: pallas_moe.moe_combine(rows, w, flat),
+                tags=("fused", "pallas"))
+
+
+def moe_routing_indices(eid, slot, keep, num_experts, capacity):
+    """Index plumbing for the fused MoE path: flat destination slot per
+    (token, choice) and the inverse slot->token map.  Integer outputs —
+    the routing gradient rides the combine weights, not these."""
+    return _d("moe_routing_indices", (eid, slot, keep),
+              {"num_experts": int(num_experts), "capacity": int(capacity)})
+
+
+def moe_dispatch(x, inv):
+    """Pack token rows [T, M] into flat expert buffers [E*C, M] by the
+    inverse slot map; differentiable through the kernel's custom VJP
+    (scatter-add transpose)."""
+    return _d("moe_dispatch", (x, inv), {})
+
+
+def moe_combine(expert_rows, w, flat):
+    """Mix expert output rows [E*C, M] back to tokens [T, M] with the
+    combine weights w [T, k]; differentiable in both expert_rows and w."""
+    return _d("moe_combine", (expert_rows, w, flat), {})

@@ -857,10 +857,73 @@ class UnpropagatedTraceContext(Rule):
         return out
 
 
+# =========================================================== R013
+class InterpretModeKernelInHotPath(Rule):
+    """A ``pallas_call(...)`` that HARDCODES ``interpret=True`` outside
+    any backend/fallback guard.  Interpret mode is the CPU-parity
+    executor — it copies every input buffer per grid step and runs the
+    kernel as traced XLA, orders of magnitude off the Mosaic lowering —
+    so a literal ``interpret=True`` in library code silently pins the
+    hot path to the slow executor even on a real TPU (the exact
+    regression the X-ray kernel-coverage audit exists to catch; its
+    ``via`` column would still read "interpret" on a TPU build).
+    Compliant shapes: thread a computed flag
+    (``interpret=jax.default_backend() != "tpu"`` — the idiom of
+    `ops/pallas_paged.py` / `ops/pallas_moe.py`), a conditional
+    expression, or put the literal inside an ``if`` whose test probes
+    the backend (a CPU-fallback branch).  Tests may hardcode it freely
+    (the rule skips ``test_*`` files like the rest of the code rules)."""
+
+    id = "R013"
+    name = "interpret-mode-kernel-in-hot-path"
+
+    # an enclosing `if` whose test mentions any of these reads as a
+    # deliberate backend/fallback branch, not a pinned executor
+    _GUARD_MARKERS = ("tpu", "backend", "interpret", "cpu", "fallback",
+                      "debug")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in sf.scopes():
+            guards: List[tuple] = []
+            calls: List[ast.Call] = []
+            for n in sf.scope_walk(scope):
+                if isinstance(n, ast.If):
+                    try:
+                        ttext = ast.unparse(n.test).lower()
+                    except Exception:  # pragma: no cover - malformed node
+                        ttext = ""
+                    if any(m in ttext for m in self._GUARD_MARKERS):
+                        guards.append((n.lineno,
+                                       getattr(n, "end_lineno", n.lineno)))
+                elif isinstance(n, ast.Call) and \
+                        callee_segment(n.func) == "pallas_call":
+                    kw = next((k for k in n.keywords
+                               if k.arg == "interpret"), None)
+                    if kw is not None and isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is True:
+                        calls.append(n)
+            for call in calls:
+                if any(a <= call.lineno <= b for a, b in guards):
+                    continue
+                out.append(self.finding(
+                    sf, call,
+                    "`pallas_call(..., interpret=True)` hardcodes the "
+                    "interpret-mode executor: on a TPU build this pins "
+                    "the kernel to the slow traced-XLA path (per-grid-"
+                    "step buffer copies, no Mosaic lowering) and the "
+                    "X-ray audit keeps reporting via=interpret.  Compute "
+                    "the flag instead (`interpret=jax.default_backend() "
+                    '!= "tpu"`) or guard the literal with a backend '
+                    "check"))
+        return out
+
+
 RULES: List[Rule] = [
     HostSyncInTracedCode(), AliasUnsafeDeviceInput(), UseAfterDonate(),
     TraceTimeFlagRead(), LockOrderInversion(), UnsyncedTiming(),
     UnpairedKVHandoff(), UnpropagatedTraceContext(),
+    InterpretModeKernelInHotPath(),
 ]
 
 # the interprocedural rule set (R007-R010) registers itself here; the

@@ -605,11 +605,101 @@ def test_xray_rung_schema():
     assert val["sampled_dispatches"] > 0
     assert val["top_program"]
     assert val["kernel_coverage_programs"] >= 9
-    # the CPU build lowers nothing to Pallas: both ROADMAP 5b suspects
-    # must be reported dense — evidence, not assumption
+    # the CPU build lowers no Pallas CUSTOM CALLS (interpret mode is
+    # traced XLA) — but since ISSUE 18 the suspects run the paged
+    # kernels in interpret mode, evidenced by trace-time claims: the
+    # rows must read NOT dense, via "interpret"
     assert val["pallas_programs"] == 0
-    assert val["suffix_prefill_dense"] is True
-    assert val["spec_verify_dense"] is True
+    assert val["suffix_prefill_dense"] is False
+    assert val["spec_verify_dense"] is False
+    assert val["suffix_prefill_via"] == ["interpret"]
+    assert val["spec_verify_via"] == ["interpret"]
+
+
+def _load_bench(modname):
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        modname, os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench
+
+
+def _kernel_coverage_record(bench, smoke):
+    from types import SimpleNamespace
+
+    ctx = SimpleNamespace(smoke=smoke, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_kernel_coverage(ctx)
+    rec = {"rung": "kernel_coverage", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    return val
+
+
+def test_kernel_coverage_rung_schema():
+    """Pin the ISSUE 18 `kernel_coverage` rung: both regression keys
+    present and >= 1.0 on the CPU interpret smoke (the kernels must
+    BEAT the dense gather at the table-slack shapes, or the flip is a
+    regression dressed as a feature), and the embedded audit rows carry
+    kernel=True via=interpret for all three X-ray suspects."""
+    bench = _load_bench("bench_module_kc")
+    val = _kernel_coverage_record(bench, smoke=True)
+    assert harness.get_rung("kernel_coverage").smoke
+    assert bench._REGRESSION_KEYS["kernel_coverage"] == (
+        "paged_prefill_kernel_speedup", "spec_verify_kernel_speedup")
+    for key in bench._REGRESSION_KEYS["kernel_coverage"]:
+        assert isinstance(val[key], float)
+        assert val[key] >= 1.0, (key, val[key])
+    assert val["paged_prefill_kernel_ms"] > 0
+    assert val["spec_verify_dense_ms"] > 0
+    paths = {r["path"]: r for r in val["audit"]}
+    assert set(paths) == {"suffix/chunked prefill", "spec verify chunk",
+                          "moe dispatch/combine"}
+    for r in paths.values():
+        assert r["kernel"] is True and r["via"] == "interpret"
+    assert "paged_chunk_prefill" in \
+        paths["suffix/chunked prefill"]["kernels"]
+    assert "paged_spec_verify" in paths["spec verify chunk"]["kernels"]
+    assert "moe_fused_dispatch" in \
+        paths["moe dispatch/combine"]["kernels"]
+
+
+def test_kernel_coverage_degrades_without_pallas(monkeypatch):
+    """ISSUE 18 satellite: a jax build without Pallas must degrade the
+    kernel rung to `ok:false reason:backend_unavailable` — an
+    environment answer, not an rc=1 code bug."""
+    bench = _load_bench("bench_module_kc_deg")
+    from paddle_tpu.ops import pallas_paged
+
+    monkeypatch.setattr(pallas_paged, "pltpu", None)
+    rec = harness.run_rung(harness.get_rung("kernel_coverage"),
+                           probe={"ok": True, "platform": "cpu",
+                                  "device_kind": "cpu", "n_devices": 1,
+                                  "error": None})
+    assert rec["ok"] is False
+    assert rec["reason"] == "backend_unavailable"
+    assert "pallas" in rec["error"].lower()
+    assert harness.validate_record(rec) is None
+    assert bench is not None   # rung registration came from this load
+
+
+@pytest.mark.slow  # 4s measured: the non-smoke shapes of the kernel rung
+def test_kernel_coverage_rung_heavy():
+    """The heavy twin: same pins at the non-smoke CPU shapes (wider
+    tables, longer prefixes — the regime the speedup keys are diffed
+    at across bench rounds)."""
+    bench = _load_bench("bench_module_kc_heavy")
+    val = _kernel_coverage_record(bench, smoke=False)
+    for key in bench._REGRESSION_KEYS["kernel_coverage"]:
+        assert val[key] >= 1.0, (key, val[key])
+    assert val["max_blocks"] == 256
+    assert {r["path"] for r in val["audit"]} == {
+        "suffix/chunked prefill", "spec verify chunk",
+        "moe dispatch/combine"}
 
 
 @pytest.mark.slow  # 5s measured: compiles the fused-optimizer step; joins the other rung-schema drills

@@ -160,6 +160,7 @@ def _drive_mixed_traffic(eng, vocab, lens, budget=7):
     return reqs
 
 
+@pytest.mark.slow  # 18s measured (PR 18 re-budget): warms the full bucket grid; test_ladder_drives_worst_case_accounting keeps the fast ladder pin and test_pallas_paged_kernels warms an engine fast
 def test_warmup_grid_zero_compiles_then_one_blamed_outside(model):
     """THE acceptance test (ISSUE 7 satellite): after warmup, mixed
     greedy/sampled traffic across every pad bucket triggers zero

@@ -46,7 +46,8 @@ def test_cached_greedy_matches_full_forward(build):
 
 @pytest.mark.parametrize("build", [
     lambda: GPTForCausalLM(gpt3_tiny()),
-    lambda: LlamaForCausalLM(tiny_llama()),
+    # llama variant: 8s measured (PR 18 re-budget); the gpt param keeps the fast pin
+    pytest.param(lambda: LlamaForCausalLM(tiny_llama()), marks=pytest.mark.slow),
 ], ids=["gpt", "llama"])
 def test_static_cache_matches_dense(build):
     """StaticKVCache (preallocated, one compiled program per step shape)

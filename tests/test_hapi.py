@@ -83,7 +83,9 @@ def _prepared_model(jit_compile=True, lr=0.002):
     return model
 
 
-@pytest.mark.parametrize("jit_compile", [True, False])
+@pytest.mark.parametrize("jit_compile", [
+    # jit variant: 9s measured (PR 18 re-budget); the eager fit keeps the fast pin
+    pytest.param(True, marks=pytest.mark.slow), False])
 def test_model_fit_learns(jit_compile):
     paddle.seed(42)
     model = _prepared_model(jit_compile)
@@ -232,9 +234,10 @@ def test_fit_zero_epochs_is_noop():
     assert logs == {}
 
 
-@pytest.mark.parametrize("amp_configs", ["O1", {"level": "O2"},
-                                         {"level": "O1",
-                                          "init_loss_scaling": 1024.0}])
+@pytest.mark.parametrize("amp_configs", [
+    # bare-O1 variant: 7s measured (PR 18 re-budget); the dict-O1 param keeps the fast pin
+    pytest.param("O1", marks=pytest.mark.slow), {"level": "O2"},
+    {"level": "O1", "init_loss_scaling": 1024.0}])
 def test_model_amp_configs(amp_configs):
     paddle.seed(0)
     net = paddle.vision.models.LeNet()

@@ -43,7 +43,9 @@ def test_forward_backward_all_params(family, ctor, cfg_fn):
 
 
 @pytest.mark.parametrize("ctor,cfg_fn", [
-    (GPTForCausalLM, gpt3_tiny), (LlamaForCausalLM, llama_tiny)])
+    (GPTForCausalLM, gpt3_tiny),
+    # llama variant: 7s measured (PR 18 re-budget); the gpt param keeps the fast pin
+    pytest.param(LlamaForCausalLM, llama_tiny, marks=pytest.mark.slow)])
 def test_jit_train_step_matches_eager_and_learns(ctor, cfg_fn):
     def run(use_jit):
         paddle.seed(7)

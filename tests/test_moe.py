@@ -168,6 +168,7 @@ def test_naive_gate_under_jit():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow  # 8s measured (PR 18 re-budget): 4-device shard_map compile; test_all_to_all_dispatch_capacity_drops keeps the fast dist-dispatch pin
 def test_all_to_all_dispatch_matches_serial():
     """The hybrid step's expert-parallel dispatch (sort + pack into fixed
     lanes + lax.all_to_all + unsort — the global_scatter/global_gather
@@ -248,3 +249,88 @@ def test_all_to_all_dispatch_capacity_drops():
     # with per-dest capacity 1 and 16 tokens/rank, most rows are dropped
     zero_rows = (np.abs(out).sum(-1) == 0).mean()
     assert zero_rows > 0.5
+
+
+# ------------------------- fused dispatch/combine (ISSUE 18) ----------
+
+from paddle_tpu.flags import flag_guard  # noqa: E402
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import (  # noqa: E402
+    audit_dispatch)
+from paddle_tpu.observability import xray  # noqa: E402
+
+
+def _same_weights_pair(gate, top_k, seed=11):
+    """The same layer twice — identical init seed, one snapshotting the
+    fused data plane, one the dense einsums (the flag is read at
+    construction, like the serving view-class snapshots)."""
+    def build(fused):
+        with flag_guard(moe_fused_dispatch=fused):
+            paddle.seed(seed)
+            layer = MoELayer(d_model=16, num_expert=4, d_hidden=32,
+                             gate=gate, top_k=top_k, capacity_factor=2.0)
+        layer.eval()     # gshard's train-time random routing would
+        return layer     # decorrelate the two forwards
+    fused, dense = build(True), build(False)
+    assert fused._fused is True and dense._fused is False
+    return fused, dense
+
+
+@pytest.mark.parametrize("gate,top_k", [("switch", 1), ("naive", 2),
+                                        ("gshard", 2)])
+def test_fused_dispatch_matches_dense_einsum(gate, top_k):
+    """The tentpole parity bar: index-form routing + Pallas
+    dispatch/combine must reproduce the (T, E, C) einsum data plane —
+    outputs to one float-rounding step (the dense dot_general fuses its
+    multiply-add; top-1 is bit-exact) and the aux loss exactly."""
+    fused, dense = _same_weights_pair(gate, top_k)
+    x = tokens(T=24, M=16, seed=4)
+    got = np.asarray(fused(x)._value)
+    want = np.asarray(dense(x)._value)
+    tol = 0.0 if top_k == 1 else 1e-6
+    np.testing.assert_allclose(got, want, atol=tol, rtol=tol)
+    assert float(fused.l_aux._value) == float(dense.l_aux._value)
+
+
+def test_fused_dispatch_backward_matches_dense():
+    """Gradients flow through the custom-vjp gather/scatter transposes
+    and must land where the einsum path lands them — experts AND the
+    gate projection (routing weights carry the only gate grad)."""
+    def grads(layer):
+        x = tokens(T=24, M=16, seed=4)
+        out = layer(x)
+        loss = paddle.mean(out * out) + 0.01 * layer.l_aux
+        loss.backward()
+        # parameter auto-names are globally numbered; the two layers are
+        # built identically, so positional order is the stable identity
+        return [np.asarray(p.grad._value) for p in layer.parameters()]
+
+    fused, dense = _same_weights_pair("naive", 2)
+    gf, gd = grads(fused), grads(dense)
+    assert len(gf) == len(gd)
+    for i, (a, b) in enumerate(zip(gf, gd)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"param #{i}")
+    assert np.abs(np.asarray(
+        dense.gate.gate.weight.grad._value)).sum() > 0
+
+
+def test_moe_audit_row_flips_with_the_flag():
+    """The ISSUE 18 acceptance gate for MoE, driven through the audit
+    itself: a fused layer's `moe.dispatch` kernel-coverage row reports
+    the Pallas claims (the dispatch no longer lowers to the stock
+    gather/scatter einsums), a dense layer's row keeps the
+    dense-gather note."""
+    fused, dense = _same_weights_pair("switch", 1)
+
+    key = audit_dispatch(fused, num_tokens=32)
+    row = {r["program"]: r for r in xray.kernel_coverage()}[key]
+    assert row["path"] == "moe dispatch/combine"
+    assert row["kernel"] is True and row["via"] == "interpret"
+    assert {"moe_fused_dispatch", "moe_fused_combine"} <= set(row["kernels"])
+    assert "note" not in row
+
+    key = audit_dispatch(dense, num_tokens=32)
+    row = {r["program"]: r for r in xray.kernel_coverage()}[key]
+    assert row["kernel"] is False and row["via"] is None
+    assert row["kernels"] == []
+    assert "dense gather" in row["note"]

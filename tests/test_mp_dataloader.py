@@ -48,6 +48,7 @@ def test_mp_loader_matches_serial(use_shm):
                                       np.asarray(yp._value))
 
 
+@pytest.mark.slow  # 7s measured (PR 18 re-budget): spawns the worker pool twice; test_mp_loader_matches_serial keeps the fast mp pin
 def test_mp_loader_order_is_deterministic():
     ds = SquareDataset(24)
     loader = paddle.io.DataLoader(ds, batch_size=3, shuffle=False,

@@ -154,7 +154,9 @@ def qkv64(B=1, H=2, S=256, D=64, seed=3):
     return mk(), mk(), mk()
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [
+    # non-causal variant: 8s measured (PR 18 re-budget); causal keeps the fast pin
+    pytest.param(False, marks=pytest.mark.slow), True])
 def test_ring_attention_pallas_path_matches_full(causal):
     """hd=64 routes through the Pallas flash hop kernels (interpret mode on
     CPU); parity against dense attention, fwd + grads."""

@@ -213,6 +213,7 @@ def test_tick_dispatch_failure_evicts_batch_ledger_clean(model):
 
 # ------------------------------------------------------------ tick watchdog
 
+@pytest.mark.slow  # 7s measured (PR 18 re-budget): compiles an engine grid around a stalled harvest; the drain/admission + retry pins stay fast
 def test_tick_watchdog_fails_hung_harvest(model):
     """A harvest stalled past ``FLAGS_serving_tick_timeout_s`` raises
     TickTimeout inside the loop; the guard absorbs it — implicated

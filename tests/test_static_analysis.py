@@ -513,6 +513,62 @@ def test_r012_header_kwarg_counts_as_propagation(tmp_path):
     assert {f.symbol for f in fs} == {"disagg"}
 
 
+R013_BAD = """\
+from jax.experimental import pallas as pl
+import jax
+
+
+def hot_attention(q, k, v):
+    return pl.pallas_call(
+        _kernel, out_shape=q, interpret=True)(q, k, v)
+"""
+
+R013_GOOD = """\
+from jax.experimental import pallas as pl
+import jax
+
+
+def attention(q, k, v, interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return pl.pallas_call(
+        _kernel, out_shape=q, interpret=interpret)(q, k, v)
+
+
+def guarded(q, k, v):
+    if jax.default_backend() != "tpu":
+        return pl.pallas_call(
+            _kernel, out_shape=q, interpret=True)(q, k, v)
+    return pl.pallas_call(_kernel, out_shape=q)(q, k, v)
+
+
+def conditional(q, k, v):
+    # a conditional EXPRESSION is not a hardcoded literal either
+    return pl.pallas_call(
+        _kernel, out_shape=q,
+        interpret=True if jax.default_backend() != "tpu" else False,
+    )(q, k, v)
+"""
+
+
+def test_r013_catches_hardcoded_interpret_kernel(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R013_BAD}, rules=["R013"])
+    assert len(fs) == 1
+    assert fs[0].symbol == "hot_attention"
+    assert "interpret" in fs[0].message
+
+
+def test_r013_computed_and_guarded_interpret_are_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R013_GOOD}, rules=["R013"]) == []
+
+
+def test_r013_inline_disable(tmp_path):
+    src = R013_BAD.replace(
+        "return pl.pallas_call(",
+        "return pl.pallas_call(  # graft-lint: disable=R013")
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R013"]) == []
+
+
 # ===================================================== suppressions
 
 def test_inline_suppression_same_line(tmp_path):

@@ -333,7 +333,7 @@ def test_composition_spec_quant_tp2_chunked_ledger_pinned(model):
     Zero post-warmup compiles with sampling enabled; the ledger's
     dispatch counts reconcile exactly against the engine's own
     counters; spec verify and suffix prefill carry sampled MFU and
-    their dense-gather audit notes."""
+    their kernel-claim audit rows (via=interpret on this CPU build)."""
     with flag_guard(serving_warmup=True, serving_pad_buckets="16,32",
                     serving_prefill_chunk=8, xray_sample_interval=2):
         # max_batch=3 keeps this engine's ledger keys unique across the
@@ -378,10 +378,22 @@ def test_composition_spec_quant_tp2_chunked_ledger_pinned(model):
         # sampled MFU present on the hot programs
         hot = max(spec, key=lambda p: p["dispatches"])
         assert hot["samples"] > 0 and hot["mfu"] and hot["mfu"] > 0
-        # both ROADMAP 5b suspects audited dense, with the note
+        # both ROADMAP 5b suspects now run the paged Pallas kernels
+        # (ISSUE 18): no custom call on this CPU build (interpret mode
+        # is traced XLA), but the trace-time claims channel flips the
+        # rows to kernel=True via=interpret — and the dense-gather
+        # note is gone
         cov = {c["program"]: c for c in rep["kernel_coverage"]}
-        for p in spec + cont:
+        for p in spec:
             row = cov[p["program"]]
             assert row["pallas"] is False
-            assert "PagedChunkView" in row.get("note", "")
+            assert row["kernel"] is True and row["via"] == "interpret"
+            assert "paged_spec_verify" in row["kernels"]
+            assert "note" not in row
+        for p in cont:
+            row = cov[p["program"]]
+            assert row["pallas"] is False
+            assert row["kernel"] is True and row["via"] == "interpret"
+            assert "paged_chunk_prefill" in row["kernels"]
+            assert "note" not in row
         assert cov[hot["program"]]["path"] == "spec verify chunk"
