@@ -92,6 +92,7 @@ _REGRESSION_KEYS = {
     "fleet_telescope": "fleet_trace_overhead_pct",
     "kernel_coverage": ("paged_prefill_kernel_speedup",
                         "spec_verify_kernel_speedup"),
+    "zero3_elastic": ("zero3_step_ratio", "elastic_resume_ok"),
 }
 
 _ENV_PROBE = {}
@@ -1123,6 +1124,171 @@ def bench_kernel_coverage(ctx):
                                "kernels")}
         for r in _xray.kernel_coverage() if r["path"] in suspects]
     return out
+
+
+@harness.register_rung("zero3_elastic", est_cold_s=150, smoke=True)
+def bench_zero3_elastic(ctx):
+    """Elastic ZeRO-3 rung (ISSUE 19): the fused one-dispatch stage-3
+    step against the naive allgather-on-use loop it replaces, plus the
+    elastic-resume drill as a pinned boolean.
+
+    One subprocess on a forced 4-device CPU mesh times
+    `make_zero3_train_step` (bucketed in-program gathers, in-program
+    reduce-scatter via AD transpose, fused shard optimizer — ONE
+    dispatch per step) against a baseline that does what stage 3
+    without the fused step has to do: eagerly all-gather every
+    parameter leaf (one collective dispatch per leaf), run a jitted
+    full-parameter step, eagerly re-shard the gradients and apply the
+    shard optimizer as a second program.  `zero3_step_ratio` =
+    best-of-reps naive step time / fused step time (regression key;
+    it dropping below 1.0 means the fusion stopped paying for itself).
+    The same subprocess replays the 4 -> 2 -> 4 reshard-on-resume
+    drill through CheckpointManager and reports `elastic_resume_ok`
+    (bit-exact params AND moments vs a never-interrupted run) — a
+    fast fused step that breaks resume is a regression no ratio
+    excuses.  On TPU the rung degrades to backend_unavailable: the
+    drill NEEDS a forced multi-device CPU mesh to emulate world-size
+    changes inside one host."""
+    if ctx.on_tpu:
+        raise harness.BackendUnavailable(
+            "zero3_elastic drills world-size changes on a forced "
+            "multi-device CPU mesh; a latched TPU backend cannot "
+            "re-partition itself into 4-then-2 device worlds")
+    code = r"""
+import dataclasses, json, os, tempfile, time
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+from paddle_tpu.distributed.fleet import hybrid_step as hs
+from paddle_tpu.distributed.fleet.sharding import flat_shard_layout
+from paddle_tpu.optimizer.fused import zero3_shard_update
+
+cfg = hs.HybridConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                      num_heads=4, seq_len=32, pp=1, mp=1, dp=4,
+                      n_microbatches=2, sequence_parallel=False,
+                      remat=False, zero_stage=3)
+params = hs.init_gpt_params(jax.random.PRNGKey(0), cfg)
+ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8, 32), 0, 128)
+mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+out = {}
+
+# --- fused one-dispatch step
+fp, m, v = hs.init_zero3_state(params, mesh)
+step = hs.make_zero3_train_step(mesh, cfg)
+out["buckets"] = len(step.buckets)
+loss, fp, m, v = step(fp, m, v, jnp.float32(1.0), ids)   # compile
+jax.block_until_ready(fp)
+
+def best_of(fn, reps=5, iters=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+sno = [1.0]
+def fused_once():
+    sno[0] += 1.0
+    _, p2, m2, v2 = step(fp, m, v, jnp.float32(sno[0]), ids)
+    jax.block_until_ready(p2)
+fused_s = best_of(fused_once)
+
+# --- naive allgather-on-use baseline: one eager collective dispatch
+# per leaf to materialize full params, a jitted full-parameter
+# grad step, an eager re-shard per leaf, a second program for the
+# shard optimizer update
+leaves, treedef = jax.tree_util.tree_flatten(params)
+repl = NamedSharding(mesh, P())
+shard = NamedSharding(mesh, P("dp"))
+
+def full_grad_step(pl, batch):
+    ps = jax.tree_util.tree_unflatten(treedef, pl)
+    def loss_fn(p):
+        per_mb = jnp.stack([hs.serial_forward(p, batch[i], cfg)
+                            for i in range(batch.shape[0])])
+        return jnp.mean(per_mb)
+    return jax.value_and_grad(loss_fn)(ps)
+jf = jax.jit(full_grad_step)
+ju = jax.jit(zero3_shard_update)
+
+metas = [(tuple(l.shape), l.dtype) + flat_shard_layout(l.shape, 4)
+         for l in leaves]
+
+def naive_once(fp_l, m_l, v_l, t):
+    # allgather-on-use: leaf-by-leaf eager replication
+    full = [jax.device_put(f[:F].reshape(shape), repl)
+            for f, (shape, dt, F, Fp) in zip(fp_l, metas)]
+    loss, grads = jf(full, ids)
+    gl = jax.tree_util.tree_leaves(grads)
+    # eager per-leaf re-shard of the gradients back to the flat layout
+    g_sh = [jax.device_put(
+                jnp.pad(g.reshape(-1), (0, Fp - F)), shard)
+            for g, (shape, dt, F, Fp) in zip(gl, metas)]
+    kw = dict(learning_rate=cfg.learning_rate, beta1=cfg.beta1,
+              beta2=cfg.beta2, eps=cfg.eps)
+    p2, m2, v2 = ju(fp_l, g_sh, m_l, v_l, jnp.float32(t), **kw)
+    jax.block_until_ready(p2)
+    return p2, m2, v2
+
+tl = jax.tree_util.tree_leaves
+fp_t, m_t, v_t = hs.init_zero3_state(params, mesh)
+fp_l, m_l, v_l = naive_once(tl(fp_t), tl(m_t), tl(v_t), 1.0)  # compile
+def naive_step():
+    sno[0] += 1.0
+    naive_once(fp_l, m_l, v_l, sno[0])
+naive_s = best_of(naive_step)
+
+out["fused_step_ms"] = round(fused_s * 1e3, 3)
+out["naive_step_ms"] = round(naive_s * 1e3, 3)
+out["zero3_step_ratio"] = round(naive_s / max(fused_s, 1e-9), 3)
+
+# --- elastic resume drill: 4 -> 2 -> 4 vs uninterrupted, bit-exact
+def run(dp, n, state=None, t0=0, grain=4):
+    meshd = Mesh(np.array(jax.devices()[:dp]), ("dp",))
+    cfgd = dataclasses.replace(cfg, dp=dp)
+    if state is None:
+        state = hs.init_zero3_state(params, meshd)
+    st = hs.make_zero3_train_step(meshd, cfgd, grain=grain)
+    fp0, m0, v0 = state
+    for t in range(t0, t0 + n):
+        _, fp0, m0, v0 = st(fp0, m0, v0, jnp.float32(t + 1), ids)
+    return fp0, m0, v0
+
+with tempfile.TemporaryDirectory() as d:
+    mgr = CheckpointManager(d)
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("dp",))
+    s4 = run(4, 2)
+    hs.save_zero3_state(mgr, 2, *s4, 2.0, grain=4, wait=True)
+    fp2, m2, v2, sn, gr = hs.load_zero3_state(mgr, mesh2, cfg)
+    s2 = run(2, 1, (fp2, m2, v2), int(sn))
+    hs.save_zero3_state(mgr, 3, *s2, 3.0, grain=4, wait=True)
+    fp4, m4, v4, sn2, _ = hs.load_zero3_state(mgr, mesh, cfg)
+    sR = run(4, 1, (fp4, m4, v4), int(sn2))
+    sU = run(4, 4)
+    ok = True
+    for a, b in zip(sR, sU):
+        for x, y in zip(jax.tree_util.tree_leaves(a),
+                        jax.tree_util.tree_leaves(b)):
+            ok &= bool(np.array_equal(np.asarray(x), np.asarray(y)))
+out["elastic_resume_ok"] = bool(ok)
+print("RESULT " + json.dumps(out))
+"""
+    res = _run_result_subprocess("zero3_elastic", code)
+    if not res["elastic_resume_ok"]:
+        raise RuntimeError("elastic 4->2->4 resume lost bit-exactness")
+    return {"zero3_step_ratio": res["zero3_step_ratio"],
+            "elastic_resume_ok": bool(res["elastic_resume_ok"]),
+            "fused_step_ms": res["fused_step_ms"],
+            "naive_step_ms": res["naive_step_ms"],
+            "gather_buckets": res["buckets"]}
 
 
 def _sampled_decode_sweep(model, cfg, on_tpu):

@@ -103,17 +103,26 @@ def _assemble(pieces, offset: Tuple[int, ...], shape: Tuple[int, ...],
 
 
 def load_state_dict(state_dict: Dict, path: str,
-                    process_group=None, coordinator_rank: int = 0) -> None:
+                    process_group=None, coordinator_rank: int = 0,
+                    resize_trailing: bool = False) -> None:
     """Load `path` into `state_dict` **in place**, resharding as needed.
 
     Each target Tensor keeps its current sharding; its value is replaced by
     the checkpointed data laid out into that sharding.  Non-Tensor leaves are
     left untouched (scalars live in the metadata of the saving train loop).
+
+    ``resize_trailing=True`` additionally allows the target and saved
+    shapes to differ in their LAST dimension only: the saved extent is
+    loaded, any overhang is zero-filled.  This is the elastic-ZeRO
+    re-plan (`fleet.hybrid_step.load_zero3_state`): flat (Fp,) leaves
+    change only their dp-dependent zero pad across world sizes, so a
+    resume at a different degree is a trailing truncate/grow.
     """
     md = load_metadata(path)
     storage = _Storage(path)
     try:
-        _load_into(md, storage, state_dict, path)
+        _load_into(md, storage, state_dict, path,
+                   resize_trailing=resize_trailing)
     finally:
         storage.close()
 
@@ -143,8 +152,25 @@ def read_state_dict(path: str) -> Dict:
     return unflatten_state_dict(flat, md.flat_mapping)
 
 
+def _assemble_resized(pieces, offset: Tuple[int, ...],
+                      shape: Tuple[int, ...], dtype, key: str,
+                      saved_last: int) -> np.ndarray:
+    """`_assemble`, except the requested box may overhang the saved
+    extent along the LAST dim (trailing-dim resize): the covered prefix
+    keeps the full-coverage check, the overhang is zero-filled."""
+    last_cov = min(offset[-1] + shape[-1], saved_last) - offset[-1]
+    if last_cov <= 0:        # box lies entirely in the grown pad
+        return np.zeros(shape, dtype=dtype)
+    if last_cov == shape[-1]:
+        return _assemble(pieces, offset, shape, dtype, key)
+    dst = np.zeros(shape, dtype=dtype)
+    dst[..., :last_cov] = _assemble(
+        pieces, offset, shape[:-1] + (last_cov,), dtype, key)
+    return dst
+
+
 def _load_into(md: Metadata, storage: _Storage, state_dict: Dict,
-               path: str) -> None:
+               path: str, resize_trailing: bool = False) -> None:
     flat, _ = flatten_state_dict(state_dict)
 
     missing = [k for k in flat if isinstance(flat[k], Tensor)
@@ -158,25 +184,40 @@ def _load_into(md: Metadata, storage: _Storage, state_dict: Dict,
         val = t._value
         shape = tuple(val.shape)
         saved_shape = tuple(md.global_shape.get(key, shape))
+        saved_last = None     # set iff this key loads through a resize
         if saved_shape != shape:
-            raise ValueError(
-                f"shape mismatch for {key!r}: checkpoint has {saved_shape}, "
-                f"target expects {shape}")
+            if resize_trailing and len(shape) >= 1 and \
+                    len(saved_shape) == len(shape) and \
+                    saved_shape[:-1] == shape[:-1]:
+                saved_last = int(saved_shape[-1])
+            else:
+                raise ValueError(
+                    f"shape mismatch for {key!r}: checkpoint has "
+                    f"{saved_shape}, target expects {shape}"
+                    + (" (resize_trailing only covers a last-dim "
+                       "difference)" if resize_trailing else ""))
         dtype = np.dtype(val.dtype)
         pieces = _pieces_for(md, storage, key)
         sharding = getattr(val, "sharding", None)
         if isinstance(val, jax.Array) and sharding is not None and \
                 not sharding.is_fully_replicated:
-            def cb(index, _p=pieces, _d=dtype, _k=key, _s=shape):
+            def cb(index, _p=pieces, _d=dtype, _k=key, _s=shape,
+                   _r=saved_last):
                 off = tuple((sl.start or 0) for sl in index)
                 sub = tuple((sl.stop if sl.stop is not None else dim)
                             - (sl.start or 0)
                             for sl, dim in zip(index, _s))
-                return _assemble(_p, off, sub, _d, _k)
+                if _r is None:
+                    return _assemble(_p, off, sub, _d, _k)
+                return _assemble_resized(_p, off, sub, _d, _k, _r)
             new = jax.make_array_from_callback(shape, sharding, cb)
         else:
-            full = _assemble(pieces, tuple(0 for _ in shape), shape, dtype,
-                             key)
+            zero_off = tuple(0 for _ in shape)
+            if saved_last is None:
+                full = _assemble(pieces, zero_off, shape, dtype, key)
+            else:
+                full = _assemble_resized(pieces, zero_off, shape, dtype,
+                                         key, saved_last)
             new = jnp.asarray(full)
             if isinstance(val, jax.Array) and sharding is not None:
                 new = jax.device_put(new, sharding)

@@ -557,14 +557,19 @@ class CheckpointManager:
         _H_RESTORE_S.observe(time.perf_counter() - t0)
         return out
 
-    def restore_into(self, state: Dict,
-                     step: Optional[int] = None) -> Tuple[Dict, Dict]:
+    def restore_into(self, state: Dict, step: Optional[int] = None,
+                     resize_trailing: bool = False) -> Tuple[Dict, Dict]:
         """Sharded in-place restore: every array leaf of `state` (Tensor,
         jax.Array or numpy) is reloaded with resharding preserved (target
         sharding wins, `load_state_dict` semantics).  Returns
         ``(arrays, extra)`` where `arrays` mirrors the array leaves of
         `state` with the loaded values and `extra` holds the non-array
-        leaves of the checkpoint."""
+        leaves of the checkpoint.
+
+        ``resize_trailing=True`` lets a leaf's LAST dim differ from the
+        saved shape (truncate / zero-fill) — the elastic-ZeRO world-size
+        re-plan, where flat (Fp,) shards change only their dp-dependent
+        pad (`load_state_dict` docs)."""
         import jax.numpy as jnp
         t0 = time.perf_counter()
         step = self._resolve(step)
@@ -578,7 +583,7 @@ class CheckpointManager:
                 return node
             return Tensor._wrap(jnp.asarray(node))
         wrapped = wrap(arrays)
-        load_state_dict(wrapped, path)
+        load_state_dict(wrapped, path, resize_trailing=resize_trailing)
 
         def unwrap(node):
             if isinstance(node, dict):

@@ -55,6 +55,8 @@ __all__ = [
     "HybridConfig", "init_gpt_params", "stack_for_pipeline",
     "hybrid_param_specs", "init_zero_state", "zero_state_specs",
     "make_hybrid_train_step",
+    "make_zero3_train_step", "init_zero3_state", "zero3_unflatten",
+    "zero3_train_state", "save_zero3_state", "load_zero3_state",
     "hybrid_train_state", "save_hybrid_state", "load_hybrid_state",
     "serial_train_step", "serial_forward",
 ]
@@ -95,7 +97,10 @@ class HybridConfig:
     # ZeRO stage over dp: 1 = all-reduce grads then update a 1/dp slice;
     # 2 = reduce-scatter grads (each rank only ever holds its own grad
     # shard — the SPMD form of sharded gradients,
-    # ref group_sharded_stage2.py) — strictly less HBM and comm.
+    # ref group_sharded_stage2.py) — strictly less HBM and comm;
+    # 3 = parameters themselves live sharded (the fused ZeRO-3 step of
+    # `make_zero3_train_step`: dp-only FSDP, bucketed in-program
+    # gathers; `make_hybrid_train_step` treats 3 as 2).
     zero_stage: int = 1
     # optimizer
     learning_rate: float = 1e-3
@@ -844,6 +849,324 @@ def make_hybrid_train_step(mesh: Mesh, cfg: HybridConfig):
     timed_step.lower = jitted.lower          # AOT/debug paths still work
     timed_step._jitted = jitted
     return timed_step
+
+
+# ---------------------------------------------------------------------------
+# fused elastic ZeRO-3: stage-3 FSDP over dp, gather/release in-program
+# ---------------------------------------------------------------------------
+#
+# Parameters (and Adam moments) are RESIDENT in the flat ZeRO layout —
+# `init_zero_state`'s scheme specialised to a dp-only mesh: each leaf
+# flattened to F = prod(shape) elements, zero-padded to
+# Fp = dp*ceil(F/dp) (`sharding.flat_shard_layout`, the flattened-leaf
+# degenerate case of `_shard_spec_for`), global shape (Fp,), spec
+# P('dp').  The train step gathers full parameters INSIDE the compiled
+# program — one all_gather per bucket (`sharding.plan_zero3_buckets`,
+# sized by FLAGS_zero3_bucket_mb) so XLA's latency-hiding scheduler can
+# overlap bucket N+1's gather with bucket N's compute — gradients
+# reduce-scatter back to the (Fp/dp,)-per-rank layout, and the fused
+# Adam update runs on the 1/dp-resident shards with donated buffers.
+# No full parameter ever materializes outside the program, and no eager
+# per-layer collective ever runs (lint R014 + the program-count test pin
+# this).
+
+def _zero3_leaf_meta(cfg: HybridConfig, dp: int):
+    """Per-leaf ``(shape, dtype, F, Fp)`` in tree-flatten order, plus the
+    treedef — from `eval_shape` (no parameter materialization)."""
+    from .sharding import flat_shard_layout
+    tmpl = jax.eval_shape(lambda k: init_gpt_params(k, cfg),
+                          jax.random.PRNGKey(0))
+    leaves, treedef = jax.tree_util.tree_flatten(tmpl)
+    metas = [(tuple(l.shape), l.dtype) + flat_shard_layout(l.shape, dp)
+             for l in leaves]
+    return metas, treedef
+
+
+def init_zero3_state(params, mesh: Mesh):
+    """Enter the flat ZeRO-3 resident layout: every serial leaf is
+    flattened, zero-padded to Fp = dp*ceil(F/dp) and device_put with
+    spec P('dp'); Adam moments start as matching sharded zeros.
+    Returns ``(flat_params, m, v)`` (three trees, `params`' structure,
+    every leaf (Fp,))."""
+    from jax.sharding import NamedSharding
+
+    from .sharding import flat_shard_layout
+    dp = int(mesh.shape["dp"])
+    sh = NamedSharding(mesh, P("dp"))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    fp, fm, fv = [], [], []
+    for p in leaves:
+        F, Fp = flat_shard_layout(p.shape, dp)
+        fp.append(jax.device_put(jnp.pad(jnp.ravel(p), (0, Fp - F)), sh))
+        fm.append(jax.device_put(jnp.zeros((Fp,), p.dtype), sh))
+        fv.append(jax.device_put(jnp.zeros((Fp,), p.dtype), sh))
+    un = jax.tree_util.tree_unflatten
+    return un(treedef, fp), un(treedef, fm), un(treedef, fv)
+
+
+def zero3_unflatten(flat_params, cfg: HybridConfig):
+    """Flat ZeRO-3 layout -> serial-shaped param tree (pad dropped).
+    Parity-test/debug helper — the train step itself never materializes
+    full parameters outside its program."""
+    tmpl = jax.eval_shape(lambda k: init_gpt_params(k, cfg),
+                          jax.random.PRNGKey(0))
+    t_leaves, treedef = jax.tree_util.tree_flatten(tmpl)
+    leaves = jax.tree_util.tree_leaves(flat_params)
+    out = [jnp.asarray(f)[:int(np.prod(t.shape))].reshape(t.shape)
+           for f, t in zip(leaves, t_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_zero3_train_step(mesh: Mesh, cfg: HybridConfig, grain: int = 0):
+    """Fused elastic ZeRO-3 train step over a dp-only mesh.
+
+    ``step(flat_params, m, v, step_no, ids) -> (loss, flat_params',
+    m', v')`` with every flat leaf (Fp,) P('dp')-sharded and ids
+    [M, B, S] sharded P(None, 'dp', None).  ONE compiled program per
+    (config, bucket plan, grain): gather, forward/backward,
+    reduce-scatter and the fused shard-resident Adam update
+    (`optimizer.fused.zero3_shard_update`) all trace into it, with the
+    three state trees donated on real accelerators.
+
+    grain=0 — fast path: the bucket gather sits inside the loss closure,
+    so gradients reduce-scatter automatically (AD transposes all_gather
+    to psum_scatter) and the whole backward stays one fused subgraph.
+    The cross-dp `pmean` couples reduction shape to dp, so numerics are
+    only tolerance-stable across world sizes.
+
+    grain=G>0 — deterministic-reduction path (the elastic-resume
+    contract): the global batch is split into G fixed groups of B/G
+    rows, the batch is all-gathered, and EVERY rank differentiates EVERY
+    group against the gathered full params, folding the per-group
+    gradients in global group order (an ordered left fold, not a psum
+    tree) before slicing out its own shard.  The gradient arithmetic
+    then contains no trace of dp — bitwise identical HLO at any world
+    size — which per-rank group splits cannot give (XLA fuses the
+    per-group subgraphs differently in different step programs; ULP
+    drift that Adam's first-step sign normalization amplifies).  The
+    cost is dp-fold redundant gradient compute: grain mode trades step
+    time for the bit-exact 4->2->4 resume the elastic tests pin;
+    grain=0 is the perf path."""
+    from ... import flags as _pt_flags
+    from ...observability import compile_tracker as _ct
+    from ...observability import xray as _xray
+    from ...optimizer.fused import zero3_shard_update
+    from .sharding import plan_zero3_buckets
+
+    dp = int(mesh.shape["dp"])
+    assert cfg.zero_stage == 3, "make_zero3_train_step is the stage-3 path"
+    assert cfg.pp == 1 and cfg.mp == 1 and cfg.cp == 1, \
+        "fused ZeRO-3 is dp-only FSDP; mp/pp belong to make_hybrid_train_step"
+    assert cfg.moe_num_experts == 0, "MoE experts already shard over dp"
+    assert dp == cfg.dp, f"mesh dp {dp} != cfg.dp {cfg.dp}"
+    M = cfg.n_microbatches
+
+    metas, treedef = _zero3_leaf_meta(cfg, dp)
+    n_leaves = len(metas)
+
+    # bucket plan is fixed at BUILD time (a new flag value means building
+    # a new step — never a silent retrace mid-run)
+    bucket_mb = float(_pt_flags.get_flag("zero3_bucket_mb"))
+    raw = plan_zero3_buckets(
+        [Fp * jnp.dtype(dt).itemsize for (_, dt, _, Fp) in metas],
+        bucket_mb)
+    buckets = []          # split at dtype changes: buckets concatenate
+    for b in raw:
+        cur = [b[0]]
+        for i in b[1:]:
+            if metas[i][1] == metas[cur[-1]][1]:
+                cur.append(i)
+            else:
+                buckets.append(cur)
+                cur = [i]
+        buckets.append(cur)
+
+    def _gather_full(shards):
+        """Per-leaf (Fp/dp,) locals -> serial param tree; ONE all_gather
+        per bucket.  Untiled gather ([dp, Kb]) keeps each leaf's shard
+        rows contiguous, so the per-leaf extraction is a static window
+        slice + reshape — free for XLA to fuse."""
+        full = [None] * n_leaves
+        for b in buckets:
+            conc = (shards[b[0]] if len(b) == 1 else
+                    jnp.concatenate([shards[i] for i in b]))
+            g = jax.lax.all_gather(conc, "dp", tiled=False)    # [dp, Kb]
+            off = 0
+            for i in b:
+                shape, _, F, Fp = metas[i]
+                k = Fp // dp
+                full[i] = jax.lax.slice_in_dim(
+                    g, off, off + k, axis=1).reshape(dp * k)[:F] \
+                    .reshape(shape)
+                off += k
+        return jax.tree_util.tree_unflatten(treedef, full)
+
+    def device_fn(fp, m, v, step_no, ids_local):
+        p_shards = jax.tree_util.tree_leaves(fp)
+        m_l = jax.tree_util.tree_leaves(m)
+        v_l = jax.tree_util.tree_leaves(v)
+
+        if grain == 0:
+            def loss_fn(shards):
+                ps = _gather_full(shards)
+                per_mb = jnp.stack([serial_forward(ps, ids_local[i], cfg)
+                                    for i in range(M)])
+                return jax.lax.pmean(jnp.mean(per_mb), "dp")
+
+            loss, g_shards = jax.value_and_grad(loss_fn)(p_shards)
+        else:
+            # restore global row order: rank blocks of the tiled gather
+            # land batch-major, undoing the P(None, 'dp', None) split
+            ids_all = jax.lax.all_gather(ids_local, "dp", axis=1,
+                                         tiled=True)      # [M, B, S]
+            B = ids_all.shape[1]
+            assert B % grain == 0, \
+                f"global batch {B} must divide by grain {grain}"
+            R = B // grain                # rows per group
+            # the barrier fences the (dp-shaped) gather off from the
+            # grad region: without it XLA fuses the bucket reshapes into
+            # the dots and different world sizes compile ULP-different
+            # backward arithmetic even on identical values
+            ps, ids_all = jax.lax.optimization_barrier(
+                (_gather_full(p_shards), ids_all))
+
+            def group_loss(pfull, sub):
+                per_mb = jnp.stack([serial_forward(pfull, sub[i], cfg)
+                                    for i in range(M)])
+                return jnp.mean(per_mb)
+
+            # fori_loop, NOT a python loop or vmap: the body becomes its
+            # own HLO computation whose shapes ([M, R, S] rows against
+            # full params) carry no trace of dp, so XLA's per-computation
+            # fusion/layout passes produce the same arithmetic in the
+            # dp=2 and dp=4 programs (unrolled copies fuse with their
+            # dp-shaped surroundings and drift; vmap's batched dims
+            # change the per-group numerics outright).  The left-fold
+            # carry IS the ordered reduction, in global group order.
+            def group_body(g, carry):
+                loss_acc, gacc = carry
+                sub = jax.lax.dynamic_slice_in_dim(ids_all, g * R, R,
+                                                   axis=1)
+                lg, gg = jax.value_and_grad(group_loss)(ps, sub)
+                return (loss_acc + lg,
+                        [a + b for a, b in
+                         zip(gacc, jax.tree_util.tree_leaves(gg))])
+
+            loss_acc, gacc = jax.lax.fori_loop(
+                0, grain, group_body,
+                (jnp.zeros((), jnp.float32),
+                 [jnp.zeros(shape, dt) for (shape, dt, _, _) in metas]))
+            loss = loss_acc / grain
+            folded = [a / grain for a in gacc]
+            # second fence: everything above is world-size-invariant
+            # HLO; dp enters only BELOW, in the shard-window slice —
+            # without the barrier the slice fuses upward into the
+            # backward and perturbs it per world size
+            folded = jax.lax.optimization_barrier(tuple(folded))
+
+            d_i = jax.lax.axis_index("dp")
+            g_shards = []
+            for i, (shape, dt, F, Fp) in enumerate(metas):
+                k = Fp // dp
+                flat = jnp.pad(folded[i].reshape(-1).astype(dt),
+                               (0, Fp - F))
+                g_shards.append(
+                    jax.lax.dynamic_slice(flat, (d_i * k,), (k,)))
+
+        new_p, new_m, new_v = zero3_shard_update(
+            p_shards, g_shards, m_l, v_l, step_no,
+            learning_rate=cfg.learning_rate, beta1=cfg.beta1,
+            beta2=cfg.beta2, eps=cfg.eps)
+        un = jax.tree_util.tree_unflatten
+        return (loss, un(treedef, new_p), un(treedef, new_m),
+                un(treedef, new_v))
+
+    flat_specs = jax.tree_util.tree_unflatten(treedef, [P("dp")] * n_leaves)
+    # check_vma=False: the loss IS dp-replicated (pmean / ordered fold of
+    # an all_gather), but the static analysis can't prove it
+    mapped = _compat_shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(flat_specs, flat_specs, flat_specs, P(),
+                  P(None, "dp", None)),
+        out_specs=(P(), flat_specs, flat_specs, flat_specs),
+        check_vma=False)
+    # donation: the old param/moment shards die at the update, so their
+    # buffers host the new ones — skip on CPU, where XLA can't honor it
+    # and jax warns (same guard as optimizer.fused)
+    donate = (0, 1, 2) if jax.default_backend() != "cpu" else ()
+    jitted = jax.jit(mapped, donate_argnums=donate)
+
+    sig = (("dp", dp), ("grain", grain), ("buckets", len(buckets)),
+           ("bucket_mb", bucket_mb), ("layers", cfg.num_layers),
+           ("hidden", cfg.hidden_size))
+    step_fn = _ct.wrap_first_call(jitted, "hybrid.zero3_step", sig)
+    step_fn.lower = jitted.lower
+    step_fn._jitted = jitted
+    step_fn.buckets = [tuple(b) for b in buckets]
+
+    def audit(*args, **kwargs):
+        """Lower and attach the HLO audit to this program's xray entry,
+        so the gather/compute overlap (collective count, flops, bytes)
+        shows up in the per-program ledger (`xray.ledger`)."""
+        low = jitted.lower(*args, **kwargs)
+        _xray.attach_lowered(step_fn._xray_entry, low)
+        return low
+
+    step_fn.audit = audit
+    return step_fn
+
+
+def zero3_train_state(flat_params, m, v, step_no,
+                      grain: int = 0) -> Dict[str, Any]:
+    """Checkpointable tree for the fused ZeRO-3 state: the flat shards
+    ride the sharded save path (each process writes only its own
+    (Fp/dp,) slices), the Adam step count and reduction grain go into
+    the coordinator's extra blob (bit-exact resume is per-grain, so a
+    resume can see what the run was trained with)."""
+    return {"zero3": {"params": flat_params, "m": m, "v": v},
+            "meta": {"step_no": float(step_no), "zero3_grain": int(grain)}}
+
+
+def save_zero3_state(manager, step: int, flat_params, m, v, step_no,
+                     grain: int = 0, wait: bool = False) -> bool:
+    """Version the fused ZeRO-3 train state as `step` (atomic commit)."""
+    return manager.save(
+        step, zero3_train_state(flat_params, m, v, step_no, grain),
+        wait=wait)
+
+
+def load_zero3_state(manager, mesh: Mesh, cfg: HybridConfig, step=None):
+    """Elastic resume: reload flat ZeRO-3 state onto THIS mesh's dp
+    degree, whatever degree wrote the checkpoint.
+
+    The flat layout makes resharding a trailing-dim resize: a leaf saved
+    at dp_old has global shape (Fp_old,), the new mesh needs (Fp_new,) —
+    the same F live elements under a different zero pad.  Templates are
+    rebuilt at dp_new and ``restore_into(..., resize_trailing=True)``
+    truncates or zero-fills the tail.  That is bit-exact because the pad
+    region is an invariant 0 of the step: pads start at 0, the ``[:F]``
+    slice in the gather gives them zero gradients, and Adam maps a
+    (0, 0, 0) triple to (0, 0, 0).
+
+    Returns ``(flat_params, m, v, step_no, grain)``."""
+    from jax.sharding import NamedSharding
+    dp = int(mesh.shape["dp"])
+    metas, treedef = _zero3_leaf_meta(cfg, dp)
+    sh = NamedSharding(mesh, P("dp"))
+
+    def templ():
+        return jax.tree_util.tree_unflatten(
+            treedef, [jax.device_put(jnp.zeros((Fp,), dt), sh)
+                      for (_, dt, _, Fp) in metas])
+
+    arrays, extra = manager.restore_into(
+        {"zero3": {"params": templ(), "m": templ(), "v": templ()}},
+        step=step, resize_trailing=True)
+    z = arrays["zero3"]
+    meta = extra.get("meta", {})
+    return (z["params"], z["m"], z["v"],
+            float(meta.get("step_no", 0.0)),
+            int(meta.get("zero3_grain", 0)))
 
 
 # ---------------------------------------------------------------------------

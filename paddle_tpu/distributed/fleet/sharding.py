@@ -13,7 +13,14 @@ schedule:
 * stage 3 — the parameters themselves are sharded; XLA all-gathers them at
   use sites (allgather-on-use exactly like GroupSharedStage3's hooks).
 The explicit bucketing/overlap machinery of the reference is XLA's
-latency-hiding scheduler's job.
+latency-hiding scheduler's job — except in the FUSED ZeRO-3 train step
+(`hybrid_step.make_zero3_train_step`), where the gather is traced
+explicitly per bucket: `flat_shard_layout` is the flattened-leaf
+degenerate case of `_shard_spec_for` (dim 0 always eligible once flat,
+padding buys divisibility instead of a replication warning) and
+`plan_zero3_buckets` groups leaves under the `FLAGS_zero3_bucket_mb`
+knob so the scheduler has bucket-grained gathers to overlap with
+compute.
 
 Offload (the reference's ZeRO-Offload `offload=True`): optimizer state
 LIVES in host memory between steps via jax's `memory_kind="pinned_host"`
@@ -31,6 +38,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...optimizer.optimizer import Optimizer
@@ -38,7 +46,8 @@ from .. import mesh as _mesh
 
 __all__ = ["DygraphShardingOptimizer", "GroupShardedOptimizerStage2",
            "group_sharded_parallel", "shard_accumulator_fn",
-           "apply_stage3_param_sharding"]
+           "apply_stage3_param_sharding", "flat_shard_layout",
+           "plan_zero3_buckets"]
 
 
 _warned_shapes = set()
@@ -109,6 +118,47 @@ def _shard_spec_for(shape, existing=None, axis="sharding"):
             f"divisible by the {axis!r} degree {n}; this buffer keeps "
             f"its current (unsharded-over-{axis!r}) layout")
     return None
+
+
+def flat_shard_layout(shape, degree):
+    """``(F, Fp)`` for one flattened leaf: element count and its
+    degree-padded length ``degree * ceil(F / degree)``.
+
+    This is `_shard_spec_for`'s placement logic collapsed to the
+    flattened case the fused ZeRO-3 step uses: once a leaf is flat,
+    dim 0 is always the (only) candidate, and instead of warning when
+    the size doesn't divide, zero-padding to ``Fp`` makes every leaf
+    eligible.  The pad region starts zero and STAYS zero under Adam
+    (zero grad, zero moments), which is what makes truncate-then-repad
+    on an elastic world-size change bit-exact."""
+    F = int(np.prod(shape)) if len(tuple(shape)) else 1
+    Fp = degree * ((F + degree - 1) // degree)
+    return F, Fp
+
+
+def plan_zero3_buckets(leaf_nbytes, bucket_mb):
+    """Group leaves (tree order preserved) into gather buckets.
+
+    ``leaf_nbytes``: per-leaf GLOBAL padded byte sizes, in tree-flatten
+    order.  Returns a list of buckets, each a list of leaf indices,
+    where consecutive leaves accumulate until the next leaf would push
+    the bucket past ``bucket_mb`` MiB (every bucket holds >= 1 leaf, so
+    an oversized single leaf still gets its own bucket).  Each bucket
+    becomes ONE traced all-gather in the fused ZeRO-3 step: the bucket
+    count is the overlap granularity XLA's latency-hiding scheduler
+    schedules gather N+1 against compute N with.  ``bucket_mb <= 0``
+    puts every leaf in its own bucket (maximum overlap granularity)."""
+    limit = int(bucket_mb * (1 << 20))
+    buckets, cur, cur_bytes = [], [], 0
+    for i, nb in enumerate(leaf_nbytes):
+        if cur and (limit <= 0 or cur_bytes + nb > limit):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += int(nb)
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def shard_accumulator_fn(arr, axis="sharding"):

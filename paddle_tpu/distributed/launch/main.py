@@ -161,7 +161,19 @@ class CollectiveController:
 
     def __init__(self, args):
         self.args = args
-        self.nnodes = int(str(args.nnodes or "1").split(":")[0])
+        # "N" pins a fixed world; "MIN:MAX" is elastic — the rendezvous
+        # settles on however many nodes joined (>= MIN, <= MAX) when the
+        # join window closes, and RE-settles every restart generation,
+        # so a job resumes on a smaller/larger world after node loss
+        # (the training side reshards via the elastic-ZeRO resume,
+        # `fleet.hybrid_step.load_zero3_state`)
+        spec = str(args.nnodes or "1")
+        lo, _, hi = spec.partition(":")
+        self.nnodes_min = int(lo)
+        self.nnodes_max = int(hi) if hi else self.nnodes_min
+        assert self.nnodes_max >= self.nnodes_min > 0, \
+            f"bad --nnodes {spec!r}"
+        self.nnodes = self.nnodes_min
         self.node_rank = max(args.rank, 0)
         self.nproc = args.nproc_per_node
         self.world_size = self.nnodes * self.nproc
@@ -169,6 +181,10 @@ class CollectiveController:
         self.store: Optional[TCPStore] = None
         self.master = args.master
         self.restarts = 0
+
+    @property
+    def elastic(self) -> bool:
+        return self.nnodes_max > self.nnodes_min
 
     # ------------------------------------------------------------ rendezvous
     def rendezvous(self):
@@ -191,6 +207,8 @@ class CollectiveController:
         gen = self.restarts
         if self.args.rank < 0:
             self.node_rank = store.add(f"node_rank/{gen}", 1) - 1
+        if self.elastic:
+            self._settle_world(store, gen)
         store.barrier(f"rendezvous/{gen}", self.nnodes,
                       timeout=self.args.elastic_timeout)
         # allocate the jax.distributed coordinator endpoint: a DIFFERENT
@@ -213,6 +231,43 @@ class CollectiveController:
         else:
             store.wait(f"jax_coord/{gen}")
             self.coordinator = store.get(f"jax_coord/{gen}").decode()
+
+    def _settle_world(self, store, gen: int):
+        """Counted-join window for a MIN:MAX rendezvous (per generation).
+
+        Every node registers on `join/{gen}`; node 0 admits joins until
+        either MAX nodes arrived or MIN arrived and `--elastic_timeout`
+        elapsed, then publishes the settled count on `world/{gen}`.
+        Everyone adopts it: `self.nnodes`/`self.world_size` (and with
+        them PADDLE_TRAINERS_NUM / PADDLE_NNODES in the worker env) track
+        the settled world, so generation N+1 after a node loss comes up
+        smaller instead of hanging on the fixed-world barrier."""
+        store.add(f"join/{gen}", 1)
+        key = f"world/{gen}"
+        if self.node_rank == 0:
+            deadline = time.time() + self.args.elastic_timeout
+            while True:
+                n = store.add(f"join/{gen}", 0)
+                if n >= self.nnodes_max:
+                    break
+                if time.time() >= deadline:
+                    if n >= self.nnodes_min:
+                        break
+                    raise TimeoutError(
+                        f"elastic rendezvous gen {gen}: only {n} of the "
+                        f"required minimum {self.nnodes_min} nodes "
+                        f"joined within {self.args.elastic_timeout}s")
+                time.sleep(0.05)
+            store.set(key, str(min(n, self.nnodes_max)))
+        else:
+            store.wait(key, timeout=self.args.elastic_timeout)
+        settled = int(store.get(key))
+        if settled != self.nnodes:
+            sys.stderr.write(
+                f"[launch] elastic world settled at {settled} nodes "
+                f"(was {self.nnodes}, generation {gen})\n")
+        self.nnodes = settled
+        self.world_size = self.nnodes * self.nproc
 
     # --------------------------------------------------------------- workers
     def _worker_env(self, local_rank: int):

@@ -404,6 +404,28 @@ define_flag("serving_prefill_chunks_per_tick", 1,
             "tick boundary (the N of 'one decode tick + up to N "
             "chunks'); higher drains arriving prompts faster at the "
             "price of longer inter-token gaps for running streams")
+define_flag("serving_chunk_overlap", True,
+            "overlap chunked-prefill work across tick boundaries (the "
+            "PR 11 polish the chunks_per_tick auto-tuner didn't take): "
+            "with the tick loop double-buffered (serving_overlap) and "
+            "an admission mid-chunked-prefill, NON-FINAL chunks also "
+            "dispatch behind the chained decode tick instead of waiting "
+            "for the next real boundary — device programs serialize in "
+            "dispatch order, so the chunk chains on the in-flight "
+            "tick's pool handle and streams stay bit-identical.  The "
+            "FINAL chunk (host-sync logits screen + slot install) "
+            "always lands at a real boundary.  0 keeps all chunk work "
+            "at boundaries")
+define_flag("zero3_bucket_mb", 16,
+            "fused ZeRO-3 gather bucket size in MiB "
+            "(fleet/hybrid_step.py make_zero3_train_step): consecutive "
+            "flat parameter shards are grouped into buckets of at most "
+            "this many MiB and each bucket is ONE in-program all-gather "
+            "— small enough that XLA's latency-hiding scheduler can "
+            "overlap bucket N+1's gather with bucket N's compute, large "
+            "enough to amortize collective launch overhead.  Read at "
+            "program-build time (a new value means a new step program); "
+            "0 puts every leaf in its own bucket")
 define_flag("serving_slo_shed", False,
             "SLO-aware load shedding: at each scheduler boundary, while "
             "the live TTFT/TPOT p99 sketches breach their "

@@ -832,6 +832,7 @@ class ServingEngine:
             if _flags.get_flag("serving_pallas_verify")
             else PagedChunkView)
         self.prefill_chunks_total = 0
+        self.overlap_chunks_total = 0
         self.slo_sheds = 0
         self._chunks_this_boundary = 0
         self._chunk_s_this_boundary = 0.0
@@ -3224,7 +3225,9 @@ class ServingEngine:
         one — a kind switch is a real boundary (harvest first)."""
         if not _flags.get_flag("serving_overlap"):
             return False
-        if self.waiting or self.prefilling:
+        if self.waiting:
+            return False     # admissions join at a real boundary
+        if self.prefilling and not self._chunk_overlap_ok():
             return False     # pending chunk work needs a real boundary
         if pend.spec:
             if not self.spec_model:
@@ -3273,6 +3276,55 @@ class ServingEngine:
                 return False
         return True
 
+    def _chunk_overlap_ok(self) -> bool:
+        """May pending chunk-prefill work ride BEHIND an overlapped
+        tick instead of forcing a real boundary (the parked PR 11
+        remainder, ``FLAGS_serving_chunk_overlap``)?  Only NON-FINAL
+        chunks qualify: the final chunk host-syncs its logits row
+        (`_screen_row`) and installs the shadow table row — boundary
+        work by contract.  So the head chunked admission must still
+        have more than one chunk of prompt left."""
+        if self.chunk <= 0 \
+                or not _flags.get_flag("serving_chunk_overlap"):
+            return False
+        req = self.prefilling[0]
+        return len(req.prompt_ids) - req._chunk_off > self.chunk
+
+    def _overlap_chunk_work(self, nxt) -> None:
+        """Dispatch non-final prefill chunks for the head chunked
+        admission BEHIND the just-chained tick ``nxt``: programs
+        serialize in dispatch order on the device stream and each chunk
+        consumes ``self.pools`` — by now the chained tick's output
+        handle — so the chunk reads post-tick pool state exactly as a
+        boundary dispatch would, while its host-side enqueue cost hides
+        under the in-flight ticks.  Chunk writes land in the admission's
+        own (not-yet-decodable) blocks, disjoint from every active
+        slot's, so tick/chunk order commutes and token streams stay
+        bit-identical with the flag off.  The FINAL chunk never runs
+        here (see `_chunk_overlap_ok`); an armed X-ray sampler skips
+        the path entirely — a synced probe around a chunk program
+        would time the chained tick too."""
+        if not self.prefilling or not self._chunk_overlap_ok() \
+                or _xray.sampling_on():
+            return
+        budget = max(1, int(_flags.get_flag(
+            "serving_prefill_chunks_per_tick")))
+        req = self.prefilling[0]
+        self._chunks_this_boundary = 0
+        self._chunk_s_this_boundary = 0.0
+        spent = 0
+        while (spent < budget
+               and len(req.prompt_ids) - req._chunk_off > self.chunk):
+            self._prefill_chunk_step(req)
+            spent += 1
+            self.overlap_chunks_total += 1
+        # fold the accounting into the chained tick's record: these
+        # chunks belong to ITS dispatch window, not the next boundary's
+        nxt.chunks += self._chunks_this_boundary
+        nxt.ph_chunk += self._chunk_s_this_boundary
+        self._chunks_this_boundary = 0
+        self._chunk_s_this_boundary = 0.0
+
     def run(self) -> List[Request]:
         """Drive until every queued request finishes; returns them in
         completion order.  With ``FLAGS_serving_overlap`` the loop keeps
@@ -3308,6 +3360,7 @@ class ServingEngine:
                     if nxt is not None:
                         nxt.overlapped = True
                         _M_OVERLAP.inc()
+                        self._overlap_chunk_work(nxt)
                 self._harvest_tick(pend)
             except Exception as e:  # noqa: BLE001 - crash-only guard
                 if not self._absorb_failure(e, (pend, nxt)):

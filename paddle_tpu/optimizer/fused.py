@@ -61,7 +61,7 @@ from ..framework.tensor import Tensor
 from ..observability import compile_tracker as _compile_tracker
 from ..observability import metrics as _metrics
 
-__all__ = ["enabled", "try_step", "scaler_step"]
+__all__ = ["enabled", "try_step", "scaler_step", "zero3_shard_update"]
 
 # hit = cached program reused; miss = new (tree, config) program traced;
 # fallback = irregular step served by the per-leaf path
@@ -83,6 +83,33 @@ def enabled() -> bool:
         return bool(_flags.get_flag("fused_optimizer"))
     except ValueError:  # pragma: no cover - flag always registered
         return False
+
+
+def zero3_shard_update(p_shards, g_shards, m_shards, v_shards, step, *,
+                       learning_rate, beta1, beta2, eps):
+    """Fused Adam over 1/N-resident ZeRO-3 shard lists.
+
+    The one-dispatch fused update applied to SHARDED residents: every
+    leaf here is one dp rank's flat parameter/moment shard, and the
+    whole list updates inside the caller's program (the fused ZeRO-3
+    step traces this after its in-program reduce-scatter, so with
+    donation the flat shard buffers update in place in HBM — no
+    per-leaf dispatch, no full-parameter moment state anywhere).
+    Elementwise only, so the math is length-invariant: the same global
+    element sees bit-identical updates at any sharding world size,
+    which is what the elastic reshard-on-resume drill pins.  Primitive
+    order matches `hybrid_step._adam_math` (bit parity with the ZeRO-1/2
+    hybrid path's per-shard update)."""
+    new_p, new_m, new_v = [], [], []
+    for p, g, m, v in zip(p_shards, g_shards, m_shards, v_shards):
+        m2 = beta1 * m + (1 - beta1) * g
+        v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+        mh = m2 / (1 - beta1 ** step)
+        vh = v2 / (1 - beta2 ** step)
+        new_p.append(p - learning_rate * mh / (jnp.sqrt(vh) + eps))
+        new_m.append(m2)
+        new_v.append(v2)
+    return new_p, new_m, new_v
 
 
 def _rule_of(opt):

@@ -919,11 +919,74 @@ class InterpretModeKernelInHotPath(Rule):
         return out
 
 
+# =========================================================== R014
+class EagerCollectiveInStepLoop(Rule):
+    """An EAGER collective (`all_gather`/`all_reduce`/`reduce_scatter`/
+    `psum`/...) issued inside a loop in a training-step scope instead of
+    being traced into the compiled step program.  A per-layer eager
+    collective dispatches one program per call — per layer, per step:
+    XLA's latency-hiding scheduler never sees gather N+1 next to compute
+    N (the overlap the fused ZeRO-3 step exists for,
+    `fleet/hybrid_step.py make_zero3_train_step`), and the program count
+    grows with depth instead of staying constant after warmup.
+    Compliant shape: move the loop under `jax.jit`/`shard_map` so the
+    collectives trace into ONE program (calls lexically inside a traced
+    function — directly or through helpers — are exempt)."""
+
+    id = "R014"
+    name = "eager-collective-in-step-loop"
+
+    _COLLECTIVES = frozenset({
+        "all_gather", "all_reduce", "reduce_scatter",
+        "all_gather_into_tensor", "reduce_scatter_tensor",
+        "alltoall", "alltoall_single", "all_to_all", "broadcast",
+        "psum", "psum_scatter", "pmean", "ppermute",
+    })
+    # only scopes that read as a training-step loop body; a data loader
+    # sharding its manifest with an eager all_gather is not the hot path
+    _SCOPE_MARKERS = ("step", "train")
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for scope in sf.scopes():
+            qn = (sf.qualname(scope) or "").lower()
+            if not any(m in qn for m in self._SCOPE_MARKERS):
+                continue
+            loops: List[tuple] = []
+            calls: List[ast.Call] = []
+            for n in sf.scope_walk(scope):
+                if isinstance(n, (ast.For, ast.While)):
+                    loops.append((n.lineno,
+                                  getattr(n, "end_lineno", n.lineno)))
+                elif isinstance(n, ast.Call) and \
+                        callee_segment(n.func) in self._COLLECTIVES:
+                    calls.append(n)
+            for call in calls:
+                if sf.in_traced(call) is not None:
+                    continue    # traces into the step program: the fix
+                # strictly inside a loop BODY (the header line runs once)
+                if not any(a < call.lineno <= b for a, b in loops):
+                    continue
+                seg = callee_segment(call.func)
+                out.append(self.finding(
+                    sf, call,
+                    f"eager `{seg}(...)` inside a loop in "
+                    f"`{sf.qualname(scope) or '<module>'}`: each "
+                    "iteration dispatches its own collective program — "
+                    "per layer, per step — so nothing overlaps with "
+                    "compute and the program count grows with depth.  "
+                    "Trace the loop into the compiled step "
+                    "(`jax.jit`/`shard_map`, the fused ZeRO-3 shape of "
+                    "`make_zero3_train_step`) so XLA schedules gather "
+                    "N+1 behind compute N"))
+        return out
+
+
 RULES: List[Rule] = [
     HostSyncInTracedCode(), AliasUnsafeDeviceInput(), UseAfterDonate(),
     TraceTimeFlagRead(), LockOrderInversion(), UnsyncedTiming(),
     UnpairedKVHandoff(), UnpropagatedTraceContext(),
-    InterpretModeKernelInHotPath(),
+    InterpretModeKernelInHotPath(), EagerCollectiveInStepLoop(),
 ]
 
 # the interprocedural rule set (R007-R010) registers itself here; the

@@ -115,6 +115,51 @@ def test_chunked_overlap_parity(model):
     assert ov == sync
 
 
+def test_chunk_overlap_gate_logic(model):
+    """Fast twin of the @slow parity drill: `_chunk_overlap_ok` only
+    clears NON-FINAL chunks for dispatch behind the chained tick —
+    flag off, un-chunked engines, and a pending FINAL chunk (which
+    must host-sync the NaN screen and install the shadow row at a
+    real boundary) all force `_can_overlap` back to False."""
+    eng = ServingEngine(model, max_batch=2, max_context=96,
+                        block_size=16, prefill_chunk=8,
+                        prefix_cache=False)
+    req = Request(np.arange(1, 21), max_new_tokens=2)   # 20 toks, 2+ chunks
+    eng.prefilling.append(req)
+    assert eng._chunk_overlap_ok()              # 20 - 0 > 8: non-final
+    with flag_guard(serving_chunk_overlap=False):
+        assert not eng._chunk_overlap_ok()      # flag gates the path
+    req._chunk_off = 16
+    assert not eng._chunk_overlap_ok()          # 4 left: FINAL chunk
+    eng.prefilling.clear()
+
+
+@pytest.mark.slow  # ~8s measured: two full engine serves (flag off/on)
+                   # over a 40-token absorbing prompt; the gate-logic
+                   # twin above stays fast
+def test_chunk_boundary_overlap_parity_and_counter(model):
+    """PR 11 remainder (ISSUE 19 satellite): with
+    ``FLAGS_serving_chunk_overlap`` the NON-FINAL chunks of an
+    absorbing prompt dispatch BEHIND the chained tick instead of
+    forcing a real boundary.  Streams must stay bit-identical either
+    way (chunk writes land in the admission's own blocks, disjoint
+    from every decoding slot's), and the engine counter proves the
+    overlap path actually ran."""
+    rng = np.random.RandomState(4)
+    prompts = (rng.randint(1, 1000, (6,)), rng.randint(1, 1000, (40,)))
+    budgets = (24, 4)
+    with flag_guard(serving_overlap=True, serving_chunk_overlap=False):
+        eng0, base = _serve(model, prompts, budgets, chunk=8)
+    with flag_guard(serving_overlap=True, serving_chunk_overlap=True):
+        eng1, got = _serve(model, prompts, budgets, chunk=8)
+    assert got == base
+    assert eng0.overlap_chunks_total == 0
+    assert eng1.overlap_chunks_total > 0
+    # chunk count is conserved: overlap moves chunks off the boundary,
+    # it never adds or drops any
+    assert eng1.stats()["prefill_chunks"] == eng0.stats()["prefill_chunks"]
+
+
 # ------------------------------------- the bounded inter-token-gap claim
 
 def test_long_arrival_bounds_running_stream(model):

@@ -316,6 +316,81 @@ def test_elastic_membership_registry_and_watch():
         s.stop() if hasattr(s, "stop") else None
 
 
+def _ctrl_args(**kw):
+    from types import SimpleNamespace
+    base = dict(master=None, rank=-1, nnodes=None, nproc_per_node=1,
+                log_dir="log", log_level="INFO", job_id="elastic-test",
+                devices=None, run_mode="collective", max_restart=0,
+                elastic_timeout=10.0, training_script="x.py",
+                training_script_args=[])
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def test_elastic_rendezvous_settles_at_max():
+    """MIN:MAX rendezvous (ISSUE 19): with both nodes present inside
+    the join window, the world settles at MAX — and every node adopts
+    the settled size (world_size feeds PADDLE_TRAINERS_NUM, which the
+    training side's elastic-ZeRO resume re-plans against)."""
+    from paddle_tpu.distributed.launch.main import CollectiveController
+
+    c0 = CollectiveController(_ctrl_args(nnodes="1:2", rank=0))
+    assert c0.elastic and c0.nnodes_min == 1 and c0.nnodes_max == 2
+    done = []
+    t0 = threading.Thread(target=lambda: (c0.rendezvous(),
+                                          done.append(0)))
+    t0.start()
+    deadline = time.time() + 5
+    while c0.master is None and time.time() < deadline:
+        time.sleep(0.02)
+    assert c0.master is not None, "node 0 never hosted the store"
+    c1 = CollectiveController(_ctrl_args(nnodes="1:2", rank=1,
+                                         master=c0.master))
+    t1 = threading.Thread(target=lambda: (c1.rendezvous(),
+                                          done.append(1)))
+    t1.start()
+    t0.join(15)
+    t1.join(15)
+    assert sorted(done) == [0, 1]
+    assert c0.nnodes == 2 and c1.nnodes == 2
+    assert c0.world_size == 2 and c1.world_size == 2
+    assert c1.coordinator == c0.coordinator
+    env = c1._worker_env(0)
+    assert env["PADDLE_TRAINERS_NUM"] == "2"
+    assert env["PADDLE_NNODES"] == "2"
+
+
+def test_elastic_rendezvous_settles_at_min_on_timeout():
+    """A lone node in a 1:3 window settles at MIN when the join window
+    closes — a degraded-world resume, not a hang on the fixed-world
+    barrier.  Below MIN the rendezvous must raise instead."""
+    from paddle_tpu.distributed.launch.main import CollectiveController
+
+    c = CollectiveController(_ctrl_args(nnodes="1:3", rank=0,
+                                        elastic_timeout=0.4))
+    c.rendezvous()
+    assert c.nnodes == 1 and c.world_size == 1
+    assert c._worker_env(0)["PADDLE_TRAINERS_NUM"] == "1"
+
+    under = CollectiveController(_ctrl_args(nnodes="2:3", rank=0,
+                                            elastic_timeout=0.4))
+    with pytest.raises(TimeoutError, match="minimum 2"):
+        under.rendezvous()
+
+
+def test_non_elastic_nnodes_spec_unchanged():
+    """A plain `--nnodes N` never enters the settle window: the parsed
+    bounds collapse and `elastic` stays off (the legacy fixed-world
+    barrier path, byte-identical behavior)."""
+    from paddle_tpu.distributed.launch.main import CollectiveController
+
+    c = CollectiveController(_ctrl_args(nnodes="2", rank=0))
+    assert not c.elastic
+    assert (c.nnodes_min, c.nnodes_max, c.nnodes) == (2, 2, 2)
+    with pytest.raises(AssertionError):
+        CollectiveController(_ctrl_args(nnodes="3:2", rank=0))
+
+
 ELASTIC_RESUME_SCRIPT = r"""
 import json, os, sys
 import jax

@@ -569,6 +569,70 @@ def test_r013_inline_disable(tmp_path):
     assert run_src(tmp_path, {"mod.py": src}, rules=["R013"]) == []
 
 
+R014_BAD = """\
+import jax
+
+
+def train_step(params, grads, layers):
+    for layer in layers:
+        full = jax.lax.all_gather(params[layer], "dp", tiled=True)
+        grads[layer] = compute(full)
+    for layer in layers:
+        grads[layer] = jax.lax.psum_scatter(grads[layer], "dp")
+    return grads
+"""
+
+R014_GOOD = """\
+import jax
+
+
+def make_train_step(layers):
+    def device_fn(params, grads):
+        # traced: the SAME loop of collectives compiles into one program
+        for layer in layers:
+            full = jax.lax.all_gather(params[layer], "dp", tiled=True)
+            grads[layer] = compute(full)
+        return grads
+    return jax.jit(device_fn)
+
+
+def train_step_once(params):
+    # not in a loop: a single eager gather per step is a different
+    # problem than the per-layer dispatch storm this rule targets
+    return jax.lax.all_gather(params, "dp", tiled=True)
+
+
+def loader(shards):
+    # loop + eager collective, but not a step/train scope
+    out = []
+    for s in shards:
+        out.append(jax.lax.all_gather(s, "dp", tiled=True))
+    return out
+"""
+
+
+def test_r014_catches_eager_collective_in_step_loop(tmp_path):
+    fs = run_src(tmp_path, {"mod.py": R014_BAD}, rules=["R014"])
+    assert len(fs) == 2
+    assert {f.symbol for f in fs} == {"train_step"}
+    assert "all_gather" in fs[0].message
+    assert "psum_scatter" in fs[1].message
+
+
+def test_r014_traced_and_non_step_scopes_are_clean(tmp_path):
+    assert run_src(tmp_path, {"mod.py": R014_GOOD}, rules=["R014"]) == []
+
+
+def test_r014_inline_disable(tmp_path):
+    src = R014_BAD.replace(
+        'full = jax.lax.all_gather(',
+        'full = jax.lax.all_gather(  # graft-lint: disable=R014').replace(
+        'grads[layer] = jax.lax.psum_scatter(',
+        'grads[layer] = jax.lax.psum_scatter(  '
+        '# graft-lint: disable=R014')
+    assert run_src(tmp_path, {"mod.py": src}, rules=["R014"]) == []
+
+
 # ===================================================== suppressions
 
 def test_inline_suppression_same_line(tmp_path):

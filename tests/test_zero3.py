@@ -1,0 +1,324 @@
+"""Elastic ZeRO-3 (ISSUE 19): the fused one-dispatch stage-3 train step
+and reshard-on-resume across world sizes.
+
+The headline contracts pinned here:
+
+* the fused step traces parameter gathering INSIDE the program —
+  per-bucket `all_gather` ops in the lowered HLO (two text occurrences
+  per bucket: the op and its sharding annotation), gradients
+  reduce-scatter back via the AD transpose, and the whole step is ONE
+  compiled program (the compile-tracker entry never recompiles after
+  warmup — the eager-collective regression R014 also lints for);
+* grain=0 numerics match the serial reference step (loss near-exact,
+  params within a norm tolerance after Adam steps — first-step Adam is
+  sign descent, infinitely sensitive where g ~ 0);
+* with a reduction grain the step is BIT-exact across world sizes:
+  save at dp=4, resume at dp=2, resume again at dp=4 — params AND both
+  Adam moments bit-match a never-interrupted run (the flat layout's
+  pad region is an invariant 0, so the trailing-dim resize on restore
+  is lossless);
+* `restore_into` refuses a shape mismatch unless the caller opts into
+  `resize_trailing` — elastic resume is explicit, not a silent cast.
+"""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu import flags as fl
+from paddle_tpu.distributed.checkpoint.manager import CheckpointManager
+from paddle_tpu.distributed.fleet import hybrid_step as hs
+from paddle_tpu.distributed.fleet.sharding import (flat_shard_layout,
+                                                   plan_zero3_buckets)
+from paddle_tpu.observability import compile_tracker as obs_compile
+
+
+def _cfg(dp, **kw):
+    base = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+                seq_len=16, pp=1, mp=1, dp=dp, n_microbatches=2,
+                sequence_parallel=False, remat=False, zero_stage=3)
+    base.update(kw)
+    return hs.HybridConfig(**base)
+
+
+def _mesh(dp):
+    return Mesh(np.array(jax.devices()[:dp]), ("dp",))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return hs.init_gpt_params(jax.random.PRNGKey(0), _cfg(4))
+
+
+@pytest.fixture(scope="module")
+def ids():
+    return jax.random.randint(jax.random.PRNGKey(1), (2, 8, 16), 0, 64)
+
+
+# ------------------------------------------------------------ layout math
+
+def test_flat_shard_layout():
+    """Fp is the smallest degree-multiple >= F; scalars flatten to 1."""
+    assert flat_shard_layout((3, 5), 4) == (15, 16)
+    assert flat_shard_layout((8,), 4) == (8, 8)
+    assert flat_shard_layout((), 4) == (1, 4)
+    F, Fp = flat_shard_layout((7, 11), 3)
+    assert F == 77 and Fp % 3 == 0 and Fp - F < 3
+
+
+def test_bucket_plan():
+    """Consecutive leaves group under the MiB limit; 0 = one per leaf;
+    every index appears exactly once, in order."""
+    mb = 1 << 20
+    sizes = [mb, mb, 3 * mb, mb // 2, mb // 2]
+    got = plan_zero3_buckets(sizes, 2)
+    assert got == [[0, 1], [2], [3, 4]]
+    assert plan_zero3_buckets(sizes, 0) == [[i] for i in range(len(sizes))]
+    # an oversized leaf gets its own bucket rather than being dropped
+    assert plan_zero3_buckets([5 * mb], 2) == [[0]]
+    flat = [i for b in plan_zero3_buckets(sizes, 1) for i in b]
+    assert flat == list(range(len(sizes)))
+
+
+# ------------------------------------------------- fused step: numerics
+
+def test_zero3_shard_update_adam_reference_and_pad_invariance():
+    """Fast twin of the @slow serial-parity and resume drills, at the
+    update-rule level: the fused shard update is textbook Adam against
+    a float64 numpy reference (element-wise — no reduction order in
+    play at this level), and a (0, 0, 0) pad triple under a zero
+    gradient maps back to exactly (0, 0, 0) — the invariant that makes
+    the trailing resize on elastic resume lossless."""
+    from paddle_tpu.optimizer.fused import zero3_shard_update
+    hp = dict(learning_rate=1e-3, beta1=0.9, beta2=0.999, eps=1e-8)
+    rng = np.random.RandomState(0)
+    p = rng.randn(33).astype(np.float32)
+    g = rng.randn(33).astype(np.float32)
+    m = rng.randn(33).astype(np.float32) * 0.1
+    v = np.abs(rng.randn(33)).astype(np.float32) * 0.1
+    for t in (1.0, 7.0):
+        (p2,), (m2,), (v2,) = zero3_shard_update(
+            [jnp.asarray(p)], [jnp.asarray(g)], [jnp.asarray(m)],
+            [jnp.asarray(v)], jnp.float32(t), **hp)
+        rm = 0.9 * m + 0.1 * g
+        rv = 0.999 * v + 0.001 * np.square(g)
+        ref = p - 1e-3 * (rm / (1 - 0.9 ** t)) / (
+            np.sqrt(rv / (1 - 0.999 ** t)) + 1e-8)
+        np.testing.assert_allclose(np.asarray(p2), ref, rtol=2e-5,
+                                   atol=2e-6)
+        np.testing.assert_allclose(np.asarray(m2), rm, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(v2), rv, rtol=1e-6)
+    z = [jnp.zeros(5)]
+    (pz,), (mz,), (vz,) = zero3_shard_update(
+        z, z, z, z, jnp.float32(3.0), **hp)
+    for arr in (pz, mz, vz):
+        assert (np.asarray(arr) == 0).all()
+
+
+@pytest.mark.slow  # ~13s measured: compiles the fused zero3 step AND
+                   # the serial reference; the fast twins are the
+                   # update-rule parity above + the HLO/program pin
+                   # below (which compiles only the zero3 step)
+def test_zero3_grain0_parity_vs_serial(params, ids):
+    """The fused sharded-resident step trains like the serial reference:
+    losses near-exact per step, params within a norm tolerance after 3
+    Adam steps (reduction-order drift through psum is amplified by
+    first-step Adam's sign-descent behavior, so element-wise compare
+    is the wrong pin)."""
+    cfg = _cfg(4)
+    mesh = _mesh(4)
+    fp, m, v = hs.init_zero3_state(params, mesh)
+    step = hs.make_zero3_train_step(mesh, cfg)
+    sp = params
+    sm = jax.tree_util.tree_map(jnp.zeros_like, params)
+    sv = jax.tree_util.tree_map(jnp.zeros_like, params)
+    for t in range(3):
+        sl, sp, sm, sv = hs.serial_train_step(
+            sp, sm, sv, jnp.float32(t + 1), ids, cfg)
+        loss, fp, m, v = step(fp, m, v, jnp.float32(t + 1), ids)
+        assert abs(float(sl) - float(loss)) < 2e-4, (t, float(sl),
+                                                     float(loss))
+    for a, b in zip(jax.tree_util.tree_leaves(hs.zero3_unflatten(fp, cfg)),
+                    jax.tree_util.tree_leaves(sp)):
+        da = np.asarray(a).ravel().astype(np.float64)
+        db = np.asarray(b).ravel().astype(np.float64)
+        assert np.linalg.norm(da - db) <= 5e-3 * (np.linalg.norm(db)
+                                                  + 1e-6)
+
+
+def test_zero3_in_program_gathers_single_program(params, ids):
+    """The perf contract: gathers live INSIDE the one program (HLO
+    carries exactly two `all_gather` text occurrences per bucket;
+    bucket_mb=0 degenerates to one bucket per leaf), and repeated
+    steps never recompile — the compile-tracker entry stays at one
+    compilation, which is what makes eager per-layer collectives
+    (lint R014) structurally impossible here."""
+    cfg = _cfg(4)
+    mesh = _mesh(4)
+    fp, m, v = hs.init_zero3_state(params, mesh)
+    step = hs.make_zero3_train_step(mesh, cfg)
+    txt = str(step.lower(fp, m, v, jnp.float32(1.0), ids).as_text())
+    assert txt.count("all_gather") == 2 * len(step.buckets)
+    with fl.flag_guard(zero3_bucket_mb=0.0):
+        step0 = hs.make_zero3_train_step(mesh, cfg)
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    assert len(step0.buckets) == n_leaves
+    # program-count pin: warmup compiles once, then the entry is frozen
+    loss0, fp, m, v = step(fp, m, v, jnp.float32(1.0), ids)
+    ent = obs_compile.get("hybrid.zero3_step")
+    assert ent is not None and ent["compiles"] >= 1
+    frozen = ent["compiles"]
+    for t in range(2, 4):
+        _, fp, m, v = step(fp, m, v, jnp.float32(t), ids)
+    assert obs_compile.get("hybrid.zero3_step")["compiles"] == frozen
+
+
+# ----------------------------------------------- elastic resume drills
+
+def _run_steps(dp, grain, n, params, ids, state=None, t0=0):
+    cfgd = _cfg(dp)
+    meshd = _mesh(dp)
+    if state is None:
+        state = hs.init_zero3_state(params, meshd)
+    st = hs.make_zero3_train_step(meshd, cfgd, grain=grain)
+    fp, m, v = state
+    for t in range(t0, t0 + n):
+        _, fp, m, v = st(fp, m, v, jnp.float32(t + 1), ids)
+    return fp, m, v
+
+
+def _assert_bit_equal(a, b, what):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), what
+
+
+@pytest.mark.slow  # ~19s measured: three grain-mode program builds
+                   # (dp4/dp2/dp4) + the uninterrupted reference; fast
+                   # resume coverage = the restore_into resize test
+                   # below + the pad-invariance half of the update-rule
+                   # twin above
+def test_zero3_elastic_resume_bit_exact(params, ids):
+    """The short form of the satellite drill: save at dp=4 after one
+    step, resume at dp=2 for one step, resume back at dp=4 for one
+    step — params and BOTH moments bit-match a never-interrupted
+    3-step dp=4 run (the full-drill twin runs the longer schedule)."""
+    cfg = _cfg(4)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d)
+        s4 = _run_steps(4, 4, 1, params, ids)
+        hs.save_zero3_state(mgr, 1, *s4, 1.0, grain=4, wait=True)
+
+        fp2, m2, v2, sn, gr = hs.load_zero3_state(mgr, _mesh(2), cfg)
+        assert (sn, gr) == (1.0, 4)
+        s2 = _run_steps(2, 4, 1, params, ids, (fp2, m2, v2), int(sn))
+        hs.save_zero3_state(mgr, 2, *s2, 2.0, grain=4, wait=True)
+
+        fp4, m4, v4, sn2, _ = hs.load_zero3_state(mgr, _mesh(4), cfg)
+        sR = _run_steps(4, 4, 1, params, ids, (fp4, m4, v4), int(sn2))
+        sU = _run_steps(4, 4, 3, params, ids)
+        for name, a, b in zip("pmv", sR, sU):
+            _assert_bit_equal(a, b, name)
+
+
+@pytest.mark.slow  # ~35s measured: six program builds (dp4/dp2 at two
+                   # grains) + two checkpoint round-trips
+def test_zero3_elastic_resume_full_drill(params, ids):
+    """The full satellite drill: multi-step segments across 4 -> 2 -> 4
+    with a mid-segment grain the short drill doesn't cover, against the
+    uninterrupted run — and the restored flat shards land back on the
+    WIDER pad layout without disturbing live elements."""
+    cfg = _cfg(4)
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d)
+        s4 = _run_steps(4, 2, 2, params, ids)
+        hs.save_zero3_state(mgr, 2, *s4, 2.0, grain=2, wait=True)
+
+        fp2, m2, v2, sn, gr = hs.load_zero3_state(mgr, _mesh(2), cfg)
+        assert gr == 2
+        s2 = _run_steps(2, 2, 2, params, ids, (fp2, m2, v2), int(sn))
+        hs.save_zero3_state(mgr, 4, *s2, 4.0, grain=2, wait=True)
+
+        fp4, m4, v4, sn2, _ = hs.load_zero3_state(mgr, _mesh(4), cfg)
+        sR = _run_steps(4, 2, 2, params, ids, (fp4, m4, v4), int(sn2))
+        sU = _run_steps(4, 2, 6, params, ids)
+        for name, a, b in zip("pmv", sR, sU):
+            _assert_bit_equal(a, b, name)
+
+
+def test_restore_into_requires_explicit_resize():
+    """A world-size change shows up as a trailing-dim shape mismatch;
+    the load path must REFUSE it unless the caller passes
+    `resize_trailing=True` — and even then only a trailing-dim-only
+    mismatch qualifies.  With the flag, growth zero-fills the overhang
+    and shrink truncates (the pad region is an invariant 0 of the
+    fused step, which is what makes this bit-exact)."""
+    with tempfile.TemporaryDirectory() as d:
+        mgr = CheckpointManager(d)
+        mgr.save(1, {"s": {"a": jnp.arange(12, dtype=jnp.float32)}},
+                 wait=True)
+
+        def tgt(shape):
+            return {"s": {"a": jnp.zeros(shape, jnp.float32)}}
+
+        with pytest.raises(ValueError, match="shape mismatch"):
+            mgr.restore_into(tgt((16,)), step=1)
+        grown, _ = mgr.restore_into(tgt((16,)), step=1,
+                                    resize_trailing=True)
+        got = np.asarray(grown["s"]["a"])
+        assert np.array_equal(got[:12], np.arange(12, dtype=np.float32))
+        assert (got[12:] == 0).all()
+        shrunk, _ = mgr.restore_into(tgt((8,)), step=1,
+                                     resize_trailing=True)
+        assert np.array_equal(np.asarray(shrunk["s"]["a"]),
+                              np.arange(8, dtype=np.float32))
+        # a rank/non-trailing mismatch never qualifies
+        with pytest.raises(ValueError, match="resize_trailing"):
+            mgr.restore_into(tgt((2, 12)), step=1, resize_trailing=True)
+
+
+# ------------------------------------- offload staging contract (pin)
+
+@pytest.mark.xfail(jax.default_backend() == "cpu", strict=False,
+                   reason="XLA:CPU ignores host placement annotations "
+                          "on compiled-program outputs; the pinned_host "
+                          "round-trip is a TPU contract")
+def test_offload_state_roundtrips_to_pinned_host(hybrid_mesh):
+    """ZeRO-Offload staging contract (`_migrate_state`): between
+    compiled steps EVERY optimizer accumulator must sit in
+    `pinned_host` memory — the step stages host -> device -> host.
+    The existing placement test only checks SOME accumulator landed
+    there eagerly; this pins the round-trip on a to_static-captured
+    step, where the post-step host pin rides the program's output
+    shardings."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.sharding import (
+        GroupShardedOptimizerStage2)
+    from paddle_tpu.jit import to_static
+
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    sharded = GroupShardedOptimizerStage2(lin.parameters(), opt,
+                                          offload=True)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+
+    def train_step(xb):
+        loss = (lin(xb) * lin(xb)).sum()
+        loss.backward()
+        sharded.step()
+        sharded.clear_grad()
+        return loss
+
+    step = to_static(train_step)
+    for _ in range(2):
+        step(x)
+    mks = {getattr(a.sharding, "memory_kind", None)
+           for accs in opt._accumulators.values()
+           for a in accs.values() if hasattr(a, "sharding")}
+    assert mks == {"pinned_host"}, mks
