@@ -93,6 +93,7 @@ _REGRESSION_KEYS = {
     "kernel_coverage": ("paged_prefill_kernel_speedup",
                         "spec_verify_kernel_speedup"),
     "zero3_elastic": ("zero3_step_ratio", "elastic_resume_ok"),
+    "elastic_mttr": "elastic_mttr_s",
 }
 
 _ENV_PROBE = {}
@@ -1289,6 +1290,196 @@ print("RESULT " + json.dumps(out))
             "fused_step_ms": res["fused_step_ms"],
             "naive_step_ms": res["naive_step_ms"],
             "gather_buckets": res["buckets"]}
+
+
+@harness.register_rung("elastic_mttr", est_cold_s=60, smoke=True)
+def bench_elastic_mttr(ctx):
+    """Unattended-elastic MTTR rung (ISSUE 20): SIGKILL one node of a
+    3-node simulated fleet mid-run and measure seconds from the kill to
+    the first post-restart training step — with ZERO operator actions
+    (the hard gate: the fleet must recover by itself or the rung
+    fails).
+
+    One orchestrating subprocess starts three real launcher processes
+    (`python -m paddle_tpu.distributed.launch --nnodes 2:3`, each in
+    its own process group) whose workers publish step heartbeats
+    through `ProgressReporter`; once all three generation-0 heartbeats
+    are moving it SIGKILLs node 2's entire group (launcher AND worker
+    — a machine death, not a worker crash) and polls the store:
+    `t_detect_s` is kill → surviving launchers publish the bumped
+    `restart_generation` (the heartbeat-lease expiry), `elastic_mttr_s`
+    is kill → first step heartbeat of the new generation (regression
+    key; it growing means detection or re-rendezvous got slower).  The
+    drill is pure control-plane (store + launcher + subprocess
+    workers, no device mesh) but runs CPU-only like the other
+    simulated-fleet rungs."""
+    if ctx.on_tpu:
+        raise harness.BackendUnavailable(
+            "elastic_mttr drills launcher process fleets on the host; "
+            "a TPU round measures devices, not process supervision")
+    code = r"""
+import json, os, signal, socket, subprocess, sys, tempfile, time
+
+repo = os.getcwd()
+work = tempfile.mkdtemp(prefix="mttr_")
+worker_py = os.path.join(work, "worker.py")
+with open(worker_py, "w") as f:
+    f.write(
+        "import time\n"
+        "from paddle_tpu.distributed.fleet.elastic import "
+        "ProgressReporter\n"
+        "rep = ProgressReporter()\n"
+        "for step in range(100000):\n"
+        "    rep.publish(step)\n"
+        "    time.sleep(0.05)\n")
+
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+master = f"127.0.0.1:{port}"
+
+env = dict(os.environ)
+env.update({"FLAGS_elastic_lease_interval_s": "0.2",
+            "FLAGS_elastic_lease_timeout_s": "1.5",
+            "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", "")})
+
+def launcher(rank):
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--master", master, "--rank", str(rank), "--nnodes", "2:3",
+           "--max_restart", "5", "--elastic_timeout", "3",
+           "--log_dir", os.path.join(work, f"log{rank}"),
+           "--job_id", "mttr", worker_py]
+    if rank != 0:
+        cmd[6] = "-1"   # auto-rank joiners; only node 0 is explicit
+    log = open(os.path.join(work, f"launcher{rank}.log"), "wb")
+    return subprocess.Popen(cmd, cwd=repo, env=env,
+                            start_new_session=True,
+                            stdout=log, stderr=subprocess.STDOUT)
+
+nodes = [launcher(0), launcher(1), launcher(2)]
+try:
+    from paddle_tpu.distributed.store import TCPStore
+    store = TCPStore("127.0.0.1", port, timeout=30.0)
+
+    def moving(gen, ranks, deadline):
+        first = {}
+        while time.monotonic() < deadline:
+            live = 0
+            for r in ranks:
+                k = f"progress/{gen}/{r}"
+                try:
+                    if not store.check(k):
+                        continue
+                    v = store.get(k, timeout=5.0)
+                except (OSError, TimeoutError):
+                    continue
+                if r not in first:
+                    first[r] = v
+                elif v != first[r]:
+                    live += 1
+            if live >= len(ranks):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def current_gen():
+        try:
+            if store.check("restart_generation"):
+                return int(store.get("restart_generation", timeout=5.0))
+        except (OSError, TimeoutError):
+            pass
+        return 0
+
+    def logs_tail():
+        out = []
+        for rank in range(3):
+            fn = os.path.join(work, f"launcher{rank}.log")
+            if not os.path.isfile(fn):
+                continue
+            with open(fn, "rb") as f:
+                out.append(f"--- launcher{rank}: " + f.read()[-1500:]
+                           .decode(errors="replace"))
+        return "\n".join(out)
+
+    # wait for a full 3-node world stepping at the CURRENT generation
+    # (under load a node can miss generation 0's join window; the
+    # late-join scale-up restart admits it a generation later)
+    ok3 = False
+    base_gen = 0
+    deadline = time.monotonic() + 120
+    while not ok3 and time.monotonic() < deadline:
+        base_gen = max(base_gen, current_gen())
+        ok3 = moving(base_gen, [0, 1, 2], time.monotonic() + 6)
+    assert ok3, \
+        "fleet never reached a 3-node stepping world\n" + logs_tail()
+    base_gen = max(base_gen, current_gen())
+    victim = nodes[2]
+    t_kill = time.monotonic()
+    os.killpg(os.getpgid(victim.pid), signal.SIGKILL)
+
+    # detection: a survivor bumps restart_generation past the pre-kill
+    # value on lease expiry (a worker-crash bump before the kill must
+    # not count as detecting the node death)
+    gen, t_detect = None, None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        g = current_gen()
+        if g > base_gen:
+            gen = g
+            t_detect = time.monotonic() - t_kill
+            break
+        time.sleep(0.02)
+    assert gen is not None, \
+        "no survivor ever bumped restart_generation\n" + logs_tail()
+
+    # recovery: first post-restart step heartbeat.  Re-read the
+    # generation each pass — rendezvous may bump past the first
+    # detected value before settling, and progress keys only ever
+    # appear under the generation that actually settled.
+    t_rec = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        gen = max(gen, current_gen())
+        hit = False
+        for r in range(2):
+            try:
+                if store.check(f"progress/{gen}/{r}"):
+                    hit = True
+                    break
+            except (OSError, TimeoutError):
+                pass
+        if hit:
+            t_rec = time.monotonic() - t_kill
+            break
+        time.sleep(0.02)
+    assert t_rec is not None, \
+        "fleet never resumed stepping after kill\n" + logs_tail()
+    settled = int(store.get(f"world/{gen}", timeout=10.0))
+    print("RESULT " + json.dumps({
+        "elastic_mttr_s": round(t_rec, 3),
+        "t_detect_s": round(t_detect, 3),
+        "generation": gen, "settled_nodes": settled,
+        "recovered": True, "operator_actions": 0}))
+finally:
+    for p in nodes:
+        try:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+"""
+    res = _run_result_subprocess("elastic_mttr", code, timeout=300)
+    if not res.get("recovered") or res.get("operator_actions", 1) != 0:
+        raise RuntimeError(
+            "elastic MTTR drill needed operator intervention: "
+            f"{res}")
+    if res["settled_nodes"] != 2:
+        raise RuntimeError(
+            f"fleet settled at {res['settled_nodes']} nodes, wanted 2")
+    return {"elastic_mttr_s": res["elastic_mttr_s"],
+            "t_detect_s": res["t_detect_s"],
+            "generation": res["generation"],
+            "settled_nodes": res["settled_nodes"],
+            "recovered": bool(res["recovered"]),
+            "operator_actions": 0}
 
 
 def _sampled_decode_sweep(model, cfg, on_tpu):

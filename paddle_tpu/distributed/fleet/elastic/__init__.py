@@ -1,7 +1,10 @@
 """Elastic training manager.  Parity: `python/paddle/distributed/fleet/
 elastic/manager.py:124` (ElasticManager), `elastic/__init__.py` (enter/exit
-protocol)."""
+protocol).  `loop` adds the unattended auto-resume glue (ISSUE 20)."""
 
+from .loop import (ElasticContext, ProgressReporter, run_elastic,
+                   zero3_elastic_hooks)
 from .manager import ElasticManager, ElasticStatus
 
-__all__ = ["ElasticManager", "ElasticStatus"]
+__all__ = ["ElasticManager", "ElasticStatus", "ElasticContext",
+           "ProgressReporter", "run_elastic", "zero3_elastic_hooks"]

@@ -69,7 +69,8 @@ class ElasticManager:
     def last_beat(self, node: int) -> Optional[float]:
         if not self.store.check(self._key(node)):
             return None
-        return float(self.store.get(self._key(node)).decode())
+        return float(self.store.get(self._key(node),
+                                    timeout=5.0).decode())
 
     def _counter_key(self) -> str:
         return f"nodes/{self.generation}/next_id"
@@ -77,7 +78,8 @@ class ElasticManager:
     def _allocated(self) -> int:
         """Highest allocated id bound (read-only — no counter write)."""
         k = self._counter_key()
-        alloc = int(self.store.get(k).decode()) if self.store.check(k) else 0
+        alloc = (int(self.store.get(k, timeout=5.0).decode())
+                 if self.store.check(k) else 0)
         return max(self.nnodes, alloc)
 
     def _roster(self) -> List[int]:
@@ -162,7 +164,7 @@ class ElasticManager:
         for n in roster:
             k = self._node_key(n)
             if self.store.check(k):
-                out[n] = self.store.get(k).decode()
+                out[n] = self.store.get(k, timeout=5.0).decode()
         return out
 
     def collect_endpoints(self, timeout: float = 60.0) -> List[str]:

@@ -10,6 +10,19 @@ env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER, which
 the TCPStore rendezvous at `--master`, writes one log file per rank, and —
 elastic mode — restarts the collective when a worker dies, up to
 `--max_restart` times.
+
+Unattended supervision (ISSUE 20): every launcher publishes a heartbeat
+lease (`lease/{gen}/{node}`, `FLAGS_elastic_lease_interval_s`) from its
+watch loop; a peer whose lease stops moving for
+`FLAGS_elastic_lease_timeout_s` of LOCAL observation time is declared
+dead and any survivor bumps `restart_generation` — node death feeds the
+same PEER_RESTART → re-rendezvous path a worker crash does, so the world
+re-settles without the dead node and training resumes via the elastic-
+ZeRO reshard (`fleet.elastic.loop.run_elastic`).  A progress watchdog
+(`FLAGS_elastic_stall_timeout_s`) SIGKILLs a local worker whose step
+heartbeat (`progress/{gen}/{rank}`) stops advancing, converting hangs
+into the crash path.  Node 0 hosts the TCP store, so node-0 death ends
+the job — the documented single point of failure.
 """
 
 from __future__ import annotations
@@ -22,7 +35,31 @@ import sys
 import time
 from typing import List, Optional
 
+from ... import flags as _flags
+from ...testing import chaos as _chaos
 from ..store import TCPStore
+
+
+def _metric(kind: str, name: str, value: float, help_: str) -> None:
+    """Best-effort counter/gauge — the launcher must run even where the
+    observability stack cannot import."""
+    try:
+        from ...observability import metrics
+        if kind == "gauge":
+            metrics.gauge(name, help_).set(value)
+        else:
+            metrics.counter(name, help_).inc(value)
+    except Exception:  # noqa: BLE001 - observability never kills the job
+        pass
+
+
+def _event(kind: str, **info) -> None:
+    """Best-effort flight-recorder event (shows up in the fleet trace)."""
+    try:
+        from ...observability.flight_recorder import default_recorder
+        default_recorder().record_event(kind, **info)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def parse_args(argv=None):
@@ -147,6 +184,15 @@ def apply_tpu_pod(args, pod):
     return args
 
 
+class _LateJoin(Exception):
+    """This node joined a generation after its world settled; retry the
+    rendezvous at ``generation`` (the scale-up restart it announced)."""
+
+    def __init__(self, generation: int):
+        super().__init__(f"late join; retry at generation {generation}")
+        self.generation = generation
+
+
 class Proc:
     def __init__(self, popen: subprocess.Popen, rank: int, log_path: str,
                  log_file):
@@ -181,6 +227,15 @@ class CollectiveController:
         self.store: Optional[TCPStore] = None
         self.master = args.master
         self.restarts = 0
+        self.store_host = False
+        # lease / progress observation state, reset per generation:
+        # {rank: (last value seen, LOCAL time the value last changed)} —
+        # values are opaque, only their motion matters, so peer clock
+        # skew cannot fake (or hide) an expiry
+        self._lease_seen = {}
+        self._progress_seen = {}
+        self._lease_seq = 0
+        self._gen_started = 0.0
 
     @property
     def elastic(self) -> bool:
@@ -192,21 +247,82 @@ class CollectiveController:
 
         Idempotent across elastic generations: the server survives a worker
         restart, only the generation-scoped keys change.
+
+        Rank allocation: the hosting node claims counter slot 0 and then
+        opens a `rank_gate/{gen}` key; auto-rank (`--rank -1`) joiners
+        wait on the gate before drawing from the counter, so the host is
+        always node 0 and survivor ranks stay dense across generations.
+        (Mixing explicit NON-ZERO ranks with auto-rank nodes is
+        unsupported — the counter cannot see explicit claims.)
+
+        A joiner that drew a rank beyond the settled world (the join
+        window closed without it) re-rendezvouses at the next
+        generation instead of running as an unwatched extra node — see
+        `_settle_world`.  The retry is bounded: a node that keeps
+        losing the join race gives up loudly.
         """
+        for _ in range(8):
+            try:
+                return self._rendezvous_once()
+            except _LateJoin as lj:
+                self.restarts = max(lj.generation,
+                                    self._peer_generation())
+        raise TimeoutError(
+            "elastic rendezvous: this node kept joining after the world "
+            "had settled; giving up after 8 scale-up attempts")
+
+    def _rendezvous_once(self):
         if self.store is None:
             if self.master is None:
                 self.store = TCPStore(is_master=True, world_size=self.nnodes)
                 self.master = f"127.0.0.1:{self.store.port}"
+                self.store_host = True
             else:
                 host, port = self.master.rsplit(":", 1)
-                is_master = self.node_rank == 0
+                # only an EXPLICIT --rank 0 hosts a remote-addressed
+                # store; auto-rank nodes always join (the old
+                # max(rank, 0) heuristic made every auto-rank node try
+                # to bind the master port)
+                is_master = self.args.rank == 0
                 self.store = TCPStore(host=host, port=int(port),
                                       is_master=is_master,
                                       world_size=self.nnodes)
+                self.store_host = is_master
         store = self.store
         gen = self.restarts
-        if self.args.rank < 0:
+        self._gen_started = time.time()
+        self._lease_seen = {}
+        self._lease_seq = 0
+        if self.store_host:
+            if self.args.rank < 0:
+                self.node_rank = store.add(f"node_rank/{gen}", 1) - 1
+            else:
+                self.node_rank = self.args.rank
+                store.add(f"node_rank/{gen}", 1)  # reserve slot 0
+            # the persistent marker (not generation-scoped) tells
+            # auto-rank joiners a gate WILL open every generation, so
+            # they wait for it instead of racing the counter while the
+            # host is still tearing down last generation's workers
+            store.set("rank_gate_hosted", b"1")
+            store.set(f"rank_gate/{gen}", b"1")
+        elif self.args.rank < 0:
+            try:
+                hosted = store.check("rank_gate_hosted")
+            except (OSError, TimeoutError):
+                hosted = False
+            # a hosted gate can lag a restarted generation by worker
+            # teardown (up to 10s of SIGTERM grace) plus the peer-poll
+            # interval, so wait well past it; only an externally hosted
+            # store with no rank-0 claimant gets the short grace
+            gate_timeout = (self.args.elastic_timeout * 2 + 15 if hosted
+                            else min(self.args.elastic_timeout, 5.0))
+            try:
+                store.wait(f"rank_gate/{gen}", timeout=gate_timeout)
+            except (TimeoutError, OSError):
+                pass  # externally hosted store, no rank-0 claimant
             self.node_rank = store.add(f"node_rank/{gen}", 1) - 1
+        else:
+            self.node_rank = self.args.rank
         if self.elastic:
             self._settle_world(store, gen)
         store.barrier(f"rendezvous/{gen}", self.nnodes,
@@ -229,8 +345,38 @@ class CollectiveController:
             self.coordinator = f"{host}:{port}"
             store.set(f"jax_coord/{gen}", self.coordinator.encode())
         else:
-            store.wait(f"jax_coord/{gen}")
-            self.coordinator = store.get(f"jax_coord/{gen}").decode()
+            store.wait(f"jax_coord/{gen}",
+                       timeout=self.args.elastic_timeout)
+            self.coordinator = store.get(
+                f"jax_coord/{gen}",
+                timeout=self.args.elastic_timeout).decode()
+        _metric("gauge", "elastic.generation", gen,
+                "current elastic restart generation of this launcher")
+        self._gc_generation(gen - 2)
+
+    def _gc_generation(self, gen: int) -> None:
+        """Best-effort store GC of a settled-long-ago generation's keys.
+
+        Only node 0 sweeps (it outlives the job by definition — its
+        death ends the run), and only generation N-2: N-1 may still
+        have stragglers adopting the bump.  The wire protocol has no
+        LIST, so the sweep reconstructs the known key names; DEL is
+        idempotent, missing keys are free."""
+        if gen < 0 or self.node_rank != 0 or self.store is None:
+            return
+        keys = [f"node_rank/{gen}", f"rank_gate/{gen}", f"join/{gen}",
+                f"world/{gen}", f"jax_coord/{gen}",
+                f"__barrier__/rendezvous/{gen}/count",
+                f"__barrier__/rendezvous/{gen}/go"]
+        for r in range(self.nnodes_max):
+            keys.append(f"lease/{gen}/{r}")
+        for r in range(self.nnodes_max * self.nproc):
+            keys.append(f"progress/{gen}/{r}")
+        for key in keys:
+            try:
+                self.store.delete_key(key)
+            except (OSError, TimeoutError):
+                return  # transient store trouble; next generation retries
 
     def _settle_world(self, store, gen: int):
         """Counted-join window for a MIN:MAX rendezvous (per generation).
@@ -260,8 +406,35 @@ class CollectiveController:
                 time.sleep(0.05)
             store.set(key, str(min(n, self.nnodes_max)))
         else:
-            store.wait(key, timeout=self.args.elastic_timeout)
-        settled = int(store.get(key))
+            # the settler publishes only after ITS OWN full
+            # elastic_timeout window, and nodes enter a restarted
+            # generation staggered by up to a lease poll plus worker
+            # teardown — waiting with the SAME timeout loses that race
+            # about half the time, so waiters get the window plus slack
+            store.wait(key, timeout=self.args.elastic_timeout * 2 + 15)
+        settled = int(store.get(key, timeout=self.args.elastic_timeout))
+        if self.node_rank >= settled:
+            # We drew a rank beyond the settled world: the join window
+            # closed without us.  Running anyway would split the world
+            # (our workers would disagree on the trainer count, and no
+            # survivor watches a lease past the settled node count), so
+            # announce a scale-up restart and retry next generation.
+            if settled >= self.nnodes_max:
+                raise TimeoutError(
+                    f"elastic rendezvous gen {gen}: world already full "
+                    f"at {settled} nodes; hot spares are unsupported")
+            sys.stderr.write(
+                f"[launch] joined generation {gen} after it settled at "
+                f"{settled} nodes — requesting a scale-up restart\n")
+            try:
+                if self._peer_generation() <= gen:
+                    store.set("restart_generation", str(gen + 1))
+                    _event("elastic_restart_generation",
+                           generation=gen + 1, cause="late_join",
+                           node=self.node_rank)
+            except (OSError, TimeoutError):
+                pass  # survivors will still admit us next failure
+            raise _LateJoin(gen + 1)
         if settled != self.nnodes:
             sys.stderr.write(
                 f"[launch] elastic world settled at {settled} nodes "
@@ -293,6 +466,7 @@ class CollectiveController:
     def start_workers(self):
         os.makedirs(self.args.log_dir, exist_ok=True)
         self.procs = []
+        self._progress_seen = {}
         for lr in range(self.nproc):
             rank = self.node_rank * self.nproc + lr
             log_path = os.path.join(
@@ -326,15 +500,134 @@ class CollectiveController:
     def _peer_generation(self) -> int:
         try:
             if self.store.check("restart_generation"):
-                return int(self.store.get("restart_generation"))
+                return int(self.store.get("restart_generation",
+                                          timeout=5.0))
         except (OSError, TimeoutError):
             pass
         return self.restarts
 
+    # ------------------------------------------------- heartbeat leases
+    def _publish_lease(self, gen: int) -> None:
+        """Bump this node's per-generation lease key.  The value is an
+        opaque monotonic sequence — peers time its MOTION on their own
+        clocks, so no cross-node clock agreement is needed.
+
+        Chaos: the ``elastic.lease.publish`` site lets a test silence a
+        live launcher's heartbeat (a simulated sudden death) — armed
+        faults make the publish vanish, so peers see the lease expire."""
+        self._lease_seq += 1
+        try:
+            _chaos.inject("elastic.lease.publish")
+            self.store.set(f"lease/{gen}/{self.node_rank}",
+                           str(self._lease_seq))
+        except (OSError, TimeoutError):
+            pass  # transient store hiccup; next interval retries
+
+    def _check_peer_leases(self, gen: int) -> bool:
+        """Declare dead any peer whose lease stopped moving for
+        FLAGS_elastic_lease_timeout_s and bump the restart generation.
+        Returns True when a bump happened (caller exits PEER_RESTART).
+
+        The first full timeout after a (re)rendezvous is a join grace:
+        peers may still be starting workers and not publishing yet.  A
+        node that registered in the settle count but died before its
+        first publish is still caught — its never-moving absent lease
+        ages out like any other."""
+        timeout = float(_flags.get_flag("elastic_lease_timeout_s"))
+        now = time.time()
+        if timeout <= 0 or now - self._gen_started < timeout:
+            return False
+        for rank in range(self.nnodes):
+            if rank == self.node_rank:
+                continue
+            key = f"lease/{gen}/{rank}"
+            try:
+                val = (self.store.get(key, timeout=5.0)
+                       if self.store.check(key) else None)
+            except (OSError, TimeoutError):
+                return False  # store unreachable is not death evidence
+            seen = self._lease_seen.get(rank)
+            if seen is None or seen[0] != val:
+                self._lease_seen[rank] = (val, now)
+                continue
+            if now - seen[1] > timeout:
+                self._on_lease_expired(gen, rank, now - seen[1])
+                return True
+        return False
+
+    def _on_lease_expired(self, gen: int, rank: int, age: float) -> None:
+        if self._peer_generation() > self.restarts:
+            return  # another survivor already bumped; watch adopts it
+        sys.stderr.write(
+            f"[launch] node {rank} lease expired "
+            f"({age:.1f}s without a heartbeat, generation {gen}) — "
+            f"declaring it dead and re-rendezvousing\n")
+        _metric("counter", "elastic.lease_expiries_total", 1,
+                "peer launcher leases declared expired (node deaths "
+                "detected by the heartbeat-lease protocol)")
+        _event("elastic_lease_expired", generation=gen, node=rank,
+               age_s=round(age, 3))
+        try:
+            self.store.set("restart_generation", str(self.restarts + 1))
+            _event("elastic_restart_generation",
+                   generation=self.restarts + 1, cause="lease_expiry",
+                   dead_node=rank)
+        except (OSError, TimeoutError):
+            pass  # store trouble; the next watch iteration retries
+
+    # ------------------------------------------------ progress watchdog
+    def _check_stalls(self, gen: int) -> None:
+        """SIGKILL local workers whose step heartbeat stopped advancing
+        for FLAGS_elastic_stall_timeout_s — a wedged collective becomes
+        the ordinary crash→restart path.  A rank arms only after its
+        FIRST heartbeat: scripts that never publish are never killed."""
+        timeout = float(_flags.get_flag("elastic_stall_timeout_s"))
+        if timeout <= 0 or self.store is None:
+            return
+        now = time.time()
+        for p in self.procs:
+            if p.popen.poll() is not None:
+                continue
+            key = f"progress/{gen}/{p.rank}"
+            try:
+                if not self.store.check(key):
+                    continue
+                val = self.store.get(key, timeout=5.0)
+            except (OSError, TimeoutError):
+                continue
+            seen = self._progress_seen.get(p.rank)
+            if seen is None or seen[0] != val:
+                self._progress_seen[p.rank] = (val, now)
+                continue
+            if now - seen[1] > timeout:
+                stalled = now - seen[1]
+                sys.stderr.write(
+                    f"[launch] rank {p.rank} stalled at step "
+                    f"{val.decode(errors='replace')} for {stalled:.1f}s "
+                    f"(> {timeout}s) — killing it for restart\n")
+                _metric("counter", "elastic.stall_kills_total", 1,
+                        "workers SIGKILLed by the progress watchdog "
+                        "(stalled step heartbeat)")
+                _event("elastic_stall_kill", generation=gen, rank=p.rank,
+                       step=val.decode(errors="replace"),
+                       stalled_s=round(stalled, 3))
+                try:
+                    p.popen.kill()
+                except OSError:
+                    pass
+                self._progress_seen.pop(p.rank, None)
+
     def watch(self) -> int:
         """Block until all workers exit (0), one fails (its rc), or another
-        node bumped the restart generation (PEER_RESTART)."""
+        node bumped the restart generation (PEER_RESTART) — bumped either
+        explicitly by a failing peer or by THIS node observing a peer's
+        heartbeat lease expire.  Also publishes this node's own lease and
+        runs the local stall watchdog."""
         last_poll = 0.0
+        last_lease = 0.0
+        lease_iv = max(0.05,
+                       float(_flags.get_flag("elastic_lease_interval_s")))
+        gen = self.restarts
         while True:
             alive = False
             for p in self.procs:
@@ -345,10 +638,18 @@ class CollectiveController:
                     return rc
             if not alive:
                 return 0
-            if self.nnodes > 1 and time.time() - last_poll > 1.0:
-                last_poll = time.time()
-                if self._peer_generation() > self.restarts:
-                    return self.PEER_RESTART
+            now = time.time()
+            if self.nnodes > 1 and self.store is not None:
+                if now - last_lease >= lease_iv:
+                    last_lease = now
+                    self._publish_lease(gen)
+                if now - last_poll > min(1.0, lease_iv):
+                    last_poll = now
+                    if self._peer_generation() > self.restarts:
+                        return self.PEER_RESTART
+                    if self._check_peer_leases(gen):
+                        return self.PEER_RESTART
+            self._check_stalls(gen)
             time.sleep(0.2)
 
     def run(self) -> int:
@@ -361,8 +662,11 @@ class CollectiveController:
                 return 0
             self.stop_workers()
             if rc == self.PEER_RESTART:
-                # another node initiated the restart; adopt its generation
-                self.restarts = self._peer_generation()
+                # another node initiated the restart (or THIS node did,
+                # on observing a peer's lease expire); adopt the
+                # published generation
+                self.restarts = max(self._peer_generation(),
+                                    self.restarts + 1)
                 sys.stderr.write(
                     f"[launch] peer requested restart "
                     f"(generation {self.restarts})\n")
@@ -375,6 +679,9 @@ class CollectiveController:
                 self.restarts += 1
                 # publish the new generation so surviving nodes rejoin
                 self.store.set("restart_generation", str(self.restarts))
+                _event("elastic_restart_generation",
+                       generation=self.restarts, cause="worker_exit",
+                       rc=rc)
             self.rendezvous()
 
 

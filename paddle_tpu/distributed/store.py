@@ -11,6 +11,18 @@ fallback.  Each client thread gets its own socket, so a thread parked in
 wait() never blocks another thread's heartbeat/set.  The store is a
 control-plane component — data only flows through it in the documented
 eager send/recv fallback (collective.py), which deletes its keys after use.
+
+Hardening (ISSUE 20): transient socket errors (ECONNRESET / EPIPE from a
+server hiccup or a mid-request reconnect race) are retried with bounded
+exponential backoff (``FLAGS_store_retries`` attempts,
+``FLAGS_store_retry_backoff_s`` base) instead of killing the node mid-
+rendezvous.  Semantic timeouts (the server is up but the key never came)
+are NEVER retried — they must surface to the elastic machinery.  The
+non-idempotent ADD only retries when the failure provably preceded any
+bytes hitting the wire (a replayed ADD would double-count).  Every
+request passes the ``store.request`` chaos site so tests can arm
+deterministic transient faults; retries/reconnects are counted on
+``store.retries`` / ``store.reconnects``.
 """
 
 from __future__ import annotations
@@ -22,9 +34,22 @@ import threading
 import time
 from typing import Dict, Optional
 
+from .. import flags as _flags
+from ..testing import chaos as _chaos
+
 __all__ = ["TCPStore", "Store"]
 
 _ADD, _GET, _CHECK, _SET, _WAIT, _STOP, _DEL = range(7)
+
+
+def _count(name: str, help_: str) -> None:
+    """Best-effort observability counter (the store must work even when
+    the observability stack is unavailable or disabled)."""
+    try:
+        from ..observability import metrics
+        metrics.counter(name, help_).inc()
+    except Exception:  # noqa: BLE001 - counters never break the store
+        pass
 
 
 class Store:
@@ -33,7 +58,7 @@ class Store:
     def set(self, key: str, value: bytes):  # noqa: A003
         raise NotImplementedError
 
-    def get(self, key: str) -> bytes:
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         raise NotImplementedError
 
     def add(self, key: str, amount: int) -> int:
@@ -220,6 +245,9 @@ class TCPStore(Store):
         conn = getattr(self._tls, "conn", None)
         if conn is None:
             conn = self._connect()
+            _count("store.reconnects",
+                   "TCPStore client sockets (re)established lazily: a "
+                   "thread's first connect or a post-drop reconnect")
         return conn
 
     def _drop_conn(self):
@@ -235,23 +263,45 @@ class TCPStore(Store):
         kb = key.encode()
         msg = struct.pack("<BI", cmd, len(kb)) + kb + \
             struct.pack("<Q", len(val)) + val
-        conn = self._conn_for_thread()
-        conn.settimeout(timeout if timeout is not None else self.timeout)
-        try:
-            conn.sendall(msg)
-            if cmd in (_ADD, _GET):
-                ln = struct.unpack("<Q", _recv_exact(conn, 8))[0]
-                return _recv_exact(conn, ln) if ln else b""
-            return _recv_exact(conn, 1)
-        except socket.timeout:
-            # the server may still answer this request later; the socket is
-            # desynchronized — drop it so the next call starts clean
-            self._drop_conn()
-            raise TimeoutError(
-                f"TCPStore request cmd={cmd} key={key!r} timed out")
-        except (OSError, ConnectionError):
-            self._drop_conn()
-            raise
+        retries = max(1, int(_flags.get_flag("store_retries")))
+        backoff = float(_flags.get_flag("store_retry_backoff_s"))
+        attempt = 0
+        while True:
+            wired = False  # any bytes possibly on the wire this attempt?
+            try:
+                conn = self._conn_for_thread()
+                _chaos.inject("store.request")
+                conn.settimeout(
+                    timeout if timeout is not None else self.timeout)
+                wired = True
+                conn.sendall(msg)
+                if cmd in (_ADD, _GET):
+                    ln = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+                    return _recv_exact(conn, ln) if ln else b""
+                return _recv_exact(conn, 1)
+            except socket.timeout:
+                if not wired:
+                    raise  # _connect exhausted its own bounded deadline
+                # a SEMANTIC timeout: the server is reachable but the
+                # answer never came (e.g. wait() on a key nobody set).
+                # Retrying cannot help and would mask a dead peer — the
+                # socket is desynchronized, drop it and surface the
+                # timeout to the elastic machinery
+                self._drop_conn()
+                raise TimeoutError(
+                    f"TCPStore request cmd={cmd} key={key!r} timed out")
+            except (OSError, ConnectionError):
+                self._drop_conn()
+                attempt += 1
+                # ADD is not idempotent: a replay of a request that may
+                # have reached the server double-counts.  Only retry it
+                # when the failure provably preceded the send
+                if (cmd == _ADD and wired) or attempt >= retries:
+                    raise
+                _count("store.retries",
+                       "TCPStore requests retried after a transient "
+                       "socket error")
+                time.sleep(backoff * (2 ** (attempt - 1)))
 
     # Store interface ------------------------------------------------------
     def set(self, key: str, value) -> None:  # noqa: A003
@@ -259,8 +309,8 @@ class TCPStore(Store):
             value = value.encode()
         self._request(_SET, key, bytes(value))
 
-    def get(self, key: str) -> bytes:
-        return self._request(_GET, key)
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        return self._request(_GET, key, timeout=timeout)
 
     def add(self, key: str, amount: int = 1) -> int:
         return int(self._request(_ADD, key, str(int(amount)).encode()))

@@ -625,3 +625,39 @@ define_flag("fleet_error_budget", 0.05,
             "SLO error budget as a bad-event fraction (bad = TTFT-SLO "
             "violations + error/poisoned outcomes over total terminal "
             "events): the denominator of the burn rate")
+
+# Unattended elastic training: heartbeat leases, stall watchdog and
+# store hardening (distributed/launch/main.py, distributed/store.py,
+# distributed/fleet/elastic/loop.py — ISSUE 20).
+define_flag("elastic_lease_interval_s", 1.0,
+            "heartbeat-lease publish cadence: each launcher bumps its "
+            "per-generation lease key (lease/{gen}/{node}) on the TCP "
+            "store at this interval from its watch loop, proving the "
+            "node is alive to every peer")
+define_flag("elastic_lease_timeout_s", 5.0,
+            "lease expiry horizon: a peer whose lease value has not "
+            "changed for this many seconds of LOCAL observation time "
+            "(clock-skew free — the value is opaque, only its motion "
+            "matters) is declared dead; any surviving launcher then "
+            "bumps restart_generation so the fleet re-settles without "
+            "the dead node.  Should comfortably exceed "
+            "elastic_lease_interval_s; expiry checks only arm after "
+            "one full timeout of generation uptime (join grace)")
+define_flag("elastic_stall_timeout_s", 0.0,
+            "progress watchdog: a local worker whose step heartbeat "
+            "(progress/{gen}/{rank}, published by the trainer's "
+            "ProgressReporter) stops advancing for this many seconds "
+            "is SIGKILLed by its launcher, converting a wedged "
+            "collective or deadlock into the ordinary crash→restart "
+            "path.  Arms per rank only after the FIRST heartbeat is "
+            "observed (uninstrumented scripts are never stall-killed). "
+            "0 (the default) disables the watchdog")
+define_flag("store_retries", 3,
+            "TCPStore transient-error budget: attempts per request on "
+            "ECONNRESET/EPIPE-style socket errors before the error "
+            "propagates (semantic timeouts never retry; non-idempotent "
+            "ADD only retries when the failure provably preceded the "
+            "send).  1 = the historical fail-fast behavior")
+define_flag("store_retry_backoff_s", 0.05,
+            "base sleep between TCPStore retry attempts (doubles per "
+            "attempt: backoff, 2*backoff, ...)")

@@ -74,6 +74,10 @@ _M_FAILOVERS = _metrics.counter(
 _M_UNROUTABLE = _metrics.counter(
     "fleet.router.unroutable", "requests answered 503: no ready "
     "replica accepted the proxy attempt")
+_M_REPLAYED = _metrics.counter(
+    "fleet.replayed_requests", "accepted streams that died BEFORE the "
+    "first token frame reached the client and were replayed on another "
+    "replica (nothing was delivered, so the replay is idempotent)")
 _M_SLO_BURN = _metrics.gauge(
     "fleet.slo_burn", "per-replica SLO error-budget burn rate over the "
     "FAST window (fleet_burn_fast_window_s), by replica=<name>: bad-"
@@ -288,6 +292,7 @@ class FleetRouter:
         self.sheds = 0
         self.failovers = 0
         self.unroutable = 0
+        self.replayed = 0
         # fleet telescope (ISSUE 17): per-router flight recorder (an
         # in-process fleet must not interleave router spans into the
         # replicas' rings), the federated registry, the burn monitor
@@ -532,6 +537,7 @@ class FleetRouter:
         return {"routed": self.routed, "affinity_hits": self.affinity_hits,
                 "fallbacks": self.fallbacks, "sheds": self.sheds,
                 "failovers": self.failovers, "unroutable": self.unroutable,
+                "replayed": self.replayed,
                 "affinity_hit_rate": round(
                     self.affinity_hits / self.routed, 4)
                 if self.routed else None,
@@ -648,12 +654,22 @@ class FleetRouter:
                     self.fallbacks += 1
                     _M_AFFINITY.inc(outcome="fallback")
                 t_proxy0 = time.time()
-                self._relay(handler, *got)
+                outcome = self._relay(handler, *got)
                 if router_span is not None:
                     self._flightrec().record_span(
                         "proxy", "router", t_proxy0, time.time(),
                         trace_id=trace_id, span=router_span,
-                        replica=name)
+                        replica=name, outcome=outcome)
+                if outcome == "replay":
+                    # the stream died (or opened with a terminal error
+                    # frame) before the FIRST token frame left the
+                    # router: the client saw nothing, so re-routing the
+                    # request to the next candidate is idempotent —
+                    # unlike a mid-stream death, which already
+                    # delivered tokens and must surface as truncation
+                    self.replayed += 1
+                    _M_REPLAYED.inc()
+                    continue
                 return
             if time.monotonic() >= deadline:
                 break
@@ -700,10 +716,42 @@ class FleetRouter:
             return None
         return conn, resp
 
-    def _relay(self, handler: _RouterHandler, conn, resp) -> None:
+    def _relay(self, handler: _RouterHandler, conn, resp) -> str:
         """Pump the accepted response through byte-for-byte (SSE
         passthrough — chunks forwarded as they arrive, flushed
-        immediately)."""
+        immediately).
+
+        Replay gate (ISSUE 20): for an SSE stream, NOTHING is written
+        to the client until the first complete frame (``\\n\\n``
+        boundary) arrives and classifies the stream.  A first frame
+        that is a terminal ``event: error`` — or an upstream that dies
+        before completing any frame — means zero bytes were delivered:
+        the request is safely replayable on another replica and this
+        returns ``"replay"`` without touching the client socket.  Once
+        the first frame is a real token (or ``event: done``), headers +
+        buffer flush and the relay degrades to the historical byte-
+        faithful passthrough (``"delivered"`` even if the stream later
+        truncates — the client already saw tokens, a replay would
+        duplicate them).  Non-SSE responses (a replica's own 400 JSON
+        is authoritative) relay immediately."""
+        ctype = resp.headers.get("Content-Type", "")
+        gate = resp.status == 200 and "text/event-stream" in ctype
+        buf = b""
+        if gate:
+            try:
+                while b"\n\n" not in buf:
+                    chunk = resp.read1(65536)
+                    if not chunk:       # upstream died pre-first-frame
+                        conn.close()
+                        return "replay"
+                    buf += chunk
+            except OSError:
+                conn.close()
+                return "replay"
+            first = buf.split(b"\n\n", 1)[0]
+            if first.startswith(b"event: error"):
+                conn.close()
+                return "replay"
         try:
             handler.send_response(resp.status)
             for h in ("Content-Type", "Cache-Control", "Content-Length"):
@@ -712,6 +760,9 @@ class FleetRouter:
                     handler.send_header(h, v)
             handler.send_header("Connection", "close")
             handler.end_headers()
+            if buf:
+                handler.wfile.write(buf)
+                handler.wfile.flush()
             while True:
                 chunk = resp.read1(65536)
                 if not chunk:
@@ -722,3 +773,4 @@ class FleetRouter:
             pass    # client hung up; closing upstream propagates cancel
         finally:
             conn.close()
+        return "delivered"

@@ -44,6 +44,19 @@ Fleet chaos (ISSUE 16) adds the router's proxy leg:
   exactly what the rolling-restart gate in tests/test_fleet.py injects
   mid-drill.
 
+Elastic chaos (ISSUE 20) instruments the supervision layer:
+
+* :func:`fail_at` on ``store.request`` injects a transient socket-level
+  failure into every TCPStore request — the EPIPE-mid-rendezvous the
+  store's bounded retry/backoff (``FLAGS_store_retries``) must absorb.
+* :func:`fail_at` on ``elastic.lease.publish`` silences a launcher's
+  heartbeat lease without killing the process — peers must observe the
+  lease expire and bump ``restart_generation`` (simulated node death).
+* :func:`delay_at` on ``elastic.step`` freezes a worker's step
+  heartbeat in :class:`ProgressReporter.publish` — the deterministic
+  wedged-collective the launcher's progress watchdog
+  (``FLAGS_elastic_stall_timeout_s``) must convert into kill + restart.
+
 Everything is counted: each armed fault records how often it fired so a
 test can assert the injection actually happened.
 """

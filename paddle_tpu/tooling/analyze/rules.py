@@ -982,11 +982,73 @@ class EagerCollectiveInStepLoop(Rule):
         return out
 
 
+# =========================================================== R015
+class UntimedStoreWait(Rule):
+    """A blocking rendezvous-store call (`store.wait(...)` /
+    `store.get(...)` / `store.barrier(...)`) with no ``timeout=``,
+    reachable from launcher / rendezvous / elastic-supervision code.
+    GET and WAIT park on the server until the key EXISTS — if the peer
+    that was supposed to publish it died, the caller wedges forever,
+    which is exactly how a dead node used to hang every survivor (the
+    failure class the ISSUE 20 heartbeat leases exist to catch; a
+    lease expiry can only help a node that is still making progress).
+    Scope: `distributed/launch/`, `distributed/fleet/elastic/` and
+    `distributed/store.py` — control-plane code that must stay live
+    through peer death.  Compliant shapes: pass ``timeout=`` (the
+    elastic timeout for rendezvous keys, a short bound for polls), or
+    gate the read behind ``store.check(key)`` AND still bound the get.
+    A ``.get(key, default)`` two-positional-argument call reads as a
+    mapping lookup, not a blocking store get."""
+
+    id = "R015"
+    name = "untimed-store-wait"
+
+    _SCOPE_DIRS = ("distributed/launch/", "distributed/fleet/elastic/")
+    _SCOPE_FILES = ("distributed/store.py",)
+    _METHODS = frozenset({"wait", "get", "barrier"})
+
+    def wants(self, sf: SourceFile) -> bool:
+        if not super().wants(sf):
+            return False
+        return (any(d in sf.rel for d in self._SCOPE_DIRS)
+                or sf.rel.endswith(self._SCOPE_FILES))
+
+    def check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for n in sf.all_nodes:
+            if not isinstance(n, ast.Call) \
+                    or not isinstance(n.func, ast.Attribute):
+                continue
+            meth = n.func.attr
+            if meth not in self._METHODS:
+                continue
+            recv = (expr_text(n.func.value) or "").lower()
+            if "store" not in recv:
+                continue
+            if any(k.arg == "timeout" for k in n.keywords):
+                continue
+            if meth == "get" and len(n.args) >= 2:
+                continue    # mapping .get(key, default) — or a
+                # positional timeout, which is bounded either way
+            out.append(self.finding(
+                sf, n,
+                f"untimed `{recv}.{meth}(...)` in launcher/rendezvous "
+                "code: GET/WAIT park on the server until the key "
+                "exists, so a dead peer (the node that was supposed to "
+                "publish it) wedges this caller forever — the hang the "
+                "heartbeat-lease protocol cannot save it from.  Pass "
+                "`timeout=` (the elastic timeout for rendezvous keys, "
+                "a short bound for watch-loop polls) so peer death "
+                "surfaces as TimeoutError and feeds the restart path"))
+        return out
+
+
 RULES: List[Rule] = [
     HostSyncInTracedCode(), AliasUnsafeDeviceInput(), UseAfterDonate(),
     TraceTimeFlagRead(), LockOrderInversion(), UnsyncedTiming(),
     UnpairedKVHandoff(), UnpropagatedTraceContext(),
     InterpretModeKernelInHotPath(), EagerCollectiveInStepLoop(),
+    UntimedStoreWait(),
 ]
 
 # the interprocedural rule set (R007-R010) registers itself here; the

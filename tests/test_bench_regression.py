@@ -778,3 +778,44 @@ def test_zero3_elastic_rung_schema():
     assert val["elastic_resume_ok"] is True
     assert val["fused_step_ms"] > 0 and val["naive_step_ms"] > 0
     assert val["gather_buckets"] >= 1
+
+
+def test_elastic_mttr_regression_keys_and_tpu_degrade():
+    """Pin the ISSUE 20 `elastic_mttr` rung's wiring without paying for
+    the 3-launcher fleet: the regression key registered (MTTR growing
+    means detection or re-rendezvous got slower), and the TPU path
+    degrades to `ok:false reason:backend_unavailable` (the drill
+    measures host process supervision, not devices)."""
+    bench = _load_bench("bench_module_mttr")
+    assert bench._REGRESSION_KEYS["elastic_mttr"] == "elastic_mttr_s"
+    assert harness.get_rung("elastic_mttr").smoke
+    rec = harness.run_rung(harness.get_rung("elastic_mttr"),
+                           probe={"ok": True, "platform": "tpu",
+                                  "device_kind": "TPU v4", "n_devices": 4,
+                                  "error": None})
+    assert rec["ok"] is False
+    assert rec["reason"] == "backend_unavailable"
+    assert harness.validate_record(rec) is None
+
+
+@pytest.mark.slow  # ~20s measured: a real 3-launcher fleet, one node
+                   # SIGKILLed mid-run
+def test_elastic_mttr_rung_schema():
+    """The heavy twin runs the kill-a-node drill for real and pins the
+    record schema plus the zero-human-intervention hard gate: the fleet
+    re-settles at 2 nodes and resumes stepping with operator_actions
+    == 0, detection strictly precedes recovery."""
+    from types import SimpleNamespace
+
+    bench = _load_bench("bench_module_mttr_full")
+    ctx = SimpleNamespace(smoke=True, on_tpu=False, probe={"ok": True},
+                          device_kind="cpu")
+    val = bench.bench_elastic_mttr(ctx)
+    rec = {"rung": "elastic_mttr", "ok": True, "device": "cpu",
+           "elapsed_s": 0.1, "value": val}
+    assert harness.validate_record(rec) is None
+    assert val["recovered"] is True
+    assert val["operator_actions"] == 0
+    assert val["settled_nodes"] == 2
+    assert val["generation"] >= 1
+    assert 0 < val["t_detect_s"] < val["elastic_mttr_s"]

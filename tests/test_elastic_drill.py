@@ -1,0 +1,288 @@
+"""The kill-a-node-mid-run drill (ISSUE 20).
+
+Acceptance drills for unattended elastic training: a 3-node simulated
+fleet (three real launcher processes on one host, CPU-only) loses a
+node to SIGKILL mid-run and must — with ZERO operator actions —
+re-settle at 2 nodes, auto-resume from the latest COMPLETE checkpoint,
+and finish bit-identical to an uninterrupted run; a worker whose step
+heartbeat freezes must be stall-killed and restarted within
+``FLAGS_elastic_stall_timeout_s``.
+
+Fast twins (same protocol pieces, no subprocess fleet, tier-1):
+`test_launch_store.py::test_heartbeat_lease_expiry_bumps_generation`,
+`test_launch_store.py::test_late_joiner_requests_scale_up_restart`,
+`test_launch_store.py::test_progress_watchdog_kills_stalled_worker`,
+and the always-on `bench.py --rungs elastic_mttr` smoke rung.
+
+The training in the kill drill is a store-based fixed-grain allreduce
+(6 logical grains summed in grain order, PR 19's reduction-grain idea
+at the control plane): the gradient sum order is independent of the
+world size, so the 3-node prefix + 2-node suffix must land on EXACTLY
+the uninterrupted single-process weights.  Cross-process XLA
+collectives don't exist on CPU; the store path is the point — the
+drill exercises supervision, not ICI.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GRAINS = 6
+DIM = 4
+STEPS = 30
+LR = np.float32(0.1)
+
+
+def _grain_grad(grain, w):
+    """Deterministic per-grain gradient; float32 ops in a fixed order so
+    the in-test reference reproduces the workers bit-for-bit."""
+    rng = np.random.RandomState(1000 + grain)
+    A = rng.randn(DIM, DIM).astype(np.float32)
+    b = rng.randn(DIM).astype(np.float32)
+    return (A @ w - b) * np.float32(1.0 / GRAINS)
+
+
+def _reference_weights():
+    w = np.zeros(DIM, np.float32)
+    for _ in range(STEPS):
+        g = np.zeros(DIM, np.float32)
+        for grain in range(GRAINS):
+            g = g + _grain_grad(grain, w)
+        w = w - LR * g
+    return w
+
+
+KILL_DRILL_WORKER = r"""
+import json, os, time
+import numpy as np
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.fleet.elastic import (ElasticContext,
+                                                  run_elastic)
+from paddle_tpu.distributed.store import TCPStore
+
+GRAINS, DIM, STEPS = 6, 4, 30
+LR = np.float32(0.1)
+OUT = os.environ["DRILL_OUT"]
+
+
+def grain_grad(grain, w):
+    rng = np.random.RandomState(1000 + grain)
+    A = rng.randn(DIM, DIM).astype(np.float32)
+    b = rng.randn(DIM).astype(np.float32)
+    return (A @ w - b) * np.float32(1.0 / GRAINS)
+
+
+ctx = ElasticContext.from_env()
+host, port = ctx.master.rsplit(":", 1)
+store = TCPStore(host=host, port=int(port))
+manager = CheckpointManager(os.path.join(OUT, "ckpt"), keep_last=4)
+
+
+def step_fn(state, step, ctx):
+    w = state["w"]
+    # fixed-grain store allreduce: every rank publishes ITS grains'
+    # partials, then everyone sums ALL grains in grain order — the
+    # reduction order never depends on the world size, so a 3->2
+    # restart stays bit-exact
+    for grain in range(ctx.rank, GRAINS, ctx.world_size):
+        store.set(f"g/{ctx.generation}/{step}/{grain}",
+                  grain_grad(grain, w).tobytes())
+    g = np.zeros(DIM, np.float32)
+    for grain in range(GRAINS):
+        key = f"g/{ctx.generation}/{step}/{grain}"
+        store.wait(key, timeout=30.0)
+        g = g + np.frombuffer(store.get(key, timeout=30.0), np.float32)
+    time.sleep(0.15)  # widen the mid-run kill window
+    return {"w": w - LR * g}
+
+
+def init_fn(ctx):
+    return {"w": np.zeros(DIM, np.float32)}, 0
+
+
+def restore_fn(manager, ctx):
+    arrays, _ = manager.restore_into(
+        {"w": np.zeros(DIM, np.float32)}, resize_trailing=True)
+    return {"w": np.asarray(arrays["w"], np.float32)}, \
+        int(manager.latest_complete())
+
+
+def save_fn(manager, step, state, ctx):
+    if ctx.rank == 0:
+        manager.save(step, {"w": state["w"]}, wait=True)
+
+
+state, steps = run_elastic(step_fn, manager, init_fn=init_fn,
+                           restore_fn=restore_fn, save_fn=save_fn,
+                           max_steps=STEPS, save_every=1, ctx=ctx)
+if ctx.rank == 0:
+    json.dump({"w": state["w"].tolist(), "steps": steps,
+               "generation": ctx.generation,
+               "world_size": ctx.world_size},
+              open(os.path.join(OUT, "result.json"), "w"))
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launcher(rank, master, script, workdir, env, nnodes="2:3"):
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--master", master, "--rank", str(rank), "--nnodes", nnodes,
+           "--max_restart", "5", "--elastic_timeout", "3",
+           "--log_dir", os.path.join(workdir, f"log{rank}"),
+           "--job_id", "drill", script]
+    if rank != 0:
+        cmd[6] = "-1"   # auto-rank joiners; only node 0 is explicit
+    log = open(os.path.join(workdir, f"launcher{rank}.log"), "wb")
+    return subprocess.Popen(cmd, cwd=REPO, env=env,
+                            start_new_session=True,
+                            stdout=log, stderr=subprocess.STDOUT)
+
+
+def _logs(workdir):
+    out = ""
+    for fn in sorted(os.listdir(workdir)):
+        if fn.endswith(".log"):
+            with open(os.path.join(workdir, fn), "rb") as f:
+                out += f"\n--- {fn}\n" + f.read()[-2000:].decode(
+                    errors="replace")
+    return out
+
+
+@pytest.mark.slow   # tier-1 budget: 3-node subprocess fleet, ~30s
+def test_kill_a_node_mid_run_auto_resumes_bit_exact(tmp_path):
+    """SIGKILL one node's whole process group mid-run: the survivors'
+    heartbeat-lease watch declares it dead, the world re-settles at 2,
+    training auto-resumes from the latest COMPLETE checkpoint, and the
+    final weights equal the uninterrupted reference bit-for-bit."""
+    from paddle_tpu.distributed.store import TCPStore
+
+    script = tmp_path / "worker.py"
+    script.write_text(KILL_DRILL_WORKER)
+    port = _free_port()
+    master = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env.update({"DRILL_OUT": str(tmp_path), "JAX_PLATFORMS": "cpu",
+                "FLAGS_elastic_lease_interval_s": "0.2",
+                "FLAGS_elastic_lease_timeout_s": "1.5",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH",
+                                                          "")})
+    nodes = [_launcher(r, master, str(script), str(tmp_path), env)
+             for r in range(3)]
+    try:
+        store = TCPStore("127.0.0.1", port, timeout=30.0)
+
+        def current_gen():
+            try:
+                if store.check("restart_generation"):
+                    return int(store.get("restart_generation",
+                                         timeout=5.0))
+            except (OSError, TimeoutError):
+                pass
+            return 0
+
+        # wait until all 3 ranks heartbeat at the current generation
+        # but have NOT finished (kill must land mid-run)
+        gen = 0
+        deadline = time.time() + 120
+        started = False
+        while not started and time.time() < deadline:
+            gen = max(gen, current_gen())
+            try:
+                vals = [int(store.get(f"progress/{gen}/{r}", timeout=2.0))
+                        for r in range(3)
+                        if store.check(f"progress/{gen}/{r}")]
+            except (OSError, TimeoutError):
+                vals = []
+            started = len(vals) == 3 and all(1 <= v <= STEPS // 2
+                                             for v in vals)
+            time.sleep(0.05)
+        assert started, "3-node fleet never started stepping" + \
+            _logs(str(tmp_path))
+
+        os.killpg(os.getpgid(nodes[2].pid), signal.SIGKILL)
+
+        # zero operator actions from here on: the fleet must finish
+        deadline = time.time() + 120
+        result = None
+        while result is None and time.time() < deadline:
+            if (tmp_path / "result.json").exists():
+                try:
+                    result = json.load(open(tmp_path / "result.json"))
+                except (OSError, json.JSONDecodeError):
+                    result = None  # mid-write; retry
+            time.sleep(0.2)
+        assert result is not None, \
+            "fleet never finished after the kill" + _logs(str(tmp_path))
+
+        assert result["steps"] == STEPS
+        assert result["generation"] >= 1, "no restart generation ran"
+        assert result["world_size"] == 2, \
+            f"final world was {result['world_size']}, wanted 2 survivors"
+        # the supervision really went through the lease path
+        assert "lease expired" in _logs(str(tmp_path))
+        # bit-exact vs the uninterrupted trajectory
+        np.testing.assert_array_equal(
+            np.asarray(result["w"], np.float32), _reference_weights())
+    finally:
+        for p in nodes:
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+
+
+STALL_WORKER = r"""
+import os, time
+from paddle_tpu.distributed.fleet.elastic import ProgressReporter
+
+gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0"))
+rep = ProgressReporter()
+for step in range(8):
+    rep.publish(step + 1)
+    if gen == 0 and step == 2:
+        time.sleep(600)   # wedged collective: heartbeat frozen at 3
+    time.sleep(0.05)
+"""
+
+
+@pytest.mark.slow   # tier-1 budget: restarting subprocess worker, ~12s
+def test_stall_watchdog_kills_and_restarts_frozen_worker(tmp_path):
+    """A worker that freezes mid-step (heartbeat stops moving) is
+    SIGKILLed by the progress watchdog within
+    FLAGS_elastic_stall_timeout_s and restarted; the restarted
+    generation runs to completion so the launcher exits 0."""
+    script = tmp_path / "stall.py"
+    script.write_text(STALL_WORKER)
+    env = dict(os.environ)
+    env.update({"FLAGS_elastic_stall_timeout_s": "1.0",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH",
+                                                          "")})
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--max_restart", "1",
+         "--log_dir", str(tmp_path / "log"), "--job_id", "stall",
+         str(script)],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    elapsed = time.time() - t0
+    assert proc.returncode == 0, proc.stderr
+    assert "stalled at step 3" in proc.stderr, proc.stderr
+    assert "restart 0/1" in proc.stderr
+    # detection is bounded by the stall timeout, not the 600s sleep
+    assert elapsed < 60, f"watchdog took {elapsed:.0f}s"

@@ -297,6 +297,51 @@ def test_router_fails_over_on_chaos_connect_fault():
             s.close()
 
 
+def test_router_replays_stream_that_dies_before_first_token():
+    """ISSUE 20 satellite: an accepted stream that terminates before
+    the FIRST token frame — a terminal ``event: error`` opening frame,
+    or upstream EOF before any complete frame — is replayed on the next
+    replica: zero bytes reached the client, so the re-route is
+    idempotent and the client sees one clean stream.  A stream that
+    dies AFTER delivering a token is NOT replayed (the truncation must
+    surface; a replay would duplicate tokens)."""
+    err_first = b'event: error\ndata: {"error": "oom"}\n\n'
+    bad = _Stub(sse=err_first)
+    dead = _Stub(sse=b"")           # 200 + EOF before any frame
+    ok = _Stub()
+    router = FleetRouter({"bad": bad.addr, "dead": dead.addr,
+                          "ok": ok.addr}, port=0, poll_interval_s=30.0)
+    try:
+        prompt = _prompt_homed_at(router, "bad")
+        status, body = _post_generate(router.port, prompt)
+        assert status == 200 and body == SSE_PAYLOAD
+        assert _sse_outcome(body)[0] == "done"
+        st = router.stats()
+        assert st["replayed"] >= 1
+        assert st["per_replica"]["ok"] == 1
+    finally:
+        router.close()
+        for s in (bad, dead, ok):
+            s.close()
+
+    trunc_payload = b'data: {"token": 7, "n": 0}\n\n'
+    trunc = _Stub(sse=trunc_payload)
+    spare = _Stub()
+    router = FleetRouter({"trunc": trunc.addr, "spare": spare.addr},
+                         port=0, poll_interval_s=30.0)
+    try:
+        prompt = _prompt_homed_at(router, "trunc")
+        status, body = _post_generate(router.port, prompt)
+        # the token frame was delivered, then the stream ended: the
+        # truncation reaches the client as-is, with no replay
+        assert status == 200 and body == trunc_payload
+        assert router.stats()["replayed"] == 0
+    finally:
+        router.close()
+        trunc.close()
+        spare.close()
+
+
 def test_router_dead_replica_routed_around_and_endpoints():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

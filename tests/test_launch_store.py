@@ -465,6 +465,223 @@ def test_elastic_restart_resumes_from_dist_checkpoint(tmp_path):
     np.testing.assert_allclose(res["w"], w, rtol=1e-5)
 
 
+# ------------------------------------------------------------- ISSUE 20
+# unattended elastic training: store hardening, heartbeat leases,
+# progress watchdog, late-join scale-up
+
+
+def test_store_retry_absorbs_transient_fault():
+    """One transient socket error inside a request is absorbed by the
+    bounded retry (FLAGS_store_retries); a persistent fault still
+    surfaces once the budget is spent."""
+    from paddle_tpu.testing import chaos
+    s = TCPStore(is_master=True)
+    s.set("k", b"v")
+    c = TCPStore(port=s.port)
+    assert c.get("k") == b"v"   # wire the per-thread conn first
+    with chaos.fail_at("store.request", on_calls=[1]) as fault:
+        assert c.get("k") == b"v"
+    assert fault.fires == 1
+    with chaos.fail_at("store.request"):
+        with pytest.raises(OSError):
+            c.get("k")
+    assert c.get("k") == b"v"   # transparently reconnects afterwards
+
+
+def test_store_get_timeout_is_semantic_not_retried():
+    """get() on a missing key parks server-side; the client timeout is
+    a SEMANTIC timeout (TimeoutError, no retries — retrying would
+    triple the wait and never help)."""
+    s = TCPStore(is_master=True)
+    t0 = time.time()
+    with pytest.raises(TimeoutError):
+        s.get("never-set", timeout=0.3)
+    assert time.time() - t0 < 2.0  # one wait, not retries x backoff
+    s.set("after", b"ok")
+    assert s.get("after", timeout=5.0) == b"ok"
+
+
+def _two_node_controllers(**kw):
+    """Rendezvous a hosted 2-node elastic world in-process (threads)."""
+    from paddle_tpu.distributed.launch.main import CollectiveController
+    c0 = CollectiveController(_ctrl_args(nnodes="1:2", rank=0,
+                                         elastic_timeout=3.0, **kw))
+    done = []
+    t0 = threading.Thread(target=lambda: (c0.rendezvous(),
+                                          done.append(0)))
+    t0.start()
+    deadline = time.time() + 5
+    while c0.master is None and time.time() < deadline:
+        time.sleep(0.02)
+    assert c0.master is not None, "node 0 never hosted the store"
+    c1 = CollectiveController(_ctrl_args(nnodes="1:2", rank=-1,
+                                         master=c0.master,
+                                         elastic_timeout=3.0, **kw))
+    t1 = threading.Thread(target=lambda: (c1.rendezvous(),
+                                          done.append(1)))
+    t1.start()
+    t0.join(15)
+    t1.join(15)
+    assert sorted(done) == [0, 1]
+    assert c0.nnodes == 2 and c1.nnodes == 2
+    return c0, c1
+
+
+def test_heartbeat_lease_expiry_bumps_generation():
+    """Lease protocol end-to-end at store level: a silenced peer lease
+    ages out after FLAGS_elastic_lease_timeout_s and the survivor
+    publishes the bumped restart generation, which the other node's
+    watch poll adopts."""
+    from paddle_tpu import flags
+    flags.set_flags({"elastic_lease_timeout_s": 0.4})
+    try:
+        c0, c1 = _two_node_controllers()
+        gen = 0
+        # join grace: freshly rendezvoused, an absent peer lease is NOT
+        # death evidence yet
+        assert not c0._check_peer_leases(gen)
+        c0._publish_lease(gen)
+        c1._publish_lease(gen)
+        c0._gen_started = time.time() - 10   # age past the join grace
+        assert not c0._check_peer_leases(gen)
+        c1._publish_lease(gen)               # lease moved -> still alive
+        assert not c0._check_peer_leases(gen)
+        # silence node 1: after the timeout its lease expires
+        deadline = time.time() + 5
+        bumped = False
+        while time.time() < deadline and not bumped:
+            bumped = c0._check_peer_leases(gen)
+            time.sleep(0.05)
+        assert bumped, "silenced peer lease never expired"
+        assert int(c0.store.get("restart_generation", timeout=5.0)) == 1
+        assert c1._peer_generation() == 1    # watch() would PEER_RESTART
+    finally:
+        flags.set_flags({"elastic_lease_timeout_s": 5.0})
+
+
+def test_chaos_silenced_lease_is_detected():
+    """The ``elastic.lease.publish`` chaos site makes a LIVE node's
+    heartbeat vanish — the peer must still declare it dead (the drill's
+    simulated sudden death, without killing a process)."""
+    from paddle_tpu import flags
+    from paddle_tpu.testing import chaos
+    flags.set_flags({"elastic_lease_timeout_s": 0.4})
+    try:
+        c0, c1 = _two_node_controllers()
+        gen = 0
+        c0._publish_lease(gen)
+        c1._publish_lease(gen)
+        c0._gen_started = time.time() - 10
+        assert not c0._check_peer_leases(gen)
+        with chaos.fail_at("elastic.lease.publish") as fault:
+            deadline = time.time() + 5
+            bumped = False
+            while time.time() < deadline and not bumped:
+                c1._publish_lease(gen)       # armed: publish vanishes
+                bumped = c0._check_peer_leases(gen)
+                time.sleep(0.05)
+        assert fault.fires > 0
+        assert bumped, "chaos-silenced lease never expired"
+        assert int(c0.store.get("restart_generation", timeout=5.0)) == 1
+    finally:
+        flags.set_flags({"elastic_lease_timeout_s": 5.0})
+
+
+def test_progress_watchdog_kills_stalled_worker():
+    """A worker whose step heartbeat freezes past
+    FLAGS_elastic_stall_timeout_s is SIGKILLed; a worker that never
+    published is never armed, and so never killed."""
+    from paddle_tpu import flags
+    from paddle_tpu.distributed.launch.main import (CollectiveController,
+                                                    Proc)
+    flags.set_flags({"elastic_stall_timeout_s": 0.4})
+    stalled = quiet = None
+    try:
+        c = CollectiveController(_ctrl_args(nnodes="1", rank=0))
+        c.rendezvous()
+        code = "import time; time.sleep(30)"
+        # graft-lint: disable=R010 (jax-free sleeping children: the
+        # watchdog kills one, the test kills the other; ~1s measured)
+        stalled = subprocess.Popen([sys.executable, "-c", code])  # graft-lint: disable=R010
+        quiet = subprocess.Popen([sys.executable, "-c", code])
+        devnull = open(os.devnull, "ab")
+        c.procs = [Proc(stalled, 0, os.devnull, devnull),
+                   Proc(quiet, 1, os.devnull, devnull)]
+        c._progress_seen = {}
+        c.store.set("progress/0/0", b"7")   # rank 0 heartbeat, then frozen
+        deadline = time.time() + 5
+        while stalled.poll() is None and time.time() < deadline:
+            c._check_stalls(0)
+            time.sleep(0.05)
+        assert stalled.poll() is not None, "stalled worker never killed"
+        assert quiet.poll() is None, "uninstrumented worker was killed"
+    finally:
+        flags.set_flags({"elastic_stall_timeout_s": 0.0})
+        for p in (stalled, quiet):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+
+def test_progress_reporter_publish_and_chaos_delay():
+    """ProgressReporter publishes a monotonic heartbeat under the
+    launcher's key scheme; the ``elastic.step`` delay site freezes it
+    in place (the deterministic wedged-collective injection)."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticContext,
+                                                      ProgressReporter)
+    from paddle_tpu.testing import chaos
+    s = TCPStore(is_master=True)
+    ctx = ElasticContext(generation=0, rank=0, world_size=1,
+                         local_rank=0, nnodes=1,
+                         master=f"127.0.0.1:{s.port}")
+    rep = ProgressReporter(ctx=ctx)
+    rep.publish(3)
+    assert s.get("progress/0/0", timeout=5.0) == b"3"
+    t0 = time.time()
+    with chaos.delay_at("elastic.step", 0.3):
+        rep.publish(4)
+    assert time.time() - t0 >= 0.3
+    assert s.get("progress/0/0", timeout=5.0) == b"4"
+
+
+def test_late_joiner_requests_scale_up_restart():
+    """A node that joins AFTER the world settled (its drawn rank falls
+    beyond the settled count) must not run as an unwatched extra node:
+    it announces a scale-up restart and both nodes re-rendezvous into
+    a larger world one generation later."""
+    from paddle_tpu.distributed.launch.main import CollectiveController
+
+    c0 = CollectiveController(_ctrl_args(nnodes="1:2", rank=0,
+                                         elastic_timeout=0.4))
+    c0.rendezvous()             # alone: settles at 1 immediately
+    assert c0.nnodes == 1
+    done = []
+    c1 = CollectiveController(_ctrl_args(nnodes="1:2", rank=-1,
+                                         master=c0.master,
+                                         elastic_timeout=0.4))
+    t1 = threading.Thread(target=lambda: (c1.rendezvous(),
+                                          done.append(1)))
+    t1.start()
+    # the late joiner announces the scale-up...
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if c0.store.check("restart_generation") and \
+                int(c0.store.get("restart_generation", timeout=5.0)) >= 1:
+            break
+        time.sleep(0.02)
+    assert c0._peer_generation() >= 1, "late joiner never announced"
+    # ...and the survivor adopts it (watch() would return PEER_RESTART)
+    c0.restarts = c0._peer_generation()
+    t0 = threading.Thread(target=lambda: (c0.rendezvous(),
+                                          done.append(0)))
+    t0.start()
+    t0.join(20)
+    t1.join(20)
+    assert sorted(done) == [0, 1]
+    assert c0.nnodes == 2 and c1.nnodes == 2
+    assert c0.restarts == 1 and c1.restarts == 1
+    assert {c0.node_rank, c1.node_rank} == {0, 1}
+
+
 def test_elastic_death_watch_regeneration_rejoin():
     """Manager-level elastic lifecycle: node 1 dies -> m0's watch fires on
     the dead set -> next_generation() -> survivor re-registers and a

@@ -37,6 +37,7 @@ def run_src(tmp_path, files, rules=None):
     """Write {name: source} into tmp_path and analyze it."""
     tmp_path.mkdir(parents=True, exist_ok=True)
     for name, src in files.items():
+        (tmp_path / name).parent.mkdir(parents=True, exist_ok=True)
         (tmp_path / name).write_text(src)
     return analyze_paths([str(tmp_path)], root=str(tmp_path), rules=rules)
 
@@ -631,6 +632,64 @@ def test_r014_inline_disable(tmp_path):
         'grads[layer] = jax.lax.psum_scatter(  '
         '# graft-lint: disable=R014')
     assert run_src(tmp_path, {"mod.py": src}, rules=["R014"]) == []
+
+
+R015_BAD = """\
+def settle(store, gen):
+    store.wait(f"world/{gen}")
+    val = store.get(f"world/{gen}")
+    store.barrier("rendezvous", 2)
+    return val
+"""
+
+R015_GOOD = """\
+def settle(store, gen, elastic_timeout):
+    store.wait(f"world/{gen}", timeout=elastic_timeout)
+    val = store.get(f"world/{gen}", timeout=5.0)
+    store.barrier("rendezvous", 2, timeout=elastic_timeout)
+    opts = {}
+    default = opts.get("retries", 3)     # mapping .get, not a store op
+    present = store.check(f"world/{gen}")  # check never parks
+    return val, default, present
+"""
+
+
+def test_r015_flags_untimed_store_waits(tmp_path):
+    """An untimed wait/get/barrier on a store receiver inside launcher
+    or elastic-rendezvous code parks forever on a crashed peer — the
+    exact hang class the unattended-elastic watchdogs exist to kill."""
+    fs = run_src(tmp_path, {"distributed/launch/ctrl.py": R015_BAD},
+                 rules=["R015"])
+    assert len(fs) == 3
+    assert all(f.rule == "R015" for f in fs)
+    assert any("wait" in f.message for f in fs)
+
+
+def test_r015_timed_mapping_get_and_check_are_clean(tmp_path):
+    fs = run_src(tmp_path, {"distributed/launch/ctrl.py": R015_GOOD},
+                 rules=["R015"])
+    assert fs == []
+
+
+def test_r015_out_of_scope_files_are_silent(tmp_path):
+    """The rule is scoped to launcher/rendezvous code: the same calls
+    elsewhere (mapping .get idioms abound) stay unflagged."""
+    assert run_src(tmp_path, {"inference/util.py": R015_BAD},
+                   rules=["R015"]) == []
+
+
+def test_r015_inline_disable(tmp_path):
+    src = R015_BAD.replace(
+        'store.wait(f"world/{gen}")',
+        'store.wait(f"world/{gen}")  # graft-lint: disable=R015').replace(
+        'val = store.get(f"world/{gen}")',
+        'val = store.get(f"world/{gen}")  '
+        '# graft-lint: disable=R015').replace(
+        'store.barrier("rendezvous", 2)',
+        'store.barrier("rendezvous", 2)  # graft-lint: disable=R015')
+    assert run_src(tmp_path,
+                   {"distributed/launch/ctrl.py": src},
+                   rules=["R015"]) == []
 
 
 # ===================================================== suppressions
